@@ -1,0 +1,40 @@
+"""pddl_tpu — a TPU-native parallel & distributed deep-learning framework.
+
+A brand-new JAX/XLA/pjit/shard_map framework with the capabilities of
+``rrrickyz/Parallel-and-Distributed-Deep-Learning`` (the reference, 8 standalone
+TensorFlow scripts that train ResNet-50 on ImageNet-2012 under four
+distribution strategies — see ``/root/reference`` and ``SURVEY.md``), redesigned
+TPU-first:
+
+- **One SPMD core, four strategy façades** — every distribution mode
+  (single device, mirrored, multi-worker, parameter-server, Horovod-compat)
+  lowers to a ``jax.sharding.Mesh`` + ``NamedSharding`` + XLA collectives
+  over ICI/DCN. Zero CUDA / NCCL / MPI / gRPC data plane.
+- **Keras-fit-like workflow** — ``Trainer`` mirrors the reference's
+  ``compile``/``fit`` surface (callbacks, History, validation) as a custom
+  jitted train loop.
+- **Model zoo** — Flax ResNet family with exact ``tf.keras.applications``
+  architecture parity, plus pretrained-weight import from Keras ``.h5``.
+- **First-class long-context / distributed ops** — ring attention,
+  sequence-parallel helpers, Pallas kernels (``pddl_tpu.ops``).
+
+The package name abbreviates the reference repo name
+(Parallel-and-Distributed-Deep-Learning → ``pddl``) + ``_tpu``.
+"""
+
+from pddl_tpu.version import __version__
+
+# Re-exports of the primary public API.  Heavy submodules (models, data,
+# train) are imported lazily by user code; core mesh/strategy types are cheap.
+from pddl_tpu.core.mesh import MeshConfig, build_mesh, local_device_count
+from pddl_tpu.core import collectives
+from pddl_tpu.core.sharding import MinSizePartitioner
+
+__all__ = [
+    "__version__",
+    "MeshConfig",
+    "build_mesh",
+    "local_device_count",
+    "collectives",
+    "MinSizePartitioner",
+]

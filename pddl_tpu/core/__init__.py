@@ -1,0 +1,9 @@
+"""Core substrate: device meshes, multi-host bootstrap, collectives, sharding.
+
+This layer is the TPU-native replacement for everything the reference pulls in
+as external native machinery (SURVEY.md §2b): NCCL rings become XLA
+collectives compiled over ICI, ``SlurmClusterResolver`` / ``hvd.init()`` /
+in-process gRPC clusters become ``jax.distributed.initialize`` + one
+``jax.sharding.Mesh``, and parameter-server variable placement becomes
+``NamedSharding`` with a min-size partitioner.
+"""

@@ -1,0 +1,156 @@
+"""Multi-host bootstrap: the TPU-native cluster-resolution layer.
+
+Replaces, capability-for-capability, the reference's three bootstrap
+mechanisms (SURVEY.md §1 "Cluster bootstrap / resolution"):
+
+- ``tf.distribute.cluster_resolver.SlurmClusterResolver``
+  (``/root/reference/imagenet-resnet50-multiworkers.py:16``): cluster spec
+  derived from ``SLURM_*`` env vars.
+- ``hvd.init()`` MPI rendezvous (``/root/reference/imagenet-resnet50-hvd.py:16``).
+- the in-process gRPC server cluster of the PS script
+  (``/root/reference/imagenet-resnet50-ps.py:31-65``).
+
+On TPU none of those exist: every host calls
+``jax.distributed.initialize(coordinator, num_processes, process_id)`` once,
+after which ``jax.devices()`` is the global pod slice and XLA compiles
+collectives over ICI/DCN directly — there is no user-visible transport.
+
+Discovery order for coordinator/process info:
+
+1. explicit arguments,
+2. ``PDDL_COORDINATOR`` / ``PDDL_NUM_PROCESSES`` / ``PDDL_PROCESS_ID`` env,
+3. Slurm env (``SLURM_STEP_NODELIST``/``SLURM_NTASKS``/``SLURM_PROCID``),
+   mirroring the reference's use of ``SLURM_NTASKS``
+   (``imagenet-resnet50-multiworkers.py:29``),
+4. Cloud TPU pod metadata: when none of the above are present but the env
+   advertises a multi-host TPU slice (``TPU_WORKER_HOSTNAMES`` with more
+   than one host), :func:`initialize` defers to the argument-less
+   ``jax.distributed.initialize()``, which self-resolves from TPU metadata.
+
+Single-process runs skip initialization entirely, so the same training
+script works from a laptop CPU to a pod slice unchanged.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+import re
+from typing import Optional
+
+import jax
+
+log = logging.getLogger(__name__)
+
+_DEFAULT_PORT = 8476
+_initialized = False
+
+
+@dataclasses.dataclass(frozen=True)
+class ClusterSpec:
+    """Resolved multi-host process layout (the ``ClusterSpec`` analogue)."""
+
+    coordinator_address: Optional[str]
+    num_processes: int
+    process_id: int
+
+    @property
+    def is_multiprocess(self) -> bool:
+        return self.num_processes > 1
+
+
+def _first_slurm_host(nodelist: str) -> str:
+    """Expand the first host of a Slurm nodelist like ``nid[001-004]``.
+
+    Mirrors what ``SlurmClusterResolver`` does internally to pick worker 0
+    as chief.
+    """
+    m = re.match(r"([^\[,]+)(?:\[(\d+)[-,\d]*\])?", nodelist.strip())
+    if not m:
+        return nodelist.split(",")[0]
+    base, first = m.group(1), m.group(2)
+    return f"{base}{first}" if first else base
+
+
+def resolve_cluster(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> ClusterSpec:
+    """Resolve the process layout from args > PDDL_* env > Slurm env."""
+    env = os.environ
+    coord = coordinator_address or env.get("PDDL_COORDINATOR")
+    nproc = num_processes if num_processes is not None else _int_env("PDDL_NUM_PROCESSES")
+    pid = process_id if process_id is not None else _int_env("PDDL_PROCESS_ID")
+
+    if nproc is None and "SLURM_NTASKS" in env:
+        nproc = int(env["SLURM_NTASKS"])
+    if pid is None and "SLURM_PROCID" in env:
+        pid = int(env["SLURM_PROCID"])
+    if coord is None and "SLURM_STEP_NODELIST" in env:
+        coord = f"{_first_slurm_host(env['SLURM_STEP_NODELIST'])}:{_DEFAULT_PORT}"
+    elif coord is None and "SLURM_JOB_NODELIST" in env:
+        coord = f"{_first_slurm_host(env['SLURM_JOB_NODELIST'])}:{_DEFAULT_PORT}"
+
+    return ClusterSpec(coord, nproc or 1, pid or 0)
+
+
+def _int_env(name: str) -> Optional[int]:
+    v = os.environ.get(name)
+    return int(v) if v is not None else None
+
+
+def _tpu_pod_host_count() -> int:
+    """Host count advertised by Cloud TPU metadata env, 1 if absent."""
+    hosts = os.environ.get("TPU_WORKER_HOSTNAMES", "")
+    return len([h for h in hosts.split(",") if h.strip()]) or 1
+
+
+def initialize(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> ClusterSpec:
+    """Initialize multi-host JAX if (and only if) running multi-process.
+
+    Idempotent. The single call that replaces the reference's entire
+    resolver + NCCL-options + gRPC-server bootstrap surface.
+    """
+    global _initialized
+    spec = resolve_cluster(coordinator_address, num_processes, process_id)
+    if not spec.is_multiprocess and not _initialized and _tpu_pod_host_count() > 1:
+        # Cloud TPU pod with no explicit/Slurm config: jax self-resolves
+        # coordinator + process ids from TPU metadata.
+        log.info("jax.distributed.initialize() from TPU pod metadata")
+        jax.distributed.initialize()
+        _initialized = True
+        return ClusterSpec(None, jax.process_count(), jax.process_index())
+    if spec.is_multiprocess and not _initialized:
+        log.info(
+            "jax.distributed.initialize(%s, num_processes=%d, process_id=%d)",
+            spec.coordinator_address, spec.num_processes, spec.process_id,
+        )
+        jax.distributed.initialize(
+            coordinator_address=spec.coordinator_address,
+            num_processes=spec.num_processes,
+            process_id=spec.process_id,
+        )
+        _initialized = True
+    return spec
+
+
+def process_index() -> int:
+    """This host's index (Horovod ``rank()`` / TF ``task_id`` analogue)."""
+    return jax.process_index()
+
+
+def process_count() -> int:
+    """Number of participating hosts (Horovod ``size()`` at host granularity)."""
+    return jax.process_count()
+
+
+def is_coordinator() -> bool:
+    """True on the chief host — gates logging/saving the way the reference
+    gates on ``hvd.rank() == 0`` (``imagenet-resnet50-hvd.py:28,96,117``)."""
+    return jax.process_index() == 0

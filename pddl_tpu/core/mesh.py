@@ -1,0 +1,153 @@
+"""Device-mesh construction.
+
+TPU-native replacement for the reference's cluster/strategy device handling:
+
+- ``tf.distribute.MirroredStrategy`` device enumeration
+  (``/root/reference/imagenet-resnet50-mirror.py:21``) → a single-host mesh
+  over ``jax.local_devices()``.
+- ``SlurmClusterResolver`` + ``MultiWorkerMirroredStrategy``
+  (``/root/reference/imagenet-resnet50-multiworkers.py:16-25``) → a global
+  mesh over ``jax.devices()`` after ``jax.distributed.initialize`` (see
+  :mod:`pddl_tpu.core.dist`).
+- Horovod's rank/size world (``/root/reference/imagenet-resnet50-hvd.py:16``)
+  → the same mesh; ranks are positions along the ``data`` axis.
+
+Axis conventions (all optional except ``data``):
+
+========  =============================================================
+``data``  data parallelism (batch sharding, gradient all-reduce via ICI)
+``model`` tensor parallelism (reserved; size 1 for ResNet parity runs)
+``seq``   sequence/context parallelism (ring attention, long context)
+``expert`` expert parallelism for MoE layers (reserved)
+========  =============================================================
+
+The mesh is the *only* place device topology appears; everything above it
+(strategies, trainer, models) speaks named axes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+# Canonical axis names, in canonical order.
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+SEQ_AXIS = "seq"
+EXPERT_AXIS = "expert"
+CANONICAL_AXES = (DATA_AXIS, MODEL_AXIS, SEQ_AXIS, EXPERT_AXIS)
+
+
+def local_device_count() -> int:
+    """Number of accelerator devices attached to this process."""
+    return jax.local_device_count()
+
+
+def global_device_count() -> int:
+    """Number of devices across all processes (the "world size" analogue)."""
+    return jax.device_count()
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    """Declarative mesh shape.
+
+    Any axis may be ``-1`` meaning "all remaining devices". Axes of size 1
+    are kept in the mesh (they cost nothing and keep sharding rules uniform
+    across strategies).
+
+    Example::
+
+        MeshConfig(data=-1)                  # pure data parallel
+        MeshConfig(data=-1, model=2)         # DP x TP
+        MeshConfig(data=2, seq=4)            # DP x sequence parallel
+    """
+
+    data: int = -1
+    model: int = 1
+    seq: int = 1
+    expert: int = 1
+    # Restrict to this process's local devices (mirrored strategy) instead of
+    # the global device set (multi-worker).
+    local_only: bool = False
+
+    def axis_sizes(self, n_devices: int) -> dict[str, int]:
+        sizes = {
+            DATA_AXIS: self.data,
+            MODEL_AXIS: self.model,
+            SEQ_AXIS: self.seq,
+            EXPERT_AXIS: self.expert,
+        }
+        for name, s in sizes.items():
+            if s == 0 or s < -1:
+                raise ValueError(f"mesh axis {name!r} size must be >= 1 or -1, got {s}")
+        wildcard = [name for name, s in sizes.items() if s == -1]
+        if len(wildcard) > 1:
+            raise ValueError(f"at most one mesh axis may be -1, got {wildcard}")
+        fixed = math.prod(s for s in sizes.values() if s != -1)
+        if wildcard:
+            if n_devices % fixed != 0:
+                raise ValueError(
+                    f"{n_devices} devices not divisible by fixed axes product {fixed}"
+                )
+            sizes[wildcard[0]] = n_devices // fixed
+        elif fixed != n_devices:
+            raise ValueError(
+                f"mesh shape {sizes} needs {fixed} devices, have {n_devices}"
+            )
+        return sizes
+
+
+def build_mesh(
+    config: MeshConfig | None = None,
+    *,
+    devices: Sequence[jax.Device] | None = None,
+    **axis_sizes: int,
+) -> Mesh:
+    """Build a :class:`jax.sharding.Mesh` from a :class:`MeshConfig`.
+
+    ``build_mesh()`` with no arguments gives the canonical data-parallel mesh
+    over all devices — the TPU-native analogue of constructing a
+    ``MirroredStrategy``/``MultiWorkerMirroredStrategy`` in the reference.
+
+    Axis sizes can also be passed directly: ``build_mesh(data=4, model=2)``.
+    """
+    if config is None:
+        config = MeshConfig(**axis_sizes) if axis_sizes else MeshConfig()
+    elif axis_sizes:
+        raise ValueError("pass either a MeshConfig or axis sizes, not both")
+
+    if devices is None:
+        devices = jax.local_devices() if config.local_only else jax.devices()
+    devices = list(devices)
+    sizes = config.axis_sizes(len(devices))
+    shape = tuple(sizes[a] for a in CANONICAL_AXES)
+    dev_array = np.asarray(devices).reshape(shape)
+    return Mesh(dev_array, CANONICAL_AXES)
+
+
+def mesh_num_replicas(mesh: Mesh, axis: str = DATA_AXIS) -> int:
+    """Replica count along a mesh axis — the ``strategy.num_replicas_in_sync``
+    analogue (reference scales batch by it: ``imagenet-resnet50-mirror.py:54``).
+    """
+    return mesh.shape[axis]
+
+
+def validate_divisible(batch_size: int, mesh: Mesh, axis: str = DATA_AXIS) -> None:
+    n = mesh_num_replicas(mesh, axis)
+    if batch_size % n != 0:
+        raise ValueError(
+            f"global batch {batch_size} not divisible by {axis}-axis size {n}"
+        )
+
+
+def describe(mesh: Mesh) -> str:
+    """Human-readable one-liner for logs."""
+    axes = ", ".join(f"{a}={s}" for a, s in mesh.shape.items() if s > 1) or "1 device"
+    plat = mesh.devices.flat[0].platform
+    return f"Mesh({axes}) on {mesh.devices.size} {plat} device(s)"

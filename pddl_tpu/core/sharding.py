@@ -1,0 +1,119 @@
+"""Sharding rules: NamedSharding helpers + the parameter-server partitioner.
+
+The reference's parameter-server mode shards variables across PS hosts with
+``tf.distribute.experimental.partitioners.MinSizePartitioner(min_shard_bytes=
+256 << 10, max_shards=NUM_PS)`` (``/root/reference/imagenet-resnet50-ps.py:75-78``).
+On TPU there is no RPC variable hosting; the capability maps to *sharded
+state under SPMD*: parameters / optimizer state whose size crosses the
+threshold are sharded along a mesh axis with ``NamedSharding``, everything
+else is replicated. XLA then materializes gathers/scatters over ICI — the
+push/pull traffic of a parameter server without a data plane to operate.
+This is the honest TPU analogue (sync SPMD rather than async RPC; SURVEY.md
+§7 "PS capability mapping").
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+PyTree = Any
+
+REPLICATED = PartitionSpec()
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, REPLICATED)
+
+
+def batch_sharding(mesh: Mesh, axis: str = "data") -> NamedSharding:
+    """Shard the leading (batch) dimension over the data axis."""
+    return NamedSharding(mesh, PartitionSpec(axis))
+
+
+def tree_shardings(mesh: Mesh, tree: PyTree, spec_fn) -> PyTree:
+    """Map ``spec_fn(path, leaf) -> PartitionSpec`` over a pytree into
+    NamedShardings."""
+    return jax.tree_util.tree_map_with_path(
+        lambda path, leaf: NamedSharding(mesh, spec_fn(path, leaf)), tree
+    )
+
+
+@dataclasses.dataclass(frozen=True)
+class MinSizePartitioner:
+    """Shard tensors along one dimension of a mesh axis, min-size gated.
+
+    Capability analogue of TF's ``MinSizePartitioner``
+    (``/root/reference/imagenet-resnet50-ps.py:75-78``): a variable is split
+    along its largest dimension only if every resulting shard stays at least
+    ``min_shard_bytes`` and the split does not exceed ``max_shards``;
+    otherwise it stays whole (replicated).
+
+    Mapping note: TF returns a free shard *count* (1..max_shards) consumed by
+    the PS runtime; XLA's ``NamedSharding`` tiles a dimension uniformly over
+    the *whole* mesh axis. So sharding here is all-or-nothing per tensor: a
+    tensor is laid out split ``axis_size`` ways exactly when the TF
+    partitioner would have produced ≥ ``axis_size`` shards (which guarantees
+    the per-shard minimum), and is replicated otherwise. ``num_shards``
+    reports the TF-equivalent count for parity checks.
+    """
+
+    min_shard_bytes: int = 256 << 10  # 256 KiB, the reference's value (:77)
+    max_shards: Optional[int] = None  # defaults to the mesh axis size
+    axis_name: str = "data"
+
+    def num_shards(self, shape: tuple[int, ...], dtype, axis_size: int) -> int:
+        """How many shards the reference partitioner would produce."""
+        if not shape:
+            return 1
+        nbytes = math.prod(shape) * np.dtype(dtype).itemsize
+        limit = self.max_shards if self.max_shards is not None else axis_size
+        limit = min(limit, axis_size)
+        # At least min_shard_bytes per shard, at most `limit` shards.
+        by_size = max(1, nbytes // self.min_shard_bytes)
+        return int(min(by_size, limit, max(shape)))
+
+    def spec(self, shape: tuple[int, ...], dtype, axis_size: int) -> PartitionSpec:
+        """PartitionSpec for one tensor: shard its largest dim if it pays.
+
+        Shards only when splitting ``axis_size`` ways keeps every shard at or
+        above ``min_shard_bytes`` and ``max_shards`` permits ``axis_size``
+        pieces (see class docstring for the TF→XLA mapping).
+        """
+        if self.num_shards(shape, dtype, axis_size) < axis_size:
+            return REPLICATED
+        # Shard the largest dimension that tiles the axis evenly; XLA
+        # requires uniform tiling for NamedSharding.
+        dims_by_size = sorted(range(len(shape)), key=lambda d: -shape[d])
+        for d in dims_by_size:
+            if shape[d] % axis_size == 0:
+                spec = [None] * (d + 1)
+                spec[d] = self.axis_name
+                return PartitionSpec(*spec)
+        return REPLICATED
+
+    def tree_specs(self, tree: PyTree, axis_size: int) -> PyTree:
+        """PartitionSpecs for a whole pytree (params or optimizer state)."""
+        return jax.tree.map(
+            lambda leaf: self.spec(tuple(leaf.shape), leaf.dtype, axis_size), tree
+        )
+
+    def tree_shardings(self, mesh: Mesh, tree: PyTree) -> PyTree:
+        specs = self.tree_specs(tree, mesh.shape[self.axis_name])
+        return jax.tree.map(lambda spec: NamedSharding(mesh, spec), specs)
+
+
+def shard_tree(tree: PyTree, shardings: PyTree) -> PyTree:
+    """Device-put a pytree according to a matching pytree of shardings."""
+    return jax.tree.map(jax.device_put, tree, shardings)
+
+
+def with_sharding_constraint(tree: PyTree, mesh: Mesh, spec: PartitionSpec) -> PyTree:
+    """Constrain intermediate values inside jit (layout hints to XLA)."""
+    s = NamedSharding(mesh, spec)
+    return jax.tree.map(lambda x: jax.lax.with_sharding_constraint(x, s), tree)

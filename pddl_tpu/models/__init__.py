@@ -1,0 +1,30 @@
+"""Model zoo.
+
+Parity target: the reference's single model, ``tf.keras.applications.ResNet50``
+with a 1000-way softmax head (``/root/reference/imagenet-resnet50.py:51-61``).
+Provided TPU-native: the full Flax ResNet family (18/34/50/101/152) with exact
+Keras architecture parity for pretrained-weight import, plus a Transformer
+family exercising the long-context / sequence-parallel ops.
+"""
+
+from pddl_tpu.models.resnet import (
+    ResNet,
+    ResNet18,
+    ResNet34,
+    ResNet50,
+    ResNet101,
+    ResNet152,
+)
+from pddl_tpu.models.registry import get_model, register_model, list_models
+
+__all__ = [
+    "ResNet",
+    "ResNet18",
+    "ResNet34",
+    "ResNet50",
+    "ResNet101",
+    "ResNet152",
+    "get_model",
+    "register_model",
+    "list_models",
+]

@@ -1,0 +1,44 @@
+"""Tiny model registry so configs can name models by string."""
+
+from __future__ import annotations
+
+from typing import Callable, Dict
+
+_REGISTRY: Dict[str, Callable] = {}
+
+
+def register_model(name: str, factory: Callable | None = None):
+    """Register a model factory; usable as a decorator or a call."""
+    if factory is not None:
+        _REGISTRY[name] = factory
+        return factory
+
+    def deco(fn):
+        _REGISTRY[name] = fn
+        return fn
+
+    return deco
+
+
+def get_model(name: str, **kwargs):
+    if name not in _REGISTRY:
+        raise KeyError(f"unknown model {name!r}; known: {sorted(_REGISTRY)}")
+    return _REGISTRY[name](**kwargs)
+
+
+def list_models() -> list[str]:
+    return sorted(_REGISTRY)
+
+
+def _populate() -> None:
+    from pddl_tpu.models import resnet
+
+    register_model("resnet18", resnet.ResNet18)
+    register_model("resnet34", resnet.ResNet34)
+    register_model("resnet50", resnet.ResNet50)
+    register_model("resnet101", resnet.ResNet101)
+    register_model("resnet152", resnet.ResNet152)
+    register_model("tiny_resnet", resnet.tiny_resnet)
+
+
+_populate()

@@ -1,0 +1,218 @@
+"""Flax ResNet family with exact ``tf.keras.applications`` architecture parity.
+
+The reference's only model is ``tf.keras.applications.ResNet50(include_top=
+False, pooling='avg')`` plus a ``Dense(1000, softmax)`` head
+(``/root/reference/imagenet-resnet50.py:51-61``). This module provides that
+model natively in Flax — same layer structure, BN hyper-parameters
+(``epsilon=1.001e-5``, ``momentum=0.99``) and downsampling placement as the
+Keras v1 architecture so pretrained ``.h5`` weights import exactly
+(:mod:`pddl_tpu.ckpt.keras_import`) — plus the rest of the family and a
+TPU-friendlier v1.5 variant.
+
+TPU-first design notes:
+
+- NHWC layout and optional bfloat16 compute dtype: convs land on the MXU as
+  large tiled contractions; params stay float32 for stable BN/optimizer math.
+- BatchNorm mode is explicit, because the reference's most consequential quirk
+  is calling the backbone with ``training=False`` even when training from
+  scratch (``imagenet-resnet50.py:57`` — BN frozen in inference mode,
+  moving averages never updated; SURVEY.md §0). ``bn_mode`` reproduces either
+  behavior deliberately:
+
+  * ``"train"``  — correct from-scratch training (batch stats + EMA update).
+  * ``"frozen"`` — reference-faithful / fine-tune mode: running averages only.
+
+- Cross-replica BN comes for free in the trainer's jit-with-shardings regime
+  (a mean over the globally-sharded batch dim *is* a cross-replica reduction);
+  ``axis_name`` is exposed for per-replica (shard_map) execution.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Optional, Sequence
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+ModuleDef = Any
+
+# Keras BN hyper-parameters (keras.applications.resnet: epsilon 1.001e-5).
+BN_EPSILON = 1.001e-5
+BN_MOMENTUM = 0.99
+
+
+class BottleneckBlock(nn.Module):
+    """1x1 -> 3x3 -> 1x1 bottleneck (ResNet-50/101/152).
+
+    ``stride_in_3x3=False`` matches Keras v1 (downsample in the first 1x1,
+    ``keras.applications.resnet.block1``); ``True`` is the v1.5 placement
+    (better accuracy/FLOP, used by torchvision and MLPerf).
+    """
+
+    filters: int
+    stride: int = 1
+    conv_shortcut: bool = False
+    stride_in_3x3: bool = False
+    conv: ModuleDef = nn.Conv
+    norm: ModuleDef = nn.BatchNorm
+    act: Callable = nn.relu
+
+    @nn.compact
+    def __call__(self, x):
+        s1 = 1 if self.stride_in_3x3 else self.stride
+        s3 = self.stride if self.stride_in_3x3 else 1
+
+        if self.conv_shortcut:
+            shortcut = self.conv(4 * self.filters, (1, 1), strides=(self.stride,) * 2,
+                                 name="shortcut_conv")(x)
+            shortcut = self.norm(name="shortcut_bn")(shortcut)
+        else:
+            shortcut = x
+
+        y = self.conv(self.filters, (1, 1), strides=(s1, s1), name="conv1")(x)
+        y = self.norm(name="bn1")(y)
+        y = self.act(y)
+        y = self.conv(self.filters, (3, 3), strides=(s3, s3), padding="SAME",
+                      name="conv2")(y)
+        y = self.norm(name="bn2")(y)
+        y = self.act(y)
+        y = self.conv(4 * self.filters, (1, 1), name="conv3")(y)
+        y = self.norm(name="bn3")(y)
+        return self.act(y + shortcut)
+
+
+class BasicBlock(nn.Module):
+    """3x3 -> 3x3 residual block (ResNet-18/34)."""
+
+    filters: int
+    stride: int = 1
+    conv_shortcut: bool = False
+    stride_in_3x3: bool = False  # unused; kept for a uniform block signature
+    conv: ModuleDef = nn.Conv
+    norm: ModuleDef = nn.BatchNorm
+    act: Callable = nn.relu
+
+    @nn.compact
+    def __call__(self, x):
+        if self.conv_shortcut:
+            shortcut = self.conv(self.filters, (1, 1), strides=(self.stride,) * 2,
+                                 name="shortcut_conv")(x)
+            shortcut = self.norm(name="shortcut_bn")(shortcut)
+        else:
+            shortcut = x
+        y = self.conv(self.filters, (3, 3), strides=(self.stride,) * 2,
+                      padding="SAME", name="conv1")(x)
+        y = self.norm(name="bn1")(y)
+        y = self.act(y)
+        y = self.conv(self.filters, (3, 3), padding="SAME", name="conv2")(y)
+        y = self.norm(name="bn2")(y)
+        return self.act(y + shortcut)
+
+
+class ResNet(nn.Module):
+    """Configurable ResNet with Keras-v1 architecture parity.
+
+    Args mirror the knobs the reference exercises:
+
+    - ``num_classes`` + softmax-ready logits head: the reference's
+      ``Dense(1000, activation='softmax')`` head
+      (``imagenet-resnet50.py:60``) — we return *logits* (the loss applies
+      log-softmax; numerically safer and XLA-fusable).
+    - ``include_top=False`` + ``pooling='avg'`` behavior is available via
+      ``num_classes=0`` (returns pooled features), matching
+      ``imagenet-resnet50.py:56``.
+    - ``bn_mode``: see module docstring.
+    """
+
+    stage_sizes: Sequence[int]
+    block_cls: ModuleDef = BottleneckBlock
+    num_classes: int = 1000
+    width_multiplier: float = 1.0
+    stride_in_3x3: bool = False  # False = Keras v1 parity
+    small_input_stem: bool = False  # 3x3/s1 stem, no maxpool (CIFAR/tests)
+    dtype: Any = jnp.float32  # compute dtype; bfloat16 for TPU speed
+    param_dtype: Any = jnp.float32
+    bn_mode: str = "train"  # "train" | "frozen"
+    axis_name: Optional[str] = None  # per-replica sync-BN axis (shard_map only)
+    kernel_init: Callable = nn.initializers.he_normal()
+
+    @nn.compact
+    def __call__(self, x, *, train: bool = True):
+        use_running_average = (not train) or self.bn_mode == "frozen"
+        conv = functools.partial(
+            nn.Conv,
+            use_bias=True,  # Keras Conv2D keeps bias even before BN
+            dtype=self.dtype,
+            param_dtype=self.param_dtype,
+            kernel_init=self.kernel_init,
+        )
+        norm = functools.partial(
+            nn.BatchNorm,
+            use_running_average=use_running_average,
+            momentum=BN_MOMENTUM,
+            epsilon=BN_EPSILON,
+            dtype=self.dtype,
+            param_dtype=self.param_dtype,
+            axis_name=self.axis_name if (train and self.bn_mode == "train") else None,
+        )
+        width = lambda f: max(8, int(f * self.width_multiplier))
+
+        x = x.astype(self.dtype)
+        if self.small_input_stem:
+            x = conv(width(64), (3, 3), padding="SAME", name="stem_conv")(x)
+            x = norm(name="stem_bn")(x)
+            x = nn.relu(x)
+        else:
+            # Keras: ZeroPadding(3) -> 7x7/2 valid conv -> BN -> ReLU
+            #        -> ZeroPadding(1) -> 3x3/2 valid maxpool.
+            x = jnp.pad(x, ((0, 0), (3, 3), (3, 3), (0, 0)))
+            x = conv(width(64), (7, 7), strides=(2, 2), padding="VALID",
+                     name="stem_conv")(x)
+            x = norm(name="stem_bn")(x)
+            x = nn.relu(x)
+            # Keras zero-pads then max-pools VALID; inputs are post-ReLU
+            # (>= 0) so zero padding is exact.
+            x = jnp.pad(x, ((0, 0), (1, 1), (1, 1), (0, 0)))
+            x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
+
+        for stage, n_blocks in enumerate(self.stage_sizes):
+            filters = width(64 * 2 ** stage)
+            for block in range(n_blocks):
+                stride = 2 if (stage > 0 and block == 0) else 1
+                x = self.block_cls(
+                    filters=filters,
+                    stride=stride,
+                    conv_shortcut=(block == 0),
+                    stride_in_3x3=self.stride_in_3x3,
+                    conv=conv,
+                    norm=norm,
+                    name=f"stage{stage + 1}_block{block + 1}",
+                )(x)
+
+        x = jnp.mean(x, axis=(1, 2))  # global average pool ('avg' pooling)
+        if self.num_classes:
+            x = nn.Dense(
+                self.num_classes,
+                dtype=self.dtype,
+                param_dtype=self.param_dtype,
+                kernel_init=nn.initializers.glorot_uniform(),  # Keras Dense default
+                name="head",
+            )(x)
+        return x.astype(jnp.float32)  # logits/features in f32 for stable loss
+
+
+ResNet18 = functools.partial(ResNet, stage_sizes=(2, 2, 2, 2), block_cls=BasicBlock)
+ResNet34 = functools.partial(ResNet, stage_sizes=(3, 4, 6, 3), block_cls=BasicBlock)
+ResNet50 = functools.partial(ResNet, stage_sizes=(3, 4, 6, 3), block_cls=BottleneckBlock)
+ResNet101 = functools.partial(ResNet, stage_sizes=(3, 4, 23, 3), block_cls=BottleneckBlock)
+ResNet152 = functools.partial(ResNet, stage_sizes=(3, 8, 36, 3), block_cls=BottleneckBlock)
+
+
+def tiny_resnet(num_classes: int = 10, **kwargs) -> ResNet:
+    """A miniature ResNet for tests and dry-runs (fast on a CPU fake mesh)."""
+    kwargs.setdefault("stage_sizes", (1, 1))
+    kwargs.setdefault("block_cls", BasicBlock)
+    kwargs.setdefault("width_multiplier", 0.125)
+    kwargs.setdefault("small_input_stem", True)
+    return ResNet(num_classes=num_classes, **kwargs)

@@ -1,0 +1,46 @@
+"""Test harness: fake 8-device CPU mesh.
+
+The reference "tests" multi-node topologies with an in-process gRPC cluster
+(`/root/reference/imagenet-resnet50-ps.py:31-65`) and CUDA-hiding env vars
+(`:29`). The JAX equivalent (SURVEY.md §4): force the host platform and split
+it into 8 virtual devices so every sharding/collective path compiles and runs
+on one CPU.
+
+Must run before any JAX backend initialization — the axon TPU plugin
+registers itself via sitecustomize and pins ``jax_platforms=axon,cpu``, so we
+both set the env *and* override the config after import.
+"""
+
+import os
+
+os.environ.setdefault("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in os.environ["XLA_FLAGS"]:
+    os.environ["XLA_FLAGS"] += " --xla_force_host_platform_device_count=8"
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def eight_devices():
+    devs = jax.devices()
+    assert len(devs) == 8, f"expected 8 fake CPU devices, got {len(devs)}"
+    return devs
+
+
+@pytest.fixture()
+def mesh8():
+    from pddl_tpu.core.mesh import build_mesh, MeshConfig
+
+    return build_mesh(MeshConfig(data=8))
+
+
+@pytest.fixture()
+def mesh4x2():
+    from pddl_tpu.core.mesh import build_mesh, MeshConfig
+
+    return build_mesh(MeshConfig(data=4, model=2))

@@ -1,0 +1,62 @@
+"""MinSizePartitioner parity with the reference's PS variable sharding
+(`/root/reference/imagenet-resnet50-ps.py:75-78`)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from pddl_tpu.core.sharding import MinSizePartitioner, shard_tree
+
+
+def test_small_tensor_replicated():
+    part = MinSizePartitioner(min_shard_bytes=256 << 10)
+    # 64 floats = 256B << 256KB: stays whole (one "shard"), like TF's
+    # MinSizePartitioner returning 1 partition.
+    assert part.spec((64,), np.float32, axis_size=8) == P()
+    assert part.num_shards((64,), np.float32, 8) == 1
+
+
+def test_large_tensor_sharded_on_largest_dim():
+    part = MinSizePartitioner(min_shard_bytes=256 << 10)
+    # 2048x1024 f32 = 8MB >= 256KB * 8 -> shard over the axis, largest dim.
+    spec = part.spec((2048, 1024), np.float32, axis_size=8)
+    assert spec == P("data")
+    assert part.num_shards((2048, 1024), np.float32, 8) == 8
+
+
+def test_max_shards_cap():
+    part = MinSizePartitioner(min_shard_bytes=1, max_shards=2)
+    assert part.num_shards((1024, 1024), np.float32, 8) == 2
+    # XLA tiles over the whole axis or not at all: a 2-shard cap on an
+    # 8-wide axis means the tensor stays replicated (never over-sharded).
+    assert part.spec((1024, 1024), np.float32, axis_size=8) == P()
+
+
+def test_min_shard_bytes_floor_respected():
+    # 512 KiB tensor, 256 KiB floor, 8-wide axis: TF would make 2 shards;
+    # tiling 8 ways would give 64 KiB shards (< floor) -> replicate.
+    part = MinSizePartitioner(min_shard_bytes=256 << 10)
+    assert part.spec((512 << 8, 512), np.float32, axis_size=2) == P("data")
+    assert part.spec((1024, 128), np.float32, axis_size=8) == P()
+
+
+def test_indivisible_dim_falls_back_replicated():
+    part = MinSizePartitioner(min_shard_bytes=1)
+    # 1001 and 3 not divisible by 8 on any dim -> replicate rather than pad.
+    assert part.spec((1001, 3), np.float32, axis_size=8) == P()
+
+
+def test_tree_shardings_place_params(mesh8):
+    part = MinSizePartitioner(min_shard_bytes=1 << 10)
+    tree = {
+        "big": jnp.zeros((1024, 64)),  # 256KB -> sharded
+        "tiny": jnp.zeros((16,)),  # 64B -> replicated
+    }
+    shardings = part.tree_shardings(mesh8, tree)
+    placed = shard_tree(tree, shardings)
+    assert placed["big"].sharding.spec == P("data")
+    assert placed["tiny"].sharding.spec == P()
+    # The big leaf is physically split 8 ways.
+    shard_shapes = {s.data.shape for s in placed["big"].addressable_shards}
+    assert shard_shapes == {(128, 64)}

@@ -1,0 +1,79 @@
+"""ResNet family: shapes, BN-mode semantics, dtype policy."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pddl_tpu.models import resnet
+
+
+def _init(model, shape=(2, 32, 32, 3), train=True):
+    variables = model.init(jax.random.key(0), jnp.zeros(shape), train=train)
+    return variables
+
+
+def test_tiny_resnet_shapes():
+    model = resnet.tiny_resnet(num_classes=10)
+    variables = _init(model)
+    out, updates = model.apply(
+        variables, jnp.zeros((2, 32, 32, 3)), train=True, mutable=["batch_stats"]
+    )
+    assert out.shape == (2, 10)
+    assert "batch_stats" in updates
+
+
+def test_resnet50_structure_matches_keras_counts():
+    """ResNet-50 must have Keras's layer counts: 53 convs (1 stem + 16*3
+    bottleneck + 4 shortcut), 53 BNs, 1 dense — the arch the reference uses
+    (imagenet-resnet50.py:56)."""
+    model = resnet.ResNet50(num_classes=1000)
+    variables = model.init(jax.random.key(0), jnp.zeros((1, 64, 64, 3)), train=False)
+    flat = jax.tree_util.tree_flatten_with_path(variables["params"])[0]
+    conv_kernels = [p for p, _ in flat if any("conv" in str(k).lower() for k in p)
+                    and str(p[-1])
+                    == str(jax.tree_util.DictKey("kernel"))]
+    assert len(conv_kernels) == 53
+    bn_scales = [p for p, _ in flat if str(p[-1]) == str(jax.tree_util.DictKey("scale"))]
+    assert len(bn_scales) == 53
+    # Param count parity with keras ResNet50 (weights incl. head): ~25.6M.
+    n_params = sum(np.prod(v.shape) for _, v in flat)
+    assert 25_500_000 < n_params < 25_700_000
+
+
+def test_num_classes_zero_returns_pooled_features():
+    model = resnet.tiny_resnet(num_classes=0)
+    variables = _init(model)
+    out = model.apply(variables, jnp.zeros((2, 32, 32, 3)), train=False)
+    assert out.ndim == 2 and out.shape[0] == 2  # (batch, features)
+
+
+def test_frozen_bn_mode_no_stats_update():
+    """bn_mode='frozen' reproduces the reference's base_model(training=False)
+    behavior (imagenet-resnet50.py:57): batch_stats never change."""
+    model = resnet.tiny_resnet(num_classes=10, bn_mode="frozen")
+    variables = _init(model)
+    x = jax.random.normal(jax.random.key(1), (4, 32, 32, 3))
+    _, updates = model.apply(variables, x, train=True, mutable=["batch_stats"])
+    before = jax.tree.leaves(variables["batch_stats"])
+    after = jax.tree.leaves(updates["batch_stats"])
+    for b, a in zip(before, after):
+        np.testing.assert_array_equal(np.asarray(b), np.asarray(a))
+
+
+def test_train_bn_mode_updates_stats():
+    model = resnet.tiny_resnet(num_classes=10, bn_mode="train")
+    variables = _init(model)
+    x = jax.random.normal(jax.random.key(1), (4, 32, 32, 3)) + 3.0
+    _, updates = model.apply(variables, x, train=True, mutable=["batch_stats"])
+    before = np.concatenate([np.ravel(v) for v in jax.tree.leaves(variables["batch_stats"])])
+    after = np.concatenate([np.ravel(v) for v in jax.tree.leaves(updates["batch_stats"])])
+    assert not np.allclose(before, after)
+
+
+def test_bfloat16_compute_f32_logits():
+    model = resnet.tiny_resnet(num_classes=10, dtype=jnp.bfloat16)
+    variables = _init(model)
+    out = model.apply(variables, jnp.zeros((2, 32, 32, 3)), train=False)
+    assert out.dtype == jnp.float32
+    # params stay f32
+    assert all(v.dtype == jnp.float32 for v in jax.tree.leaves(variables["params"]))
