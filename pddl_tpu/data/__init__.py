@@ -1,0 +1,14 @@
+"""Data pipelines.
+
+Parity surface: the reference ingests ImageNet-2012 via TFDS + tf.data
+(``/root/reference/imagenet-resnet50.py:12-49``) with 224x224 crop/pad
+preprocessing, AUTOTUNE-parallel map, drop-remainder batching and prefetch.
+Provided here: an equivalent tf.data pipeline (TF CPU-only, feeding JAX
+arrays), a pure-NumPy synthetic generator for benches/tests, and per-host
+sharding for every scheme the reference uses (auto-shard DATA, post-batch
+rank sharding, none).
+"""
+
+from pddl_tpu.data.synthetic import SyntheticImageClassification
+
+__all__ = ["SyntheticImageClassification"]

@@ -1,0 +1,13 @@
+"""Device-side ops: augmentation, attention, Pallas kernels.
+
+The reference runs augmentation *inside the model graph* so it executes on
+the accelerator and is active only in training
+(``/root/reference/imagenet-resnet50.py:53-55``, Keras preprocessing-layer
+semantics). :mod:`pddl_tpu.ops.augment` reproduces that placement as jittable
+functions the trainer fuses into the train step. Long-context attention ops
+live in :mod:`pddl_tpu.ops.ring_attention`.
+"""
+
+from pddl_tpu.ops import augment
+
+__all__ = ["augment"]

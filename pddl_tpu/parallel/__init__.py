@@ -1,0 +1,32 @@
+"""Distribution strategies: the reference's four modes over one SPMD core.
+
+Reference surface (SURVEY.md §1-§2):
+
+- none / single device (``/root/reference/imagenet-resnet50.py``)
+- ``tf.distribute.MirroredStrategy`` (``imagenet-resnet50-mirror.py:21``)
+- ``tf.distribute.MultiWorkerMirroredStrategy`` + Slurm + NCCL
+  (``imagenet-resnet50-multiworkers.py:16-25``)
+- ``ParameterServerStrategy`` + ``MinSizePartitioner`` + gRPC cluster
+  (``imagenet-resnet50-ps.py:31-84``)
+- Horovod (``imagenet-resnet50-hvd.py``) — lives in
+  :mod:`pddl_tpu.compat.hvd` as an API shim over the same core.
+
+On TPU all of them lower to mesh + NamedSharding + XLA collectives; a
+Strategy only decides (a) which devices form the mesh, (b) how state is
+sharded, (c) batch-size arithmetic, (d) who logs/saves.
+"""
+
+from pddl_tpu.parallel.base import Strategy, get_strategy
+from pddl_tpu.parallel.single import SingleDeviceStrategy
+from pddl_tpu.parallel.mirrored import MirroredStrategy
+from pddl_tpu.parallel.multiworker import MultiWorkerMirroredStrategy
+from pddl_tpu.parallel.ps import ParameterServerStrategy
+
+__all__ = [
+    "Strategy",
+    "get_strategy",
+    "SingleDeviceStrategy",
+    "MirroredStrategy",
+    "MultiWorkerMirroredStrategy",
+    "ParameterServerStrategy",
+]

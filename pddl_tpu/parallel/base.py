@@ -1,0 +1,151 @@
+"""Strategy protocol: what varies between the reference's distribution modes.
+
+A Strategy owns the mesh and the sharding rules; the Trainer
+(:mod:`pddl_tpu.train.loop`) is strategy-agnostic — exactly the factoring
+the reference never did (its ~60-line skeleton is duplicated 8x with only
+the strategy block changing; SURVEY.md §0).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterator, Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from pddl_tpu.core import dist
+from pddl_tpu.core.mesh import DATA_AXIS, build_mesh, MeshConfig, mesh_num_replicas
+
+PyTree = Any
+
+
+class Strategy:
+    """Base strategy: replicated state, data-sharded batches.
+
+    Subclasses override device selection (``mesh_config``), state sharding
+    (``state_sharding``), and bootstrap (``setup``).
+    """
+
+    name = "base"
+
+    def __init__(self, mesh_config: Optional[MeshConfig] = None):
+        self._mesh_config = mesh_config or MeshConfig()
+        self._mesh: Optional[Mesh] = None
+
+    # -- bootstrap ---------------------------------------------------------
+    def setup(self) -> Mesh:
+        """Build (once) and return the mesh. Subclasses may bootstrap
+        multi-host first (the ``strategy.scope()`` moment)."""
+        if self._mesh is None:
+            self._mesh = build_mesh(self._mesh_config)
+        return self._mesh
+
+    @property
+    def mesh(self) -> Mesh:
+        if self._mesh is None:
+            self.setup()
+        return self._mesh
+
+    # -- replica arithmetic ------------------------------------------------
+    @property
+    def num_replicas_in_sync(self) -> int:
+        """TF's ``strategy.num_replicas_in_sync``
+        (``imagenet-resnet50-mirror.py:54``)."""
+        return mesh_num_replicas(self.mesh, DATA_AXIS)
+
+    def scale_batch_size(self, per_replica_batch: int) -> int:
+        """Global batch = per-replica x replicas — the reference's
+        ``32 * strategy.num_replicas_in_sync`` arithmetic
+        (``imagenet-resnet50-mirror.py:54``,
+        ``imagenet-resnet50-multiworkers.py:70``)."""
+        return per_replica_batch * self.num_replicas_in_sync
+
+    def scale_learning_rate(self, base_lr: float) -> float:
+        """Linear LR scaling with replica count (Horovod's ``0.1 * size``,
+        ``imagenet-resnet50-hvd.py:99``). Identity by default; DP strategies
+        may override or users opt in explicitly."""
+        return base_lr
+
+    # -- sharding rules ----------------------------------------------------
+    def batch_sharding(self) -> NamedSharding:
+        return NamedSharding(self.mesh, PartitionSpec(DATA_AXIS))
+
+    def state_sharding(self, state: PyTree) -> PyTree:
+        """Sharding for the TrainState: replicated by default (mirrored
+        variables), overridden by the PS strategy."""
+        repl = NamedSharding(self.mesh, PartitionSpec())
+        return jax.tree.map(lambda _: repl, state)
+
+    # -- data distribution -------------------------------------------------
+    @property
+    def data_process_count(self) -> int:
+        """Processes contributing shards to this strategy's mesh.
+
+        1 for a local-only mesh (mirrored on one host, even inside a
+        multi-host job); ``jax.process_count()`` for a global mesh.
+        """
+        return len({d.process_index for d in self.mesh.devices.flat})
+
+    def distribute_batch(self, batch: PyTree) -> PyTree:
+        """Host-local numpy batch -> globally-sharded jax.Array.
+
+        Each participating process contributes its local shard; together
+        they form the global batch (the auto-shard DATA policy analogue,
+        ``imagenet-resnet50-multiworkers.py:66-69``).
+        """
+        sharding = self.batch_sharding()
+        n_procs = self.data_process_count
+        leaves = jax.tree.leaves(batch)
+        if leaves:
+            local = np.asarray(leaves[0]).shape[0]
+            from pddl_tpu.core.mesh import validate_divisible
+
+            validate_divisible(local * n_procs, self.mesh)
+
+        def _to_global(x):
+            x = np.asarray(x)
+            if n_procs == 1:
+                return jax.device_put(x, sharding)
+            return jax.make_array_from_process_local_data(sharding, x)
+
+        return jax.tree.map(_to_global, batch)
+
+    def distribute_dataset(self, it: Iterator[PyTree]) -> Iterator[PyTree]:
+        for batch in it:
+            yield self.distribute_batch(batch)
+
+    # -- process topology --------------------------------------------------
+    @property
+    def process_index(self) -> int:
+        return dist.process_index()
+
+    @property
+    def is_coordinator(self) -> bool:
+        """Who logs and saves (rank-0 gating,
+        ``imagenet-resnet50-hvd.py:28,96,117,125``)."""
+        return dist.is_coordinator()
+
+    def __repr__(self) -> str:
+        return f"{type(self).__name__}(replicas={self.num_replicas_in_sync})"
+
+
+_STRATEGIES: dict[str, type] = {}
+
+
+def register_strategy(name: str):
+    def deco(cls):
+        _STRATEGIES[name] = cls
+        cls.name = name
+        return cls
+
+    return deco
+
+
+def get_strategy(name: str, **kwargs) -> Strategy:
+    """Strategy by config string (``single``/``mirrored``/``multiworker``/``ps``)."""
+    try:
+        cls = _STRATEGIES[name]
+    except KeyError:
+        raise ValueError(f"unknown strategy {name!r}; known: {sorted(_STRATEGIES)}") from None
+    return cls(**kwargs)

@@ -1,0 +1,34 @@
+"""Mirrored strategy: single-host sync data parallelism.
+
+Parity with ``tf.distribute.MirroredStrategy``
+(``/root/reference/imagenet-resnet50-mirror.py:21``): variables replicated
+on every local device, per-step gradient all-reduce, global batch scaled by
+replica count (``:54``). The reference's NCCL ring becomes an XLA all-reduce
+over ICI — not called explicitly: with params replicated and the batch
+sharded over ``data``, XLA's SPMD partitioner inserts the gradient
+all-reduce during compilation (SURVEY.md §2b C11).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+
+from pddl_tpu.core.mesh import MeshConfig, build_mesh
+from pddl_tpu.parallel.base import Strategy, register_strategy
+
+
+@register_strategy("mirrored")
+class MirroredStrategy(Strategy):
+    """Data parallelism over this host's local devices."""
+
+    def __init__(self, devices: Optional[Sequence[jax.Device]] = None):
+        super().__init__(MeshConfig(local_only=True))
+        self._devices = devices
+
+    def setup(self):
+        if self._mesh is None:
+            devs = list(self._devices) if self._devices else jax.local_devices()
+            self._mesh = build_mesh(MeshConfig(data=len(devs)), devices=devs)
+        return self._mesh

@@ -1,0 +1,46 @@
+"""Multi-worker mirrored strategy: multi-host sync data parallelism.
+
+Parity with ``tf.distribute.MultiWorkerMirroredStrategy`` + Slurm resolver +
+NCCL (``/root/reference/imagenet-resnet50-multiworkers.py:16-25``). The whole
+resolver/NCCL-options block collapses into :func:`pddl_tpu.core.dist.initialize`
+(Slurm/TPU-metadata discovery) plus one global mesh; cross-host gradient
+all-reduce is compiled by XLA over ICI within a slice and DCN across slices
+(SURVEY.md §3.3).
+
+Dataset sharding follows the DATA auto-shard policy the reference sets
+(``imagenet-resnet50-multiworkers.py:66-69``): each process feeds its local
+part of the global batch; ``Strategy.distribute_batch`` assembles the global
+array via ``jax.make_array_from_process_local_data``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from pddl_tpu.core import dist
+from pddl_tpu.core.mesh import MeshConfig, build_mesh
+from pddl_tpu.parallel.base import Strategy, register_strategy
+
+
+@register_strategy("multiworker")
+class MultiWorkerMirroredStrategy(Strategy):
+    """Data parallelism over every device of every participating host."""
+
+    def __init__(self, coordinator_address: Optional[str] = None,
+                 num_processes: Optional[int] = None,
+                 process_id: Optional[int] = None):
+        super().__init__(MeshConfig())
+        self._bootstrap = (coordinator_address, num_processes, process_id)
+        self.cluster: Optional[dist.ClusterSpec] = None
+
+    def setup(self):
+        if self._mesh is None:
+            self.cluster = dist.initialize(*self._bootstrap)
+            self._mesh = build_mesh(MeshConfig())
+        return self._mesh
+
+    @property
+    def num_workers(self) -> int:
+        """Worker count as the reference derives from ``SLURM_NTASKS``
+        (``imagenet-resnet50-multiworkers.py:29``)."""
+        return dist.process_count()

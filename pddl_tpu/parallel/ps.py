@@ -1,0 +1,98 @@
+"""Parameter-server strategy: sharded variable/optimizer state.
+
+Capability parity with ``tf.distribute.ParameterServerStrategy`` +
+``MinSizePartitioner`` + in-process gRPC cluster
+(``/root/reference/imagenet-resnet50-ps.py:31-84``): model variables above a
+size threshold live *sharded* across hosts/devices and are fetched on
+demand, scaling variable capacity with the number of "servers".
+
+TPU-native mapping (SURVEY.md §7 "PS capability mapping", documented
+semantic difference): there is no async RPC push/pull on TPU — the analogue
+is **sharded state under sync SPMD**. Variables and optimizer state that
+cross ``min_shard_bytes`` are laid out split along the ``data`` axis
+(ZeRO-style); XLA materializes the all-gather (the "pull") before use and
+the reduce-scatter (the "push") on update, riding ICI instead of gRPC.
+Capability observables preserved: min-size-gated sharding, shard count
+scaling with ``num_ps``, small variables replicated. Semantics are
+synchronous, which strictly strengthens the reference's consistency model.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec
+
+from pddl_tpu.core import dist
+from pddl_tpu.core.mesh import DATA_AXIS, MeshConfig, build_mesh
+from pddl_tpu.core.sharding import MinSizePartitioner
+from pddl_tpu.parallel.base import Strategy, register_strategy
+
+PyTree = Any
+
+
+@register_strategy("ps")
+class ParameterServerStrategy(Strategy):
+    """Sharded-state data parallelism (the PS capability, sync-SPMD).
+
+    Args:
+      num_ps: cap on shards per variable, mirroring ``max_shards=NUM_PS``
+        (``imagenet-resnet50-ps.py:78``). Defaults to the data-axis size.
+      min_shard_bytes: sharding threshold, default 256 KiB like the
+        reference (``:77``).
+      shard_optimizer_state: also shard Adam moments etc. (ZeRO-1 style);
+        on by default — optimizer state is where the memory is.
+    """
+
+    def __init__(self, num_ps: Optional[int] = None,
+                 min_shard_bytes: int = 256 << 10,
+                 shard_optimizer_state: bool = True,
+                 coordinator_address: Optional[str] = None,
+                 num_processes: Optional[int] = None,
+                 process_id: Optional[int] = None):
+        super().__init__(MeshConfig())
+        self.num_ps = num_ps
+        self.min_shard_bytes = min_shard_bytes
+        self.shard_optimizer_state = shard_optimizer_state
+        self._bootstrap = (coordinator_address, num_processes, process_id)
+
+    def setup(self):
+        if self._mesh is None:
+            dist.initialize(*self._bootstrap)
+            self._mesh = build_mesh(MeshConfig())
+        return self._mesh
+
+    @property
+    def partitioner(self) -> MinSizePartitioner:
+        return MinSizePartitioner(
+            min_shard_bytes=self.min_shard_bytes,
+            max_shards=self.num_ps,
+            axis_name=DATA_AXIS,
+        )
+
+    def state_sharding(self, state: PyTree) -> PyTree:
+        """Params (and optionally optimizer state) via the partitioner;
+        scalars/batch_stats replicated."""
+        mesh = self.mesh
+        part = self.partitioner
+        repl = NamedSharding(mesh, PartitionSpec())
+
+        def shard_leaf(leaf):
+            if not hasattr(leaf, "shape") or not hasattr(leaf, "dtype"):
+                return repl
+            return NamedSharding(
+                mesh, part.spec(tuple(leaf.shape), leaf.dtype, mesh.shape[DATA_AXIS])
+            )
+
+        params_sh = jax.tree.map(shard_leaf, state.params)
+        if self.shard_optimizer_state:
+            opt_sh = jax.tree.map(shard_leaf, state.opt_state)
+        else:
+            opt_sh = jax.tree.map(lambda _: repl, state.opt_state)
+        return state.replace(
+            step=repl,
+            params=params_sh,
+            batch_stats=jax.tree.map(lambda _: repl, state.batch_stats),
+            opt_state=opt_sh,
+        )

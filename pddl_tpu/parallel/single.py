@@ -1,0 +1,32 @@
+"""Single-device strategy — the reference's plain scripts
+(``/root/reference/imagenet-resnet50.py``, ``imagenet-pretrained-resnet50.py``:
+no ``tf.distribute`` anywhere, one GPU).
+
+A 1-device mesh rather than a special case: the train step, shardings and
+callbacks are byte-identical to the distributed modes, so moving from one
+chip to a pod is a config change (the property the reference lacked).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+
+from pddl_tpu.core.mesh import MeshConfig
+from pddl_tpu.parallel.base import Strategy, register_strategy
+
+
+@register_strategy("single")
+class SingleDeviceStrategy(Strategy):
+    def __init__(self, device: Optional[jax.Device] = None):
+        super().__init__(MeshConfig(data=1))
+        self._device = device
+
+    def setup(self):
+        if self._mesh is None:
+            from pddl_tpu.core.mesh import build_mesh
+
+            dev = self._device or jax.local_devices()[0]
+            self._mesh = build_mesh(MeshConfig(data=1), devices=[dev])
+        return self._mesh

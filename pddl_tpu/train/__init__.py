@@ -1,0 +1,24 @@
+"""Training orchestration: the reference's ``compile``/``fit`` layer.
+
+The reference drives everything through ``keras.Model.compile`` + ``fit``
+(``/root/reference/imagenet-resnet50.py:62,67``) with callbacks. Here that
+surface is a custom SPMD loop: a jitted ``train_step``/``eval_step`` over a
+mesh, an epoch driver, and a Keras-compatible callback engine.
+"""
+
+from pddl_tpu.train.state import TrainState, make_optimizer, get_learning_rate, set_learning_rate
+from pddl_tpu.train.loop import Trainer
+from pddl_tpu.train.history import History
+from pddl_tpu.train import callbacks
+from pddl_tpu.train import metrics
+
+__all__ = [
+    "TrainState",
+    "Trainer",
+    "History",
+    "callbacks",
+    "metrics",
+    "make_optimizer",
+    "get_learning_rate",
+    "set_learning_rate",
+]

@@ -1,0 +1,257 @@
+"""Callback engine with parity for every callback the reference uses.
+
+Reference surface (SURVEY.md §2a C10, §5):
+
+- ``ReduceLROnPlateau(monitor='val_loss', factor=0.1, patience=5,
+  min_lr=1e-5)`` — ``/root/reference/imagenet-resnet50.py:64``
+- ``EarlyStopping(monitor='val_loss', min_delta=0.001, patience=10)`` —
+  ``imagenet-resnet50.py:65``
+- ``hvd.callbacks.BroadcastGlobalVariablesCallback(0)`` —
+  ``imagenet-resnet50-hvd.py:111`` (replicated-init no-op under SPMD; kept
+  in :mod:`pddl_tpu.compat.hvd`)
+- ``hvd.callbacks.MetricAverageCallback`` — ``imagenet-resnet50-hvd.py:112``
+  (metrics are already global means under jit-with-shardings)
+- ``hvd.callbacks.LearningRateWarmupCallback(warmup_epochs=3, verbose=1)``
+  — ``imagenet-resnet50-hvd.py:114`` → :class:`LearningRateWarmup`
+- rank-0-gated verbosity/saving — ``imagenet-resnet50-hvd.py:117,125`` →
+  coordinator gating lives in the Trainer/logging layer.
+
+Callbacks mutate training functionally: they may return a new ``TrainState``
+from hooks (LR changes are state edits, not attribute pokes) and set
+``trainer.stop_training`` exactly like Keras EarlyStopping.
+"""
+
+from __future__ import annotations
+
+import math
+import sys
+import time
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from pddl_tpu.train.state import TrainState, get_learning_rate, set_learning_rate
+
+
+class Callback:
+    """Base class; hooks mirror ``keras.callbacks.Callback``.
+
+    Hooks that can change training state return a ``TrainState`` (or None to
+    leave it untouched). ``self.trainer`` is bound by the Trainer before use.
+    """
+
+    trainer = None  # set by Trainer
+
+    def set_trainer(self, trainer) -> None:
+        self.trainer = trainer
+
+    # epoch/train hooks: return Optional[TrainState]
+    def on_train_begin(self, state: TrainState):
+        return None
+
+    def on_train_end(self, state: TrainState, logs: Dict[str, float]):
+        return None
+
+    def on_epoch_begin(self, epoch: int, state: TrainState):
+        return None
+
+    def on_epoch_end(self, epoch: int, state: TrainState, logs: Dict[str, float]):
+        return None
+
+    def on_train_batch_end(self, step: int, state: TrainState, logs: Dict[str, float]):
+        return None
+
+
+class ReduceLROnPlateau(Callback):
+    """LR decay on metric plateau — defaults exactly the reference's
+    (``imagenet-resnet50.py:64``)."""
+
+    def __init__(self, monitor: str = "val_loss", factor: float = 0.1,
+                 patience: int = 5, min_lr: float = 1e-5,
+                 min_delta: float = 1e-4, mode: str = "min", verbose: int = 0):
+        if factor >= 1.0:
+            raise ValueError("ReduceLROnPlateau factor must be < 1")
+        self.monitor, self.factor, self.patience = monitor, factor, patience
+        self.min_lr, self.min_delta, self.mode, self.verbose = min_lr, min_delta, mode, verbose
+        self.best = math.inf if mode == "min" else -math.inf
+        self.wait = 0
+
+    def _improved(self, current: float) -> bool:
+        if self.mode == "min":
+            return current < self.best - self.min_delta
+        return current > self.best + self.min_delta
+
+    def on_epoch_end(self, epoch, state, logs):
+        current = logs.get(self.monitor)
+        if current is None:
+            return None
+        if self._improved(current):
+            self.best, self.wait = current, 0
+            return None
+        self.wait += 1
+        if self.wait >= self.patience:
+            old = get_learning_rate(state)
+            new = max(old * self.factor, self.min_lr)
+            self.wait = 0
+            if new < old:
+                if self.verbose:
+                    print(f"ReduceLROnPlateau: lr {old:.2e} -> {new:.2e}", file=sys.stderr)
+                return set_learning_rate(state, new)
+        return None
+
+
+class EarlyStopping(Callback):
+    """Stop when the monitored metric stops improving — defaults exactly the
+    reference's (``imagenet-resnet50.py:65``)."""
+
+    def __init__(self, monitor: str = "val_loss", min_delta: float = 0.001,
+                 patience: int = 10, mode: str = "min",
+                 restore_best_weights: bool = False):
+        self.monitor, self.min_delta, self.patience = monitor, min_delta, patience
+        self.mode = mode
+        self.restore_best_weights = restore_best_weights
+        self.best = math.inf if mode == "min" else -math.inf
+        self.wait = 0
+        self.best_params = None
+        self.stopped_epoch: Optional[int] = None
+
+    def _improved(self, current: float) -> bool:
+        if self.mode == "min":
+            return current < self.best - self.min_delta
+        return current > self.best + self.min_delta
+
+    def on_epoch_end(self, epoch, state, logs):
+        current = logs.get(self.monitor)
+        if current is None:
+            return None
+        if self._improved(current):
+            self.best, self.wait = current, 0
+            if self.restore_best_weights:
+                # Deep-copy: the live params buffers are donated by the next
+                # jitted train step and would be deleted under our feet.
+                self.best_params = jax.tree.map(jnp.copy, state.params)
+            return None
+        self.wait += 1
+        if self.wait >= self.patience:
+            self.stopped_epoch = epoch
+            self.trainer.stop_training = True
+            if self.restore_best_weights and self.best_params is not None:
+                return state.replace(params=self.best_params)
+        return None
+
+
+class LearningRateWarmup(Callback):
+    """Linear LR warmup over the first epochs, Horovod-style.
+
+    Equivalent of ``hvd.callbacks.LearningRateWarmupCallback(warmup_epochs=3)``
+    (``imagenet-resnet50-hvd.py:114-115``): ramps from ``initial_lr/world``
+    (or a given start) to the target LR over ``warmup_epochs`` epochs,
+    stepping each batch.
+    """
+
+    def __init__(self, warmup_epochs: int = 3, steps_per_epoch: Optional[int] = None,
+                 start_lr: Optional[float] = None, verbose: int = 0):
+        self.warmup_epochs = warmup_epochs
+        self.steps_per_epoch = steps_per_epoch
+        self.start_lr = start_lr
+        self.verbose = verbose
+        self.target_lr: Optional[float] = None
+        self._warmup_steps: Optional[int] = None
+
+    def on_train_begin(self, state):
+        self.target_lr = get_learning_rate(state)
+        spe = self.steps_per_epoch or self.trainer.steps_per_epoch
+        if spe is None:
+            raise ValueError("LearningRateWarmup needs steps_per_epoch")
+        self._warmup_steps = max(1, self.warmup_epochs * spe)
+        start = self.start_lr if self.start_lr is not None else self.target_lr / self._warmup_steps
+        return set_learning_rate(state, start)
+
+    def on_train_batch_end(self, step, state, logs):
+        if step >= self._warmup_steps:
+            return None
+        start = self.start_lr if self.start_lr is not None else 0.0
+        frac = (step + 1) / self._warmup_steps
+        lr = start + (self.target_lr - start) * frac
+        new_state = set_learning_rate(state, lr)
+        if self.verbose and step + 1 == self._warmup_steps:
+            print(f"LearningRateWarmup: reached target lr {self.target_lr:.2e}", file=sys.stderr)
+        return new_state
+
+
+class LambdaCallback(Callback):
+    def __init__(self, on_epoch_end=None, on_train_batch_end=None,
+                 on_train_begin=None, on_train_end=None):
+        self._on_epoch_end = on_epoch_end
+        self._on_train_batch_end = on_train_batch_end
+        self._on_train_begin = on_train_begin
+        self._on_train_end = on_train_end
+
+    def on_train_begin(self, state):
+        return self._on_train_begin(state) if self._on_train_begin else None
+
+    def on_train_end(self, state, logs):
+        return self._on_train_end(state, logs) if self._on_train_end else None
+
+    def on_epoch_end(self, epoch, state, logs):
+        return self._on_epoch_end(epoch, state, logs) if self._on_epoch_end else None
+
+    def on_train_batch_end(self, step, state, logs):
+        return self._on_train_batch_end(step, state, logs) if self._on_train_batch_end else None
+
+
+class CSVLogger(Callback):
+    """Epoch metrics to CSV on the coordinator — the History-file analogue."""
+
+    def __init__(self, path: str, append: bool = False):
+        self.path = path
+        self.append = append
+        self._file = None
+        self._keys: Optional[List[str]] = None
+
+    def on_train_begin(self, state):
+        from pddl_tpu.core import dist
+
+        if dist.is_coordinator():
+            self._file = open(self.path, "a" if self.append else "w")
+        return None
+
+    def on_epoch_end(self, epoch, state, logs):
+        if self._file is None:
+            return None
+        if self._keys is None:
+            self._keys = sorted(logs)
+            self._file.write(",".join(["epoch"] + self._keys) + "\n")
+        row = [str(epoch)] + [f"{logs.get(k, float('nan')):.6g}" for k in self._keys]
+        self._file.write(",".join(row) + "\n")
+        self._file.flush()
+        return None
+
+    def on_train_end(self, state, logs):
+        if self._file is not None:
+            self._file.close()
+            self._file = None
+        return None
+
+
+class Timing(Callback):
+    """Wall-clock timing like the Horovod script's rank-0 ``Total time``
+    print (``imagenet-resnet50-hvd.py:119-126``)."""
+
+    def __init__(self, verbose: int = 1):
+        self.verbose = verbose
+        self.start: Optional[float] = None
+        self.total: Optional[float] = None
+
+    def on_train_begin(self, state):
+        self.start = time.perf_counter()
+        return None
+
+    def on_train_end(self, state, logs):
+        self.total = time.perf_counter() - self.start
+        from pddl_tpu.core import dist
+
+        if self.verbose and dist.is_coordinator():
+            print(f"Total time: {self.total:.1f}s", file=sys.stderr)
+        return None

@@ -1,0 +1,127 @@
+"""Train state + optimizer factory.
+
+The reference compiles with ``optimizer='adam'`` everywhere
+(``/root/reference/imagenet-resnet50.py:62``), with the Horovod variant
+scaling LR by world size (``imagenet-resnet50-hvd.py:99``). Optimizers here
+are optax transforms wrapped in ``inject_hyperparams`` so the learning rate
+is *state*, not a trace-time constant — that is what lets
+``ReduceLROnPlateau`` / warmup callbacks (``imagenet-resnet50.py:64``,
+``imagenet-resnet50-hvd.py:114``) adjust LR between steps without
+recompiling the jitted train step.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax import struct
+
+PyTree = Any
+
+
+class TrainState(struct.PyTreeNode):
+    """Pure-data training state (params + BN stats + optimizer state).
+
+    Unlike Keras's stateful ``Model``, everything mutable lives here and the
+    train step is a pure function ``(state, batch, rng) -> (state, metrics)``
+    — the property that lets XLA compile the whole update, shard it over a
+    mesh, and donate buffers.
+    """
+
+    step: jnp.ndarray
+    params: PyTree
+    batch_stats: PyTree
+    opt_state: optax.OptState
+
+    def apply_gradients(self, tx: optax.GradientTransformation, grads: PyTree,
+                        new_batch_stats: PyTree | None = None) -> "TrainState":
+        updates, new_opt_state = tx.update(grads, self.opt_state, self.params)
+        new_params = optax.apply_updates(self.params, updates)
+        return self.replace(
+            step=self.step + 1,
+            params=new_params,
+            batch_stats=new_batch_stats if new_batch_stats is not None else self.batch_stats,
+            opt_state=new_opt_state,
+        )
+
+
+_OPTIMIZERS: dict[str, Callable[..., optax.GradientTransformation]] = {
+    "adam": optax.adam,
+    "adamw": optax.adamw,
+    "sgd": optax.sgd,
+    "momentum": lambda learning_rate, **kw: optax.sgd(learning_rate, momentum=kw.pop("momentum", 0.9), **kw),
+    "rmsprop": optax.rmsprop,
+    "lamb": optax.lamb,
+    "lars": optax.lars,
+    "adagrad": optax.adagrad,
+}
+
+
+def make_optimizer(
+    name: str | optax.GradientTransformation = "adam",
+    learning_rate: float = 1e-3,  # Keras Adam default, as compiled at :62
+    *,
+    weight_decay: Optional[float] = None,
+    grad_clip_norm: Optional[float] = None,
+    **kwargs,
+) -> optax.GradientTransformation:
+    """Build an optimizer with a state-injected (callback-adjustable) LR."""
+    if isinstance(name, optax.GradientTransformation):
+        return name
+    try:
+        factory = _OPTIMIZERS[name.lower()]
+    except KeyError:
+        raise ValueError(f"unknown optimizer {name!r}; known: {sorted(_OPTIMIZERS)}") from None
+    if weight_decay is not None and name.lower() in ("adamw", "lamb"):
+        kwargs["weight_decay"] = weight_decay
+    tx = optax.inject_hyperparams(factory)(learning_rate=learning_rate, **kwargs)
+    if grad_clip_norm is not None:
+        tx = optax.chain(optax.clip_by_global_norm(grad_clip_norm), tx)
+    return tx
+
+
+def _find_hyperparams(opt_state) -> Optional[dict]:
+    """Locate the inject_hyperparams dict inside a possibly-chained state."""
+    if hasattr(opt_state, "hyperparams") and "learning_rate" in opt_state.hyperparams:
+        return opt_state.hyperparams
+    if isinstance(opt_state, tuple):
+        for sub in opt_state:
+            found = _find_hyperparams(sub)
+            if found is not None:
+                return found
+    return None
+
+
+def get_learning_rate(state: TrainState) -> float:
+    """Current LR (the ``model.optimizer.lr`` read in Keras callbacks)."""
+    hp = _find_hyperparams(state.opt_state)
+    if hp is None:
+        raise ValueError("optimizer has no injected learning_rate hyperparam")
+    return float(jax.device_get(hp["learning_rate"]))
+
+
+def set_learning_rate(state: TrainState, value: float) -> TrainState:
+    """Return state with a new LR — functional ``optimizer.lr.assign``.
+
+    Powers ReduceLROnPlateau (``imagenet-resnet50.py:64``) and Horovod-style
+    warmup (``imagenet-resnet50-hvd.py:114``) without retracing: the LR is an
+    optimizer-state leaf, so the jitted step just sees a new value.
+    """
+
+    def _set(opt_state):
+        if hasattr(opt_state, "hyperparams") and "learning_rate" in opt_state.hyperparams:
+            old = opt_state.hyperparams["learning_rate"]
+            new_hp = dict(opt_state.hyperparams)
+            new_hp["learning_rate"] = jnp.asarray(value, dtype=jnp.asarray(old).dtype)
+            return opt_state._replace(hyperparams=new_hp)
+        if isinstance(opt_state, tuple):
+            subs = [_set(s) for s in opt_state]
+            return type(opt_state)(*subs) if hasattr(opt_state, "_fields") else tuple(subs)
+        return opt_state
+
+    if _find_hyperparams(state.opt_state) is None:
+        raise ValueError("optimizer has no injected learning_rate hyperparam")
+    return state.replace(opt_state=_set(state.opt_state))

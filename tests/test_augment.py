@@ -1,0 +1,73 @@
+"""Augmentation parity: rescale / crop-or-pad / random crop / random flip
+(`/root/reference/imagenet-resnet50.py:36-41,53-55`)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pddl_tpu.ops import augment
+
+
+def test_rescale():
+    x = jnp.full((1, 2, 2, 3), 255.0)
+    np.testing.assert_allclose(augment.rescale(x), jnp.ones((1, 2, 2, 3)))
+
+
+def test_center_crop():
+    x = jnp.arange(6 * 6, dtype=jnp.float32).reshape(1, 6, 6, 1)
+    out = augment.center_crop_or_pad(x, 4, 4)
+    assert out.shape == (1, 4, 4, 1)
+    np.testing.assert_allclose(out[0, 0, 0, 0], x[0, 1, 1, 0])
+
+
+def test_center_pad():
+    x = jnp.ones((1, 2, 2, 1))
+    out = augment.center_crop_or_pad(x, 4, 4)
+    assert out.shape == (1, 4, 4, 1)
+    assert float(out.sum()) == 4.0  # original mass preserved
+    assert float(out[0, 0, 0, 0]) == 0.0  # padded corner
+
+
+def test_random_crop_shape_and_content():
+    rng = jax.random.key(0)
+    x = jax.random.normal(jax.random.key(1), (4, 8, 8, 3))
+    out = augment.random_crop(rng, x, 5, 5)
+    assert out.shape == (4, 5, 5, 3)
+    # every crop window is a contiguous sub-block of the source image
+    x0 = np.asarray(x[0, :, :, 0])
+    o0 = np.asarray(out[0, :, :, 0])
+    found = any(
+        np.allclose(x0[i : i + 5, j : j + 5], o0)
+        for i in range(4)
+        for j in range(4)
+    )
+    assert found
+
+
+def test_random_crop_pads_when_target_larger():
+    """The reference's RandomCrop(244) on 224 input quirk: we pad instead of
+    upscale (SURVEY.md §0 faithfulness fix)."""
+    rng = jax.random.key(0)
+    x = jnp.ones((2, 4, 4, 1))
+    out = augment.random_crop(rng, x, 6, 6)
+    assert out.shape == (2, 6, 6, 1)
+
+
+def test_random_flip_is_flip_or_identity():
+    rng = jax.random.key(2)
+    x = jax.random.normal(jax.random.key(3), (8, 4, 4, 1))
+    out = augment.random_flip_horizontal(rng, x)
+    for i in range(8):
+        same = np.allclose(out[i], x[i])
+        flipped = np.allclose(out[i], jnp.flip(x[i], axis=-2))
+        assert same or flipped
+    # with 8 images, overwhelmingly likely both outcomes occur
+    outcomes = {bool(np.allclose(out[i], x[i])) for i in range(8)}
+    assert len(outcomes) == 2
+
+
+def test_standard_augment_jits():
+    fn = jax.jit(augment.standard_augment(crop=3, flip=True))
+    out = fn(jax.random.key(0), jnp.ones((2, 5, 5, 3)) * 255.0)
+    assert out.shape == (2, 3, 3, 3)
+    assert float(out.max()) <= 1.0

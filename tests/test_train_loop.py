@@ -1,0 +1,154 @@
+"""End-to-end training on the fake 8-device mesh: the minimum slice of
+SURVEY.md §7 build order step 1 (loss decreases, metrics flow, History)."""
+
+import jax
+import numpy as np
+import pytest
+
+from pddl_tpu.data.synthetic import SyntheticImageClassification
+from pddl_tpu.models.resnet import tiny_resnet
+from pddl_tpu.ops.augment import standard_augment
+from pddl_tpu.parallel import MirroredStrategy, SingleDeviceStrategy
+from pddl_tpu.train.loop import Trainer
+
+
+def _dataset(batch=32, **kw):
+    kw.setdefault("image_size", 32)
+    kw.setdefault("num_classes", 10)
+    kw.setdefault("signal_strength", 3.0)
+    return SyntheticImageClassification(batch_size=batch, **kw)
+
+
+def test_fit_loss_decreases_single_device():
+    tr = Trainer(tiny_resnet(num_classes=10), learning_rate=1e-2,
+                 strategy=SingleDeviceStrategy())
+    h = tr.fit(_dataset(16), epochs=3, steps_per_epoch=6, verbose=0)
+    losses = h.history["loss"]
+    assert losses[-1] < losses[0] * 0.8
+    assert h.history["accuracy"][-1] > h.history["accuracy"][0]
+
+
+def test_fit_mirrored_8_devices():
+    strat = MirroredStrategy()
+    assert strat.num_replicas_in_sync == 8
+    # global batch = 4 * 8, the reference's 32*n arithmetic
+    # (imagenet-resnet50-mirror.py:54)
+    global_batch = strat.scale_batch_size(4)
+    assert global_batch == 32
+    tr = Trainer(tiny_resnet(num_classes=10), learning_rate=1e-2, strategy=strat)
+    h = tr.fit(_dataset(global_batch), epochs=2, steps_per_epoch=6, verbose=0)
+    assert h.history["loss"][-1] < h.history["loss"][0]
+    # params stay replicated; batch was sharded 8 ways
+    leaf = jax.tree.leaves(tr.state.params)[0]
+    assert leaf.sharding.is_fully_replicated
+
+
+def test_validation_metrics_and_history():
+    ds = _dataset(16)
+    val = _dataset(16, index_offset=10_000)
+    tr = Trainer(tiny_resnet(num_classes=10), learning_rate=1e-2,
+                 strategy=SingleDeviceStrategy())
+    h = tr.fit(ds, epochs=2, steps_per_epoch=4, validation_data=val,
+               validation_steps=2, verbose=0)
+    assert set(h.history) >= {"loss", "accuracy", "val_loss", "val_accuracy"}
+    assert len(h.epoch) == 2
+
+
+def test_mirrored_equals_single_device_math():
+    """Same global batch, same seed => mirrored DP must match single-device
+    numerics (the sync-SPMD guarantee NCCL gave the reference)."""
+    ds = _dataset(16)
+    t1 = Trainer(tiny_resnet(num_classes=10), learning_rate=1e-2,
+                 strategy=SingleDeviceStrategy(), seed=7)
+    t8 = Trainer(tiny_resnet(num_classes=10), learning_rate=1e-2,
+                 strategy=MirroredStrategy(), seed=7)
+    h1 = t1.fit(ds, epochs=1, steps_per_epoch=4, verbose=0)
+    h8 = t8.fit(ds, epochs=1, steps_per_epoch=4, verbose=0)
+    np.testing.assert_allclose(
+        h1.history["loss"][0], h8.history["loss"][0], rtol=2e-4
+    )
+    p1 = jax.device_get(jax.tree.leaves(t1.state.params)[0])
+    p8 = jax.device_get(jax.tree.leaves(t8.state.params)[0])
+    np.testing.assert_allclose(p1, p8, rtol=5e-4, atol=5e-6)
+
+
+def test_augmented_training_runs():
+    tr = Trainer(
+        tiny_resnet(num_classes=10), learning_rate=1e-2,
+        strategy=MirroredStrategy(),
+        augment=standard_augment(crop=28, flip=True, rescale_factor=None),
+    )
+    h = tr.fit(_dataset(32), epochs=1, steps_per_epoch=3, verbose=0)
+    assert np.isfinite(h.history["loss"][0])
+
+
+def test_predict_shape():
+    tr = Trainer(tiny_resnet(num_classes=10), strategy=SingleDeviceStrategy())
+    tr.fit(_dataset(16), epochs=1, steps_per_epoch=2, verbose=0)
+    out = tr.predict(np.zeros((8, 32, 32, 3), np.float32))
+    assert out.shape == (8, 10)
+
+
+def test_evaluate_before_fit_raises():
+    tr = Trainer(tiny_resnet(num_classes=10), strategy=SingleDeviceStrategy())
+    with pytest.raises(RuntimeError):
+        tr.evaluate(_dataset(16), steps=1)
+
+
+def test_restore_best_weights_survives_donation():
+    """EarlyStopping must deep-copy its snapshot: live param buffers are
+    donated by the next jitted step (regression test)."""
+    from pddl_tpu.train.callbacks import EarlyStopping
+
+    noise = SyntheticImageClassification(
+        batch_size=16, image_size=32, num_classes=10, signal_strength=0.0
+    )
+    tr = Trainer(tiny_resnet(num_classes=10), learning_rate=1e-2,
+                 strategy=SingleDeviceStrategy())
+    cb = EarlyStopping(monitor="val_loss", patience=1, min_delta=10.0,
+                       restore_best_weights=True)
+    tr.fit(noise, epochs=10, steps_per_epoch=1, validation_data=noise,
+           validation_steps=1, callbacks=[cb], verbose=0)
+    # restored params must be alive and usable
+    out = tr.predict(np.zeros((2, 32, 32, 3), np.float32))
+    assert np.all(np.isfinite(out))
+
+
+def test_generator_dataset_trains_on_all_batches():
+    """The batch consumed by lazy init must still be trained on; a 3-batch
+    generator with steps_per_epoch=None must yield 3 steps (regression)."""
+    ds = _dataset(16)
+    seen = []
+
+    def gen():
+        for i in range(3):
+            b = ds.batch(i)
+            seen.append(i)
+            yield b
+
+    tr = Trainer(tiny_resnet(num_classes=10), learning_rate=1e-2,
+                 strategy=SingleDeviceStrategy())
+    tr.fit(gen(), epochs=1, verbose=0)
+    assert seen == [0, 1, 2]
+    assert int(jax.device_get(tr.state.step)) == 3
+
+
+def test_one_shot_iterator_multi_epoch_raises():
+    ds = _dataset(16)
+    tr = Trainer(tiny_resnet(num_classes=10), strategy=SingleDeviceStrategy())
+    with pytest.raises(ValueError, match="one-shot iterator"):
+        tr.fit(iter([ds.batch(0), ds.batch(1)]), epochs=2, verbose=0)
+
+
+def test_determinism_same_seed_bitwise():
+    """Same seed -> bitwise-equal params after N steps (SURVEY.md §5 race
+    detection: functional purity + fixed PRNG keys replace TSAN)."""
+    def run():
+        tr = Trainer(tiny_resnet(num_classes=10), learning_rate=1e-2,
+                     strategy=MirroredStrategy(), seed=3)
+        tr.fit(_dataset(32), epochs=1, steps_per_epoch=4, verbose=0)
+        return jax.device_get(tr.state.params)
+
+    a, b = run(), run()
+    for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+        np.testing.assert_array_equal(x, y)
