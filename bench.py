@@ -1,0 +1,118 @@
+"""Headline benchmark: ResNet-50 training-step throughput (images/sec/chip).
+
+The reference publishes no numbers (BASELINE.md); the driver-set north star
+is >=70% of the MLPerf-reference ResNet-50 throughput per chip
+(`BASELINE.json`). This bench measures the full jitted training step —
+forward + backward + Adam update, bfloat16 compute, batch-norm in training
+mode — on one chip with a device-resident batch, which is the per-chip
+number the data-parallel strategies multiply out (gradient all-reduce is
+the only addition at scale and rides ICI).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Env overrides: PDDL_BENCH_BATCH (default 256), PDDL_BENCH_STEPS (default 30),
+PDDL_BENCH_IMAGE (default 224).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import optax
+
+# "MLPerf reference" per-chip throughput assumed for vs_baseline scaling:
+# ~3000 images/sec/chip for ResNet-50 on a current TPU chip; the north-star
+# target is 70% of that (BASELINE.json). vs_baseline = value / (0.7 * 3000).
+MLPERF_REFERENCE_IMAGES_PER_SEC_PER_CHIP = 3000.0
+BASELINE_TARGET = 0.7 * MLPERF_REFERENCE_IMAGES_PER_SEC_PER_CHIP
+
+
+def main() -> None:
+    batch = int(os.environ.get("PDDL_BENCH_BATCH", "256"))
+    steps = int(os.environ.get("PDDL_BENCH_STEPS", "30"))
+    image = int(os.environ.get("PDDL_BENCH_IMAGE", "224"))
+
+    from pddl_tpu.models.resnet import ResNet50
+    from pddl_tpu.train.state import TrainState
+
+    device = jax.devices()[0]
+    print(f"bench: device={device}, batch={batch}, image={image}, steps={steps}",
+          file=sys.stderr)
+
+    model = ResNet50(num_classes=1000, dtype=jnp.bfloat16)
+    tx = optax.adam(1e-3)
+    rng = jax.random.key(0)
+
+    images = jax.device_put(
+        jax.random.normal(rng, (batch, image, image, 3), jnp.float32), device
+    )
+    labels = jax.device_put(
+        jax.random.randint(jax.random.key(1), (batch,), 0, 1000), device
+    )
+
+    def init(rng):
+        variables = model.init(rng, images[:1], train=False)
+        return TrainState(
+            step=jnp.zeros((), jnp.int32),
+            params=variables["params"],
+            batch_stats=variables.get("batch_stats", {}),
+            opt_state=tx.init(variables["params"]),
+        )
+
+    t0 = time.perf_counter()
+    state = jax.jit(init)(rng)
+    jax.block_until_ready(state)
+    print(f"bench: init {time.perf_counter() - t0:.1f}s", file=sys.stderr)
+
+    def train_step(state, images, labels):
+        def loss_of(params):
+            logits, updates = model.apply(
+                {"params": params, "batch_stats": state.batch_stats},
+                images, train=True, mutable=["batch_stats"],
+            )
+            logp = jax.nn.log_softmax(logits)
+            loss = -jnp.mean(jnp.take_along_axis(logp, labels[:, None], axis=1))
+            return loss, updates["batch_stats"]
+
+        (loss, batch_stats), grads = jax.value_and_grad(loss_of, has_aux=True)(
+            state.params
+        )
+        new_state = state.apply_gradients(tx, grads, batch_stats)
+        return new_state, loss
+
+    step = jax.jit(train_step, donate_argnums=(0,))
+
+    t0 = time.perf_counter()
+    state, loss = step(state, images, labels)
+    # Sync via scalar fetch: under the axon tunnel block_until_ready can
+    # return before execution finishes; float(loss) cannot.
+    float(loss)
+    print(f"bench: compile+first step {time.perf_counter() - t0:.1f}s", file=sys.stderr)
+
+    for _ in range(3):  # warmup
+        state, loss = step(state, images, labels)
+    float(loss)
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, loss = step(state, images, labels)
+    loss = float(loss)
+    dt = time.perf_counter() - t0
+
+    images_per_sec = batch * steps / dt
+    print(f"bench: {dt:.3f}s for {steps} steps, loss={loss:.3f}", file=sys.stderr)
+    print(json.dumps({
+        "metric": "resnet50_train_images_per_sec_per_chip",
+        "value": round(images_per_sec, 2),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(images_per_sec / BASELINE_TARGET, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -108,3 +108,26 @@ def standard_augment(
         return x
 
     return _augment
+
+
+def standard_eval_transform(
+    crop: Optional[int] = 224,
+    rescale_factor: Optional[float] = 1.0 / 255,
+) -> Callable[[jnp.ndarray], jnp.ndarray]:
+    """Deterministic eval/predict counterpart of :func:`standard_augment`.
+
+    In the reference, ``Rescaling`` runs at inference too and ``RandomCrop``
+    center-crops when not training (Keras preprocessing-layer semantics), so
+    evaluation sees the same input distribution as training. This returns
+    that deterministic pipeline: rescale + center crop/pad — pass it as
+    ``Trainer(eval_transform=...)`` whenever ``augment`` is set.
+    """
+
+    def _transform(x: jnp.ndarray) -> jnp.ndarray:
+        if rescale_factor is not None:
+            x = rescale(x, rescale_factor)
+        if crop is not None:
+            x = center_crop_or_pad(x, crop, crop)
+        return x
+
+    return _transform

@@ -62,14 +62,17 @@ class Strategy:
         return per_replica_batch * self.num_replicas_in_sync
 
     def scale_learning_rate(self, base_lr: float) -> float:
-        """Linear LR scaling with replica count (Horovod's ``0.1 * size``,
-        ``imagenet-resnet50-hvd.py:99``). Identity by default; DP strategies
-        may override or users opt in explicitly."""
-        return base_lr
+        """Linear LR scaling rule: ``base_lr * replicas`` (Horovod's
+        ``0.1 * size``, ``imagenet-resnet50-hvd.py:99``). Opt-in — used by
+        the hvd compat shim and config presets that mirror the reference's
+        Horovod script; the other reference scripts never scale LR."""
+        return base_lr * self.num_replicas_in_sync
 
     # -- sharding rules ----------------------------------------------------
     def batch_sharding(self) -> NamedSharding:
-        return NamedSharding(self.mesh, PartitionSpec(DATA_AXIS))
+        from pddl_tpu.core.sharding import batch_sharding
+
+        return batch_sharding(self.mesh, DATA_AXIS)
 
     def state_sharding(self, state: PyTree) -> PyTree:
         """Sharding for the TrainState: replicated by default (mirrored

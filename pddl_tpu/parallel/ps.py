@@ -19,6 +19,7 @@ synchronous, which strictly strengthens the reference's consistency model.
 
 from __future__ import annotations
 
+import logging
 from typing import Any, Optional
 
 import jax
@@ -28,6 +29,8 @@ from pddl_tpu.core import dist
 from pddl_tpu.core.mesh import DATA_AXIS, MeshConfig, build_mesh
 from pddl_tpu.core.sharding import MinSizePartitioner
 from pddl_tpu.parallel.base import Strategy, register_strategy
+
+log = logging.getLogger(__name__)
 
 PyTree = Any
 
@@ -77,12 +80,17 @@ class ParameterServerStrategy(Strategy):
         mesh = self.mesh
         part = self.partitioner
         repl = NamedSharding(mesh, PartitionSpec())
+        axis_size = mesh.shape[DATA_AXIS]
+        capped = [0]  # leaves TF would shard but XLA's uniform tiling can't
 
         def shard_leaf(leaf):
             if not hasattr(leaf, "shape") or not hasattr(leaf, "dtype"):
                 return repl
+            n = part.num_shards(tuple(leaf.shape), leaf.dtype, axis_size)
+            if 1 < n < axis_size:
+                capped[0] += 1
             return NamedSharding(
-                mesh, part.spec(tuple(leaf.shape), leaf.dtype, mesh.shape[DATA_AXIS])
+                mesh, part.spec(tuple(leaf.shape), leaf.dtype, axis_size)
             )
 
         params_sh = jax.tree.map(shard_leaf, state.params)
@@ -90,6 +98,15 @@ class ParameterServerStrategy(Strategy):
             opt_sh = jax.tree.map(shard_leaf, state.opt_state)
         else:
             opt_sh = jax.tree.map(lambda _: repl, state.opt_state)
+        if capped[0]:
+            log.warning(
+                "%d variable(s) would shard %s-ways under the reference's "
+                "MinSizePartitioner but stay REPLICATED here: NamedSharding "
+                "tiles uniformly over the full %d-device data axis, and "
+                "num_ps/min_shard_bytes cap the shard count below that. "
+                "Raise num_ps (or lower min_shard_bytes) to shard them.",
+                capped[0], f"<{axis_size}", axis_size,
+            )
         return state.replace(
             step=repl,
             params=params_sh,

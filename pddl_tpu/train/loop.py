@@ -61,11 +61,13 @@ class Trainer:
         strategy: Optional[Strategy] = None,
         seed: int = 0,
         augment: Optional[Callable] = None,  # fn(rng, images) -> images, on-device
+        eval_transform: Optional[Callable] = None,  # fn(images) -> images, deterministic
         donate_state: bool = True,
     ):
         self.model = model
         self.strategy = strategy or SingleDeviceStrategy()
         self.tx = make_optimizer(optimizer, learning_rate)
+        self.eval_transform = eval_transform
         self.loss_fn = metrics_lib.resolve_loss(loss)
         self.metric_fns = dict(metrics_lib.resolve_metric(m) for m in metrics)
         self.seed = seed
@@ -154,6 +156,8 @@ class Trainer:
 
         def eval_step(state: TrainState, batch):
             images, labels = batch["image"], batch["label"]
+            if self.eval_transform is not None:
+                images = self.eval_transform(images)
             (logits, _) = self._apply(state.params, state.batch_stats, images, train=False)
             logs = {"loss": self.loss_fn(logits, labels)}
             for name, fn in self.metric_fns.items():
@@ -185,6 +189,11 @@ class Trainer:
         verbose: int = 2,  # reference uses verbose=2 (imagenet-resnet50.py:67)
         initial_epoch: int = 0,
     ) -> History:
+        if validation_data is not None and isinstance(validation_data, Iterator):
+            raise ValueError(
+                "validation_data is a one-shot iterator; fit() evaluates it "
+                "once per epoch, so pass a re-iterable dataset"
+            )
         self.steps_per_epoch = steps_per_epoch
         history = History()
         self.stop_training = False
@@ -298,13 +307,15 @@ class Trainer:
         if self.state is None:
             raise RuntimeError("call fit() or init_state() before predict()")
         x = self.strategy.distribute_batch({"image": np.asarray(images)})["image"]
+        if self.eval_transform is not None:
+            x = self.eval_transform(x)
         logits, _ = self._apply(self.state.params, self.state.batch_stats, x, train=False)
         return np.asarray(jax.device_get(logits))
 
     # --------------------------------------------------------------- helpers
     def _ensure_iterator(self, data, fresh: bool = False) -> Iterator:
-        # A bare iterator cannot be restarted; when `fresh` matters the call
-        # sites check Iterator-ness themselves and raise a clear error.
+        # A bare iterator cannot be restarted; fit() rejects one-shot
+        # iterators for train (multi-epoch) and validation data up front.
         if isinstance(data, Iterator):
             return data
         return iter(data)

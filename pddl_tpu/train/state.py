@@ -75,8 +75,15 @@ def make_optimizer(
         factory = _OPTIMIZERS[name.lower()]
     except KeyError:
         raise ValueError(f"unknown optimizer {name!r}; known: {sorted(_OPTIMIZERS)}") from None
-    if weight_decay is not None and name.lower() in ("adamw", "lamb"):
-        kwargs["weight_decay"] = weight_decay
+    if weight_decay is not None:
+        if name.lower() in ("adamw", "lamb"):
+            kwargs["weight_decay"] = weight_decay
+        else:
+            raise ValueError(
+                f"weight_decay is not supported for {name!r} (it would be "
+                "silently ignored); use 'adamw'/'lamb', or pass a prebuilt "
+                "optax.GradientTransformation with optax.add_decayed_weights"
+            )
     tx = optax.inject_hyperparams(factory)(learning_rate=learning_rate, **kwargs)
     if grad_clip_norm is not None:
         tx = optax.chain(optax.clip_by_global_norm(grad_clip_norm), tx)

@@ -2,6 +2,7 @@
 
 import jax
 import numpy as np
+import pytest
 from jax.sharding import PartitionSpec as P
 
 from pddl_tpu.data.synthetic import SyntheticImageClassification
@@ -106,3 +107,17 @@ def test_distribute_batch_global_shape(mesh8):
     assert out["image"].sharding.spec == P("data")
     # each device holds 4 samples
     assert out["image"].addressable_shards[0].data.shape == (4, 8, 8, 3)
+
+
+def test_weight_decay_unsupported_optimizer_raises():
+    from pddl_tpu.train.state import make_optimizer
+
+    with pytest.raises(ValueError, match="weight_decay"):
+        make_optimizer("adam", 1e-3, weight_decay=1e-4)
+    make_optimizer("adamw", 1e-3, weight_decay=1e-4)  # supported: no raise
+
+
+def test_scale_learning_rate_linear_rule():
+    strat = MirroredStrategy()
+    # Horovod's 0.1 * size rule (imagenet-resnet50-hvd.py:99).
+    assert strat.scale_learning_rate(0.1) == pytest.approx(0.1 * 8)
