@@ -11,4 +11,18 @@ rank sharding, none).
 
 from pddl_tpu.data.synthetic import SyntheticImageClassification
 
-__all__ = ["SyntheticImageClassification"]
+__all__ = [
+    "SyntheticImageClassification",
+    "ImageNetConfig",
+    "ImageNetDataset",
+    "load_imagenet",
+]
+
+
+def __getattr__(name):
+    # Lazy: the ImageNet pipeline pulls in TensorFlow only when used.
+    if name in ("ImageNetConfig", "ImageNetDataset", "load_imagenet"):
+        from pddl_tpu.data import imagenet
+
+        return getattr(imagenet, name)
+    raise AttributeError(name)

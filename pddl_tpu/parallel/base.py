@@ -63,9 +63,12 @@ class Strategy:
 
     def scale_learning_rate(self, base_lr: float) -> float:
         """Linear LR scaling rule: ``base_lr * replicas`` (Horovod's
-        ``0.1 * size``, ``imagenet-resnet50-hvd.py:99``). Opt-in — used by
-        the hvd compat shim and config presets that mirror the reference's
-        Horovod script; the other reference scripts never scale LR."""
+        ``0.1 * size``, ``imagenet-resnet50-hvd.py:99``).
+
+        Never applied automatically — the Trainer uses the LR it is given.
+        Calling this is the opt-in: the hvd compat shim and the hvd config
+        preset do; presets mirroring the other reference scripts must not
+        (those scripts never scale LR)."""
         return base_lr * self.num_replicas_in_sync
 
     # -- sharding rules ----------------------------------------------------

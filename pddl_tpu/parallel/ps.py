@@ -87,11 +87,13 @@ class ParameterServerStrategy(Strategy):
             if not hasattr(leaf, "shape") or not hasattr(leaf, "dtype"):
                 return repl
             n = part.num_shards(tuple(leaf.shape), leaf.dtype, axis_size)
-            if 1 < n < axis_size:
+            spec = part.spec(tuple(leaf.shape), leaf.dtype, axis_size)
+            # TF's partitioner would split this leaf (n > 1) but uniform
+            # XLA tiling can't (shard count capped below the axis size, or
+            # no dimension divides the axis evenly) — it stays replicated.
+            if n > 1 and spec == PartitionSpec():
                 capped[0] += 1
-            return NamedSharding(
-                mesh, part.spec(tuple(leaf.shape), leaf.dtype, axis_size)
-            )
+            return NamedSharding(mesh, spec)
 
         params_sh = jax.tree.map(shard_leaf, state.params)
         if self.shard_optimizer_state:

@@ -1,0 +1,153 @@
+"""Data-pipeline tests: source formats + the reference's three sharding schemes.
+
+The reference's shard semantics under test (SURVEY.md §0, §2a C7):
+- auto-shard DATA: per-example sharding (`imagenet-resnet50-multiworkers.py:66-69`)
+- Horovod: per-*batch* sharding after batching (`imagenet-resnet50-hvd.py:77-81`)
+- single/mirrored: no sharding
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+tf = pytest.importorskip("tensorflow")
+
+from pddl_tpu.data.imagenet import ImageNetConfig, ImageNetDataset, load_imagenet
+
+
+def _write_image_folder(root, split="train", classes=4, per_class=6, size=10):
+    """Tiny image-folder tree; pixel values encode the class id."""
+    rng = np.random.default_rng(0)
+    for c in range(classes):
+        d = os.path.join(root, split, f"class_{c:02d}")
+        os.makedirs(d, exist_ok=True)
+        for i in range(per_class):
+            img = np.full((size, size, 3), c * 10, np.uint8)
+            img[0, 0] = rng.integers(0, 255, 3)  # break exact duplicates
+            png = tf.io.encode_png(tf.constant(img)).numpy()
+            with open(os.path.join(d, f"img_{i}.png"), "wb") as f:
+                f.write(png)
+
+
+def _write_tfrecords(root, split="train", n=24, size=10, shards=3):
+    os.makedirs(root, exist_ok=True)
+    idx = 0
+    for s in range(shards):
+        path = os.path.join(root, f"{split}-{s:05d}-of-{shards:05d}")
+        with tf.io.TFRecordWriter(path) as w:
+            for _ in range(n // shards):
+                label = idx % 7
+                img = np.full((size, size, 3), label, np.uint8)
+                png = tf.io.encode_png(tf.constant(img)).numpy()
+                ex = tf.train.Example(features=tf.train.Features(feature={
+                    "image/encoded": tf.train.Feature(
+                        bytes_list=tf.train.BytesList(value=[png])),
+                    "image/class/label": tf.train.Feature(
+                        int64_list=tf.train.Int64List(value=[label])),
+                }))
+                w.write(ex.SerializeToString())
+                idx += 1
+
+
+def test_image_folder_pipeline(tmp_path):
+    _write_image_folder(tmp_path, classes=3, per_class=4, size=10)
+    ds = ImageNetDataset(ImageNetConfig(
+        data_dir=str(tmp_path), split="train", global_batch_size=4,
+        image_size=8, shuffle=False,
+    ))
+    batches = list(ds)
+    assert len(batches) == 3  # 12 images / 4
+    b = batches[0]
+    assert b["image"].shape == (4, 8, 8, 3)
+    assert b["image"].dtype == np.float32
+    assert b["label"].dtype == np.int32
+    # Labels are class-dir indices; pixel value 10*c must match label c
+    # (center pixel survives the central crop).
+    for img, lbl in zip(b["image"], b["label"]):
+        assert img[4, 4, 0] == pytest.approx(10.0 * lbl)
+
+
+def test_tfrecord_pipeline(tmp_path):
+    _write_tfrecords(tmp_path, n=24, shards=3)
+    ds = ImageNetDataset(ImageNetConfig(
+        data_dir=str(tmp_path), split="train", global_batch_size=6,
+        image_size=8, shuffle=False,
+    ))
+    batches = list(ds)
+    assert len(batches) == 4
+    for b in batches:
+        assert b["image"].shape == (6, 8, 8, 3)
+        # pixel encodes label
+        np.testing.assert_allclose(b["image"][:, 4, 4, 0], b["label"])
+
+
+def test_data_sharding_disjoint_and_complete(tmp_path):
+    """DATA auto-shard analogue: per-example, disjoint, smaller local batch."""
+    _write_image_folder(tmp_path, classes=4, per_class=4, size=10)
+
+    def labels_for(proc):
+        ds = ImageNetDataset(ImageNetConfig(
+            data_dir=str(tmp_path), global_batch_size=8, image_size=8,
+            shuffle=False, shard="data", process_index=proc, process_count=2,
+        ))
+        out = []
+        for b in ds:
+            assert b["label"].shape == (4,)  # local = global/2
+            out.extend(b["image"][:, 4, 4, 0].tolist())
+        return out
+
+    a, b = labels_for(0), labels_for(1)
+    assert len(a) == len(b) == 8
+    # Round-robin example sharding: together they cover all 16 images.
+    assert sorted(a + b) == sorted(
+        [10.0 * c for c in range(4) for _ in range(4)]
+    )
+
+
+def test_batch_sharding_keeps_full_batches(tmp_path):
+    """Horovod scheme: shard after batch — full-size batches, every n-th."""
+    _write_tfrecords(tmp_path, n=24, shards=3)
+
+    def batches_for(proc):
+        ds = ImageNetDataset(ImageNetConfig(
+            data_dir=str(tmp_path), global_batch_size=6, image_size=8,
+            shuffle=False, shard="batch", process_index=proc, process_count=2,
+        ))
+        return list(ds)
+
+    a, b = batches_for(0), batches_for(1)
+    assert len(a) == 2 and len(b) == 2  # 4 batches split 2/2
+    for batch in a + b:
+        assert batch["image"].shape[0] == 6  # full batch per rank
+    # Ranks see different batches.
+    assert not np.array_equal(a[0]["label"], b[0]["label"])
+
+
+def test_validation_split_deterministic(tmp_path):
+    _write_image_folder(tmp_path, split="validation", classes=2, per_class=4)
+    train_dir = tmp_path  # train absent; only build val
+    train, val = load_imagenet(str(train_dir), train_batch_size=4,
+                               image_size=8, shard="none")
+    v1 = [b["label"] for b in val]
+    v2 = [b["label"] for b in val]
+    for x, y in zip(v1, v2):
+        np.testing.assert_array_equal(x, y)
+
+
+def test_missing_source_raises(tmp_path):
+    ds = ImageNetDataset(ImageNetConfig(data_dir=str(tmp_path / "nope")))
+    with pytest.raises(FileNotFoundError):
+        ds.build()
+
+
+def test_repeat_stream(tmp_path):
+    """PS-style .repeat()ed stream (`imagenet-resnet50-ps.py:118-119`)."""
+    _write_tfrecords(tmp_path, n=12, shards=2)
+    ds = ImageNetDataset(ImageNetConfig(
+        data_dir=str(tmp_path), global_batch_size=4, image_size=8,
+        shuffle=False, repeat=True,
+    ))
+    it = iter(ds)
+    got = [next(it) for _ in range(10)]  # > one epoch (3 batches)
+    assert len(got) == 10
