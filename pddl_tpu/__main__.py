@@ -1,0 +1,7 @@
+"""``python -m pddl_tpu`` — the CLI entry (see :mod:`pddl_tpu.run`)."""
+
+import sys
+
+from pddl_tpu.run import main
+
+sys.exit(main())

@@ -1,0 +1,35 @@
+"""Checkpoint / restore / pretrained-weight import.
+
+The reference's entire persistence surface is a final
+``model.save('ImageNet-<name>-reuse.h5')`` (``/root/reference/
+imagenet-resnet50.py:69-72``; rank-0-gated and str+int-broken in the Horovod
+script, ``imagenet-resnet50-hvd.py:125-129``) plus pretrained-weight loading
+via ``weights='imagenet'`` (``imagenet-pretrained-resnet50.py:56``). This
+package provides that and the mid-training story the reference lacks
+(SURVEY.md §5 "Checkpoint / resume"):
+
+- :class:`Checkpointer` — Orbax-backed sharded, optionally async
+  save/restore of the full :class:`~pddl_tpu.train.state.TrainState`
+  (params, BN stats, optimizer state, step) with epoch metadata; restore
+  places shards directly on the mesh.
+- :class:`ModelCheckpoint` / :class:`BackupAndRestore` — Keras-style
+  callbacks for periodic saving and crash-resume.
+- :func:`load_keras_resnet50_h5` — imports ``tf.keras.applications``
+  ResNet-50 ``.h5`` weights into the Flax model for the pretrained mode.
+"""
+
+from pddl_tpu.ckpt.checkpoint import (
+    BackupAndRestore,
+    Checkpointer,
+    ModelCheckpoint,
+    latest_epoch,
+)
+from pddl_tpu.ckpt.keras_import import load_keras_resnet50_h5
+
+__all__ = [
+    "Checkpointer",
+    "ModelCheckpoint",
+    "BackupAndRestore",
+    "latest_epoch",
+    "load_keras_resnet50_h5",
+]

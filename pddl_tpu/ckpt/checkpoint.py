@@ -1,0 +1,209 @@
+"""Orbax-backed checkpointing: sharded, async-capable, resume-aware.
+
+TPU-native upgrade over the reference's final-save-only persistence
+(``/root/reference/imagenet-resnet50.py:69-72``): every host writes its own
+param/optimizer shards in parallel (no gather to host 0 — the reference's
+``model.save`` funnels everything through one process), restore places
+shards directly onto the mesh via the state's ``NamedSharding``s, and saves
+can overlap the next training step (``async_save``).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional
+
+import jax
+import numpy as np
+
+from pddl_tpu.train.callbacks import Callback
+
+PyTree = Any
+
+
+def _ocp():
+    import orbax.checkpoint as ocp  # noqa: PLC0415
+
+    return ocp
+
+
+class Checkpointer:
+    """Save/restore the full TrainState with step-numbered retention.
+
+    >>> ckpt = Checkpointer("/tmp/run1", max_to_keep=3)
+    >>> ckpt.save(state, epoch=4)
+    >>> state = ckpt.restore(trainer.state)   # shard-aware in-place layout
+    """
+
+    def __init__(self, directory: str, max_to_keep: Optional[int] = 5,
+                 async_save: bool = True):
+        ocp = _ocp()
+        self.directory = os.path.abspath(directory)
+        self._mngr = ocp.CheckpointManager(
+            self.directory,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep,
+                enable_async_checkpointing=async_save,
+            ),
+        )
+
+    # ---------------------------------------------------------------- save
+    def save(self, state: PyTree, epoch: Optional[int] = None,
+             metrics: Optional[Dict[str, float]] = None, force: bool = False) -> int:
+        """Save at the state's step; records epoch/metrics as metadata."""
+        ocp = _ocp()
+        step = int(jax.device_get(state.step))
+        meta = {"epoch": epoch, "metrics": metrics or {}}
+        self._mngr.save(
+            step,
+            args=ocp.args.Composite(
+                state=ocp.args.StandardSave(state),
+                meta=ocp.args.JsonSave(meta),
+            ),
+            force=force,
+        )
+        return step
+
+    def wait(self) -> None:
+        """Block until any in-flight async save completes."""
+        self._mngr.wait_until_finished()
+
+    # ------------------------------------------------------------- restore
+    def latest_step(self) -> Optional[int]:
+        return self._mngr.latest_step()
+
+    def restore(self, target: PyTree, step: Optional[int] = None) -> PyTree:
+        """Restore into the layout of ``target`` (a live, correctly-sharded
+        TrainState — e.g. ``trainer.state`` right after ``init_state``).
+
+        Each leaf is restored with the sharding ``target``'s leaf carries, so
+        PS/ZeRO-sharded states come back sharded without a replicated
+        staging copy.
+        """
+        ocp = _ocp()
+        step = step if step is not None else self.latest_step()
+        if step is None:
+            raise FileNotFoundError(f"no checkpoint under {self.directory}")
+        abstract = jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding)
+            if isinstance(x, jax.Array) else x,
+            target,
+        )
+        out = self._mngr.restore(
+            step,
+            args=ocp.args.Composite(state=ocp.args.StandardRestore(abstract)),
+        )
+        return out["state"]
+
+    def metadata(self, step: Optional[int] = None) -> Dict[str, Any]:
+        ocp = _ocp()
+        step = step if step is not None else self.latest_step()
+        if step is None:
+            return {}
+        out = self._mngr.restore(
+            step, args=ocp.args.Composite(meta=ocp.args.JsonRestore())
+        )
+        return out["meta"] or {}
+
+    def close(self) -> None:
+        self._mngr.close()
+
+
+def latest_epoch(directory: str) -> Optional[int]:
+    """Epoch recorded in the newest checkpoint under ``directory`` (for
+    computing ``initial_epoch`` on resume), or None if no checkpoint."""
+    if not os.path.isdir(directory):
+        return None
+    ckpt = Checkpointer(directory, async_save=False)
+    try:
+        if ckpt.latest_step() is None:
+            return None
+        return ckpt.metadata().get("epoch")
+    finally:
+        ckpt.close()
+
+
+class ModelCheckpoint(Callback):
+    """Periodic epoch-end checkpointing (the Keras ``ModelCheckpoint``
+    capability the reference never used; its only save is train-end).
+
+    ``save_best_only`` monitors a metric like the reference's callbacks
+    monitor ``val_loss`` (``imagenet-resnet50.py:64-65``).
+    """
+
+    def __init__(self, directory: str, monitor: str = "val_loss",
+                 save_best_only: bool = False, mode: str = "min",
+                 every_n_epochs: int = 1, max_to_keep: Optional[int] = 5,
+                 async_save: bool = True):
+        self.ckpt = Checkpointer(directory, max_to_keep=max_to_keep,
+                                 async_save=async_save)
+        self.monitor = monitor
+        self.save_best_only = save_best_only
+        self.mode = mode
+        self.every_n_epochs = every_n_epochs
+        self.best = float("inf") if mode == "min" else -float("inf")
+
+    def _improved(self, current: float) -> bool:
+        return current < self.best if self.mode == "min" else current > self.best
+
+    def on_epoch_end(self, epoch, state, logs):
+        if (epoch + 1) % self.every_n_epochs:
+            return None
+        if self.save_best_only:
+            current = logs.get(self.monitor)
+            if current is None or not self._improved(current):
+                return None
+            self.best = current
+        self.ckpt.save(state, epoch=epoch, metrics=logs)
+        return None
+
+    def on_train_end(self, state, logs):
+        self.ckpt.wait()
+        return None
+
+
+class BackupAndRestore(Callback):
+    """Crash-resume: restore the newest checkpoint at train start and keep
+    a rolling backup every epoch — fault tolerance the reference almost
+    entirely lacks (SURVEY.md §5 "Failure detection": its only crumbs are
+    ``GRPC_FAIL_FAST`` and the Horovod re-broadcast comment,
+    ``imagenet-resnet50-hvd.py:108-111``).
+
+    Use with ``initial_epoch=latest_epoch(dir) + 1`` (or the CLI runner's
+    ``--resume``, which wires both ends).
+    """
+
+    def __init__(self, directory: str, async_save: bool = True):
+        self.ckpt = Checkpointer(directory, max_to_keep=1, async_save=async_save)
+
+    def on_train_begin(self, state):
+        if self.ckpt.latest_step() is None:
+            return None
+        return self.ckpt.restore(state)
+
+    def on_epoch_end(self, epoch, state, logs):
+        self.ckpt.save(state, epoch=epoch, metrics=logs)
+        return None
+
+    def on_train_end(self, state, logs):
+        self.ckpt.wait()
+        return None
+
+
+def save_params_npz(path: str, params: PyTree) -> None:
+    """Small, dependency-light final export (the ``model.save('...h5')``
+    moment, ``imagenet-resnet50.py:69-72``): flat ``{path: array}`` npz,
+    coordinator-only under multi-host."""
+    from pddl_tpu.core import dist
+
+    if not dist.is_coordinator():
+        return
+    flat = {}
+    for keypath, leaf in jax.tree_util.tree_flatten_with_path(params)[0]:
+        name = "/".join(str(getattr(k, "key", k)) for k in keypath)
+        flat[name] = np.asarray(jax.device_get(leaf))
+    # Write through a file object: np.savez(path) silently appends ".npz"
+    # to extensionless paths, landing the file somewhere the caller's
+    # save_path doesn't point.
+    with open(path, "wb") as f:
+        np.savez(f, **flat)

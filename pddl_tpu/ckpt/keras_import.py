@@ -1,0 +1,234 @@
+"""Import ``tf.keras.applications`` ResNet-50 ``.h5`` weights into Flax.
+
+Pretrained-mode parity: the reference's fine-tune scripts build the backbone
+with ``weights='imagenet'`` (``/root/reference/imagenet-pretrained-resnet50.py
+:56``), i.e. Keras downloads a ``.h5`` weight file and loads it by layer
+name. This module performs the same load against a *local* ``.h5`` into the
+:class:`pddl_tpu.models.resnet.ResNet` variable tree (which mirrors the
+Keras v1 architecture exactly so every tensor maps 1:1 — see
+``models/resnet.py``).
+
+Name mapping (Keras → pddl_tpu), derived from the keras.applications
+``resnet`` layer-naming scheme:
+
+==============================  =================================
+``conv1_conv`` / ``conv1_bn``   ``stem_conv`` / ``stem_bn``
+``conv{s}_block{b}_0_conv/bn``  ``stage{s-1}_block{b}/shortcut_conv|bn``
+``conv{s}_block{b}_{i}_conv``   ``stage{s-1}_block{b}/conv{i}``
+``conv{s}_block{b}_{i}_bn``     ``stage{s-1}_block{b}/bn{i}``
+``predictions`` (``probs``)     ``head``
+==============================  =================================
+
+BN weight translation: ``gamma→scale``, ``beta→bias`` (params);
+``moving_mean→mean``, ``moving_variance→var`` (batch_stats). Conv/Dense
+kernels share the (kh, kw, in, out) / (in, out) layouts between Keras and
+Flax, so no transposition is needed.
+
+Both weight-file flavors are handled: weights-only ``.h5`` (layer groups at
+root) and full ``model.save`` archives (layers under ``model_weights``) —
+the latter is what the reference's own final save produces
+(``imagenet-resnet50.py:69-72``), so weights round-trip with Keras.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+PyTree = Any
+
+# Keras BN weight name → (collection, our leaf name)
+_BN_WEIGHTS = {
+    "gamma": ("params", "scale"),
+    "beta": ("params", "bias"),
+    "moving_mean": ("batch_stats", "mean"),
+    "moving_variance": ("batch_stats", "var"),
+}
+
+
+def keras_layer_map(
+    stage_sizes: Sequence[int] = (3, 4, 6, 3),
+) -> Dict[str, Tuple[str, Tuple[str, ...]]]:
+    """Keras layer name → (kind, module path) for a ResNet-v1 topology."""
+    m: Dict[str, Tuple[str, Tuple[str, ...]]] = {
+        "conv1_conv": ("conv", ("stem_conv",)),
+        "conv1_bn": ("bn", ("stem_bn",)),
+    }
+    for s, n_blocks in enumerate(stage_sizes):
+        for b in range(n_blocks):
+            keras_pre = f"conv{s + 2}_block{b + 1}"
+            ours = f"stage{s + 1}_block{b + 1}"
+            if b == 0:
+                m[f"{keras_pre}_0_conv"] = ("conv", (ours, "shortcut_conv"))
+                m[f"{keras_pre}_0_bn"] = ("bn", (ours, "shortcut_bn"))
+            for i in (1, 2, 3):
+                m[f"{keras_pre}_{i}_conv"] = ("conv", (ours, f"conv{i}"))
+                m[f"{keras_pre}_{i}_bn"] = ("bn", (ours, f"bn{i}"))
+    # include_top head: named `predictions` in keras.applications, `probs`
+    # in some exported variants.
+    m["predictions"] = ("dense", ("head",))
+    m["probs"] = ("dense", ("head",))
+    return m
+
+
+def _collect_datasets(group) -> Dict[str, np.ndarray]:
+    """All datasets under an h5 group, keyed by base name ('kernel',...)."""
+    out: Dict[str, np.ndarray] = {}
+
+    def visit(name, obj):
+        import h5py  # noqa: PLC0415
+
+        if isinstance(obj, h5py.Dataset):
+            base = name.split("/")[-1].split(":")[0]
+            out[base] = np.asarray(obj)
+
+    group.visititems(visit)
+    return out
+
+
+def _set_leaf(tree: dict, path: Tuple[str, ...], leaf_name: str,
+              value: np.ndarray, source: str) -> None:
+    node = tree
+    for key in path:
+        if key not in node:
+            raise KeyError(
+                f"importing {source}: module path {'/'.join(path)} not in "
+                f"model tree (have: {sorted(node)})"
+            )
+        node = node[key]
+    if leaf_name not in node:
+        raise KeyError(
+            f"importing {source}: weight {leaf_name!r} not in "
+            f"{'/'.join(path)} (have: {sorted(node)})"
+        )
+    old = node[leaf_name]
+    if tuple(old.shape) != tuple(value.shape):
+        raise ValueError(
+            f"importing {source} -> {'/'.join(path)}/{leaf_name}: shape "
+            f"{tuple(value.shape)} != model's {tuple(old.shape)} — "
+            "architecture mismatch (wrong depth/width or not a v1 ResNet?)"
+        )
+    node[leaf_name] = value.astype(np.asarray(old).dtype)
+
+
+def load_keras_resnet50_h5(
+    path: str,
+    variables: PyTree,
+    stage_sizes: Sequence[int] = (3, 4, 6, 3),
+    require_head: Optional[bool] = None,
+) -> PyTree:
+    """Load Keras ResNet ``.h5`` weights into a model variable tree.
+
+    Args:
+      path: ``.h5`` file — either a keras.applications weight file (with or
+        without top) or a full Keras ``model.save`` archive.
+      variables: the tree from ``model.init`` (``{"params", "batch_stats"}``);
+        returned updated, input untouched.
+      stage_sizes: block counts, default ResNet-50. Use the model family's
+        sizes for 101/152 imports.
+      require_head: True → fail if the file has no classifier head; None →
+        import it when present (``include_top`` behavior), skip otherwise
+        (the reference uses ``include_top=False`` + its own head,
+        ``imagenet-resnet50.py:56-60``).
+
+    Returns a new variables tree with every matched tensor replaced.
+    """
+    try:
+        import h5py  # noqa: PLC0415
+    except ImportError as e:  # pragma: no cover
+        raise ImportError("load_keras_resnet50_h5 requires h5py") from e
+
+    new_vars = {
+        "params": copy.deepcopy(_as_mutable(variables["params"])),
+        "batch_stats": copy.deepcopy(_as_mutable(variables.get("batch_stats", {}))),
+    }
+    layer_map = keras_layer_map(stage_sizes)
+    imported, saw_head = [], False
+
+    with h5py.File(path, "r") as f:
+        root = f["model_weights"] if "model_weights" in f else f
+        for layer_name in root:
+            if layer_name not in layer_map:
+                continue
+            kind, module_path = layer_map[layer_name]
+            weights = _collect_datasets(root[layer_name])
+            if not weights:
+                continue
+            if kind in ("conv", "dense"):
+                _set_leaf(new_vars["params"], module_path, "kernel",
+                          weights["kernel"], layer_name)
+                if "bias" in weights:
+                    _set_leaf(new_vars["params"], module_path, "bias",
+                              weights["bias"], layer_name)
+                saw_head |= kind == "dense"
+            else:  # bn
+                for keras_name, (coll, ours) in _BN_WEIGHTS.items():
+                    if keras_name in weights:
+                        _set_leaf(new_vars[coll], module_path, ours,
+                                  weights[keras_name], layer_name)
+            imported.append(layer_name)
+
+    expected = len(keras_layer_map(stage_sizes)) - 2  # head counts once
+    if len(imported) < expected - (0 if saw_head else 1):
+        missing = sorted(set(layer_map) - set(imported) - {"predictions", "probs"})
+        raise ValueError(
+            f"{path}: only {len(imported)} of ~{expected} layers matched; "
+            f"first missing: {missing[:5]} — is this a v1 ResNet-{sum(s * 3 for s in stage_sizes) + 2} "
+            "weight file?"
+        )
+    if require_head and not saw_head:
+        raise ValueError(f"{path} has no classifier head (notop weights)")
+
+    out = dict(variables)
+    out["params"] = new_vars["params"]
+    if new_vars["batch_stats"]:
+        out["batch_stats"] = new_vars["batch_stats"]
+    return out
+
+
+def _as_mutable(tree):
+    """FrozenDict (older flax) → plain nested dict; dicts pass through."""
+    if hasattr(tree, "unfreeze"):
+        return tree.unfreeze()
+    return {k: _as_mutable(v) if isinstance(v, dict) or hasattr(v, "unfreeze")
+            else v for k, v in dict(tree).items()}
+
+
+def export_keras_style_h5(path: str, variables: PyTree,
+                          stage_sizes: Sequence[int] = (3, 4, 6, 3)) -> None:
+    """Write the model tree as a Keras-layout ``.h5`` — the final-save
+    counterpart of the reference's ``model.save('...-reuse.h5')``
+    (``imagenet-resnet50.py:69-72``), loadable back by
+    :func:`load_keras_resnet50_h5` (and name-compatible with Keras)."""
+    import h5py  # noqa: PLC0415
+
+    params = _as_mutable(variables["params"])
+    stats = _as_mutable(variables.get("batch_stats", {}))
+
+    def get(tree, pth):
+        node = tree
+        for k in pth:
+            node = node[k]
+        return node
+
+    with h5py.File(path, "w") as f:
+        for layer_name, (kind, module_path) in keras_layer_map(stage_sizes).items():
+            if layer_name == "probs":  # alias of predictions
+                continue
+            try:
+                node = get(params, module_path)
+            except KeyError:
+                continue
+            g = f.create_group(layer_name).create_group(layer_name)
+            if kind in ("conv", "dense"):
+                g.create_dataset("kernel:0", data=np.asarray(node["kernel"]))
+                if "bias" in node:
+                    g.create_dataset("bias:0", data=np.asarray(node["bias"]))
+            else:
+                g.create_dataset("gamma:0", data=np.asarray(node["scale"]))
+                g.create_dataset("beta:0", data=np.asarray(node["bias"]))
+                stat = get(stats, module_path)
+                g.create_dataset("moving_mean:0", data=np.asarray(stat["mean"]))
+                g.create_dataset("moving_variance:0", data=np.asarray(stat["var"]))

@@ -1,0 +1,7 @@
+"""API-compat shims for the reference's third-party distribution APIs.
+
+Currently: :mod:`pddl_tpu.compat.hvd`, a Horovod-surface shim
+(``import pddl_tpu.compat.hvd as hvd``) covering everything
+``/root/reference/imagenet-resnet50-hvd.py`` uses, with XLA collectives
+instead of MPI/NCCL.
+"""

@@ -1,0 +1,219 @@
+"""Horovod API shim: ``import pddl_tpu.compat.hvd as hvd``.
+
+Covers, symbol for symbol, the Horovod surface the reference's script uses
+(``/root/reference/imagenet-resnet50-hvd.py``) with TPU-native semantics —
+no MPI, no NCCL, no process-per-GPU:
+
+================================  ============================================
+reference call                    shim behavior
+================================  ============================================
+``hvd.init()`` (``:16``)          ``jax.distributed`` bootstrap + global mesh
+``hvd.size()`` (``:99``)          replica count = devices on the data axis
+``hvd.rank()`` (``:28,96,117``)   global index of this host's first replica
+``hvd.local_rank()`` (``:41``)    0 — one process drives all local replicas
+``hvd.DistributedOptimizer``      gradient ``pmean`` (explicit in shard_map
+(``:101``)                        regime; already-global under the Trainer's
+                                  jit-with-shardings regime)
+``BroadcastGlobalVariables-``     host-0 value broadcast via
+``Callback(0)`` (``:111``)        ``multihost_utils`` (replicated-init no-op
+                                  single-host; real sync after restore)
+``MetricAverageCallback``         cross-process metric mean (``:112-113``)
+``LearningRateWarmupCallback``    linear warmup (``:114-115``)
+================================  ============================================
+
+Semantic mapping (documented, not hidden): Horovod runs one *process* per
+accelerator; under SPMD one process drives every local device. The
+"Horovod world" here is the set of data-parallel **replicas** (devices), so
+``size()`` counts devices — which keeps the script-observable arithmetic
+(LR scaling ``0.1*size`` ``:99``, effective global batch ``32*size``)
+identical to Horovod's on the same chip count. Rank-gated side effects
+(logging, saving ``:117-129``) key off ``rank()==0`` ⇔ coordinator host.
+Data sharding is per *process*: use ``num_data_shards()``/``data_shard_index()``
+(the ``.shard(hvd.size(), hvd.rank())`` moment, ``:77-81``, maps to
+per-host, not per-device, sharding — one pipeline feeds all local replicas).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+import numpy as np
+import optax
+
+from pddl_tpu.core import dist
+from pddl_tpu.core.mesh import DATA_AXIS, MeshConfig, build_mesh
+from pddl_tpu.train.callbacks import Callback, LearningRateWarmup
+
+PyTree = Any
+
+_mesh = None
+
+
+def init(coordinator_address: Optional[str] = None,
+         num_processes: Optional[int] = None,
+         process_id: Optional[int] = None) -> None:
+    """``hvd.init()``: multi-host rendezvous + global data mesh."""
+    global _mesh
+    dist.initialize(coordinator_address, num_processes, process_id)
+    _mesh = build_mesh(MeshConfig())
+
+
+def _require_init():
+    if _mesh is None:
+        raise RuntimeError("call hvd.init() first (imagenet-resnet50-hvd.py:16)")
+    return _mesh
+
+
+def is_initialized() -> bool:
+    return _mesh is not None
+
+
+def mesh():
+    return _require_init()
+
+
+def size() -> int:
+    """World size = data-parallel replica count (LR/batch arithmetic parity)."""
+    return int(np.prod(list(_require_init().shape.values())))
+
+
+def rank() -> int:
+    """Global rank of this process's first replica; 0 ⇔ coordinator."""
+    return jax.process_index() * local_size()
+
+
+def local_rank() -> int:
+    """Always 0: one SPMD process drives all local devices (the reference
+    uses this only to pin one GPU per process, ``:36-41`` — moot on TPU)."""
+    return 0
+
+
+def local_size() -> int:
+    m = _require_init()
+    return len([d for d in m.devices.flat if d.process_index == jax.process_index()])
+
+
+def num_data_shards() -> int:
+    """Shard count for input pipelines: per host (process), not per replica."""
+    _require_init()
+    return jax.process_count()
+
+
+def data_shard_index() -> int:
+    _require_init()
+    return jax.process_index()
+
+
+# ------------------------------------------------------------------ comms
+def allreduce(value, average: bool = True):
+    """Cross-process all-reduce of a host value (numpy/scalar/pytree).
+
+    Jit-free utility — the gradient path never calls this (gradients are
+    averaged inside the compiled step); it exists for host-side sums like
+    sample counts or custom metrics.
+    """
+    _require_init()
+    if jax.process_count() == 1:
+        return value
+    from jax.experimental import multihost_utils  # noqa: PLC0415
+
+    def _one(x):
+        gathered = multihost_utils.process_allgather(np.asarray(x))
+        return gathered.mean(axis=0) if average else gathered.sum(axis=0)
+
+    return jax.tree.map(_one, value)
+
+
+def broadcast(value, root_rank: int = 0):
+    """Broadcast host-``root_rank``'s value to every process."""
+    _require_init()
+    if jax.process_count() == 1:
+        return value
+    from jax.experimental import multihost_utils  # noqa: PLC0415
+
+    if root_rank != 0:
+        raise NotImplementedError(
+            "only root_rank=0 broadcast is supported (the reference only "
+            "ever broadcasts from 0, imagenet-resnet50-hvd.py:111)"
+        )
+    return jax.tree.map(
+        lambda x: multihost_utils.broadcast_one_to_all(np.asarray(x)), value
+    )
+
+
+def DistributedOptimizer(optimizer: str | optax.GradientTransformation,
+                         learning_rate: Optional[float] = None,
+                         axis_name: Optional[str] = None,
+                         **kwargs) -> optax.GradientTransformation:
+    """``hvd.DistributedOptimizer`` (``:101``): optimizer whose updates are
+    computed from *globally averaged* gradients.
+
+    - Default (Trainer regime): gradients of a loss over the globally
+      data-sharded batch are already the global average — XLA inserts the
+      all-reduce; the optimizer is returned as-is (plus LR wiring).
+    - ``axis_name=...`` (explicit per-replica regime, e.g. inside
+      ``jax.shard_map``): prepends a gradient ``pmean`` over that axis, the
+      literal ring-allreduce moment.
+    """
+    from pddl_tpu.train.state import make_optimizer  # noqa: PLC0415
+
+    tx = make_optimizer(optimizer, learning_rate if learning_rate is not None
+                        else 1e-3, **kwargs)
+    if axis_name is None:
+        return tx
+
+    def _pmean_grads(updates, state, params=None):
+        del state, params
+        return jax.tree.map(lambda g: jax.lax.pmean(g, axis_name), updates), ()
+
+    pmean_stage = optax.GradientTransformation(lambda params: (), _pmean_grads)
+    return optax.chain(pmean_stage, tx)
+
+
+# -------------------------------------------------------------- callbacks
+class BroadcastGlobalVariablesCallback(Callback):
+    """``hvd.callbacks.BroadcastGlobalVariablesCallback(0)`` (``:108-111``).
+
+    Forces bitwise-identical start weights. Under the Trainer's SPMD init,
+    parameters are created identically on every host (same seed, replicated
+    sharding), so normally a no-op; after a per-host restore it performs a
+    real host-0 broadcast — the "consistent initialization ... when training
+    is restored" case the Horovod docs (quoted by the reference) describe.
+    """
+
+    def __init__(self, root_rank: int = 0):
+        if root_rank != 0:
+            raise NotImplementedError("only root_rank=0 is supported")
+
+    def on_train_begin(self, state):
+        if jax.process_count() == 1:
+            return None
+        return broadcast(state)
+
+
+class MetricAverageCallback(Callback):
+    """``hvd.callbacks.MetricAverageCallback`` (``:112-113``).
+
+    The reference needs it because each rank evaluates a different
+    validation shard. Under the Trainer, step metrics are computed inside
+    the compiled step over the *global* batch, so epoch logs are already
+    world-averages; per-host extras (if any) are averaged here.
+    """
+
+    def on_epoch_end(self, epoch, state, logs: Dict[str, float]):
+        if jax.process_count() == 1:
+            return None
+        averaged = allreduce({k: float(v) for k, v in logs.items()})
+        logs.update(averaged)
+        return None
+
+
+# Same class, Horovod's name (``:114-115``).
+LearningRateWarmupCallback = LearningRateWarmup
+
+
+class callbacks:  # namespace mirror of horovod.tensorflow.keras.callbacks
+    BroadcastGlobalVariablesCallback = BroadcastGlobalVariablesCallback
+    MetricAverageCallback = MetricAverageCallback
+    LearningRateWarmupCallback = LearningRateWarmupCallback

@@ -1,0 +1,128 @@
+"""Experiment configuration + the 8 reference-script presets.
+
+The reference has essentially no config system (SURVEY.md §5 "Config / flag
+system"): hard-coded paths and batch sizes, one commented-out argparse
+(``/root/reference/imagenet-resnet50-hvd.py:17-23``) and one with broken
+flag names ``' -- ps'``/``' -- worker'`` (``imagenet-resnet50-ps.py:21-27``).
+This module replaces all of that with one dataclass and a preset per
+reference script, so every experiment the reference expresses as a separate
+file is here a named configuration over the same library.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+# Reference batch arithmetic, cited per script (SURVEY.md §6):
+#   single/mirrored: 32/replica (imagenet-resnet50.py:46, -mirror.py:54)
+#   multiworker scratch: 128/replica train, 256 val (-multiworkers.py:70-72)
+#   multiworker pretrained: 32/replica both (-pretrained-...-multiworkers.py:63-65)
+#   hvd: 32/replica, post-batch shard (-hvd.py:77-81)
+#   ps: 32 global, repeat + fixed steps (-ps.py:118-119,142-143)
+
+
+@dataclasses.dataclass
+class ExperimentConfig:
+    """Everything a reference script hard-codes, as data."""
+
+    name: str = "experiment"
+    # model
+    model: str = "resnet50"
+    num_classes: int = 1000
+    pretrained_h5: Optional[str] = None  # weights='imagenet' analogue: local .h5
+    bn_mode: str = "train"  # "frozen" reproduces the reference's training=False
+    compute_dtype: str = "bfloat16"
+    # data
+    data_dir: Optional[str] = None  # None → synthetic
+    image_size: int = 224
+    per_replica_batch: int = 32
+    val_per_replica_batch: Optional[int] = None
+    data_shard: str = "data"  # "data" | "batch" | "none"
+    # strategy
+    strategy: str = "single"  # single|mirrored|multiworker|ps
+    strategy_options: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    # optimizer / schedule
+    optimizer: str = "adam"
+    learning_rate: float = 1e-3  # Keras Adam default (compile at :62)
+    scale_lr: bool = False  # Horovod's 0.1*size rule (-hvd.py:99)
+    epochs: int = 50  # reference (imagenet-resnet50.py:67)
+    steps_per_epoch: Optional[int] = None
+    warmup_epochs: int = 0  # hvd preset: 3 (-hvd.py:114)
+    # reference callbacks (imagenet-resnet50.py:64-65)
+    reduce_lr_on_plateau: bool = True
+    early_stopping: bool = True
+    # augmentation (model-graph layers :53-55; crop 160 in hvd :89)
+    crop: Optional[int] = None  # None → image_size
+    flip: bool = True
+    # persistence
+    checkpoint_dir: Optional[str] = None
+    resume: bool = False
+    save_path: Optional[str] = None  # final export (model.save analogue :69-72)
+    # misc
+    seed: int = 0
+    verbose: int = 2  # reference verbose=2 (:67)
+
+    def replace(self, **kw) -> "ExperimentConfig":
+        return dataclasses.replace(self, **kw)
+
+
+# One preset per reference script. `weights_required` marks the pretrained
+# variants (they need --pretrained-h5 since TPU hosts can't download Keras
+# weights implicitly).
+PRESETS: Dict[str, ExperimentConfig] = {
+    # imagenet-resnet50.py — single device, from scratch
+    "single": ExperimentConfig(
+        name="ResNet50_ImageNet", strategy="single", per_replica_batch=32,
+    ),
+    # imagenet-pretrained-resnet50.py — single device, frozen-BN fine-tune
+    "single-pretrained": ExperimentConfig(
+        name="ResNet50_ImageNet_pretrained", strategy="single",
+        per_replica_batch=32, bn_mode="frozen",
+    ),
+    # imagenet-resnet50-mirror.py — single-host sync DP, 32×replicas
+    "mirrored": ExperimentConfig(
+        name="ResNet50_ImageNet_mirror", strategy="mirrored",
+        per_replica_batch=32,
+    ),
+    # imagenet-pretrained-resnet50-mirror.py
+    "mirrored-pretrained": ExperimentConfig(
+        name="ResNet50_ImageNet_mirror_pretrained", strategy="mirrored",
+        per_replica_batch=32, bn_mode="frozen",
+    ),
+    # imagenet-resnet50-multiworkers.py — multi-host DP, 128×n train/256×n val
+    "multiworker": ExperimentConfig(
+        name="ResNet50_ImageNet_multiworker", strategy="multiworker",
+        per_replica_batch=128, val_per_replica_batch=256, data_shard="data",
+    ),
+    # imagenet-pretrained-resnet50-multiworkers.py — 32×n both, frozen BN
+    "multiworker-pretrained": ExperimentConfig(
+        name="ResNet50_ImageNet_multiworker_pretrained", strategy="multiworker",
+        per_replica_batch=32, bn_mode="frozen",
+    ),
+    # imagenet-resnet50-hvd.py — DP with hvd semantics: LR 0.1×size,
+    # 3-epoch warmup, post-batch sharding, crop 160 (:89,99,114,77-81)
+    "hvd": ExperimentConfig(
+        name="ResNet50_ImageNet_hvd", strategy="multiworker",
+        per_replica_batch=32, data_shard="batch", learning_rate=0.1,
+        scale_lr=True, warmup_epochs=3, crop=160,
+        reduce_lr_on_plateau=False, early_stopping=False,
+    ),
+    # imagenet-resnet50-ps.py — sharded-state PS analogue, repeated stream
+    # with fixed steps/epoch (:118-119,142-143 — we default to data-derived
+    # steps rather than the reference's wrong 312500)
+    "ps": ExperimentConfig(
+        name="ResNet50_ImageNet_ps", strategy="ps", per_replica_batch=32,
+        reduce_lr_on_plateau=False, early_stopping=False,
+    ),
+}
+
+
+def get_preset(name: str, **overrides) -> ExperimentConfig:
+    try:
+        cfg = PRESETS[name]
+    except KeyError:
+        raise ValueError(
+            f"unknown preset {name!r}; known: {sorted(PRESETS)}"
+        ) from None
+    return cfg.replace(**overrides) if overrides else cfg

@@ -1,0 +1,186 @@
+"""Python bindings for the native C++ data-loader runtime.
+
+The reference's input pipeline executes inside TensorFlow's C++ tf.data
+runtime (SURVEY.md §2b C15); this is the framework's own native equivalent:
+``native/pddl_io.cpp`` — a threaded, ring-buffered, deterministic batch
+loader for a packed uint8 sample format — bound here with ctypes (no
+pybind11). The loader yields the same ``{"image": f32, "label": i32}``
+batches as the tf.data and synthetic pipelines, so it drops into
+``Trainer.fit`` unchanged.
+
+Workflow::
+
+    # one-time: pack any image source (done per host shard for ImageNet)
+    write_packed(path, images_uint8, labels)
+    # training: native threads read + batch + prefetch, Python just consumes
+    for batch in NativeLoader([path], batch_size=256, num_workers=4): ...
+
+Performance notes: batches are assembled by C++ worker threads overlapping
+the device step (the ``.prefetch(AUTOTUNE)`` analogue); ``next`` copies
+straight into preallocated numpy buffers (two copies total: file→batch,
+batch→numpy); uint8 stays uint8 until the float cast, which happens on
+device inside the jitted step via the augment pipeline.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import struct
+import subprocess
+from typing import Dict, Iterator, Optional, Sequence
+
+import numpy as np
+
+_MAGIC = 0x314C4450  # "PDL1"
+_HEADER = struct.Struct("<IIHHHH")
+
+_NATIVE_DIR = os.path.join(os.path.dirname(__file__), "..", "..", "native")
+_LIB_PATH = os.path.abspath(os.path.join(_NATIVE_DIR, "libpddl_io.so"))
+
+_lib = None
+
+
+def _load_lib(build_if_missing: bool = True):
+    global _lib
+    if _lib is not None:
+        return _lib
+    if not os.path.exists(_LIB_PATH) and build_if_missing:
+        try:
+            subprocess.run(["make", "-C", os.path.dirname(_LIB_PATH)],
+                           check=True, capture_output=True)
+        except (subprocess.CalledProcessError, FileNotFoundError) as e:
+            raise RuntimeError(
+                f"native loader library missing and build failed: {e}; "
+                f"run `make -C {os.path.dirname(_LIB_PATH)}`"
+            ) from e
+    lib = ctypes.CDLL(_LIB_PATH)
+    lib.pddl_loader_open.restype = ctypes.c_void_p
+    lib.pddl_loader_open.argtypes = [
+        ctypes.POINTER(ctypes.c_char_p), ctypes.c_int, ctypes.c_int,
+        ctypes.c_int, ctypes.c_uint64, ctypes.c_int, ctypes.c_int,
+        ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+    ]
+    lib.pddl_loader_shape.argtypes = [ctypes.c_void_p] + [
+        ctypes.POINTER(ctypes.c_int)] * 3
+    lib.pddl_loader_num_samples.restype = ctypes.c_long
+    lib.pddl_loader_num_samples.argtypes = [ctypes.c_void_p]
+    lib.pddl_loader_batches_per_epoch.restype = ctypes.c_long
+    lib.pddl_loader_batches_per_epoch.argtypes = [ctypes.c_void_p]
+    lib.pddl_loader_next.restype = ctypes.c_int
+    lib.pddl_loader_next.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint8),
+        ctypes.POINTER(ctypes.c_int32),
+    ]
+    lib.pddl_loader_reset.argtypes = [ctypes.c_void_p]
+    lib.pddl_loader_close.argtypes = [ctypes.c_void_p]
+    _lib = lib
+    return lib
+
+
+def build_native() -> None:
+    """Explicitly build the native library (``make -C native``)."""
+    _load_lib(build_if_missing=True)
+
+
+def native_available() -> bool:
+    """Pure availability probe: True iff the library is already built."""
+    try:
+        _load_lib(build_if_missing=False)
+        return True
+    except (RuntimeError, OSError):
+        return False
+
+
+def write_packed(path: str, images: np.ndarray, labels: np.ndarray) -> None:
+    """Write samples in the PDL1 packed format.
+
+    ``images``: uint8 [N, H, W, C]; ``labels``: int [N].
+    """
+    images = np.ascontiguousarray(images, np.uint8)
+    labels = np.asarray(labels, np.int32)
+    if images.ndim != 4 or len(labels) != len(images):
+        raise ValueError(f"bad shapes {images.shape} / {labels.shape}")
+    n, h, w, c = images.shape
+    with open(path, "wb") as f:
+        f.write(_HEADER.pack(_MAGIC, n, h, w, c, 0))
+        for i in range(n):
+            f.write(struct.pack("<i", int(labels[i])))
+            f.write(images[i].tobytes())
+
+
+class NativeLoader:
+    """Re-iterable batch source backed by the C++ runtime.
+
+    Yields ``{"image": [B,H,W,C], "label": int32 [B]}`` — Trainer-
+    compatible. Images default to **uint8** (4x less host memory and
+    host→device bandwidth than f32; models/augment cast on device); pass
+    ``dtype="float32"`` for consumers that need the cast on host.
+    ``shard_index/shard_count`` give per-process example sharding (the
+    DATA auto-shard analogue). Constructing a loader builds the native
+    library on first use if missing (see :func:`build_native`).
+    """
+
+    def __init__(self, paths: Sequence[str], batch_size: int,
+                 shuffle: bool = True, seed: int = 0,
+                 shard_index: int = 0, shard_count: int = 1,
+                 prefetch_depth: int = 4, num_workers: int = 2,
+                 drop_remainder: bool = True, dtype: str = "uint8"):
+        self._lib = _load_lib()
+        arr = (ctypes.c_char_p * len(paths))(
+            *[p.encode() for p in paths])
+        self._handle = self._lib.pddl_loader_open(
+            arr, len(paths), batch_size, int(shuffle), seed, shard_index,
+            shard_count, prefetch_depth, num_workers, int(drop_remainder), 0,
+        )
+        if not self._handle:
+            raise FileNotFoundError(
+                f"native loader failed to open {list(paths)} (missing files, "
+                "bad magic, or heterogeneous shapes)"
+            )
+        h, w, c = ctypes.c_int(), ctypes.c_int(), ctypes.c_int()
+        self._lib.pddl_loader_shape(self._handle, ctypes.byref(h),
+                                    ctypes.byref(w), ctypes.byref(c))
+        self.image_shape = (h.value, w.value, c.value)
+        self.batch_size = batch_size
+        self.dtype = dtype
+        self._first_epoch = True
+
+    @property
+    def num_samples(self) -> int:
+        return self._lib.pddl_loader_num_samples(self._handle)
+
+    @property
+    def batches_per_epoch(self) -> int:
+        return self._lib.pddl_loader_batches_per_epoch(self._handle)
+
+    def __iter__(self) -> Iterator[Dict[str, np.ndarray]]:
+        if self._handle is None:
+            raise RuntimeError("loader is closed")
+        if not self._first_epoch:
+            self._lib.pddl_loader_reset(self._handle)
+        self._first_epoch = False
+        h, w, c = self.image_shape
+        images = np.empty((self.batch_size, h, w, c), np.uint8)
+        labels = np.empty((self.batch_size,), np.int32)
+        img_ptr = images.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+        lbl_ptr = labels.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+        while True:
+            n = self._lib.pddl_loader_next(self._handle, img_ptr, lbl_ptr)
+            if n <= 0:
+                return
+            yield {
+                "image": images[:n].astype(self.dtype),
+                "label": labels[:n].copy(),
+            }
+
+    def close(self) -> None:
+        if self._handle is not None:
+            self._lib.pddl_loader_close(self._handle)
+            self._handle = None
+
+    def __del__(self):  # pragma: no cover - GC timing
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -15,6 +15,7 @@ from pddl_tpu.models.resnet import (
     ResNet101,
     ResNet152,
 )
+from pddl_tpu.models.vit import ViT, ViT_S16, ViT_B16, ViT_L16
 from pddl_tpu.models.registry import get_model, register_model, list_models
 
 __all__ = [
@@ -24,6 +25,10 @@ __all__ = [
     "ResNet50",
     "ResNet101",
     "ResNet152",
+    "ViT",
+    "ViT_S16",
+    "ViT_B16",
+    "ViT_L16",
     "get_model",
     "register_model",
     "list_models",

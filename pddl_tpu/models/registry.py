@@ -31,7 +31,7 @@ def list_models() -> list[str]:
 
 
 def _populate() -> None:
-    from pddl_tpu.models import resnet
+    from pddl_tpu.models import resnet, vit
 
     register_model("resnet18", resnet.ResNet18)
     register_model("resnet34", resnet.ResNet34)
@@ -39,6 +39,19 @@ def _populate() -> None:
     register_model("resnet101", resnet.ResNet101)
     register_model("resnet152", resnet.ResNet152)
     register_model("tiny_resnet", resnet.tiny_resnet)
+
+    def _vit(factory):
+        # ViTs take no bn_mode (no BatchNorm anywhere in a ViT); accept and
+        # drop it so configs stay uniform across model families.
+        def make(bn_mode: str = "train", **kwargs):
+            return factory(**kwargs)
+
+        return make
+
+    register_model("vit_s16", _vit(vit.ViT_S16))
+    register_model("vit_b16", _vit(vit.ViT_B16))
+    register_model("vit_l16", _vit(vit.ViT_L16))
+    register_model("tiny_vit", _vit(vit.tiny_vit))
 
 
 _populate()

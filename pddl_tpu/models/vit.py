@@ -1,0 +1,180 @@
+"""Vision Transformer family: the framework's attention-bearing model line.
+
+The reference repo is ResNet-only (``tf.keras.applications.ResNet50``,
+``/root/reference/imagenet-resnet50.py:56``); the ViT family exists because
+the TPU build treats long-context/attention workloads as first-class
+(SURVEY.md §5 "Long-context") — it is the model that exercises
+:mod:`pddl_tpu.ops.attention` (flash kernel) and
+:mod:`pddl_tpu.ops.ring_attention` (sequence parallelism), and it trains
+under every distribution strategy exactly like the ResNets (same Trainer,
+same data pipeline, same ``{"image", "label"}`` batches).
+
+TPU-first choices:
+
+- token count = (image/patch)² stays MXU-friendly (multiples of 128 for
+  standard configs: 224/16 → 196 tokens + padding-free mean-pool head).
+- bf16 compute / f32 params, f32 LayerNorm and softmax (numerics).
+- ``attention="flash"`` routes through the Pallas kernel on TPU and the
+  reference path elsewhere; ``attention="ring"`` shard-maps over the
+  ``seq`` mesh axis for sequence-parallel long-context runs.
+- no data-dependent control flow; everything jits to one XLA program.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from pddl_tpu.ops.attention import attention_reference, flash_attention
+
+
+class MultiHeadAttention(nn.Module):
+    """MHA over our attention ops (``[B, S, E]`` in/out)."""
+
+    num_heads: int
+    attention: str = "flash"  # "flash" | "reference" | "ring"
+    mesh: Optional[Any] = None  # required for "ring"
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        b, s, e = x.shape
+        if e % self.num_heads:
+            raise ValueError(f"embed dim {e} not divisible by {self.num_heads} heads")
+        head_dim = e // self.num_heads
+        dense = functools.partial(
+            nn.DenseGeneral, dtype=self.dtype, param_dtype=self.param_dtype,
+        )
+        # [B, S, H, D] then transpose to the kernel layout [B, H, S, D].
+        q = dense(features=(self.num_heads, head_dim), name="query")(x)
+        k = dense(features=(self.num_heads, head_dim), name="key")(x)
+        v = dense(features=(self.num_heads, head_dim), name="value")(x)
+        q, k, v = (t.transpose(0, 2, 1, 3) for t in (q, k, v))
+
+        if self.attention == "flash":
+            o = flash_attention(q, k, v)
+        elif self.attention == "reference":
+            o = attention_reference(q, k, v)
+        elif self.attention == "ring":
+            from pddl_tpu.ops.ring_attention import sequence_parallel_attention
+
+            if self.mesh is None:
+                raise ValueError('attention="ring" needs the mesh')
+            o = sequence_parallel_attention(q, k, v, self.mesh)
+        else:
+            raise ValueError(f"unknown attention {self.attention!r}")
+
+        o = o.transpose(0, 2, 1, 3).reshape(b, s, e)
+        return dense(features=e, name="out")(o)
+
+
+class TransformerBlock(nn.Module):
+    num_heads: int
+    mlp_ratio: int = 4
+    attention: str = "flash"
+    mesh: Optional[Any] = None
+    dropout: float = 0.0
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, *, train: bool):
+        e = x.shape[-1]
+        # Pre-LN (f32 for stability even under bf16 compute).
+        h = nn.LayerNorm(dtype=jnp.float32, param_dtype=self.param_dtype,
+                         name="ln1")(x)
+        h = MultiHeadAttention(
+            num_heads=self.num_heads, attention=self.attention,
+            mesh=self.mesh, dtype=self.dtype, param_dtype=self.param_dtype,
+            name="attn",
+        )(h.astype(self.dtype))
+        if self.dropout:
+            h = nn.Dropout(self.dropout, deterministic=not train)(h)
+        x = x + h
+
+        h = nn.LayerNorm(dtype=jnp.float32, param_dtype=self.param_dtype,
+                         name="ln2")(x)
+        h = nn.Dense(e * self.mlp_ratio, dtype=self.dtype,
+                     param_dtype=self.param_dtype, name="mlp1")(h.astype(self.dtype))
+        h = nn.gelu(h)
+        h = nn.Dense(e, dtype=self.dtype, param_dtype=self.param_dtype,
+                     name="mlp2")(h)
+        if self.dropout:
+            h = nn.Dropout(self.dropout, deterministic=not train)(h)
+        return x + h
+
+
+class ViT(nn.Module):
+    """Vision Transformer (patch embed → blocks → mean-pool → head).
+
+    Mean-pool head instead of a CLS token: one fewer ragged token keeps the
+    sequence length a clean multiple for flash blocks and seq sharding.
+    """
+
+    patch_size: int = 16
+    embed_dim: int = 384
+    depth: int = 12
+    num_heads: int = 6
+    num_classes: int = 1000
+    mlp_ratio: int = 4
+    attention: str = "flash"
+    mesh: Optional[Any] = None
+    dropout: float = 0.0
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, *, train: bool = True):
+        p = self.patch_size
+        if x.shape[1] % p or x.shape[2] % p:
+            raise ValueError(f"image {x.shape[1]}x{x.shape[2]} not divisible "
+                             f"by patch {p}")
+        x = x.astype(self.dtype)
+        # Patchify = non-overlapping conv; one big MXU contraction.
+        x = nn.Conv(self.embed_dim, (p, p), strides=(p, p), padding="VALID",
+                    dtype=self.dtype, param_dtype=self.param_dtype,
+                    name="patch_embed")(x)
+        b, gh, gw, e = x.shape
+        x = x.reshape(b, gh * gw, e)
+        pos = self.param("pos_embed", nn.initializers.normal(0.02),
+                         (1, gh * gw, e), self.param_dtype)
+        x = x + pos.astype(self.dtype)
+
+        for i in range(self.depth):
+            x = TransformerBlock(
+                num_heads=self.num_heads, mlp_ratio=self.mlp_ratio,
+                attention=self.attention, mesh=self.mesh,
+                dropout=self.dropout, dtype=self.dtype,
+                param_dtype=self.param_dtype, name=f"block{i}",
+            )(x, train=train)
+
+        x = nn.LayerNorm(dtype=jnp.float32, param_dtype=self.param_dtype,
+                         name="ln_final")(x)
+        x = jnp.mean(x, axis=1)  # mean-pool over tokens
+        if self.num_classes:
+            x = nn.Dense(self.num_classes, dtype=self.dtype,
+                         param_dtype=self.param_dtype, name="head")(x)
+        return x.astype(jnp.float32)
+
+
+ViT_S16 = functools.partial(ViT, patch_size=16, embed_dim=384, depth=12,
+                            num_heads=6)
+ViT_B16 = functools.partial(ViT, patch_size=16, embed_dim=768, depth=12,
+                            num_heads=12)
+ViT_L16 = functools.partial(ViT, patch_size=16, embed_dim=1024, depth=24,
+                            num_heads=16)
+
+
+def tiny_vit(num_classes: int = 10, **kwargs) -> ViT:
+    """Miniature ViT for tests/dry-runs (8x8 patches on 32px inputs)."""
+    kwargs.setdefault("patch_size", 8)
+    kwargs.setdefault("embed_dim", 32)
+    kwargs.setdefault("depth", 2)
+    kwargs.setdefault("num_heads", 4)
+    kwargs.setdefault("attention", "reference")
+    return ViT(num_classes=num_classes, **kwargs)

@@ -9,5 +9,16 @@ live in :mod:`pddl_tpu.ops.ring_attention`.
 """
 
 from pddl_tpu.ops import augment
+from pddl_tpu.ops.attention import attention_reference, flash_attention
+from pddl_tpu.ops.ring_attention import (
+    ring_attention,
+    sequence_parallel_attention,
+)
 
-__all__ = ["augment"]
+__all__ = [
+    "augment",
+    "attention_reference",
+    "flash_attention",
+    "ring_attention",
+    "sequence_parallel_attention",
+]

@@ -1,0 +1,203 @@
+"""Attention ops: reference softmax attention + a Pallas TPU flash kernel.
+
+The reference repo has no attention at all (fixed 224x224 CNN inputs,
+SURVEY.md §5 "Long-context": absent) — this module exists because
+long-context support is first-class in the TPU build, not an afterthought.
+It provides the single-device kernels; cross-device sequence parallelism
+lives in :mod:`pddl_tpu.ops.ring_attention`.
+
+Design:
+
+- :func:`attention_reference` — straight jnp (materializes the [Sq, Sk]
+  score matrix); numerics oracle for tests and the fallback path.
+- :func:`flash_attention` — blockwise online-softmax Pallas kernel: scores
+  never leave VMEM, HBM traffic is O(S·d) instead of O(S²), q/k/v blocks
+  are MXU-tiled matmuls. Grid is (batch·heads, q_blocks, k_blocks) with the
+  k dimension innermost: TPU grids execute sequentially, so running max /
+  normalizer / accumulator persist in VMEM scratch across the k sweep.
+- Backward: ``flash_attention`` carries a ``jax.custom_vjp`` whose backward
+  pass recomputes scores via the reference path (flash forward is where the
+  memory win matters for inference/eval; a fused Pallas backward is a
+  planned optimization — the API contract will not change).
+
+All shapes are ``[batch, heads, seq, head_dim]``; dtypes bf16/f32 in, f32
+accumulation inside (MXU-native mixed precision).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+NEG_INF = -1e30  # large-negative instead of -inf: keeps masked softmax NaN-free
+
+
+def attention_reference(
+    q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+    *, causal: bool = False, scale: Optional[float] = None,
+    k_offset: int = 0,
+) -> jnp.ndarray:
+    """Plain softmax attention (the numerics oracle).
+
+    ``k_offset`` shifts key/value global positions for causal masking —
+    used by ring attention where each shard sees a rotated K/V slice.
+    """
+    *_, sq, d = q.shape
+    sk = k.shape[-2]
+    scale = (1.0 / math.sqrt(d)) if scale is None else scale
+    s = jnp.einsum("...qd,...kd->...qk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    if causal:
+        q_pos = jnp.arange(sq)[:, None]
+        k_pos = jnp.arange(sk)[None, :] + k_offset
+        s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("...qk,...kd->...qd", p, v.astype(jnp.float32)).astype(q.dtype)
+
+
+# --------------------------------------------------------------- flash fwd
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref,
+                  *, scale: float, causal: bool, block_q: int, block_k: int,
+                  num_k: int):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    # Causal: skip blocks strictly above the diagonal.
+    run = True
+    if causal:
+        run = ki * block_k <= qi * block_q + block_q - 1
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32) * scale          # (bq, d)
+        k = k_ref[0].astype(jnp.float32)                  # (bk, d)
+        s = jax.lax.dot_general(                          # (bq, bk) on MXU
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+
+        m_prev = m_ref[:, :1]                             # (bq, 1)
+        l_prev = l_ref[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)                   # rescale old stats
+        p = jnp.exp(s - m_new)                            # (bq, bk)
+        l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        pv = jax.lax.dot_general(                         # (bq, d) on MXU
+            p, v_ref[0].astype(jnp.float32),
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        acc_ref[:] = acc_ref[:] * alpha + pv
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(ki == num_k - 1)
+    def _finalize():
+        l = l_ref[:, :1]
+        o_ref[0] = (acc_ref[:] / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+def flash_attention(
+    q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+    *, causal: bool = False, scale: Optional[float] = None,
+    block_q: int = 128, block_k: int = 128,
+    interpret: Optional[bool] = None,
+) -> jnp.ndarray:
+    """Flash attention with a reference-path backward (see module docs).
+
+    Falls back to :func:`attention_reference` when shapes don't block-tile
+    (tiny test shapes) — call sites never need to special-case.
+    """
+    *_, sq, d = q.shape
+    sk = k.shape[-2]
+    scale_v = (1.0 / math.sqrt(d)) if scale is None else scale
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    bq = _largest_dividing_block(sq, block_q)
+    bk = _largest_dividing_block(sk, block_k)
+    if bq < 8 or bk < 8:
+        # Degenerate tiling (e.g. prime-ish lengths): the kernel would run
+        # sub-VPU-width blocks slower than one fused XLA softmax.
+        return attention_reference(q, k, v, causal=causal, scale=scale_v)
+    return _flash(q, k, v, causal, scale_v, bq, bk, bool(interpret))
+
+
+def _largest_dividing_block(n: int, want: int) -> int:
+    """Largest block <= ``want`` that tiles ``n`` evenly.
+
+    ViT token counts are rarely powers of two (224/16 -> 196 tokens), so a
+    fixed 128 block would never divide and the kernel would silently fall
+    back; 196 tiles as 98."""
+    for b in range(min(want, n), 0, -1):
+        if n % b == 0:
+            return b
+    return 1
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, causal, scale, block_q, block_k, interpret):
+    b, h, sq, d = q.shape
+    sk = k.shape[-2]
+    qf = q.reshape(b * h, sq, d)
+    kf = k.reshape(b * h, sk, d)
+    vf = v.reshape(b * h, sk, d)
+    num_q = pl.cdiv(sq, block_q)
+    num_k = pl.cdiv(sk, block_k)
+    from jax.experimental.pallas import tpu as pltpu  # noqa: PLC0415
+
+    kernel = functools.partial(
+        _flash_kernel, scale=scale, causal=causal,
+        block_q=block_q, block_k=block_k, num_k=num_k,
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid=(b * h, num_q, num_k),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, qi, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, qi, ki: (bh, ki, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 128), jnp.float32),   # running max m
+            pltpu.VMEM((block_q, 128), jnp.float32),   # running denom l
+            pltpu.VMEM((block_q, d), jnp.float32),     # output accumulator
+        ],
+        interpret=interpret,
+    )(qf, kf, vf)
+    return out.reshape(b, h, sq, d)
+
+
+def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+    return _flash(q, k, v, causal, scale, block_q, block_k, interpret), (q, k, v)
+
+
+def _flash_bwd(causal, scale, block_q, block_k, interpret, res, g):
+    q, k, v = res
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: attention_reference(
+            q_, k_, v_, causal=causal, scale=scale),
+        q, k, v,
+    )
+    return vjp(g)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)

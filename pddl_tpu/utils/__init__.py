@@ -1,0 +1,1 @@
+"""Utilities: profiling/tracing, throughput accounting, determinism helpers."""

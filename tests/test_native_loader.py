@@ -1,0 +1,142 @@
+"""Native C++ loader tests: correctness, sharding, determinism, Trainer
+integration (the in-repo replacement for tf.data's C++ runtime, SURVEY.md
+§2b C15)."""
+
+import numpy as np
+import pytest
+
+from pddl_tpu.data.native_loader import (
+    NativeLoader,
+    native_available,
+    write_packed,
+)
+
+pytestmark = pytest.mark.skipif(
+    not native_available(), reason="native library not built"
+)
+
+
+def _make_packed(tmp_path, n=32, h=8, w=8, c=3, files=2, seed=0):
+    rng = np.random.default_rng(seed)
+    paths = []
+    per = n // files
+    for fi in range(files):
+        images = rng.integers(0, 255, (per, h, w, c), np.uint8)
+        # label encodes (file, index) so we can detect duplicates/omissions
+        labels = np.arange(fi * per, (fi + 1) * per, dtype=np.int32)
+        # make pixel [0,0,0] equal the label for content checks
+        images[:, 0, 0, 0] = (labels % 256).astype(np.uint8)
+        p = str(tmp_path / f"shard{fi}.pdl")
+        write_packed(p, images, labels)
+        paths.append(p)
+    return paths
+
+
+def test_roundtrip_content(tmp_path):
+    paths = _make_packed(tmp_path, n=16, files=1)
+    loader = NativeLoader(paths, batch_size=4, shuffle=False, num_workers=1)
+    assert loader.num_samples == 16
+    assert loader.batches_per_epoch == 4
+    seen = []
+    for b in loader:
+        assert b["image"].shape == (4, 8, 8, 3)
+        assert b["image"].dtype == np.uint8  # device-side cast is the default
+        np.testing.assert_array_equal(b["image"][:, 0, 0, 0],
+                                      b["label"] % 256)
+        seen.extend(b["label"].tolist())
+    assert seen == list(range(16))  # unshuffled order preserved
+    loader.close()
+
+
+def test_shuffle_deterministic_and_complete(tmp_path):
+    paths = _make_packed(tmp_path, n=32, files=2)
+
+    def epoch_labels(seed):
+        loader = NativeLoader(paths, batch_size=8, shuffle=True, seed=seed,
+                              num_workers=1)
+        out = [l for b in loader for l in b["label"].tolist()]
+        loader.close()
+        return out
+
+    a, b, c = epoch_labels(7), epoch_labels(7), epoch_labels(8)
+    assert a == b                      # same seed → same order
+    assert a != c                      # different seed → different order
+    assert sorted(a) == list(range(32))  # permutation, no dup/loss
+
+
+def test_reshuffles_between_epochs(tmp_path):
+    paths = _make_packed(tmp_path, n=32, files=1)
+    loader = NativeLoader(paths, batch_size=8, shuffle=True, seed=1,
+                          num_workers=1)
+    e1 = [l for b in loader for l in b["label"].tolist()]
+    e2 = [l for b in loader for l in b["label"].tolist()]
+    assert sorted(e1) == sorted(e2) == list(range(32))
+    assert e1 != e2
+    loader.close()
+
+
+def test_sharding_disjoint_complete(tmp_path):
+    paths = _make_packed(tmp_path, n=32, files=2)
+    got = []
+    for idx in range(4):
+        loader = NativeLoader(paths, batch_size=4, shuffle=False,
+                              shard_index=idx, shard_count=4, num_workers=1)
+        assert loader.num_samples == 8
+        got.append([l for b in loader for l in b["label"].tolist()])
+        loader.close()
+    flat = [l for shard in got for l in shard]
+    assert sorted(flat) == list(range(32))
+    for i in range(4):
+        for j in range(i + 1, 4):
+            assert not set(got[i]) & set(got[j])
+
+
+def test_drop_remainder_and_partial(tmp_path):
+    paths = _make_packed(tmp_path, n=16, files=1)
+    full = NativeLoader(paths, batch_size=5, shuffle=False,
+                        drop_remainder=True, num_workers=1)
+    assert full.batches_per_epoch == 3
+    assert sum(len(b["label"]) for b in full) == 15
+    full.close()
+    part = NativeLoader(paths, batch_size=5, shuffle=False,
+                        drop_remainder=False, num_workers=1)
+    counts = [len(b["label"]) for b in part]
+    assert counts == [5, 5, 5, 1]
+    part.close()
+
+
+def test_many_workers_no_loss(tmp_path):
+    paths = _make_packed(tmp_path, n=64, files=2)
+    loader = NativeLoader(paths, batch_size=8, shuffle=True, seed=3,
+                          num_workers=4, prefetch_depth=8)
+    labels = [l for b in loader for l in b["label"].tolist()]
+    assert sorted(labels) == list(range(64))
+    loader.close()
+
+
+def test_missing_file_raises(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        NativeLoader([str(tmp_path / "nope.pdl")], batch_size=4)
+
+
+def test_trainer_integration(tmp_path):
+    from pddl_tpu.models.resnet import tiny_resnet
+    from pddl_tpu.parallel.single import SingleDeviceStrategy
+    from pddl_tpu.train.loop import Trainer
+
+    rng = np.random.default_rng(0)
+    n, classes = 64, 4
+    labels = rng.integers(0, classes, n).astype(np.int32)
+    # Class-dependent mean so the model can fit.
+    images = (rng.normal(64, 8, (n, 16, 16, 3)) + labels[:, None, None, None]
+              * 40).clip(0, 255).astype(np.uint8)
+    path = str(tmp_path / "train.pdl")
+    write_packed(path, images, labels)
+
+    loader = NativeLoader([path], batch_size=16, shuffle=True, seed=0,
+                          num_workers=2)
+    tr = Trainer(tiny_resnet(num_classes=classes), learning_rate=3e-3,
+                 strategy=SingleDeviceStrategy())
+    hist = tr.fit(loader, epochs=3, verbose=0)
+    assert hist.history["loss"][-1] < hist.history["loss"][0]
+    loader.close()
