@@ -15,10 +15,12 @@ Design:
   are MXU-tiled matmuls. Grid is (batch·heads, q_blocks, k_blocks) with the
   k dimension innermost: TPU grids execute sequentially, so running max /
   normalizer / accumulator persist in VMEM scratch across the k sweep.
-- Backward: ``flash_attention`` carries a ``jax.custom_vjp`` whose backward
-  pass recomputes scores via the reference path (flash forward is where the
-  memory win matters for inference/eval; a fused Pallas backward is a
-  planned optimization — the API contract will not change).
+- Backward: fully fused Pallas kernels as well. The forward additionally
+  emits the log-sum-exp rows (lane-replicated, the standard TPU layout);
+  the backward recomputes each score block from q/k + LSE in VMEM — never
+  materializing the [S, S] probability matrix — in two sweeps: a dq kernel
+  (k innermost, dq accumulates in scratch) and a dk/dv kernel (q innermost,
+  dk/dv accumulate in scratch).
 
 All shapes are ``[batch, heads, seq, head_dim]``; dtypes bf16/f32 in, f32
 accumulation inside (MXU-native mixed precision).
@@ -60,10 +62,39 @@ def attention_reference(
     return jnp.einsum("...qk,...kd->...qd", p, v.astype(jnp.float32)).astype(q.dtype)
 
 
+# LSE/di rows are stored lane-replicated — shape [..., seq, LANES] — the
+# standard Mosaic-friendly layout for per-row scalars (a bare [seq] column
+# would fight the (sublane, lane) tiling).
+LANES = 128
+
+
+def _masked_scores(q_ref, k_ref, qi, ki, *, scale, causal, block_q, block_k):
+    """Recompute one (bq, bk) score block: s = scale·q·kᵀ, causal-masked.
+
+    Shared by the forward and both backward kernels so the mask/scale
+    semantics can never drift between the p used forward and the p
+    recomputed backward.
+    """
+    s = scale * jax.lax.dot_general(                      # (bq, bk) on MXU
+        q_ref[0].astype(jnp.float32), k_ref[0].astype(jnp.float32),
+        (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    if causal:
+        q_pos = qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        k_pos = ki * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+    return s
+
+
 # --------------------------------------------------------------- flash fwd
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref,
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
                   *, scale: float, causal: bool, block_q: int, block_k: int,
                   num_k: int):
+    """Forward kernel; ``lse_ref is None`` in the inference (no-vjp) variant,
+    which then skips the LSE write entirely."""
     qi = pl.program_id(1)
     ki = pl.program_id(2)
 
@@ -80,19 +111,8 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref,
 
     @pl.when(run)
     def _compute():
-        q = q_ref[0].astype(jnp.float32) * scale          # (bq, d)
-        k = k_ref[0].astype(jnp.float32)                  # (bk, d)
-        s = jax.lax.dot_general(                          # (bq, bk) on MXU
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        if causal:
-            q_pos = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            k_pos = ki * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
-
+        s = _masked_scores(q_ref, k_ref, qi, ki, scale=scale, causal=causal,
+                           block_q=block_q, block_k=block_k)
         m_prev = m_ref[:, :1]                             # (bq, 1)
         l_prev = l_ref[:, :1]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
@@ -112,6 +132,8 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref,
     def _finalize():
         l = l_ref[:, :1]
         o_ref[0] = (acc_ref[:] / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+        if lse_ref is not None:
+            lse_ref[0] = m_ref[:] + jnp.log(jnp.maximum(l_ref[:], 1e-30))
 
 
 def flash_attention(
@@ -119,15 +141,26 @@ def flash_attention(
     *, causal: bool = False, scale: Optional[float] = None,
     block_q: int = 128, block_k: int = 128,
     interpret: Optional[bool] = None,
+    fused_backward: bool = True,
 ) -> jnp.ndarray:
-    """Flash attention with a reference-path backward (see module docs).
+    """Flash attention, fused Pallas forward AND backward (see module docs).
 
+    Under ``jax.grad`` the forward additionally saves per-row LSE and the
+    backward recomputes score blocks in VMEM (two fused kernels for dq and
+    dk/dv) — the [S, S] matrices never reach HBM in either direction.
     Falls back to :func:`attention_reference` when shapes don't block-tile
     (tiny test shapes) — call sites never need to special-case.
+
+    The fused backward is first-order only (a ``pallas_call`` has no AD
+    rule): for higher-order differentiation — Hessian-vector products,
+    gradient penalties — pass ``fused_backward=False`` to use the exact
+    O(S²)-memory reference path, differentiable at any order.
     """
     *_, sq, d = q.shape
     sk = k.shape[-2]
     scale_v = (1.0 / math.sqrt(d)) if scale is None else scale
+    if not fused_backward:
+        return attention_reference(q, k, v, causal=causal, scale=scale_v)
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     bq = _largest_dividing_block(sq, block_q)
@@ -151,8 +184,20 @@ def _largest_dividing_block(n: int, want: int) -> int:
     return 1
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash(q, k, v, causal, scale, block_q, block_k, interpret):
+def _flash_kernel_nolse(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref,
+                        **kw):
+    _flash_kernel(q_ref, k_ref, v_ref, o_ref, None, m_ref, l_ref, acc_ref,
+                  **kw)
+
+
+def _flash_forward_call(q, k, v, causal, scale, block_q, block_k, interpret,
+                        want_lse):
+    """Run the forward kernel; returns flat (out [bh,sq,d], lse or None).
+
+    ``want_lse=False`` (inference / non-differentiated calls) uses a variant
+    with no LSE output at all — a pallas_call output can't be DCE'd by XLA,
+    so the [bh, sq, LANES] write must not exist rather than be unused.
+    """
     b, h, sq, d = q.shape
     sk = k.shape[-2]
     qf = q.reshape(b * h, sq, d)
@@ -163,10 +208,13 @@ def _flash(q, k, v, causal, scale, block_q, block_k, interpret):
     from jax.experimental.pallas import tpu as pltpu  # noqa: PLC0415
 
     kernel = functools.partial(
-        _flash_kernel, scale=scale, causal=causal,
+        _flash_kernel if want_lse else _flash_kernel_nolse,
+        scale=scale, causal=causal,
         block_q=block_q, block_k=block_k, num_k=num_k,
     )
-    out = pl.pallas_call(
+    o_spec = pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0))
+    lse_spec = pl.BlockSpec((1, block_q, LANES), lambda bh, qi, ki: (bh, qi, 0))
+    result = pl.pallas_call(
         kernel,
         grid=(b * h, num_q, num_k),
         in_specs=[
@@ -174,30 +222,193 @@ def _flash(q, k, v, causal, scale, block_q, block_k, interpret):
             pl.BlockSpec((1, block_k, d), lambda bh, qi, ki: (bh, ki, 0)),
             pl.BlockSpec((1, block_k, d), lambda bh, qi, ki: (bh, ki, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
-        out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+        out_specs=[o_spec] + ([lse_spec] if want_lse else []),
+        out_shape=[jax.ShapeDtypeStruct((b * h, sq, d), q.dtype)]
+        + ([jax.ShapeDtypeStruct((b * h, sq, LANES), jnp.float32)]
+           if want_lse else []),
         scratch_shapes=[
-            pltpu.VMEM((block_q, 128), jnp.float32),   # running max m
-            pltpu.VMEM((block_q, 128), jnp.float32),   # running denom l
-            pltpu.VMEM((block_q, d), jnp.float32),     # output accumulator
+            pltpu.VMEM((block_q, LANES), jnp.float32),  # running max m
+            pltpu.VMEM((block_q, LANES), jnp.float32),  # running denom l
+            pltpu.VMEM((block_q, d), jnp.float32),      # output accumulator
         ],
         interpret=interpret,
     )(qf, kf, vf)
+    if want_lse:
+        return result[0], result[1]
+    return result[0], None
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, causal, scale, block_q, block_k, interpret):
+    b, h, sq, d = q.shape
+    out, _ = _flash_forward_call(q, k, v, causal, scale, block_q, block_k,
+                                 interpret, want_lse=False)
     return out.reshape(b, h, sq, d)
 
 
 def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
-    return _flash(q, k, v, causal, scale, block_q, block_k, interpret), (q, k, v)
+    b, h, sq, d = q.shape
+    out, lse = _flash_forward_call(q, k, v, causal, scale, block_q, block_k,
+                                   interpret, want_lse=True)
+    # Residuals live from forward to backward — across every later layer's
+    # forward. Keep LSE packed [bh, sq] for that window; the transient
+    # lane-replicated buffer the kernel wrote is freed here.
+    return out.reshape(b, h, sq, d), (q, k, v, out, lse[..., 0])
+
+
+# --------------------------------------------------------------- flash bwd
+#
+# Standard two-sweep recomputation backward. With
+#   p  = exp(scale·qkᵀ − lse),  dp = do·vᵀ,  di = Σ_d(do ⊙ o),
+#   ds = p ⊙ (dp − di):
+#   dq = scale · ds·k   dk = scale · dsᵀ·q   dv = pᵀ·do
+# Each kernel recomputes its p block in VMEM from q/k + saved LSE; the [S,S]
+# matrices never touch HBM.
+
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, di_ref,
+                         dq_ref, acc_ref,
+                         *, scale: float, causal: bool, block_q: int,
+                         block_k: int, num_k: int):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    run = True
+    if causal:
+        run = ki * block_k <= qi * block_q + block_q - 1
+
+    @pl.when(run)
+    def _compute():
+        do = do_ref[0].astype(jnp.float32)
+        s = _masked_scores(q_ref, k_ref, qi, ki, scale=scale, causal=causal,
+                           block_q=block_q, block_k=block_k)
+        p = jnp.exp(s - lse_ref[0][:, :1])                # masked -> exactly 0
+        dp = jax.lax.dot_general(                         # (bq, bk)
+            do, v_ref[0].astype(jnp.float32), (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - di_ref[0][:, :1])
+        acc_ref[:] += scale * jax.lax.dot_general(        # (bq, d)
+            ds, k_ref[0].astype(jnp.float32), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(ki == num_k - 1)
+    def _finalize():
+        dq_ref[0] = acc_ref[:].astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, di_ref,
+                          dk_ref, dv_ref, dk_acc_ref, dv_acc_ref,
+                          *, scale: float, causal: bool, block_q: int,
+                          block_k: int, num_q: int):
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc_ref[:] = jnp.zeros_like(dk_acc_ref)
+        dv_acc_ref[:] = jnp.zeros_like(dv_acc_ref)
+
+    run = True
+    if causal:
+        run = qi * block_q + block_q - 1 >= ki * block_k
+
+    @pl.when(run)
+    def _compute():
+        do = do_ref[0].astype(jnp.float32)
+        s = _masked_scores(q_ref, k_ref, qi, ki, scale=scale, causal=causal,
+                           block_q=block_q, block_k=block_k)
+        p = jnp.exp(s - lse_ref[0][:, :1])
+        dv_acc_ref[:] += jax.lax.dot_general(             # pᵀ·do -> (bk, d)
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dp = jax.lax.dot_general(                         # (bq, bk)
+            do, v_ref[0].astype(jnp.float32), (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - di_ref[0][:, :1])
+        dk_acc_ref[:] += scale * jax.lax.dot_general(     # dsᵀ·q -> (bk, d)
+            ds, q_ref[0].astype(jnp.float32), (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(qi == num_q - 1)
+    def _finalize():
+        dk_ref[0] = dk_acc_ref[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc_ref[:].astype(dv_ref.dtype)
 
 
 def _flash_bwd(causal, scale, block_q, block_k, interpret, res, g):
-    q, k, v = res
-    _, vjp = jax.vjp(
-        lambda q_, k_, v_: attention_reference(
-            q_, k_, v_, causal=causal, scale=scale),
-        q, k, v,
+    q, k, v, out, lse_packed = res
+    b, h, sq, d = q.shape
+    sk = k.shape[-2]
+    qf = q.reshape(b * h, sq, d)
+    kf = k.reshape(b * h, sk, d)
+    vf = v.reshape(b * h, sk, d)
+    dof = g.reshape(b * h, sq, d)
+    num_q = pl.cdiv(sq, block_q)
+    num_k = pl.cdiv(sk, block_k)
+    from jax.experimental.pallas import tpu as pltpu  # noqa: PLC0415
+
+    # Re-expand packed LSE and compute di = rowsum(do ⊙ o), both
+    # lane-replicated for the kernels (transient buffers, freed after the
+    # two pallas calls; everything O(S²) stays inside the kernels).
+    lse = jnp.broadcast_to(lse_packed[..., None], (b * h, sq, LANES))
+    di = jnp.broadcast_to(
+        jnp.sum(dof.astype(jnp.float32) * out.astype(jnp.float32),
+                axis=-1, keepdims=True),
+        (b * h, sq, LANES),
     )
-    return vjp(g)
+
+    q_spec = pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0))
+    row_spec = pl.BlockSpec((1, block_q, LANES), lambda bh, i, j: (bh, i, 0))
+    k_spec = pl.BlockSpec((1, block_k, d), lambda bh, i, j: (bh, j, 0))
+
+    dq = pl.pallas_call(
+        functools.partial(
+            _flash_bwd_dq_kernel, scale=scale, causal=causal,
+            block_q=block_q, block_k=block_k, num_k=num_k,
+        ),
+        grid=(b * h, num_q, num_k),
+        in_specs=[q_spec, k_spec, k_spec, q_spec, row_spec, row_spec],
+        out_specs=q_spec,
+        out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=interpret,
+    )(qf, kf, vf, dof, lse, di)
+
+    # dk/dv sweep: grid (bh, k_blocks, q_blocks) — q innermost so the k/v
+    # accumulators persist in scratch across the q sweep.
+    qT_spec = pl.BlockSpec((1, block_q, d), lambda bh, j, i: (bh, i, 0))
+    rowT_spec = pl.BlockSpec((1, block_q, LANES), lambda bh, j, i: (bh, i, 0))
+    kT_spec = pl.BlockSpec((1, block_k, d), lambda bh, j, i: (bh, j, 0))
+
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _flash_bwd_dkv_kernel, scale=scale, causal=causal,
+            block_q=block_q, block_k=block_k, num_q=num_q,
+        ),
+        grid=(b * h, num_k, num_q),
+        in_specs=[qT_spec, kT_spec, kT_spec, qT_spec, rowT_spec, rowT_spec],
+        out_specs=[kT_spec, kT_spec],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, sk, d), k.dtype),
+            jax.ShapeDtypeStruct((b * h, sk, d), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qf, kf, vf, dof, lse, di)
+
+    return (dq.reshape(b, h, sq, d), dk.reshape(b, h, sk, d),
+            dv.reshape(b, h, sk, d))
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)

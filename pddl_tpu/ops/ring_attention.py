@@ -76,11 +76,15 @@ def ring_attention(
         vc = lax.ppermute(vc, axis_name, perm)
         return m_new, l, acc, kc, vc
 
-    # pvary: the accumulators are logically per-shard (device-varying along
-    # the ring axis) even though their initial values are constants.
-    m0 = lax.pvary(jnp.full((b, h, s_local, 1), NEG_INF, jnp.float32), (axis_name,))
-    l0 = lax.pvary(jnp.zeros((b, h, s_local, 1), jnp.float32), (axis_name,))
-    acc0 = lax.pvary(jnp.zeros((b, h, s_local, d), jnp.float32), (axis_name,))
+    # pcast-to-varying: the accumulators are logically per-shard
+    # (device-varying along the ring axis) even though their initial values
+    # are constants.
+    def _vary(x):
+        return lax.pcast(x, axis_name, to="varying")
+
+    m0 = _vary(jnp.full((b, h, s_local, 1), NEG_INF, jnp.float32))
+    l0 = _vary(jnp.zeros((b, h, s_local, 1), jnp.float32))
+    acc0 = _vary(jnp.zeros((b, h, s_local, d), jnp.float32))
     m, l, acc, _, _ = lax.fori_loop(0, n, step, (m0, l0, acc0, k, v))
     return (acc / jnp.maximum(l, 1e-30)).astype(q.dtype)
 

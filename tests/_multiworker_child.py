@@ -1,0 +1,90 @@
+"""Child process for the multi-process bootstrap test (not a pytest file).
+
+Each of the 2 processes owns 2 fake CPU devices; together they form the
+4-device global mesh. This is the JAX analogue of the reference's
+in-process gRPC cluster trick (``/root/reference/imagenet-resnet50-ps.py:31-65``)
+— a genuine multi-process topology on one machine, no hardware needed
+(SURVEY.md §4 mechanism 1).
+
+Run by tests/test_multiprocess.py with PDDL_COORDINATOR / PDDL_NUM_PROCESSES
+/ PDDL_PROCESS_ID set; exits non-zero on any assertion failure.
+"""
+
+import os
+import sys
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "")
+    + " --xla_force_host_platform_device_count=2"
+)
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+
+def main() -> None:
+    from pddl_tpu.core import dist
+
+    # Bootstrap purely from PDDL_* env (discovery order step 2 in core/dist).
+    spec = dist.initialize()
+    assert spec.is_multiprocess, spec
+    assert spec.num_processes == 2, spec
+    assert jax.process_count() == 2
+    assert len(jax.local_devices()) == 2
+    assert len(jax.devices()) == 4
+    assert dist.is_coordinator() == (jax.process_index() == 0)
+
+    # The multiworker strategy over the global mesh (idempotent re-init).
+    from pddl_tpu.parallel.multiworker import MultiWorkerMirroredStrategy
+
+    strategy = MultiWorkerMirroredStrategy()
+    mesh = strategy.setup()
+    assert mesh.devices.size == 4
+    assert strategy.num_workers == 2
+    assert strategy.num_replicas_in_sync == 4
+    # Reference batch arithmetic at multi-host scale: 32 * replicas
+    # (imagenet-resnet50-multiworkers.py:70).
+    assert strategy.scale_batch_size(32) == 128
+
+    # DATA-sharded feeding: each process contributes its local half; the
+    # assembled array is the 4-row global batch.
+    local = np.full((2, 3), float(jax.process_index()), np.float32)
+    batch = strategy.distribute_batch({"image": local})
+    assert batch["image"].shape == (4, 3)
+
+    # A real cross-process collective (the NCCL-allreduce moment): global
+    # mean over the whole array = mean of process ids = 0.5.
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mean = jax.jit(
+        jnp.mean, out_shardings=NamedSharding(mesh, P())
+    )(batch["image"])
+    np.testing.assert_allclose(np.asarray(mean), 0.5, atol=1e-6)
+
+    # One real training step through the Trainer (grad all-reduce across
+    # both processes compiled into the step).
+    from pddl_tpu.data.synthetic import SyntheticImageClassification
+    from pddl_tpu.models.resnet import tiny_resnet
+    from pddl_tpu.train.loop import Trainer
+
+    data = SyntheticImageClassification(
+        batch_size=strategy.scale_batch_size(2), image_size=16, num_classes=4,
+        seed=0, process_index=strategy.process_index,
+        process_count=strategy.data_process_count,
+    )
+    trainer = Trainer(tiny_resnet(num_classes=4), learning_rate=1e-2,
+                      strategy=strategy)
+    hist = trainer.fit(data, epochs=1, steps_per_epoch=2, verbose=0)
+    loss = hist.history["loss"][-1]
+    assert np.isfinite(loss), loss
+
+    print(f"child {jax.process_index()} OK loss={loss:.4f}", flush=True)
+
+
+if __name__ == "__main__":
+    main()
+    sys.exit(0)
