@@ -21,6 +21,7 @@ from pddl_tpu.parallel.single import SingleDeviceStrategy
 from pddl_tpu.parallel.mirrored import MirroredStrategy
 from pddl_tpu.parallel.multiworker import MultiWorkerMirroredStrategy
 from pddl_tpu.parallel.ps import ParameterServerStrategy
+from pddl_tpu.parallel.tensor_parallel import TensorParallelStrategy
 
 __all__ = [
     "Strategy",
@@ -29,4 +30,5 @@ __all__ = [
     "MirroredStrategy",
     "MultiWorkerMirroredStrategy",
     "ParameterServerStrategy",
+    "TensorParallelStrategy",
 ]

@@ -1,0 +1,100 @@
+"""Tensor parallelism: Megatron-style weight sharding over the ``model``
+axis (beyond-parity capability; the mesh reserves the axis — SURVEY.md §2c).
+
+Checks on the fake 8-device mesh: rule table places shards on the right
+dims, optimizer moments inherit the layout, TP training is numerically the
+sync-SPMD identity (same global batch + seed => same params as
+single-device), and DP x TP composes.
+"""
+
+import jax
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from pddl_tpu.core.mesh import MODEL_AXIS
+from pddl_tpu.data.synthetic import SyntheticImageClassification
+from pddl_tpu.models.vit import tiny_vit
+from pddl_tpu.parallel import SingleDeviceStrategy, TensorParallelStrategy
+from pddl_tpu.train.loop import Trainer
+
+
+def _dataset(batch, **kw):
+    kw.setdefault("image_size", 32)
+    kw.setdefault("num_classes", 8)
+    kw.setdefault("signal_strength", 3.0)
+    return SyntheticImageClassification(batch_size=batch, **kw)
+
+
+def _fit(strategy, batch=16, seed=3, steps=4, optimizer="adamw", lr=1e-2,
+         epochs=1):
+    tr = Trainer(tiny_vit(num_classes=8, num_heads=4), optimizer=optimizer,
+                 learning_rate=lr, strategy=strategy, seed=seed)
+    hist = tr.fit(_dataset(batch, seed=7), epochs=epochs,
+                  steps_per_epoch=steps, verbose=0)
+    return tr, hist
+
+
+def test_tp_param_shardings_follow_megatron_layout():
+    strategy = TensorParallelStrategy(model_parallel=4)
+    tr, _ = _fit(strategy)
+    params = tr.state.params
+
+    def spec_of(leaf):
+        return leaf.sharding.spec
+
+    blk = params["block0"]
+    # column-parallel: q/k/v kernels (E, H, D) sharded on H
+    assert spec_of(blk["attn"]["query"]["kernel"]) == P(None, MODEL_AXIS, None)
+    assert spec_of(blk["attn"]["query"]["bias"]) == P(MODEL_AXIS, None)
+    # row-parallel: out kernel (E, E) sharded on the (head-major) input dim
+    assert spec_of(blk["attn"]["out"]["kernel"]) == P(MODEL_AXIS, None)
+    assert spec_of(blk["attn"]["out"]["bias"]) == P()
+    # MLP: up column-parallel, down row-parallel
+    assert spec_of(blk["mlp1"]["kernel"]) == P(None, MODEL_AXIS)
+    assert spec_of(blk["mlp1"]["bias"]) == P(MODEL_AXIS)
+    assert spec_of(blk["mlp2"]["kernel"]) == P(MODEL_AXIS, None)
+    assert spec_of(blk["mlp2"]["bias"]) == P()
+    # Non-transformer leaves stay replicated
+    assert spec_of(params["patch_embed"]["kernel"]) == P()
+
+
+def test_tp_optimizer_state_inherits_layout():
+    strategy = TensorParallelStrategy(model_parallel=4)
+    tr, _ = _fit(strategy)
+    # Find an adamw moment leaf for mlp1/kernel and check it is sharded.
+    flat = jax.tree_util.tree_flatten_with_path(tr.state.opt_state)[0]
+    hits = [leaf for path, leaf in flat
+            if "mlp1" in str(path) and "kernel" in str(path)
+            and hasattr(leaf, "sharding") and leaf.ndim == 2]
+    assert hits, "no mlp1 kernel moments found in opt_state"
+    assert all(h.sharding.spec == P(None, MODEL_AXIS) for h in hits)
+
+
+def test_tp_matches_single_device_numerics():
+    """Sharding the weights must not change the math (sync-SPMD identity).
+
+    SGD, not adamw: TP splits contractions into partial sums whose float
+    rounding differs from the unsharded order, and adaptive optimizers
+    amplify that noise through grad/sqrt(v) for near-zero grads. With SGD
+    the param delta is linear in the grad, so agreement is tight.
+    """
+    # model_parallel=4 divides num_heads=4, so q/k/v genuinely shard by
+    # head here (8 would trip the divisibility fallback and silently test
+    # replicated attention weights).
+    tp, _ = _fit(TensorParallelStrategy(model_parallel=4), batch=16,
+                 optimizer="sgd", steps=3)
+    single, _ = _fit(SingleDeviceStrategy(), batch=16,
+                     optimizer="sgd", steps=3)
+    a = jax.device_get(tp.state.params)
+    b = jax.device_get(single.state.params)
+    for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+        np.testing.assert_allclose(np.asarray(x), np.asarray(y),
+                                   atol=1e-4, rtol=1e-3)
+
+
+def test_dp_tp_composes_and_trains():
+    strategy = TensorParallelStrategy(model_parallel=2)  # data=4 x model=2
+    assert strategy.num_replicas_in_sync == 4
+    tr, hist = _fit(strategy, batch=strategy.scale_batch_size(4), steps=4,
+                    epochs=2, lr=1e-3)
+    assert hist.history["loss"][-1] < hist.history["loss"][0]
