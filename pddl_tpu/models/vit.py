@@ -79,6 +79,7 @@ class TransformerBlock(nn.Module):
     attention: str = "flash"
     mesh: Optional[Any] = None
     dropout: float = 0.0
+    moe_experts: int = 0  # >0: Switch-MoE FFN instead of the dense MLP
     dtype: Any = jnp.float32
     param_dtype: Any = jnp.float32
 
@@ -99,11 +100,19 @@ class TransformerBlock(nn.Module):
 
         h = nn.LayerNorm(dtype=jnp.float32, param_dtype=self.param_dtype,
                          name="ln2")(x)
-        h = nn.Dense(e * self.mlp_ratio, dtype=self.dtype,
-                     param_dtype=self.param_dtype, name="mlp1")(h.astype(self.dtype))
-        h = nn.gelu(h)
-        h = nn.Dense(e, dtype=self.dtype, param_dtype=self.param_dtype,
-                     name="mlp2")(h)
+        if self.moe_experts:
+            from pddl_tpu.ops.moe import SwitchFFN
+
+            h = SwitchFFN(
+                num_experts=self.moe_experts, mlp_ratio=self.mlp_ratio,
+                dtype=self.dtype, param_dtype=self.param_dtype, name="moe",
+            )(h.astype(self.dtype))
+        else:
+            h = nn.Dense(e * self.mlp_ratio, dtype=self.dtype,
+                         param_dtype=self.param_dtype, name="mlp1")(h.astype(self.dtype))
+            h = nn.gelu(h)
+            h = nn.Dense(e, dtype=self.dtype, param_dtype=self.param_dtype,
+                         name="mlp2")(h)
         if self.dropout:
             h = nn.Dropout(self.dropout, deterministic=not train)(h)
         return x + h
@@ -125,6 +134,8 @@ class ViT(nn.Module):
     attention: str = "flash"
     mesh: Optional[Any] = None
     dropout: float = 0.0
+    moe_experts: int = 0  # >0: every `moe_every`-th block uses Switch-MoE
+    moe_every: int = 2
     dtype: Any = jnp.float32
     param_dtype: Any = jnp.float32
 
@@ -146,10 +157,15 @@ class ViT(nn.Module):
         x = x + pos.astype(self.dtype)
 
         for i in range(self.depth):
+            # Interleave MoE FFN blocks (every moe_every-th, from the back
+            # so depth=1 test models still get one) with dense MLP blocks —
+            # the standard Switch/GShard placement.
+            moe = (self.moe_experts
+                   if (self.depth - 1 - i) % self.moe_every == 0 else 0)
             x = TransformerBlock(
                 num_heads=self.num_heads, mlp_ratio=self.mlp_ratio,
                 attention=self.attention, mesh=self.mesh,
-                dropout=self.dropout, dtype=self.dtype,
+                dropout=self.dropout, moe_experts=moe, dtype=self.dtype,
                 param_dtype=self.param_dtype, name=f"block{i}",
             )(x, train=train)
 

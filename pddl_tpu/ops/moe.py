@@ -1,0 +1,105 @@
+"""Mixture-of-Experts: Switch-style top-1 routed FFN, expert-parallel ready.
+
+Beyond-parity capability (the reference has no MoE — SURVEY.md §2c lists
+expert parallelism as absent; the mesh reserves an ``expert`` axis for it,
+``pddl_tpu/core/mesh.py``). TPU-first formulation:
+
+- **Dense one-hot dispatch** (the Mesh-TF/Switch-Transformer pattern):
+  routing becomes two einsums against a ``[tokens, experts, capacity]``
+  dispatch tensor — all FLOPs are MXU contractions with static shapes; no
+  gather/scatter, no dynamic shapes, nothing XLA can't tile.
+- **Expert-major weights**: expert FFN kernels are ``[n_experts, ...]`` so
+  sharding dim 0 over the ``expert`` mesh axis places one expert group per
+  device; XLA lowers the dispatch/combine einsums to the all-to-alls.
+- **Capacity factor**: batch rows are the dispatch groups; each expert
+  processes at most ``capacity_factor * seq / n_experts`` tokens per group
+  (dispatch tensors are ``[B, S, N, C]`` — linear in batch). Overflow
+  tokens pass through the residual (standard Switch behavior), keeping
+  per-expert work static-shaped.
+- **Load-balancing aux loss** (Switch loss: ``n·Σ fᵢ·Pᵢ``) is exported via
+  ``self.sow("losses", ...)``; the Trainer adds every sown loss to the
+  task loss.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+
+class SwitchFFN(nn.Module):
+    """Top-1 routed expert FFN (drop-in for a transformer MLP block).
+
+    Input/output ``[batch, seq, embed]``; experts are two-layer GELU FFNs
+    with hidden dim ``mlp_ratio * embed``.
+    """
+
+    num_experts: int
+    mlp_ratio: int = 4
+    capacity_factor: float = 1.25
+    aux_loss_weight: float = 0.01
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        b, s, d = x.shape
+        n = self.num_experts
+        # Batch rows are the dispatch groups (the Switch/Mesh-TF "group"
+        # dim): capacity is per group, so dispatch/combine are
+        # [B, S, N, C] — linear in batch, never quadratic in total tokens.
+        capacity = max(1, int(self.capacity_factor * s / n))
+        hidden = d * self.mlp_ratio
+
+        # Router (f32 for a stable softmax regardless of compute dtype).
+        router_logits = nn.Dense(
+            n, dtype=jnp.float32, param_dtype=self.param_dtype, name="router"
+        )(x.astype(jnp.float32))
+        probs = nn.softmax(router_logits, axis=-1)            # (B, S, N)
+        expert_index = jnp.argmax(probs, axis=-1)             # (B, S)
+        expert_gate = jnp.max(probs, axis=-1)                 # (B, S)
+
+        # Capacity-limited one-hot dispatch: position of each token within
+        # its expert's queue (per group); tokens past capacity are dropped
+        # (residual passthrough happens at the call site via x + moe(x)).
+        raw_onehot = nn.one_hot(expert_index, n)              # (B, S, N)
+        position = jnp.cumsum(raw_onehot, axis=1) * raw_onehot  # 1-based
+        onehot = raw_onehot * (position <= capacity)
+        pos_in_expert = (position - 1.0) * onehot             # 0-based, 0 where dropped
+        # (B, S, N, C) one-hot over capacity slots.
+        dispatch = onehot[..., None] * nn.one_hot(
+            pos_in_expert.sum(axis=-1).astype(jnp.int32), capacity
+        )[..., None, :]
+        combine = dispatch * expert_gate[..., None, None]     # gate-weighted
+
+        # Load-balancing loss BEFORE capacity drop (Switch eq. 4-6):
+        # n * sum_i( fraction_of_tokens_i * mean_router_prob_i ).
+        frac = jnp.mean(raw_onehot, axis=(0, 1))
+        mean_prob = jnp.mean(probs, axis=(0, 1))
+        aux = self.aux_loss_weight * n * jnp.sum(frac * mean_prob)
+        self.sow("losses", "moe_aux_loss", aux)
+
+        dispatch = dispatch.astype(self.dtype)
+        combine = combine.astype(self.dtype)
+        xc = x.astype(self.dtype)
+
+        # Expert-major parameters: dim 0 shards over the `expert` mesh axis.
+        # batch_axis=(0,): the expert dim must not count toward fan-in, or
+        # every expert initializes sqrt(n) too small.
+        he = nn.initializers.he_normal(batch_axis=(0,))
+        w1 = self.param("w1", he, (n, d, hidden),
+                        self.param_dtype).astype(self.dtype)
+        b1 = self.param("b1", nn.initializers.zeros, (n, hidden),
+                        self.param_dtype).astype(self.dtype)
+        w2 = self.param("w2", he, (n, hidden, d),
+                        self.param_dtype).astype(self.dtype)
+        b2 = self.param("b2", nn.initializers.zeros, (n, d),
+                        self.param_dtype).astype(self.dtype)
+
+        # Dispatch -> expert FFN -> combine: all MXU einsums, static shapes.
+        expert_in = jnp.einsum("bsnc,bsd->bncd", dispatch, xc)
+        h = nn.gelu(jnp.einsum("bncd,ndh->bnch", expert_in, w1) + b1[:, None, :])
+        expert_out = jnp.einsum("bnch,nhd->bncd", h, w2) + b2[:, None, :]
+        return jnp.einsum("bsnc,bncd->bsd", combine, expert_out)

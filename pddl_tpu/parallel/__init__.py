@@ -21,7 +21,10 @@ from pddl_tpu.parallel.single import SingleDeviceStrategy
 from pddl_tpu.parallel.mirrored import MirroredStrategy
 from pddl_tpu.parallel.multiworker import MultiWorkerMirroredStrategy
 from pddl_tpu.parallel.ps import ParameterServerStrategy
-from pddl_tpu.parallel.tensor_parallel import TensorParallelStrategy
+from pddl_tpu.parallel.tensor_parallel import (
+    ExpertParallelStrategy,
+    TensorParallelStrategy,
+)
 
 __all__ = [
     "Strategy",
@@ -31,4 +34,5 @@ __all__ = [
     "MultiWorkerMirroredStrategy",
     "ParameterServerStrategy",
     "TensorParallelStrategy",
+    "ExpertParallelStrategy",
 ]

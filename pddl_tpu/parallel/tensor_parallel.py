@@ -33,6 +33,7 @@ from jax.sharding import NamedSharding, PartitionSpec
 from pddl_tpu.core import dist
 from pddl_tpu.core.mesh import (
     DATA_AXIS,
+    EXPERT_AXIS,
     MODEL_AXIS,
     MeshConfig,
     build_mesh,
@@ -47,14 +48,14 @@ PyTree = Any
 Rule = Tuple[str, Callable[[Tuple[int, ...]], Optional[PartitionSpec]]]
 
 
-def _shard_dim(dim: int):
-    """Spec factory: shard dimension ``dim`` of the leaf over ``model``."""
+def _shard_dim(dim: int, axis: str = MODEL_AXIS):
+    """Spec factory: shard dimension ``dim`` of the leaf over ``axis``."""
 
     def spec(shape: Tuple[int, ...]) -> Optional[PartitionSpec]:
         if dim >= len(shape):
             return None
         axes: list = [None] * len(shape)
-        axes[dim] = MODEL_AXIS
+        axes[dim] = axis
         return PartitionSpec(*axes)
 
     return spec
@@ -80,6 +81,14 @@ VIT_TP_RULES: Sequence[Rule] = (
     (r"/mlp2/kernel", _shard_dim(0)),                     # row-parallel (4E, E)
     (r"/mlp2/bias", lambda s: PartitionSpec()),
 )
+
+# Expert parallelism: Switch-MoE expert-major weights (pddl_tpu/ops/moe.py,
+# w1/w2/b1/b2 of shape [n_experts, ...]) shard dim 0 over `expert`; the
+# router stays replicated. Composes with the TP rules above.
+VIT_EP_RULES: Sequence[Rule] = (
+    (r"/moe/(w1|w2|b1|b2)", _shard_dim(0, EXPERT_AXIS)),
+    (r"/moe/router/", lambda s: PartitionSpec()),
+) + tuple(VIT_TP_RULES)
 
 
 @register_strategy("tensor_parallel")
@@ -109,28 +118,38 @@ class TensorParallelStrategy(Strategy):
             self._mesh = build_mesh(self._mesh_config)
         return self._mesh
 
-    def _spec_for(self, path: str, shape: Tuple[int, ...],
-                  model_size: int) -> PartitionSpec:
+    def _spec_for(self, path: str,
+                  shape: Tuple[int, ...]) -> PartitionSpec:
         for pat, fn in self.rules:
             if pat.search(path):
                 spec = fn(shape)
                 if spec is None:
                     continue
-                # The sharded dim must tile evenly over the model axis.
+                # Each sharded dim must tile evenly over its mesh axis
+                # (model, expert, ...) or the leaf stays replicated.
                 for i, ax in enumerate(spec):
-                    if ax == MODEL_AXIS and shape[i] % model_size:
+                    if ax is None:
+                        continue
+                    axis_size = self.mesh.shape[ax]
+                    if shape[i] % axis_size:
                         log.warning(
-                            "TP rule %s matched %s but dim %d (%d) is not "
-                            "divisible by model axis %d; leaf replicated",
-                            pat.pattern, path, i, shape[i], model_size,
+                            "rule %s matched %s but dim %d (%d) is not "
+                            "divisible by %s axis %d; leaf replicated",
+                            pat.pattern, path, i, shape[i], ax, axis_size,
                         )
                         return PartitionSpec()
-                return spec
+                # Canonicalize: a 1-way shard IS replication — drop axes of
+                # size 1 (e.g. TP rules under an expert-only mesh) and
+                # trailing Nones so replicated specs compare equal to P().
+                axes = [ax if ax is not None and self.mesh.shape[ax] > 1
+                        else None for ax in spec]
+                while axes and axes[-1] is None:
+                    axes.pop()
+                return PartitionSpec(*axes)
         return PartitionSpec()
 
     def state_sharding(self, state: PyTree) -> PyTree:
         mesh = self.mesh
-        model_size = mesh.shape[MODEL_AXIS]
 
         def tree_sharding(tree):
             flat, treedef = jax.tree_util.tree_flatten_with_path(tree)
@@ -141,7 +160,7 @@ class TensorParallelStrategy(Strategy):
                     for k in keypath
                 )
                 if hasattr(leaf, "shape") and leaf.ndim > 0:
-                    spec = self._spec_for(path, tuple(leaf.shape), model_size)
+                    spec = self._spec_for(path, tuple(leaf.shape))
                 else:
                     spec = PartitionSpec()
                 out.append(NamedSharding(mesh, spec))
@@ -153,4 +172,24 @@ class TensorParallelStrategy(Strategy):
             params=tree_sharding(state.params),
             batch_stats=jax.tree.map(lambda _: repl, state.batch_stats),
             opt_state=tree_sharding(state.opt_state),
+        )
+
+
+@register_strategy("expert_parallel")
+class ExpertParallelStrategy(TensorParallelStrategy):
+    """DP x EP (x TP) over a ``data`` x ``expert`` (x ``model``) mesh.
+
+    Expert-major MoE weights (``[n_experts, ...]``, see
+    :class:`pddl_tpu.ops.moe.SwitchFFN`) shard dim 0 over ``expert`` — one
+    expert group per device position; XLA lowers the dispatch/combine
+    einsums to all-to-alls on the ``expert`` axis. All other transformer
+    weights follow the Megatron TP rules (over ``model``, size 1 unless
+    ``model_parallel`` is raised), so EP and TP compose in one rule table.
+    """
+
+    def __init__(self, expert_parallel: int, model_parallel: int = 1,
+                 rules: Sequence[Rule] = VIT_EP_RULES, **kwargs):
+        super().__init__(model_parallel=model_parallel, rules=rules, **kwargs)
+        self._mesh_config = MeshConfig(
+            data=-1, model=model_parallel, expert=expert_parallel
         )

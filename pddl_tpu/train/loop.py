@@ -121,7 +121,15 @@ class Trainer:
         if rngs:
             kwargs["rngs"] = rngs
         if mutable:
-            return self.model.apply(variables, images, mutable=["batch_stats"], **kwargs)
+            # "losses" collects model-internal auxiliary losses sown via
+            # self.sow("losses", ...) — e.g. the MoE load-balancing loss
+            # (pddl_tpu/ops/moe.py); train AND eval steps add them to the
+            # task loss (Keras add_loss semantics: evaluate() includes
+            # add_loss terms, so train loss and val_loss stay comparable).
+            collections = ["batch_stats", "losses"] if train else ["losses"]
+            return self.model.apply(
+                variables, images, mutable=collections, **kwargs
+            )
         return self.model.apply(variables, images, **kwargs), {}
 
     def _build_steps(self) -> None:
@@ -141,7 +149,11 @@ class Trainer:
                     params, state.batch_stats, images, train=True,
                     rngs={"dropout": rng}, mutable=True,
                 )
-                return self.loss_fn(logits, labels), (logits, updates)
+                loss = self.loss_fn(logits, labels)
+                # Model-internal auxiliary losses (sown into "losses").
+                for aux in jax.tree.leaves(updates.get("losses", {})):
+                    loss = loss + jnp.sum(aux)
+                return loss, (logits, updates)
 
             (loss, (logits, updates)), grads = jax.value_and_grad(
                 loss_of, has_aux=True
@@ -158,8 +170,14 @@ class Trainer:
             images, labels = batch["image"], batch["label"]
             if self.eval_transform is not None:
                 images = self.eval_transform(images)
-            (logits, _) = self._apply(state.params, state.batch_stats, images, train=False)
-            logs = {"loss": self.loss_fn(logits, labels)}
+            (logits, updates) = self._apply(
+                state.params, state.batch_stats, images, train=False,
+                mutable=True,
+            )
+            loss = self.loss_fn(logits, labels)
+            for aux in jax.tree.leaves(updates.get("losses", {})):
+                loss = loss + jnp.sum(aux)
+            logs = {"loss": loss}
             for name, fn in self.metric_fns.items():
                 logs[name] = fn(logits, labels)
             return logs

@@ -43,16 +43,17 @@ def test_tp_param_shardings_follow_megatron_layout():
         return leaf.sharding.spec
 
     blk = params["block0"]
+    # (specs are canonicalized: trailing Nones trimmed)
     # column-parallel: q/k/v kernels (E, H, D) sharded on H
-    assert spec_of(blk["attn"]["query"]["kernel"]) == P(None, MODEL_AXIS, None)
-    assert spec_of(blk["attn"]["query"]["bias"]) == P(MODEL_AXIS, None)
+    assert spec_of(blk["attn"]["query"]["kernel"]) == P(None, MODEL_AXIS)
+    assert spec_of(blk["attn"]["query"]["bias"]) == P(MODEL_AXIS)
     # row-parallel: out kernel (E, E) sharded on the (head-major) input dim
-    assert spec_of(blk["attn"]["out"]["kernel"]) == P(MODEL_AXIS, None)
+    assert spec_of(blk["attn"]["out"]["kernel"]) == P(MODEL_AXIS)
     assert spec_of(blk["attn"]["out"]["bias"]) == P()
     # MLP: up column-parallel, down row-parallel
     assert spec_of(blk["mlp1"]["kernel"]) == P(None, MODEL_AXIS)
     assert spec_of(blk["mlp1"]["bias"]) == P(MODEL_AXIS)
-    assert spec_of(blk["mlp2"]["kernel"]) == P(MODEL_AXIS, None)
+    assert spec_of(blk["mlp2"]["kernel"]) == P(MODEL_AXIS)
     assert spec_of(blk["mlp2"]["bias"]) == P()
     # Non-transformer leaves stay replicated
     assert spec_of(params["patch_embed"]["kernel"]) == P()
