@@ -16,9 +16,12 @@ Axis conventions (all optional except ``data``):
 
 ========  =============================================================
 ``data``  data parallelism (batch sharding, gradient all-reduce via ICI)
-``model`` tensor parallelism (reserved; size 1 for ResNet parity runs)
+``model`` tensor parallelism (Megatron weight sharding,
+          :mod:`pddl_tpu.parallel.tensor_parallel`)
 ``seq``   sequence/context parallelism (ring attention, long context)
-``expert`` expert parallelism for MoE layers (reserved)
+``expert`` expert parallelism for MoE layers (:mod:`pddl_tpu.ops.moe`)
+``stage`` pipeline parallelism (GPipe microbatch pipeline,
+          :mod:`pddl_tpu.ops.pipeline`)
 ========  =============================================================
 
 The mesh is the *only* place device topology appears; everything above it
@@ -40,7 +43,8 @@ DATA_AXIS = "data"
 MODEL_AXIS = "model"
 SEQ_AXIS = "seq"
 EXPERT_AXIS = "expert"
-CANONICAL_AXES = (DATA_AXIS, MODEL_AXIS, SEQ_AXIS, EXPERT_AXIS)
+STAGE_AXIS = "stage"  # pipeline parallelism (GPipe microbatch pipeline)
+CANONICAL_AXES = (DATA_AXIS, MODEL_AXIS, SEQ_AXIS, EXPERT_AXIS, STAGE_AXIS)
 
 
 def local_device_count() -> int:
@@ -72,6 +76,7 @@ class MeshConfig:
     model: int = 1
     seq: int = 1
     expert: int = 1
+    stage: int = 1
     # Restrict to this process's local devices (mirrored strategy) instead of
     # the global device set (multi-worker).
     local_only: bool = False
@@ -82,6 +87,7 @@ class MeshConfig:
             MODEL_AXIS: self.model,
             SEQ_AXIS: self.seq,
             EXPERT_AXIS: self.expert,
+            STAGE_AXIS: self.stage,
         }
         for name, s in sizes.items():
             if s == 0 or s < -1:

@@ -141,20 +141,13 @@ class ViT(nn.Module):
 
     @nn.compact
     def __call__(self, x, *, train: bool = True):
-        p = self.patch_size
-        if x.shape[1] % p or x.shape[2] % p:
-            raise ValueError(f"image {x.shape[1]}x{x.shape[2]} not divisible "
-                             f"by patch {p}")
-        x = x.astype(self.dtype)
-        # Patchify = non-overlapping conv; one big MXU contraction.
-        x = nn.Conv(self.embed_dim, (p, p), strides=(p, p), padding="VALID",
-                    dtype=self.dtype, param_dtype=self.param_dtype,
-                    name="patch_embed")(x)
-        b, gh, gw, e = x.shape
-        x = x.reshape(b, gh * gw, e)
-        pos = self.param("pos_embed", nn.initializers.normal(0.02),
-                         (1, gh * gw, e), self.param_dtype)
-        x = x + pos.astype(self.dtype)
+        # Stem shared with GPipeViT; share_scope keeps the historical param
+        # names (patch_embed/pos_embed) at this module's top level.
+        embed = _ViTEmbed(patch_size=self.patch_size,
+                          embed_dim=self.embed_dim, dtype=self.dtype,
+                          param_dtype=self.param_dtype)
+        nn.share_scope(self, embed)
+        x = embed(x)
 
         for i in range(self.depth):
             # Interleave MoE FFN blocks (every moe_every-th, from the back
@@ -169,13 +162,156 @@ class ViT(nn.Module):
                 param_dtype=self.param_dtype, name=f"block{i}",
             )(x, train=train)
 
+        # Head shared with GPipeViT (ln_final/head names preserved).
+        head = _ViTHead(num_classes=self.num_classes, dtype=self.dtype,
+                        param_dtype=self.param_dtype)
+        nn.share_scope(self, head)
+        return head(x)
+
+
+class _ViTEmbed(nn.Module):
+    """Patch embed + positional embedding (ViT stem; also the pre-pipeline
+    stem of :class:`GPipeViT`). Single source of truth — ``ViT.__call__``
+    delegates here via ``nn.share_scope`` so param names are identical."""
+
+    patch_size: int
+    embed_dim: int
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        p = self.patch_size
+        if x.shape[1] % p or x.shape[2] % p:
+            raise ValueError(f"image {x.shape[1]}x{x.shape[2]} not divisible "
+                             f"by patch {p}")
+        x = x.astype(self.dtype)
+        x = nn.Conv(self.embed_dim, (p, p), strides=(p, p), padding="VALID",
+                    dtype=self.dtype, param_dtype=self.param_dtype,
+                    name="patch_embed")(x)
+        b, gh, gw, e = x.shape
+        x = x.reshape(b, gh * gw, e)
+        pos = self.param("pos_embed", nn.initializers.normal(0.02),
+                         (1, gh * gw, e), self.param_dtype)
+        return x + pos.astype(self.dtype)
+
+
+class _ViTStage(nn.Module):
+    """One pipeline stage: a run of transformer blocks (identical across
+    stages so their params stack on a leading ``[n_stages, ...]`` dim)."""
+
+    num_heads: int
+    blocks: int
+    mlp_ratio: int = 4
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        for i in range(self.blocks):
+            x = TransformerBlock(
+                num_heads=self.num_heads, mlp_ratio=self.mlp_ratio,
+                attention="reference", dtype=self.dtype,
+                param_dtype=self.param_dtype, name=f"block{i}",
+            )(x, train=False)
+        return x
+
+
+class _ViTHead(nn.Module):
+    """Final LN + mean pool + classifier (the post-pipeline head)."""
+
+    num_classes: int
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
         x = nn.LayerNorm(dtype=jnp.float32, param_dtype=self.param_dtype,
                          name="ln_final")(x)
-        x = jnp.mean(x, axis=1)  # mean-pool over tokens
+        x = jnp.mean(x, axis=1)
         if self.num_classes:
             x = nn.Dense(self.num_classes, dtype=self.dtype,
                          param_dtype=self.param_dtype, name="head")(x)
         return x.astype(jnp.float32)
+
+
+class GPipeViT:
+    """Pipeline-parallel ViT: embed (replicated) → ``n_stages`` stacked
+    transformer stages run through :func:`pddl_tpu.ops.pipeline.gpipe_apply`
+    → head (replicated).
+
+    Duck-types the flax ``init``/``apply`` surface the Trainer uses, so it
+    trains under any strategy whose mesh carries a ``stage`` axis
+    (:class:`pddl_tpu.parallel.pipeline.PipelineStrategy`). Dropout is
+    unsupported inside the pipeline (stages run deterministic).
+    """
+
+    def __init__(self, *, n_stages: int, blocks_per_stage: int,
+                 n_microbatches: int, mesh,
+                 patch_size: int = 16, embed_dim: int = 384,
+                 num_heads: int = 6, num_classes: int = 1000,
+                 mlp_ratio: int = 4,
+                 dtype: Any = jnp.float32, param_dtype: Any = jnp.float32):
+        from pddl_tpu.core.mesh import STAGE_AXIS
+
+        if mesh.shape[STAGE_AXIS] != n_stages:
+            raise ValueError(
+                f"n_stages={n_stages} but the mesh's '{STAGE_AXIS}' axis has "
+                f"size {mesh.shape[STAGE_AXIS]} — they must match (one "
+                "pipeline stage per mesh position)"
+            )
+        self.n_stages = n_stages
+        self.n_microbatches = n_microbatches
+        self.mesh = mesh
+        self.embed = _ViTEmbed(patch_size=patch_size, embed_dim=embed_dim,
+                               dtype=dtype, param_dtype=param_dtype)
+        self.stage = _ViTStage(num_heads=num_heads, blocks=blocks_per_stage,
+                               mlp_ratio=mlp_ratio, dtype=dtype,
+                               param_dtype=param_dtype)
+        self.head = _ViTHead(num_classes=num_classes, dtype=dtype,
+                             param_dtype=param_dtype)
+
+    # -- flax-like surface --------------------------------------------------
+    def init(self, rng, x, train: bool = False):
+        r_embed, r_stage, r_head = jax.random.split(rng, 3)
+        embed_params = self.embed.init(r_embed, x)["params"]
+        h = self.embed.apply({"params": embed_params}, x)
+        stage_params = [
+            self.stage.init(jax.random.fold_in(r_stage, i), h)["params"]
+            for i in range(self.n_stages)
+        ]
+        stacked = jax.tree.map(lambda *leaves: jnp.stack(leaves), *stage_params)
+        head_params = self.head.init(r_head, h)["params"]
+        return {"params": {"embed": embed_params, "stages": stacked,
+                           "head": head_params}}
+
+    def _stage_fn(self, params_slice, h):
+        return self.stage.apply({"params": params_slice}, h)
+
+    def apply(self, variables, x, *, train: bool = True, mutable=False,
+              rngs=None):
+        from pddl_tpu.ops.pipeline import gpipe_apply
+
+        p = variables["params"]
+        h = self.embed.apply({"params": p["embed"]}, x)
+        h = gpipe_apply(
+            p["stages"], h, mesh=self.mesh, stage_fn=self._stage_fn,
+            n_microbatches=self.n_microbatches,
+        )
+        out = self.head.apply({"params": p["head"]}, h)
+        if mutable:
+            return out, {}
+        return out
+
+    def apply_sequential(self, variables, x):
+        """Reference path: the same stacked params applied stage by stage
+        with no pipeline — the numerics oracle for tests."""
+        p = variables["params"]
+        h = self.embed.apply({"params": p["embed"]}, x)
+        for i in range(self.n_stages):
+            h = self._stage_fn(
+                jax.tree.map(lambda leaf: leaf[i], p["stages"]), h)
+        return self.head.apply({"params": p["head"]}, h)
 
 
 ViT_S16 = functools.partial(ViT, patch_size=16, embed_dim=384, depth=12,

@@ -25,6 +25,7 @@ from pddl_tpu.parallel.tensor_parallel import (
     ExpertParallelStrategy,
     TensorParallelStrategy,
 )
+from pddl_tpu.parallel.pipeline import PipelineStrategy
 
 __all__ = [
     "Strategy",
@@ -35,4 +36,5 @@ __all__ = [
     "ParameterServerStrategy",
     "TensorParallelStrategy",
     "ExpertParallelStrategy",
+    "PipelineStrategy",
 ]

@@ -1,0 +1,50 @@
+"""Pipeline-parallel strategy: DP x PP over a ``data`` x ``stage`` mesh.
+
+Beyond-parity capability (the reference has no pipeline parallelism —
+SURVEY.md §2c). Stage-stacked parameters (leading ``[n_stages, ...]`` dim,
+see :class:`pddl_tpu.models.vit.GPipeViT`) shard dim 0 over the ``stage``
+axis — one stage's weights per mesh position; the GPipe schedule itself is
+:func:`pddl_tpu.ops.pipeline.gpipe_apply` (scan + ppermute, one compiled
+SPMD program, AD-derived backward). Optimizer moments inherit the stage
+layout via the same path rules.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+from pddl_tpu.core.mesh import MeshConfig, STAGE_AXIS
+from pddl_tpu.parallel.base import register_strategy
+from pddl_tpu.parallel.tensor_parallel import (
+    Rule,
+    TensorParallelStrategy,
+    _shard_dim,
+)
+
+# Stage-stacked parameter trees live under a "stages" key; everything in
+# them shards its leading (stage) dim. Embed/head params fall through the
+# rule table and replicate.
+PIPELINE_RULES: Sequence[Rule] = (
+    (r"/stages/", _shard_dim(0, STAGE_AXIS)),
+)
+
+
+@register_strategy("pipeline")
+class PipelineStrategy(TensorParallelStrategy):
+    """DP x PP: batch sharded over ``data``, stage weights over ``stage``.
+
+    Args:
+      n_stages: size of the ``stage`` mesh axis (remaining devices form
+        the ``data`` axis).
+      model_parallel: optional TP inside each stage (composes; the rule
+        table is consulted first-match so pass combined rules if both are
+        wanted on custom models).
+    """
+
+    def __init__(self, n_stages: int, model_parallel: int = 1,
+                 rules: Sequence[Rule] = PIPELINE_RULES, **kwargs):
+        super().__init__(model_parallel=model_parallel, rules=rules, **kwargs)
+        self._mesh_config = MeshConfig(
+            data=-1, model=model_parallel, stage=n_stages
+        )
+        self.n_stages = n_stages
