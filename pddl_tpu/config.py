@@ -40,7 +40,8 @@ class ExperimentConfig:
     val_per_replica_batch: Optional[int] = None
     data_shard: str = "data"  # "data" | "batch" | "none"
     # strategy
-    strategy: str = "single"  # single|mirrored|multiworker|ps
+    strategy: str = "single"  # single|mirrored|multiworker|ps|
+    #                           tensor_parallel|expert_parallel|pipeline
     strategy_options: Dict[str, Any] = dataclasses.field(default_factory=dict)
     # optimizer / schedule
     optimizer: str = "adam"

@@ -11,13 +11,16 @@ layout via the same path rules.
 
 from __future__ import annotations
 
-from typing import Optional, Sequence
+from typing import Callable, Optional, Sequence, Tuple
+
+from jax.sharding import PartitionSpec
 
 from pddl_tpu.core.mesh import MeshConfig, STAGE_AXIS
 from pddl_tpu.parallel.base import register_strategy
 from pddl_tpu.parallel.tensor_parallel import (
     Rule,
     TensorParallelStrategy,
+    VIT_TP_RULES,
     _shard_dim,
 )
 
@@ -29,20 +32,47 @@ PIPELINE_RULES: Sequence[Rule] = (
 )
 
 
+def _stage_shifted(fn: Callable) -> Callable:
+    """Lift a TP spec rule onto stage-stacked leaves: the leading dim
+    shards over ``stage``, the TP spec applies to the rest."""
+
+    def spec(shape: Tuple[int, ...]) -> Optional[PartitionSpec]:
+        if len(shape) < 2:
+            return None
+        inner = fn(shape[1:])
+        if inner is None:
+            return None
+        return PartitionSpec(STAGE_AXIS, *inner)
+
+    return spec
+
+
+# 3D parallelism (DP x PP x TP): staged block weights shard over BOTH
+# `stage` (leading dim) and `model` (the Megatron layout, shifted right by
+# one); anything else under /stages/ (LayerNorms, ...) shards over `stage`
+# only; embed/head fall through and replicate.
+PIPELINE_TP_RULES: Sequence[Rule] = tuple(
+    (r"/stages/.*" + pat.lstrip("/"), _stage_shifted(fn))
+    for pat, fn in VIT_TP_RULES
+) + tuple(PIPELINE_RULES)
+
+
 @register_strategy("pipeline")
 class PipelineStrategy(TensorParallelStrategy):
-    """DP x PP: batch sharded over ``data``, stage weights over ``stage``.
+    """DP x PP (x TP): batch over ``data``, stage weights over ``stage``,
+    optionally Megatron TP over ``model`` inside each stage.
 
     Args:
       n_stages: size of the ``stage`` mesh axis (remaining devices form
         the ``data`` axis).
-      model_parallel: optional TP inside each stage (composes; the rule
-        table is consulted first-match so pass combined rules if both are
-        wanted on custom models).
+      model_parallel: TP degree inside each stage; >1 switches the default
+        rule table to the combined 3D layout (``PIPELINE_TP_RULES``).
     """
 
     def __init__(self, n_stages: int, model_parallel: int = 1,
-                 rules: Sequence[Rule] = PIPELINE_RULES, **kwargs):
+                 rules: Optional[Sequence[Rule]] = None, **kwargs):
+        if rules is None:
+            rules = PIPELINE_TP_RULES if model_parallel > 1 else PIPELINE_RULES
         super().__init__(model_parallel=model_parallel, rules=rules, **kwargs)
         self._mesh_config = MeshConfig(
             data=-1, model=model_parallel, stage=n_stages

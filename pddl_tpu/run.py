@@ -218,7 +218,14 @@ def main(argv=None) -> int:
     p.add_argument("--num-classes", type=int, default=None)
     p.add_argument("--model", default=None)
     p.add_argument("--strategy", default=None,
-                   choices=["single", "mirrored", "multiworker", "ps"])
+                   choices=["single", "mirrored", "multiworker", "ps",
+                            "tensor_parallel", "expert_parallel"])
+    # (pipeline parallelism needs a stage-stacked model — GPipeViT — which
+    # carries its mesh; it is a library-API construction, see README.)
+    p.add_argument("--model-parallel", type=int, default=None,
+                   help="TP degree (tensor_parallel/expert_parallel only)")
+    p.add_argument("--expert-parallel", type=int, default=None,
+                   help="EP degree (expert_parallel only)")
     p.add_argument("--pretrained-h5", default=None)
     p.add_argument("--checkpoint-dir", default=None)
     p.add_argument("--resume", action="store_true")
@@ -247,6 +254,24 @@ def main(argv=None) -> int:
         overrides["resume"] = True
     if args.synthetic:
         overrides["data_dir"] = None
+
+    # Degree flags only apply to the strategies whose constructors take
+    # them; reject mismatches here instead of a TypeError deep inside.
+    if args.model_parallel is not None and args.strategy not in (
+            "tensor_parallel", "expert_parallel"):
+        p.error("--model-parallel requires --strategy tensor_parallel "
+                "or expert_parallel")
+    if args.expert_parallel is not None and args.strategy != "expert_parallel":
+        p.error("--expert-parallel requires --strategy expert_parallel")
+    if args.strategy == "expert_parallel" and args.expert_parallel is None:
+        p.error("--strategy expert_parallel needs --expert-parallel N")
+    strategy_options = {}
+    if args.model_parallel is not None:
+        strategy_options["model_parallel"] = args.model_parallel
+    if args.expert_parallel is not None:
+        strategy_options["expert_parallel"] = args.expert_parallel
+    if strategy_options:
+        overrides["strategy_options"] = strategy_options
 
     cfg = get_preset(args.preset, **overrides)
     run_experiment(cfg)

@@ -85,6 +85,38 @@ def test_pipeline_strategy_shards_stages_and_trains():
     assert moment and all(m.sharding.spec[0] == STAGE_AXIS for m in moment)
 
 
+def test_3d_parallelism_dp_pp_tp():
+    """data=2 x stage=2 x model=2: staged block weights shard over BOTH
+    stage and model; the full 3D train step compiles and trains."""
+    from pddl_tpu.core.mesh import MODEL_AXIS
+
+    strategy = PipelineStrategy(n_stages=2, model_parallel=2)
+    mesh = strategy.setup()
+    assert mesh.shape == {"data": 2, "model": 2, "seq": 1, "expert": 1,
+                          "stage": 2}
+    model = GPipeViT(n_stages=2, blocks_per_stage=1, n_microbatches=2,
+                     mesh=mesh, patch_size=8, embed_dim=32, num_heads=4,
+                     num_classes=8)
+    tr = Trainer(model, optimizer="adamw", learning_rate=1e-3,
+                 strategy=strategy, seed=0)
+    ds = SyntheticImageClassification(
+        batch_size=8, image_size=32, num_classes=8, seed=0,
+        signal_strength=3.0)
+    hist = tr.fit(ds, epochs=2, steps_per_epoch=4, verbose=0)
+    assert hist.history["loss"][-1] < hist.history["loss"][0]
+
+    stages = tr.state.params["stages"]
+    # q/k/v kernels: [n_stages, E, H, D] -> P(stage, None, model)
+    qk = stages["block0"]["attn"]["query"]["kernel"]
+    assert qk.sharding.spec == P(STAGE_AXIS, None, MODEL_AXIS)
+    # MLP up: [n_stages, E, 4E] -> P(stage, None, model)
+    m1 = stages["block0"]["mlp1"]["kernel"]
+    assert m1.sharding.spec == P(STAGE_AXIS, None, MODEL_AXIS)
+    # LayerNorm scale: [n_stages, E] -> stage only
+    ln = stages["block0"]["ln1"]["scale"]
+    assert ln.sharding.spec == P(STAGE_AXIS)
+
+
 def test_pipeline_bubble_arithmetic():
     """Every microbatch count yields the same math (bubble only wastes
     compute, never correctness)."""
