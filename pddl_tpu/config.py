@@ -39,6 +39,9 @@ class ExperimentConfig:
     per_replica_batch: int = 32
     val_per_replica_batch: Optional[int] = None
     data_shard: str = "data"  # "data" | "batch" | "none"
+    # language-model runs (models named gpt_*): sequence length of the
+    # synthetic next-token task; vocab comes from num_classes.
+    seq_len: int = 64
     # strategy
     strategy: str = "single"  # single|mirrored|multiworker|ps|
     #                           tensor_parallel|expert_parallel|pipeline

@@ -1,0 +1,85 @@
+"""GPT family: decoder-only causal transformer (the long-context workload).
+
+The reference is a vision-only repo (fixed 224x224 CNN,
+``/root/reference/imagenet-resnet50.py:52`` — SURVEY.md §5 "Long-context:
+absent"); this family exists because long-context training is first-class
+in the TPU build. It is the model line that exercises *causal* flash
+attention (:mod:`pddl_tpu.ops.attention`) and causal ring attention
+(:mod:`pddl_tpu.ops.ring_attention`) on the training path, and it reuses
+:class:`pddl_tpu.models.vit.TransformerBlock` — so Megatron TP
+(``/attn/``-path rules), Switch-MoE and every distribution strategy apply
+unchanged.
+
+Batches are ``{"tokens": int32 [B, S], "targets": int32 [B, S]}`` (the
+Trainer's ``input_key``/``target_key``); loss/metrics are the standard
+sparse CE / accuracy, which broadcast over the sequence dim as-is.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Optional
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from pddl_tpu.models.vit import TransformerBlock
+
+
+class GPT(nn.Module):
+    """Decoder-only transformer LM: tokens ``[B, S]`` → logits ``[B, S, V]``."""
+
+    vocab_size: int
+    max_len: int = 1024
+    embed_dim: int = 256
+    depth: int = 4
+    num_heads: int = 4
+    mlp_ratio: int = 4
+    attention: str = "flash"  # "flash" | "reference" | "ring"
+    mesh: Optional[Any] = None  # required for "ring"
+    dropout: float = 0.0
+    moe_experts: int = 0
+    moe_every: int = 2
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, tokens, *, train: bool = True):
+        b, s = tokens.shape
+        if s > self.max_len:
+            raise ValueError(f"sequence {s} exceeds max_len {self.max_len}")
+        x = nn.Embed(self.vocab_size, self.embed_dim,
+                     dtype=self.dtype, param_dtype=self.param_dtype,
+                     name="token_embed")(tokens)
+        pos = self.param("pos_embed", nn.initializers.normal(0.02),
+                         (1, self.max_len, self.embed_dim), self.param_dtype)
+        x = x + pos[:, :s].astype(self.dtype)
+
+        for i in range(self.depth):
+            moe = (self.moe_experts
+                   if (self.depth - 1 - i) % self.moe_every == 0 else 0)
+            x = TransformerBlock(
+                num_heads=self.num_heads, mlp_ratio=self.mlp_ratio,
+                attention=self.attention, mesh=self.mesh, causal=True,
+                dropout=self.dropout, moe_experts=moe, dtype=self.dtype,
+                param_dtype=self.param_dtype, name=f"block{i}",
+            )(x, train=train)
+
+        x = nn.LayerNorm(dtype=jnp.float32, param_dtype=self.param_dtype,
+                         name="ln_final")(x)
+        logits = nn.Dense(self.vocab_size, dtype=self.dtype,
+                          param_dtype=self.param_dtype, name="lm_head")(x)
+        return logits.astype(jnp.float32)
+
+
+GPT_Small = functools.partial(GPT, embed_dim=768, depth=12, num_heads=12)
+
+
+def tiny_gpt(vocab_size: int = 64, **kwargs) -> GPT:
+    """Miniature GPT for tests/dry-runs."""
+    kwargs.setdefault("max_len", 128)
+    kwargs.setdefault("embed_dim", 32)
+    kwargs.setdefault("depth", 2)
+    kwargs.setdefault("num_heads", 4)
+    kwargs.setdefault("attention", "reference")
+    return GPT(vocab_size=vocab_size, **kwargs)

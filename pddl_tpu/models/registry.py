@@ -53,5 +53,21 @@ def _populate() -> None:
     register_model("vit_l16", _vit(vit.ViT_L16))
     register_model("tiny_vit", _vit(vit.tiny_vit))
 
+    from pddl_tpu.models import gpt
+
+    # GPT configs take no bn_mode; num_classes maps onto vocab_size so the
+    # uniform ExperimentConfig drives LMs too (run.py sets the LM batch
+    # keys and synthetic-text data for these names).
+    def _gpt(factory):
+        def make(bn_mode: str = "train", num_classes: int = 0, **kwargs):
+            if num_classes and "vocab_size" not in kwargs:
+                kwargs["vocab_size"] = num_classes
+            return factory(**kwargs)
+
+        return make
+
+    register_model("gpt_small", _gpt(gpt.GPT_Small))
+    register_model("tiny_gpt", _gpt(gpt.tiny_gpt))
+
 
 _populate()

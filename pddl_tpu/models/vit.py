@@ -38,6 +38,7 @@ class MultiHeadAttention(nn.Module):
     num_heads: int
     attention: str = "flash"  # "flash" | "reference" | "ring"
     mesh: Optional[Any] = None  # required for "ring"
+    causal: bool = False  # decoder-style masking (the GPT family)
     dtype: Any = jnp.float32
     param_dtype: Any = jnp.float32
 
@@ -57,15 +58,16 @@ class MultiHeadAttention(nn.Module):
         q, k, v = (t.transpose(0, 2, 1, 3) for t in (q, k, v))
 
         if self.attention == "flash":
-            o = flash_attention(q, k, v)
+            o = flash_attention(q, k, v, causal=self.causal)
         elif self.attention == "reference":
-            o = attention_reference(q, k, v)
+            o = attention_reference(q, k, v, causal=self.causal)
         elif self.attention == "ring":
             from pddl_tpu.ops.ring_attention import sequence_parallel_attention
 
             if self.mesh is None:
                 raise ValueError('attention="ring" needs the mesh')
-            o = sequence_parallel_attention(q, k, v, self.mesh)
+            o = sequence_parallel_attention(q, k, v, self.mesh,
+                                            causal=self.causal)
         else:
             raise ValueError(f"unknown attention {self.attention!r}")
 
@@ -78,6 +80,7 @@ class TransformerBlock(nn.Module):
     mlp_ratio: int = 4
     attention: str = "flash"
     mesh: Optional[Any] = None
+    causal: bool = False
     dropout: float = 0.0
     moe_experts: int = 0  # >0: Switch-MoE FFN instead of the dense MLP
     dtype: Any = jnp.float32
@@ -91,8 +94,8 @@ class TransformerBlock(nn.Module):
                          name="ln1")(x)
         h = MultiHeadAttention(
             num_heads=self.num_heads, attention=self.attention,
-            mesh=self.mesh, dtype=self.dtype, param_dtype=self.param_dtype,
-            name="attn",
+            mesh=self.mesh, causal=self.causal, dtype=self.dtype,
+            param_dtype=self.param_dtype, name="attn",
         )(h.astype(self.dtype))
         if self.dropout:
             h = nn.Dropout(self.dropout, deterministic=not train)(h)

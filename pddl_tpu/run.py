@@ -43,20 +43,28 @@ def build_trainer(cfg: ExperimentConfig, strategy=None):
     if cfg.scale_lr:  # Horovod's 0.1*size (imagenet-resnet50-hvd.py:99)
         lr = strategy.scale_learning_rate(lr)
 
-    # Crop never exceeds the input (the reference's RandomCrop(244) on 224
-    # inputs is the documented bug we deliberately fix — SURVEY.md §0); a
-    # preset crop (hvd: 160) shrinks proportionally if image_size is
-    # overridden smaller.
-    crop = min(cfg.crop or cfg.image_size, cfg.image_size)
-    trainer = Trainer(
-        model,
-        optimizer=cfg.optimizer,
-        learning_rate=lr,
-        strategy=strategy,
-        seed=cfg.seed,
-        augment=standard_augment(crop=crop, flip=cfg.flip),
-        eval_transform=standard_eval_transform(crop=crop),
-    )
+    if _is_lm(cfg.model):
+        # Language models: token batches, no image augmentation.
+        trainer = Trainer(
+            model, optimizer=cfg.optimizer, learning_rate=lr,
+            strategy=strategy, seed=cfg.seed,
+            input_key="tokens", target_key="targets",
+        )
+    else:
+        # Crop never exceeds the input (the reference's RandomCrop(244) on
+        # 224 inputs is the documented bug we deliberately fix — SURVEY.md
+        # §0); a preset crop (hvd: 160) shrinks proportionally if
+        # image_size is overridden smaller.
+        crop = min(cfg.crop or cfg.image_size, cfg.image_size)
+        trainer = Trainer(
+            model,
+            optimizer=cfg.optimizer,
+            learning_rate=lr,
+            strategy=strategy,
+            seed=cfg.seed,
+            augment=standard_augment(crop=crop, flip=cfg.flip),
+            eval_transform=standard_eval_transform(crop=crop),
+        )
 
     callbacks = []
     if cfg.reduce_lr_on_plateau:  # defaults = reference's (:64)
@@ -81,6 +89,11 @@ def build_trainer(cfg: ExperimentConfig, strategy=None):
     return trainer, callbacks
 
 
+def _is_lm(model_name: str) -> bool:
+    """Language-model registry names (token batches, no augmentation)."""
+    return model_name.startswith("gpt") or model_name.endswith("gpt")
+
+
 def build_data(cfg: ExperimentConfig, strategy):
     """Train/val iterables: real ImageNet when ``data_dir`` is set, else
     synthetic (same shapes/dtypes)."""
@@ -88,6 +101,25 @@ def build_data(cfg: ExperimentConfig, strategy):
     val_global = strategy.scale_batch_size(
         cfg.val_per_replica_batch or cfg.per_replica_batch
     )
+    if _is_lm(cfg.model):
+        if cfg.data_dir:
+            raise ValueError(
+                "text-corpus ingestion is not wired into the CLI yet; run "
+                "LM models with --synthetic (the deterministic next-token "
+                "task) or drive the Trainer via the library API"
+            )
+        from pddl_tpu.data.synthetic import SyntheticLanguageModeling
+
+        n_procs = strategy.data_process_count
+        common = dict(
+            seq_len=cfg.seq_len, vocab_size=cfg.num_classes or 64,
+            seed=cfg.seed,
+            process_index=strategy.process_index if n_procs > 1 else 0,
+            process_count=n_procs,
+        )
+        return (SyntheticLanguageModeling(batch_size=global_batch, **common),
+                SyntheticLanguageModeling(batch_size=val_global,
+                                          index_offset=1 << 20, **common))
     if cfg.data_dir:
         from pddl_tpu.data.imagenet import load_imagenet
 

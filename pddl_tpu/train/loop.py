@@ -63,8 +63,12 @@ class Trainer:
         augment: Optional[Callable] = None,  # fn(rng, images) -> images, on-device
         eval_transform: Optional[Callable] = None,  # fn(images) -> images, deterministic
         donate_state: bool = True,
+        input_key: str = "image",   # batch keys; the GPT family uses
+        target_key: str = "label",  # tokens/targets (models/gpt.py)
     ):
         self.model = model
+        self.input_key = input_key
+        self.target_key = target_key
         self.strategy = strategy or SingleDeviceStrategy()
         self.tx = make_optimizer(optimizer, learning_rate)
         self.eval_transform = eval_transform
@@ -90,8 +94,8 @@ class Trainer:
         round-trip, no replicated staging (matters for PS-sharded state).
         """
         mesh = self.strategy.setup()
-        image = jnp.zeros((1,) + tuple(np.asarray(sample_batch["image"]).shape[1:]),
-                          np.asarray(sample_batch["image"]).dtype)
+        sample = np.asarray(sample_batch[self.input_key])
+        image = jnp.zeros((1,) + tuple(sample.shape[1:]), sample.dtype)
         rng = jax.random.key(self.seed)
 
         def _init(rng):
@@ -138,7 +142,7 @@ class Trainer:
         base_rng = jax.random.key(self.seed + 1)
 
         def train_step(state: TrainState, batch):
-            images, labels = batch["image"], batch["label"]
+            images, labels = batch[self.input_key], batch[self.target_key]
             rng = jax.random.fold_in(base_rng, state.step)
             if self.augment is not None:
                 aug_rng, rng = jax.random.split(rng)
@@ -167,7 +171,7 @@ class Trainer:
             return new_state, logs
 
         def eval_step(state: TrainState, batch):
-            images, labels = batch["image"], batch["label"]
+            images, labels = batch[self.input_key], batch[self.target_key]
             if self.eval_transform is not None:
                 images = self.eval_transform(images)
             (logits, updates) = self._apply(
@@ -182,7 +186,7 @@ class Trainer:
                 logs[name] = fn(logits, labels)
             return logs
 
-        batch_shardings = {"image": batch_sh, "label": batch_sh}
+        batch_shardings = {self.input_key: batch_sh, self.target_key: batch_sh}
         self._train_step = jax.jit(
             train_step,
             in_shardings=(state_sh, batch_shardings),
@@ -228,6 +232,7 @@ class Trainer:
         self._run_hooks(callbacks, "on_train_begin")
 
         final_logs: Dict[str, float] = {}
+        stopped_mid_epoch = False
         for epoch in range(initial_epoch, epochs):
             if self.stop_training:
                 break
@@ -252,7 +257,7 @@ class Trainer:
                     batch = next(epoch_iter)
                 except StopIteration:
                     break
-                samples += len(np.asarray(batch["label"])) * (
+                samples += len(np.asarray(batch[self.target_key])) * (
                     self.strategy.data_process_count
                 )
                 global_batch = self.strategy.distribute_batch(batch)
@@ -263,8 +268,20 @@ class Trainer:
                 )
                 steps += 1
                 self.global_step += 1
+                if self.stop_training:
+                    # Honored mid-epoch (Keras semantics) — e.g. preemption
+                    # checkpointing stops at the next batch boundary.
+                    stopped_mid_epoch = True
+                    break
             if steps == 0:
                 raise ValueError("empty training dataset/epoch")
+            if stopped_mid_epoch:
+                # A mid-epoch stop means "exit NOW" (preemption grace
+                # window): no validation pass, no epoch-end hooks (whose
+                # checkpoint saves could also collide with the preemption
+                # save), no partial-epoch History entry that would mislead
+                # plateau/early-stop logic on resume.
+                break
 
             # Training throughput: window closes before validation runs.
             dt = time.perf_counter() - t0
@@ -324,7 +341,8 @@ class Trainer:
         """Forward pass (inference mode) on a batch of images."""
         if self.state is None:
             raise RuntimeError("call fit() or init_state() before predict()")
-        x = self.strategy.distribute_batch({"image": np.asarray(images)})["image"]
+        x = self.strategy.distribute_batch(
+            {self.input_key: np.asarray(images)})[self.input_key]
         if self.eval_transform is not None:
             x = self.eval_transform(x)
         logits, _ = self._apply(self.state.params, self.state.batch_stats, x, train=False)

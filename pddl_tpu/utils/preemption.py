@@ -1,0 +1,90 @@
+"""Preemption handling: checkpoint-on-SIGTERM, the failure-detection layer.
+
+The reference has almost nothing here (SURVEY.md §5 "Failure detection":
+its only crumbs are ``GRPC_FAIL_FAST`` — ``/root/reference/
+imagenet-resnet50-ps.py:67-69`` — and the Horovod re-broadcast comment).
+On Cloud TPU the real-world failure mode is *preemption*: the VM gets a
+SIGTERM with a grace window. This callback turns that signal into a clean
+save + stop, pairing with :class:`pddl_tpu.ckpt.BackupAndRestore` /
+``--resume`` for end-to-end crash-resume:
+
+    trainer.fit(..., callbacks=[PreemptionCheckpoint("/ckpt/run1")])
+
+The handler only sets a flag (async-signal-safe); the actual save happens
+at the next batch boundary on the training thread, so the checkpoint is a
+consistent TrainState, not a torn mid-step capture.
+"""
+
+from __future__ import annotations
+
+import logging
+import signal
+from typing import Optional
+
+from pddl_tpu.train.callbacks import Callback
+
+log = logging.getLogger(__name__)
+
+
+class PreemptionCheckpoint(Callback):
+    """Save a checkpoint and stop training cleanly when preempted.
+
+    Args:
+      directory: checkpoint directory (shared with ``BackupAndRestore`` /
+        ``--resume`` so the restarted job continues from the save).
+      signals: which signals mean "about to be killed" (default SIGTERM —
+        what Cloud TPU / GCE / Slurm send before eviction).
+      restore_previous_handlers: put the old handlers back at train end.
+    """
+
+    def __init__(self, directory: str, signals=(signal.SIGTERM,),
+                 restore_previous_handlers: bool = True):
+        self.directory = directory
+        self.signals = tuple(signals)
+        self.restore_previous_handlers = restore_previous_handlers
+        self.preempted = False
+        self._previous: dict = {}
+        self._ckpt = None
+        self._epoch = 0
+
+    # -- signal plumbing ----------------------------------------------------
+    def _on_signal(self, signum, frame):  # async-signal-safe: flag only
+        self.preempted = True
+
+    def on_train_begin(self, state):
+        from pddl_tpu.ckpt.checkpoint import Checkpointer
+
+        # Sync saves: during a grace window there may be no "later" to
+        # finish an async save in.
+        self._ckpt = Checkpointer(self.directory, max_to_keep=2,
+                                  async_save=False)
+        for sig in self.signals:
+            self._previous[sig] = signal.signal(sig, self._on_signal)
+        return None
+
+    def on_epoch_begin(self, epoch, state):
+        self._epoch = epoch
+        return None
+
+    # -- checkpoint at the next safe point ---------------------------------
+    def on_train_batch_end(self, step, state, logs):
+        if not self.preempted or self.trainer.stop_training:
+            return None
+        log.warning("preemption signal received: checkpointing to %s and "
+                    "stopping", self.directory)
+        # epoch-1: the interrupted epoch is incomplete, so --resume's
+        # initial_epoch = saved+1 restarts exactly it.
+        self._ckpt.save(state, epoch=self._epoch - 1, metrics=None,
+                        force=True)
+        self._ckpt.wait()
+        self.trainer.stop_training = True
+        return None
+
+    def on_train_end(self, state, logs):
+        if self.restore_previous_handlers:
+            for sig, old in self._previous.items():
+                signal.signal(sig, old)
+        if self._ckpt is not None:
+            self._ckpt.close()
+            self._ckpt = None
+        return None
