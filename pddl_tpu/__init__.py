@@ -14,9 +14,16 @@ TPU-first:
   ``compile``/``fit`` surface (callbacks, History, validation) as a custom
   jitted train loop.
 - **Model zoo** — Flax ResNet family with exact ``tf.keras.applications``
-  architecture parity, plus pretrained-weight import from Keras ``.h5``.
-- **First-class long-context / distributed ops** — ring attention,
-  sequence-parallel helpers, Pallas kernels (``pddl_tpu.ops``).
+  architecture parity (pretrained-weight import/export via Keras ``.h5``),
+  ViT family (incl. the pipeline-parallel ``GPipeViT``), and the causal
+  GPT family for long-context work.
+- **Every parallelism axis, composable** — data / tensor (Megatron) /
+  sequence (ring attention) / expert (Switch-MoE) / pipeline (GPipe) over
+  one mesh (``data``/``model``/``seq``/``expert``/``stage``), plus
+  ZeRO-style sharded state (the PS strategy).
+- **First-class long-context / distributed ops** — Pallas flash attention
+  (fused forward AND backward), ring attention, GPipe schedule, MoE
+  dispatch (``pddl_tpu.ops``).
 
 The package name abbreviates the reference repo name
 (Parallel-and-Distributed-Deep-Learning → ``pddl``) + ``_tpu``.

@@ -198,10 +198,16 @@ def _as_mutable(tree):
 
 def export_keras_style_h5(path: str, variables: PyTree,
                           stage_sizes: Sequence[int] = (3, 4, 6, 3)) -> None:
-    """Write the model tree as a Keras-layout ``.h5`` — the final-save
-    counterpart of the reference's ``model.save('...-reuse.h5')``
-    (``imagenet-resnet50.py:69-72``), loadable back by
-    :func:`load_keras_resnet50_h5` (and name-compatible with Keras)."""
+    """Write the model tree as a Keras legacy ``.h5`` weight file — the
+    final-save counterpart of the reference's ``model.save('...-reuse.h5')``
+    (``imagenet-resnet50.py:69-72``).
+
+    Emits the genuine legacy format (root ``layer_names`` attr, per-layer
+    ``weight_names`` attrs), so the file loads back both via
+    :func:`load_keras_resnet50_h5` AND via
+    ``tf.keras.Model.load_weights(path, by_name=True)`` — verified against
+    keras.applications.ResNet50 in ``tests/test_keras_parity.py``.
+    """
     import h5py  # noqa: PLC0415
 
     params = _as_mutable(variables["params"])
@@ -213,6 +219,7 @@ def export_keras_style_h5(path: str, variables: PyTree,
             node = node[k]
         return node
 
+    layer_names = []
     with h5py.File(path, "w") as f:
         for layer_name, (kind, module_path) in keras_layer_map(stage_sizes).items():
             if layer_name == "probs":  # alias of predictions
@@ -221,14 +228,25 @@ def export_keras_style_h5(path: str, variables: PyTree,
                 node = get(params, module_path)
             except KeyError:
                 continue
-            g = f.create_group(layer_name).create_group(layer_name)
+            top = f.create_group(layer_name)
+            g = top.create_group(layer_name)
             if kind in ("conv", "dense"):
-                g.create_dataset("kernel:0", data=np.asarray(node["kernel"]))
+                weights = {"kernel:0": np.asarray(node["kernel"])}
                 if "bias" in node:
-                    g.create_dataset("bias:0", data=np.asarray(node["bias"]))
+                    weights["bias:0"] = np.asarray(node["bias"])
             else:
-                g.create_dataset("gamma:0", data=np.asarray(node["scale"]))
-                g.create_dataset("beta:0", data=np.asarray(node["bias"]))
                 stat = get(stats, module_path)
-                g.create_dataset("moving_mean:0", data=np.asarray(stat["mean"]))
-                g.create_dataset("moving_variance:0", data=np.asarray(stat["var"]))
+                weights = {
+                    "gamma:0": np.asarray(node["scale"]),
+                    "beta:0": np.asarray(node["bias"]),
+                    "moving_mean:0": np.asarray(stat["mean"]),
+                    "moving_variance:0": np.asarray(stat["var"]),
+                }
+            for wname, value in weights.items():
+                g.create_dataset(wname, data=value)
+            top.attrs["weight_names"] = np.array(
+                [f"{layer_name}/{w}".encode() for w in weights]
+            )
+            layer_names.append(layer_name)
+        f.attrs["layer_names"] = np.array([n.encode() for n in layer_names])
+        f.attrs["backend"] = b"tensorflow"

@@ -60,8 +60,9 @@ def _populate() -> None:
     # keys and synthetic-text data for these names).
     def _gpt(factory):
         def make(bn_mode: str = "train", num_classes: int = 0, **kwargs):
-            if num_classes and "vocab_size" not in kwargs:
-                kwargs["vocab_size"] = num_classes
+            # Same fallback as run.py's synthetic-text vocab (num_classes
+            # or 64), so the model and data always agree on vocab size.
+            kwargs.setdefault("vocab_size", num_classes or 64)
             return factory(**kwargs)
 
         return make

@@ -86,6 +86,11 @@ def build_trainer(cfg: ExperimentConfig, strategy=None):
             from pddl_tpu.ckpt import ModelCheckpoint
 
             callbacks.append(ModelCheckpoint(cfg.checkpoint_dir, max_to_keep=1))
+        # Cloud-TPU preemption (SIGTERM) -> consistent save + clean stop;
+        # the next --resume run continues from it.
+        from pddl_tpu.utils.preemption import PreemptionCheckpoint
+
+        callbacks.append(PreemptionCheckpoint(cfg.checkpoint_dir))
     return trainer, callbacks
 
 

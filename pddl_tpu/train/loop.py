@@ -233,78 +233,83 @@ class Trainer:
 
         final_logs: Dict[str, float] = {}
         stopped_mid_epoch = False
-        for epoch in range(initial_epoch, epochs):
-            if self.stop_training:
-                break
-            self._run_hooks(callbacks, "on_epoch_begin", epoch)
-            t0 = time.perf_counter()
-            step_logs = []
-            steps = 0
-            samples = 0
-            if steps_per_epoch is not None or epoch == initial_epoch:
-                # Continuous stream (or first epoch, which must include the
-                # batch consumed by init_state via _chain_first).
-                epoch_iter = train_iter
-            else:
-                if isinstance(train_data, Iterator):
-                    raise ValueError(
-                        "train_data is a one-shot iterator but steps_per_epoch "
-                        "is None; pass a re-iterable dataset or set steps_per_epoch"
-                    )
-                epoch_iter = iter(train_data)
-            while steps_per_epoch is None or steps < steps_per_epoch:
-                try:
-                    batch = next(epoch_iter)
-                except StopIteration:
-                    break
-                samples += len(np.asarray(batch[self.target_key])) * (
-                    self.strategy.data_process_count
-                )
-                global_batch = self.strategy.distribute_batch(batch)
-                self.state, logs = self._train_step(self.state, global_batch)
-                step_logs.append(logs)
-                self._run_hooks(
-                    callbacks, "on_train_batch_end", self.global_step, logs=logs
-                )
-                steps += 1
-                self.global_step += 1
+        # on_train_end runs in the finally below so cleanup-style callbacks
+        # (signal-handler restore, checkpoint-manager close — see
+        # utils/preemption.py) execute even when training raises.
+        try:
+            for epoch in range(initial_epoch, epochs):
                 if self.stop_training:
-                    # Honored mid-epoch (Keras semantics) — e.g. preemption
-                    # checkpointing stops at the next batch boundary.
-                    stopped_mid_epoch = True
                     break
-            if steps == 0:
-                raise ValueError("empty training dataset/epoch")
-            if stopped_mid_epoch:
-                # A mid-epoch stop means "exit NOW" (preemption grace
-                # window): no validation pass, no epoch-end hooks (whose
-                # checkpoint saves could also collide with the preemption
-                # save), no partial-epoch History entry that would mislead
-                # plateau/early-stop logic on resume.
-                break
+                self._run_hooks(callbacks, "on_epoch_begin", epoch)
+                t0 = time.perf_counter()
+                step_logs = []
+                steps = 0
+                samples = 0
+                if steps_per_epoch is not None or epoch == initial_epoch:
+                    # Continuous stream (or first epoch, which must include the
+                    # batch consumed by init_state via _chain_first).
+                    epoch_iter = train_iter
+                else:
+                    if isinstance(train_data, Iterator):
+                        raise ValueError(
+                            "train_data is a one-shot iterator but steps_per_epoch "
+                            "is None; pass a re-iterable dataset or set steps_per_epoch"
+                        )
+                    epoch_iter = iter(train_data)
+                while steps_per_epoch is None or steps < steps_per_epoch:
+                    try:
+                        batch = next(epoch_iter)
+                    except StopIteration:
+                        break
+                    samples += len(np.asarray(batch[self.target_key])) * (
+                        self.strategy.data_process_count
+                    )
+                    global_batch = self.strategy.distribute_batch(batch)
+                    self.state, logs = self._train_step(self.state, global_batch)
+                    step_logs.append(logs)
+                    self._run_hooks(
+                        callbacks, "on_train_batch_end", self.global_step, logs=logs
+                    )
+                    steps += 1
+                    self.global_step += 1
+                    if self.stop_training:
+                        # Honored mid-epoch (Keras semantics) — e.g. preemption
+                        # checkpointing stops at the next batch boundary.
+                        stopped_mid_epoch = True
+                        break
+                if steps == 0:
+                    raise ValueError("empty training dataset/epoch")
+                if stopped_mid_epoch:
+                    # A mid-epoch stop means "exit NOW" (preemption grace
+                    # window): no validation pass, no epoch-end hooks (whose
+                    # checkpoint saves could also collide with the preemption
+                    # save), no partial-epoch History entry that would mislead
+                    # plateau/early-stop logic on resume.
+                    break
 
-            # Training throughput: window closes before validation runs.
-            dt = time.perf_counter() - t0
-            epoch_logs = _mean_logs(step_logs)
-            if validation_data is not None:
-                val_logs = self.evaluate(validation_data, steps=validation_steps,
-                                         verbose=0, _prefix="val_")
-                epoch_logs.update(val_logs)
+                # Training throughput: window closes before validation runs.
+                dt = time.perf_counter() - t0
+                epoch_logs = _mean_logs(step_logs)
+                if validation_data is not None:
+                    val_logs = self.evaluate(validation_data, steps=validation_steps,
+                                             verbose=0, _prefix="val_")
+                    epoch_logs.update(val_logs)
 
-            epoch_logs["images_per_sec"] = samples / dt if dt > 0 else 0.0
-            history.append(epoch, epoch_logs)
-            if verbose and self.strategy.is_coordinator:
-                line = " - ".join(
-                    [f"Epoch {epoch + 1}/{epochs}", f"{dt:.1f}s"]
-                    + [f"{k}: {v:.4f}" for k, v in epoch_logs.items()
-                       if k != "images_per_sec"]
-                    + [f"{epoch_logs['images_per_sec']:.0f} img/s"]
-                )
-                print(line, file=sys.stderr)
-            self._run_hooks(callbacks, "on_epoch_end", epoch, logs=epoch_logs)
-            final_logs = epoch_logs
+                epoch_logs["images_per_sec"] = samples / dt if dt > 0 else 0.0
+                history.append(epoch, epoch_logs)
+                if verbose and self.strategy.is_coordinator:
+                    line = " - ".join(
+                        [f"Epoch {epoch + 1}/{epochs}", f"{dt:.1f}s"]
+                        + [f"{k}: {v:.4f}" for k, v in epoch_logs.items()
+                           if k != "images_per_sec"]
+                        + [f"{epoch_logs['images_per_sec']:.0f} img/s"]
+                    )
+                    print(line, file=sys.stderr)
+                self._run_hooks(callbacks, "on_epoch_end", epoch, logs=epoch_logs)
+                final_logs = epoch_logs
 
-        self._run_hooks(callbacks, "on_train_end", logs=final_logs)
+        finally:
+            self._run_hooks(callbacks, "on_train_end", logs=final_logs)
         self.history = history
         return history
 

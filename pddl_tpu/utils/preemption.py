@@ -54,6 +54,9 @@ class PreemptionCheckpoint(Callback):
     def on_train_begin(self, state):
         from pddl_tpu.ckpt.checkpoint import Checkpointer
 
+        # Fresh run: a reused callback instance (in-process resume/retry)
+        # must not inherit the previous run's preempted flag.
+        self.preempted = False
         # Sync saves: during a grace window there may be no "later" to
         # finish an async save in.
         self._ckpt = Checkpointer(self.directory, max_to_keep=2,

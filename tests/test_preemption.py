@@ -69,6 +69,43 @@ def test_sigterm_checkpoints_and_stops(tmp_path):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
+def test_reused_callback_resets_preempted_flag(tmp_path):
+    """In-process retry: the same callback instance must not stop the next
+    fit() after one step just because the previous run was preempted."""
+    cb = PreemptionCheckpoint(str(tmp_path / "re"))
+    tr = Trainer(tiny_resnet(num_classes=8), learning_rate=1e-2,
+                 strategy=SingleDeviceStrategy(), seed=0)
+    ds = SyntheticImageClassification(batch_size=8, image_size=16,
+                                      num_classes=8, seed=0)
+    tr.fit(ds, epochs=2, steps_per_epoch=3, verbose=0,
+           callbacks=[_SendSigterm(at_step=1), cb])
+    assert cb.preempted
+    # Second run with the SAME callback completes normally.
+    hist = tr.fit(ds, epochs=2, steps_per_epoch=3, verbose=0, callbacks=[cb])
+    assert len(hist.epoch) == 2
+
+
+def test_handlers_restored_even_when_fit_raises(tmp_path):
+    """on_train_end cleanup (handler restore) must survive a training
+    error — otherwise the process is left ignoring SIGTERM."""
+    prev = signal.getsignal(signal.SIGTERM)
+
+    class Boom(Callback):
+        def on_train_batch_end(self, step, state, logs):
+            raise RuntimeError("boom")
+
+    tr = Trainer(tiny_resnet(num_classes=8), learning_rate=1e-2,
+                 strategy=SingleDeviceStrategy(), seed=0)
+    ds = SyntheticImageClassification(batch_size=8, image_size=16,
+                                      num_classes=8, seed=0)
+    try:
+        tr.fit(ds, epochs=1, steps_per_epoch=2, verbose=0,
+               callbacks=[PreemptionCheckpoint(str(tmp_path / "x")), Boom()])
+    except RuntimeError:
+        pass
+    assert signal.getsignal(signal.SIGTERM) is prev
+
+
 def test_handlers_restored_after_train(tmp_path):
     prev = signal.getsignal(signal.SIGTERM)
     tr = Trainer(tiny_resnet(num_classes=8), learning_rate=1e-2,
