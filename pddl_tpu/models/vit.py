@@ -206,6 +206,7 @@ class _ViTStage(nn.Module):
     num_heads: int
     blocks: int
     mlp_ratio: int = 4
+    attention: str = "reference"  # "flash" uses the Pallas kernel per stage
     dtype: Any = jnp.float32
     param_dtype: Any = jnp.float32
 
@@ -214,7 +215,7 @@ class _ViTStage(nn.Module):
         for i in range(self.blocks):
             x = TransformerBlock(
                 num_heads=self.num_heads, mlp_ratio=self.mlp_ratio,
-                attention="reference", dtype=self.dtype,
+                attention=self.attention, dtype=self.dtype,
                 param_dtype=self.param_dtype, name=f"block{i}",
             )(x, train=False)
         return x
@@ -253,7 +254,7 @@ class GPipeViT:
                  n_microbatches: int, mesh,
                  patch_size: int = 16, embed_dim: int = 384,
                  num_heads: int = 6, num_classes: int = 1000,
-                 mlp_ratio: int = 4,
+                 mlp_ratio: int = 4, attention: str = "reference",
                  dtype: Any = jnp.float32, param_dtype: Any = jnp.float32):
         from pddl_tpu.core.mesh import STAGE_AXIS
 
@@ -269,8 +270,8 @@ class GPipeViT:
         self.embed = _ViTEmbed(patch_size=patch_size, embed_dim=embed_dim,
                                dtype=dtype, param_dtype=param_dtype)
         self.stage = _ViTStage(num_heads=num_heads, blocks=blocks_per_stage,
-                               mlp_ratio=mlp_ratio, dtype=dtype,
-                               param_dtype=param_dtype)
+                               mlp_ratio=mlp_ratio, attention=attention,
+                               dtype=dtype, param_dtype=param_dtype)
         self.head = _ViTHead(num_classes=num_classes, dtype=dtype,
                              param_dtype=param_dtype)
 
@@ -297,9 +298,14 @@ class GPipeViT:
 
         p = variables["params"]
         h = self.embed.apply({"params": p["embed"]}, x)
+        # Flash stages under pallas interpret mode (non-TPU test backends)
+        # can't declare varying axes on their outputs; relax the vma check
+        # there only (Mosaic on TPU declares them fine).
+        check_vma = not (self.stage.attention == "flash"
+                         and jax.default_backend() != "tpu")
         h = gpipe_apply(
             p["stages"], h, mesh=self.mesh, stage_fn=self._stage_fn,
-            n_microbatches=self.n_microbatches,
+            n_microbatches=self.n_microbatches, check_vma=check_vma,
         )
         out = self.head.apply({"params": p["head"]}, h)
         if mutable:

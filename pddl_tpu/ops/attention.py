@@ -190,6 +190,16 @@ def _flash_kernel_nolse(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref,
                   **kw)
 
 
+def _sds_like(ref_value):
+    """ShapeDtypeStruct factory that propagates the varying-manual-axes set
+    of ``ref_value`` — inside shard_map (GPipe stages, seq-sharded regions)
+    pallas outputs must declare how they vary across mesh axes."""
+    vma = getattr(jax.typeof(ref_value), "vma", None)
+    if vma:
+        return functools.partial(jax.ShapeDtypeStruct, vma=vma)
+    return jax.ShapeDtypeStruct
+
+
 def _flash_forward_call(q, k, v, causal, scale, block_q, block_k, interpret,
                         want_lse):
     """Run the forward kernel; returns flat (out [bh,sq,d], lse or None).
@@ -212,6 +222,8 @@ def _flash_forward_call(q, k, v, causal, scale, block_q, block_k, interpret,
         scale=scale, causal=causal,
         block_q=block_q, block_k=block_k, num_k=num_k,
     )
+    sds = _sds_like(qf)
+
     o_spec = pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0))
     lse_spec = pl.BlockSpec((1, block_q, LANES), lambda bh, qi, ki: (bh, qi, 0))
     result = pl.pallas_call(
@@ -223,9 +235,8 @@ def _flash_forward_call(q, k, v, causal, scale, block_q, block_k, interpret,
             pl.BlockSpec((1, block_k, d), lambda bh, qi, ki: (bh, ki, 0)),
         ],
         out_specs=[o_spec] + ([lse_spec] if want_lse else []),
-        out_shape=[jax.ShapeDtypeStruct((b * h, sq, d), q.dtype)]
-        + ([jax.ShapeDtypeStruct((b * h, sq, LANES), jnp.float32)]
-           if want_lse else []),
+        out_shape=[sds((b * h, sq, d), q.dtype)]
+        + ([sds((b * h, sq, LANES), jnp.float32)] if want_lse else []),
         scratch_shapes=[
             pltpu.VMEM((block_q, LANES), jnp.float32),  # running max m
             pltpu.VMEM((block_q, LANES), jnp.float32),  # running denom l
@@ -365,6 +376,7 @@ def _flash_bwd(causal, scale, block_q, block_k, interpret, res, g):
         (b * h, sq, LANES),
     )
 
+    sds = _sds_like(qf)
     q_spec = pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0))
     row_spec = pl.BlockSpec((1, block_q, LANES), lambda bh, i, j: (bh, i, 0))
     k_spec = pl.BlockSpec((1, block_k, d), lambda bh, i, j: (bh, j, 0))
@@ -377,7 +389,7 @@ def _flash_bwd(causal, scale, block_q, block_k, interpret, res, g):
         grid=(b * h, num_q, num_k),
         in_specs=[q_spec, k_spec, k_spec, q_spec, row_spec, row_spec],
         out_specs=q_spec,
-        out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+        out_shape=sds((b * h, sq, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=interpret,
     )(qf, kf, vf, dof, lse, di)
@@ -397,8 +409,8 @@ def _flash_bwd(causal, scale, block_q, block_k, interpret, res, g):
         in_specs=[qT_spec, kT_spec, kT_spec, qT_spec, rowT_spec, rowT_spec],
         out_specs=[kT_spec, kT_spec],
         out_shape=[
-            jax.ShapeDtypeStruct((b * h, sk, d), k.dtype),
-            jax.ShapeDtypeStruct((b * h, sk, d), v.dtype),
+            sds((b * h, sk, d), k.dtype),
+            sds((b * h, sk, d), v.dtype),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_k, d), jnp.float32),

@@ -46,6 +46,7 @@ def gpipe_apply(
     n_microbatches: int,
     stage_axis: str = STAGE_AXIS,
     data_axis: str = DATA_AXIS,
+    check_vma: bool = True,
 ) -> jnp.ndarray:
     """Run ``x`` through the stage pipeline; returns same-shape activations.
 
@@ -128,4 +129,7 @@ def gpipe_apply(
         mesh=mesh,
         in_specs=(param_specs, P(data_axis, *([None] * (x.ndim - 1)))),
         out_specs=P(data_axis, *([None] * (x.ndim - 1))),
+        # check_vma=False only for stage_fns whose pallas interpret mode
+        # can't declare varying axes (CPU test path); Mosaic on TPU can.
+        check_vma=check_vma,
     )(stage_params, x)

@@ -85,6 +85,20 @@ def test_pipeline_strategy_shards_stages_and_trains():
     assert moment and all(m.sharding.spec[0] == STAGE_AXIS for m in moment)
 
 
+def test_pipeline_with_flash_attention_stages():
+    """Flash-attention stages inside the GPipe shard_map: same function as
+    the sequential oracle (vma check relaxed only on interpret backends)."""
+    mesh = build_mesh(MeshConfig(data=2, stage=4))
+    model = GPipeViT(n_stages=4, blocks_per_stage=1, n_microbatches=2,
+                     mesh=mesh, patch_size=8, embed_dim=32, num_heads=4,
+                     num_classes=8, attention="flash")
+    x = jax.random.normal(jax.random.key(0), (8, 32, 32, 3))
+    variables = model.init(jax.random.key(1), x)
+    piped = np.asarray(jax.jit(lambda v, xx: model.apply(v, xx))(variables, x))
+    seq = np.asarray(model.apply_sequential(variables, x))
+    np.testing.assert_allclose(piped, seq, atol=1e-4, rtol=1e-4)
+
+
 def test_3d_parallelism_dp_pp_tp():
     """data=2 x stage=2 x model=2: staged block weights shard over BOTH
     stage and model; the full 3D train step compiles and trains."""
