@@ -1,0 +1,91 @@
+"""Generic GPipe model wrapper: embed → staged body → head.
+
+Factors the pipeline-parallel model pattern out of the ViT family so any
+embed/stage/head triple pipelines the same way
+(:class:`pddl_tpu.models.vit.GPipeViT` for vision,
+:class:`pddl_tpu.models.gpt.GPipeGPT` for causal LMs):
+
+- ``embed``/``head`` are ordinary flax modules with replicated params,
+  running under plain GSPMD outside the pipeline;
+- ``stage`` is one flax module whose params are initialized ``n_stages``
+  times and stacked on a leading dim — sharded one-stage-per-position over
+  the ``stage`` mesh axis by :class:`pddl_tpu.parallel.pipeline.PipelineStrategy`;
+- the schedule is :func:`pddl_tpu.ops.pipeline.gpipe_apply` (scan ticks +
+  ppermute hops, AD-derived backward pipeline).
+
+Duck-types the flax ``init``/``apply`` surface the Trainer uses. Stages
+run deterministically (no dropout inside the pipeline).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+class GPipeModel:
+    """Pipeline-parallel model = embed + ``n_stages`` x stage + head."""
+
+    def __init__(self, *, embed, stage, head, n_stages: int,
+                 n_microbatches: int, mesh):
+        from pddl_tpu.core.mesh import STAGE_AXIS
+
+        if mesh.shape[STAGE_AXIS] != n_stages:
+            raise ValueError(
+                f"n_stages={n_stages} but the mesh's '{STAGE_AXIS}' axis has "
+                f"size {mesh.shape[STAGE_AXIS]} — they must match (one "
+                "pipeline stage per mesh position)"
+            )
+        self.embed = embed
+        self.stage = stage
+        self.head = head
+        self.n_stages = n_stages
+        self.n_microbatches = n_microbatches
+        self.mesh = mesh
+
+    # -- flax-like surface --------------------------------------------------
+    def init(self, rng, x, train: bool = False):
+        r_embed, r_stage, r_head = jax.random.split(rng, 3)
+        embed_params = self.embed.init(r_embed, x)["params"]
+        h = self.embed.apply({"params": embed_params}, x)
+        stage_params = [
+            self.stage.init(jax.random.fold_in(r_stage, i), h)["params"]
+            for i in range(self.n_stages)
+        ]
+        stacked = jax.tree.map(lambda *leaves: jnp.stack(leaves), *stage_params)
+        head_params = self.head.init(r_head, h)["params"]
+        return {"params": {"embed": embed_params, "stages": stacked,
+                           "head": head_params}}
+
+    def _stage_fn(self, params_slice, h):
+        return self.stage.apply({"params": params_slice}, h)
+
+    def apply(self, variables, x, *, train: bool = True, mutable=False,
+              rngs=None):
+        from pddl_tpu.ops.pipeline import gpipe_apply
+
+        p = variables["params"]
+        h = self.embed.apply({"params": p["embed"]}, x)
+        # Flash stages under pallas interpret mode (non-TPU test backends)
+        # can't declare varying axes on their outputs; relax the vma check
+        # there only (Mosaic on TPU declares them fine).
+        check_vma = not (getattr(self.stage, "attention", None) == "flash"
+                         and jax.default_backend() != "tpu")
+        h = gpipe_apply(
+            p["stages"], h, mesh=self.mesh, stage_fn=self._stage_fn,
+            n_microbatches=self.n_microbatches, check_vma=check_vma,
+        )
+        out = self.head.apply({"params": p["head"]}, h)
+        if mutable:
+            return out, {}
+        return out
+
+    def apply_sequential(self, variables, x):
+        """Reference path: the same stacked params applied stage by stage
+        with no pipeline — the numerics oracle for tests."""
+        p = variables["params"]
+        h = self.embed.apply({"params": p["embed"]}, x)
+        for i in range(self.n_stages):
+            h = self._stage_fn(
+                jax.tree.map(lambda leaf: leaf[i], p["stages"]), h)
+        return self.head.apply({"params": p["head"]}, h)

@@ -23,6 +23,7 @@ from typing import Any, Optional
 import jax.numpy as jnp
 from flax import linen as nn
 
+from pddl_tpu.models.gpipe import GPipeModel
 from pddl_tpu.models.vit import TransformerBlock
 
 
@@ -45,15 +46,13 @@ class GPT(nn.Module):
 
     @nn.compact
     def __call__(self, tokens, *, train: bool = True):
-        b, s = tokens.shape
-        if s > self.max_len:
-            raise ValueError(f"sequence {s} exceeds max_len {self.max_len}")
-        x = nn.Embed(self.vocab_size, self.embed_dim,
-                     dtype=self.dtype, param_dtype=self.param_dtype,
-                     name="token_embed")(tokens)
-        pos = self.param("pos_embed", nn.initializers.normal(0.02),
-                         (1, self.max_len, self.embed_dim), self.param_dtype)
-        x = x + pos[:, :s].astype(self.dtype)
+        # Stem shared with GPipeGPT; share_scope keeps the param names
+        # (token_embed/pos_embed) at this module's top level.
+        embed = _GPTEmbed(vocab_size=self.vocab_size, max_len=self.max_len,
+                          embed_dim=self.embed_dim, dtype=self.dtype,
+                          param_dtype=self.param_dtype)
+        nn.share_scope(self, embed)
+        x = embed(tokens)
 
         for i in range(self.depth):
             moe = (self.moe_experts
@@ -65,11 +64,95 @@ class GPT(nn.Module):
                 param_dtype=self.param_dtype, name=f"block{i}",
             )(x, train=train)
 
+        # Head shared with GPipeGPT (ln_final/lm_head names preserved).
+        head = _GPTHead(vocab_size=self.vocab_size, dtype=self.dtype,
+                        param_dtype=self.param_dtype)
+        nn.share_scope(self, head)
+        return head(x)
+
+
+class _GPTEmbed(nn.Module):
+    """Token + positional embedding (the pre-pipeline LM stem)."""
+
+    vocab_size: int
+    max_len: int
+    embed_dim: int
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, tokens):
+        b, s = tokens.shape
+        if s > self.max_len:
+            raise ValueError(f"sequence {s} exceeds max_len {self.max_len}")
+        x = nn.Embed(self.vocab_size, self.embed_dim,
+                     dtype=self.dtype, param_dtype=self.param_dtype,
+                     name="token_embed")(tokens)
+        pos = self.param("pos_embed", nn.initializers.normal(0.02),
+                         (1, self.max_len, self.embed_dim), self.param_dtype)
+        return x + pos[:, :s].astype(self.dtype)
+
+
+class _GPTStage(nn.Module):
+    """One pipeline stage: a run of causal transformer blocks."""
+
+    num_heads: int
+    blocks: int
+    mlp_ratio: int = 4
+    attention: str = "reference"
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        for i in range(self.blocks):
+            x = TransformerBlock(
+                num_heads=self.num_heads, mlp_ratio=self.mlp_ratio,
+                attention=self.attention, causal=True, dtype=self.dtype,
+                param_dtype=self.param_dtype, name=f"block{i}",
+            )(x, train=False)
+        return x
+
+
+class _GPTHead(nn.Module):
+    """Final LN + LM head (the post-pipeline projection to vocab)."""
+
+    vocab_size: int
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
         x = nn.LayerNorm(dtype=jnp.float32, param_dtype=self.param_dtype,
                          name="ln_final")(x)
         logits = nn.Dense(self.vocab_size, dtype=self.dtype,
                           param_dtype=self.param_dtype, name="lm_head")(x)
         return logits.astype(jnp.float32)
+
+
+class GPipeGPT(GPipeModel):
+    """Pipeline-parallel causal LM: PP x long-context — token/pos embed
+    (replicated) → ``n_stages`` stacked causal-transformer stages through
+    the GPipe schedule → LM head (replicated). See
+    :class:`pddl_tpu.models.gpipe.GPipeModel`."""
+
+    def __init__(self, *, vocab_size: int, n_stages: int,
+                 blocks_per_stage: int, n_microbatches: int, mesh,
+                 max_len: int = 1024, embed_dim: int = 256,
+                 num_heads: int = 4, mlp_ratio: int = 4,
+                 attention: str = "reference",
+                 dtype: Any = jnp.float32, param_dtype: Any = jnp.float32):
+        super().__init__(
+            embed=_GPTEmbed(vocab_size=vocab_size, max_len=max_len,
+                            embed_dim=embed_dim, dtype=dtype,
+                            param_dtype=param_dtype),
+            stage=_GPTStage(num_heads=num_heads, blocks=blocks_per_stage,
+                            mlp_ratio=mlp_ratio, attention=attention,
+                            dtype=dtype, param_dtype=param_dtype),
+            head=_GPTHead(vocab_size=vocab_size, dtype=dtype,
+                          param_dtype=param_dtype),
+            n_stages=n_stages, n_microbatches=n_microbatches, mesh=mesh,
+        )
 
 
 GPT_Small = functools.partial(GPT, embed_dim=768, depth=12, num_heads=12)

@@ -25,10 +25,10 @@ from __future__ import annotations
 import functools
 from typing import Any, Optional
 
-import jax
 import jax.numpy as jnp
 from flax import linen as nn
 
+from pddl_tpu.models.gpipe import GPipeModel
 from pddl_tpu.ops.attention import attention_reference, flash_attention
 
 
@@ -239,16 +239,10 @@ class _ViTHead(nn.Module):
         return x.astype(jnp.float32)
 
 
-class GPipeViT:
-    """Pipeline-parallel ViT: embed (replicated) → ``n_stages`` stacked
-    transformer stages run through :func:`pddl_tpu.ops.pipeline.gpipe_apply`
-    → head (replicated).
-
-    Duck-types the flax ``init``/``apply`` surface the Trainer uses, so it
-    trains under any strategy whose mesh carries a ``stage`` axis
-    (:class:`pddl_tpu.parallel.pipeline.PipelineStrategy`). Dropout is
-    unsupported inside the pipeline (stages run deterministic).
-    """
+class GPipeViT(GPipeModel):
+    """Pipeline-parallel ViT: patch embed (replicated) → ``n_stages``
+    stacked transformer stages through the GPipe schedule → head
+    (replicated). See :class:`pddl_tpu.models.gpipe.GPipeModel`."""
 
     def __init__(self, *, n_stages: int, blocks_per_stage: int,
                  n_microbatches: int, mesh,
@@ -256,71 +250,16 @@ class GPipeViT:
                  num_heads: int = 6, num_classes: int = 1000,
                  mlp_ratio: int = 4, attention: str = "reference",
                  dtype: Any = jnp.float32, param_dtype: Any = jnp.float32):
-        from pddl_tpu.core.mesh import STAGE_AXIS
-
-        if mesh.shape[STAGE_AXIS] != n_stages:
-            raise ValueError(
-                f"n_stages={n_stages} but the mesh's '{STAGE_AXIS}' axis has "
-                f"size {mesh.shape[STAGE_AXIS]} — they must match (one "
-                "pipeline stage per mesh position)"
-            )
-        self.n_stages = n_stages
-        self.n_microbatches = n_microbatches
-        self.mesh = mesh
-        self.embed = _ViTEmbed(patch_size=patch_size, embed_dim=embed_dim,
-                               dtype=dtype, param_dtype=param_dtype)
-        self.stage = _ViTStage(num_heads=num_heads, blocks=blocks_per_stage,
-                               mlp_ratio=mlp_ratio, attention=attention,
-                               dtype=dtype, param_dtype=param_dtype)
-        self.head = _ViTHead(num_classes=num_classes, dtype=dtype,
-                             param_dtype=param_dtype)
-
-    # -- flax-like surface --------------------------------------------------
-    def init(self, rng, x, train: bool = False):
-        r_embed, r_stage, r_head = jax.random.split(rng, 3)
-        embed_params = self.embed.init(r_embed, x)["params"]
-        h = self.embed.apply({"params": embed_params}, x)
-        stage_params = [
-            self.stage.init(jax.random.fold_in(r_stage, i), h)["params"]
-            for i in range(self.n_stages)
-        ]
-        stacked = jax.tree.map(lambda *leaves: jnp.stack(leaves), *stage_params)
-        head_params = self.head.init(r_head, h)["params"]
-        return {"params": {"embed": embed_params, "stages": stacked,
-                           "head": head_params}}
-
-    def _stage_fn(self, params_slice, h):
-        return self.stage.apply({"params": params_slice}, h)
-
-    def apply(self, variables, x, *, train: bool = True, mutable=False,
-              rngs=None):
-        from pddl_tpu.ops.pipeline import gpipe_apply
-
-        p = variables["params"]
-        h = self.embed.apply({"params": p["embed"]}, x)
-        # Flash stages under pallas interpret mode (non-TPU test backends)
-        # can't declare varying axes on their outputs; relax the vma check
-        # there only (Mosaic on TPU declares them fine).
-        check_vma = not (self.stage.attention == "flash"
-                         and jax.default_backend() != "tpu")
-        h = gpipe_apply(
-            p["stages"], h, mesh=self.mesh, stage_fn=self._stage_fn,
-            n_microbatches=self.n_microbatches, check_vma=check_vma,
+        super().__init__(
+            embed=_ViTEmbed(patch_size=patch_size, embed_dim=embed_dim,
+                            dtype=dtype, param_dtype=param_dtype),
+            stage=_ViTStage(num_heads=num_heads, blocks=blocks_per_stage,
+                            mlp_ratio=mlp_ratio, attention=attention,
+                            dtype=dtype, param_dtype=param_dtype),
+            head=_ViTHead(num_classes=num_classes, dtype=dtype,
+                          param_dtype=param_dtype),
+            n_stages=n_stages, n_microbatches=n_microbatches, mesh=mesh,
         )
-        out = self.head.apply({"params": p["head"]}, h)
-        if mutable:
-            return out, {}
-        return out
-
-    def apply_sequential(self, variables, x):
-        """Reference path: the same stacked params applied stage by stage
-        with no pipeline — the numerics oracle for tests."""
-        p = variables["params"]
-        h = self.embed.apply({"params": p["embed"]}, x)
-        for i in range(self.n_stages):
-            h = self._stage_fn(
-                jax.tree.map(lambda leaf: leaf[i], p["stages"]), h)
-        return self.head.apply({"params": p["head"]}, h)
 
 
 ViT_S16 = functools.partial(ViT, patch_size=16, embed_dim=384, depth=12,
