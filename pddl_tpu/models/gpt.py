@@ -20,6 +20,7 @@ from __future__ import annotations
 import functools
 from typing import Any, Optional
 
+import jax
 import jax.numpy as jnp
 from flax import linen as nn
 
@@ -41,6 +42,7 @@ class GPT(nn.Module):
     dropout: float = 0.0
     moe_experts: int = 0
     moe_every: int = 2
+    decode: bool = False  # KV-cache generation mode (see generate())
     dtype: Any = jnp.float32
     param_dtype: Any = jnp.float32
 
@@ -49,8 +51,8 @@ class GPT(nn.Module):
         # Stem shared with GPipeGPT; share_scope keeps the param names
         # (token_embed/pos_embed) at this module's top level.
         embed = _GPTEmbed(vocab_size=self.vocab_size, max_len=self.max_len,
-                          embed_dim=self.embed_dim, dtype=self.dtype,
-                          param_dtype=self.param_dtype)
+                          embed_dim=self.embed_dim, decode=self.decode,
+                          dtype=self.dtype, param_dtype=self.param_dtype)
         nn.share_scope(self, embed)
         x = embed(tokens)
 
@@ -60,6 +62,7 @@ class GPT(nn.Module):
             x = TransformerBlock(
                 num_heads=self.num_heads, mlp_ratio=self.mlp_ratio,
                 attention=self.attention, mesh=self.mesh, causal=True,
+                decode=self.decode, max_decode_len=self.max_len,
                 dropout=self.dropout, moe_experts=moe, dtype=self.dtype,
                 param_dtype=self.param_dtype, name=f"block{i}",
             )(x, train=train)
@@ -72,11 +75,15 @@ class GPT(nn.Module):
 
 
 class _GPTEmbed(nn.Module):
-    """Token + positional embedding (the pre-pipeline LM stem)."""
+    """Token + positional embedding (the pre-pipeline LM stem).
+
+    ``decode=True``: one token per call, positioned at a running index
+    kept in the ``"cache"`` collection (generation mode)."""
 
     vocab_size: int
     max_len: int
     embed_dim: int
+    decode: bool = False
     dtype: Any = jnp.float32
     param_dtype: Any = jnp.float32
 
@@ -90,6 +97,14 @@ class _GPTEmbed(nn.Module):
                      name="token_embed")(tokens)
         pos = self.param("pos_embed", nn.initializers.normal(0.02),
                          (1, self.max_len, self.embed_dim), self.param_dtype)
+        if self.decode:
+            initialized = self.has_variable("cache", "pos_index")
+            idx = self.variable("cache", "pos_index",
+                                lambda: jnp.zeros((), jnp.int32))
+            step_pos = jax.lax.dynamic_slice_in_dim(pos, idx.value, s, axis=1)
+            if initialized:  # init() must return a pristine cache
+                idx.value = idx.value + s
+            return x + step_pos.astype(self.dtype)
         return x + pos[:, :s].astype(self.dtype)
 
 
@@ -153,6 +168,67 @@ class GPipeGPT(GPipeModel):
                           param_dtype=param_dtype),
             n_stages=n_stages, n_microbatches=n_microbatches, mesh=mesh,
         )
+
+
+def generate(model: GPT, variables, prompt, max_new_tokens: int, *,
+             temperature: float = 0.0, rng=None):
+    """Autoregressive sampling with a KV cache.
+
+    Args:
+      model: the (trained) non-decode GPT; a decode twin sharing its params
+        is constructed internally via ``model.clone(decode=True)``.
+      variables: ``{"params": ...}`` from training.
+      prompt: int32 ``[B, P]`` prompt tokens (``P >= 1``).
+      max_new_tokens: tokens to append.
+      temperature: 0 → greedy argmax; >0 → temperature sampling (``rng``
+        required).
+
+    Returns int32 ``[B, P + max_new_tokens]`` (prompt + continuation).
+    One jitted single-token step; the cache is donated so K/V update in
+    place in HBM across steps.
+    """
+    b, p = prompt.shape
+    total = p + max_new_tokens
+    if p < 1:
+        raise ValueError("generate() needs a non-empty prompt (P >= 1)")
+    if total > model.max_len:
+        raise ValueError(f"prompt+new tokens {total} exceed max_len {model.max_len}")
+    if temperature > 0 and rng is None:
+        raise ValueError("temperature sampling needs an rng key")
+    dec = model.clone(decode=True)
+    params = variables["params"]
+    # The fresh cache is all zeros by construction; eval_shape over init
+    # gets its structure without materializing (and discarding) a full
+    # random parameter set.
+    cache_shapes = jax.eval_shape(
+        lambda: dec.init(jax.random.key(0), prompt[:, :1], train=False)
+    )["cache"]
+    cache = jax.tree.map(lambda sd: jnp.zeros(sd.shape, sd.dtype),
+                         cache_shapes)
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def step(cache, tok):
+        logits, mutated = dec.apply(
+            {"params": params, "cache": cache}, tok,
+            train=False, mutable=["cache"],
+        )
+        return mutated["cache"], logits[:, -1]
+
+    # Batched prefill: the whole prompt in ONE call (causal within the
+    # block), then one token per step — no wasted final step.
+    cache, logits = step(cache, prompt)
+    tokens = [prompt]
+    for i in range(max_new_tokens):
+        if temperature > 0:
+            rng, sub = jax.random.split(rng)
+            nxt = jax.random.categorical(sub, logits / temperature, axis=-1)
+        else:
+            nxt = jnp.argmax(logits, axis=-1)
+        nxt = nxt[:, None].astype(jnp.int32)
+        tokens.append(nxt)
+        if i + 1 < max_new_tokens:
+            cache, logits = step(cache, nxt)
+    return jnp.concatenate(tokens, axis=1)
 
 
 GPT_Small = functools.partial(GPT, embed_dim=768, depth=12, num_heads=12)

@@ -25,6 +25,9 @@ from __future__ import annotations
 import functools
 from typing import Any, Optional
 
+import math
+
+import jax
 import jax.numpy as jnp
 from flax import linen as nn
 
@@ -33,12 +36,20 @@ from pddl_tpu.ops.attention import attention_reference, flash_attention
 
 
 class MultiHeadAttention(nn.Module):
-    """MHA over our attention ops (``[B, S, E]`` in/out)."""
+    """MHA over our attention ops (``[B, S, E]`` in/out).
+
+    ``decode=True`` enables single-token autoregressive decoding with a KV
+    cache (``"cache"`` variable collection): each call consumes one token
+    (``S == 1``), appends its K/V at the running index, and attends over
+    the cached prefix — the generation path of the GPT family.
+    """
 
     num_heads: int
     attention: str = "flash"  # "flash" | "reference" | "ring"
     mesh: Optional[Any] = None  # required for "ring"
     causal: bool = False  # decoder-style masking (the GPT family)
+    decode: bool = False  # KV-cache single-token decoding
+    max_decode_len: int = 1024
     dtype: Any = jnp.float32
     param_dtype: Any = jnp.float32
 
@@ -57,6 +68,9 @@ class MultiHeadAttention(nn.Module):
         v = dense(features=(self.num_heads, head_dim), name="value")(x)
         q, k, v = (t.transpose(0, 2, 1, 3) for t in (q, k, v))
 
+        if self.decode:
+            return self._decode_step(q, k, v, b, s, head_dim, dense)
+
         if self.attention == "flash":
             o = flash_attention(q, k, v, causal=self.causal)
         elif self.attention == "reference":
@@ -74,6 +88,50 @@ class MultiHeadAttention(nn.Module):
         o = o.transpose(0, 2, 1, 3).reshape(b, s, e)
         return dense(features=e, name="out")(o)
 
+    def _decode_step(self, q, k, v, b, s, head_dim, dense):
+        """Autoregressive decoding with a KV cache.
+
+        Handles both the batched prefill (``s`` prompt tokens in one call,
+        causal within the block) and single-token steps (``s == 1``): the
+        block's K/V land at the running index, queries attend over
+        ``k_pos <= index + q_local_pos`` of the full (masked) cache.
+        """
+        h = self.num_heads
+        # During init() the cache variables don't exist yet: create them
+        # but DON'T mutate, so init returns a pristine cache (index 0).
+        initialized = self.has_variable("cache", "cached_key")
+        cached_k = self.variable(
+            "cache", "cached_key", jnp.zeros,
+            (b, h, self.max_decode_len, head_dim), self.dtype)
+        cached_v = self.variable(
+            "cache", "cached_value", jnp.zeros,
+            (b, h, self.max_decode_len, head_dim), self.dtype)
+        index = self.variable(
+            "cache", "cache_index", lambda: jnp.zeros((), jnp.int32))
+
+        i = index.value
+        if initialized:
+            cached_k.value = jax.lax.dynamic_update_slice(
+                cached_k.value, k.astype(self.dtype), (0, 0, i, 0))
+            cached_v.value = jax.lax.dynamic_update_slice(
+                cached_v.value, v.astype(self.dtype), (0, 0, i, 0))
+            index.value = i + s
+
+        kf = cached_k.value.astype(jnp.float32)
+        vf = cached_v.value.astype(jnp.float32)
+        qf = q.astype(jnp.float32) * (1.0 / math.sqrt(head_dim))
+        scores = jnp.einsum("bhqd,bhkd->bhqk", qf, kf)     # (b, h, s, L)
+        k_pos = jnp.arange(self.max_decode_len)[None, :]
+        q_pos = i + jnp.arange(s)[:, None]
+        mask = k_pos <= q_pos                              # (s, L)
+        scores = jnp.where(mask[None, None], scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1)
+        o = jnp.einsum("bhqk,bhkd->bhqd", probs, vf).astype(q.dtype)
+        o = o.transpose(0, 2, 1, 3).reshape(b, s, h * head_dim)
+        # Same `dense` partial as the training path: one definition of the
+        # 'out' projection, so the two can never diverge.
+        return dense(features=h * head_dim, name="out")(o)
+
 
 class TransformerBlock(nn.Module):
     num_heads: int
@@ -81,6 +139,8 @@ class TransformerBlock(nn.Module):
     attention: str = "flash"
     mesh: Optional[Any] = None
     causal: bool = False
+    decode: bool = False  # KV-cache decoding (see MultiHeadAttention)
+    max_decode_len: int = 1024
     dropout: float = 0.0
     moe_experts: int = 0  # >0: Switch-MoE FFN instead of the dense MLP
     dtype: Any = jnp.float32
@@ -94,7 +154,8 @@ class TransformerBlock(nn.Module):
                          name="ln1")(x)
         h = MultiHeadAttention(
             num_heads=self.num_heads, attention=self.attention,
-            mesh=self.mesh, causal=self.causal, dtype=self.dtype,
+            mesh=self.mesh, causal=self.causal, decode=self.decode,
+            max_decode_len=self.max_decode_len, dtype=self.dtype,
             param_dtype=self.param_dtype, name="attn",
         )(h.astype(self.dtype))
         if self.dropout:
