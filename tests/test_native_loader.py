@@ -7,12 +7,25 @@ import pytest
 
 from pddl_tpu.data.native_loader import (
     NativeLoader,
-    native_available,
+    build_native,
     write_packed,
 )
 
+
+def _ensure_built() -> str:
+    """Build the library if missing (g++ is in the image). Returns an
+    empty string on success, the build error otherwise — so a toolchain
+    failure produces a self-explanatory skip reason."""
+    try:
+        build_native()  # no-op when the .so already exists
+        return ""
+    except Exception as e:
+        return str(e)
+
+
+_BUILD_ERROR = _ensure_built()
 pytestmark = pytest.mark.skipif(
-    not native_available(), reason="native library not built"
+    bool(_BUILD_ERROR), reason=f"native library unbuildable: {_BUILD_ERROR}"
 )
 
 
