@@ -33,6 +33,8 @@
 #include <thread>
 #include <vector>
 
+#include "pddl_rng.h"
+
 namespace {
 
 constexpr uint32_t kMagic = 0x314C4450;  // "PDL1"
@@ -48,17 +50,6 @@ struct Batch {
   long epoch;
 };
 
-// Deterministic 64-bit xorshift; seeded per epoch for reshuffling.
-struct XorShift {
-  uint64_t s;
-  explicit XorShift(uint64_t seed) : s(seed ? seed : 0x9E3779B97F4A7C15ull) {}
-  uint64_t next() {
-    s ^= s << 13;
-    s ^= s >> 7;
-    s ^= s << 17;
-    return s;
-  }
-};
 
 class Loader {
  public:
@@ -177,12 +168,7 @@ class Loader {
   }
 
   void reshuffle() {  // call with mu_ held (or before threads start)
-    if (!shuffle_) return;
-    XorShift rng(seed_ + 0x1000003ull * (uint64_t)(epoch_ + 1));
-    for (size_t i = order_.size(); i > 1; --i) {
-      size_t j = rng.next() % i;
-      std::swap(order_[i - 1], order_[j]);
-    }
+    if (shuffle_) pddl::epoch_shuffle(order_, seed_, epoch_);
   }
 
   void worker(int) {

@@ -41,19 +41,54 @@ _LIB_PATH = os.path.abspath(os.path.join(_NATIVE_DIR, "libpddl_io.so"))
 _lib = None
 
 
+def _stale() -> bool:
+    """True when the .so is missing or older than any native source."""
+    if not os.path.exists(_LIB_PATH):
+        return True
+    built = os.path.getmtime(_LIB_PATH)
+    src_dir = os.path.dirname(_LIB_PATH)
+    return any(
+        os.path.getmtime(os.path.join(src_dir, f)) > built
+        for f in os.listdir(src_dir)
+        if f.endswith((".cpp", ".h")) or f == "Makefile"
+    )
+
+
+def _build_error_detail(e) -> str:
+    """Stringify a make failure including the captured compiler stderr."""
+    detail = str(e)
+    stderr = getattr(e, "stderr", None)
+    if stderr:
+        detail += "\n" + stderr.decode(errors="replace").strip()
+    return detail
+
+
 def _load_lib(build_if_missing: bool = True):
     global _lib
     if _lib is not None:
         return _lib
-    if not os.path.exists(_LIB_PATH) and build_if_missing:
+    if _stale() and build_if_missing:
         try:
             subprocess.run(["make", "-C", os.path.dirname(_LIB_PATH)],
                            check=True, capture_output=True)
         except (subprocess.CalledProcessError, FileNotFoundError) as e:
-            raise RuntimeError(
-                f"native loader library missing and build failed: {e}; "
-                f"run `make -C {os.path.dirname(_LIB_PATH)}`"
-            ) from e
+            # A stale-but-working prebuilt .so beats no loader at all
+            # (deployed hosts may lack the toolchain); only a missing
+            # library is fatal.
+            if not os.path.exists(_LIB_PATH):
+                raise RuntimeError(
+                    "native loader library missing and build failed: "
+                    f"{_build_error_detail(e)}; "
+                    f"run `make -C {os.path.dirname(_LIB_PATH)}`"
+                ) from e
+            import warnings
+
+            warnings.warn(
+                f"native sources newer than {_LIB_PATH} but rebuild failed "
+                f"({_build_error_detail(e)}); loading the existing library",
+                RuntimeWarning,
+                stacklevel=2,
+            )
     lib = ctypes.CDLL(_LIB_PATH)
     lib.pddl_loader_open.restype = ctypes.c_void_p
     lib.pddl_loader_open.argtypes = [
@@ -84,12 +119,47 @@ def build_native() -> None:
 
 
 def native_available() -> bool:
-    """Pure availability probe: True iff the library is already built."""
-    try:
-        _load_lib(build_if_missing=False)
-        return True
-    except (RuntimeError, OSError):
-        return False
+    """Pure availability probe: True iff the library is built and fresh.
+
+    Deliberately does NOT load the library: caching a stale .so into
+    ``_lib`` would pin it for the whole process and defeat the
+    rebuild-on-stale path in :func:`_load_lib`.
+    """
+    return os.path.exists(_LIB_PATH) and not _stale()
+
+
+class PackedWriter:
+    """Streaming PDL1 writer: append samples one by one, count patched on
+    close (so converters need not know N up front)."""
+
+    def __init__(self, path: str, height: int, width: int, channels: int):
+        self.shape = (height, width, channels)
+        self._f = open(path, "wb")
+        self._n = 0
+        self._f.write(_HEADER.pack(_MAGIC, 0, height, width, channels, 0))
+
+    def add(self, image: np.ndarray, label: int) -> None:
+        image = np.ascontiguousarray(image, np.uint8)
+        if image.shape != self.shape:
+            raise ValueError(f"sample shape {image.shape} != {self.shape}")
+        self._f.write(struct.pack("<i", int(label)))
+        self._f.write(image.tobytes())
+        self._n += 1
+
+    def close(self) -> int:
+        if self._f is None:
+            return self._n
+        self._f.seek(4)
+        self._f.write(struct.pack("<I", self._n))
+        self._f.close()
+        self._f = None
+        return self._n
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
 
 
 def write_packed(path: str, images: np.ndarray, labels: np.ndarray) -> None:
@@ -102,11 +172,9 @@ def write_packed(path: str, images: np.ndarray, labels: np.ndarray) -> None:
     if images.ndim != 4 or len(labels) != len(images):
         raise ValueError(f"bad shapes {images.shape} / {labels.shape}")
     n, h, w, c = images.shape
-    with open(path, "wb") as f:
-        f.write(_HEADER.pack(_MAGIC, n, h, w, c, 0))
+    with PackedWriter(path, h, w, c) as w_:
         for i in range(n):
-            f.write(struct.pack("<i", int(labels[i])))
-            f.write(images[i].tobytes())
+            w_.add(images[i], int(labels[i]))
 
 
 class NativeLoader:
@@ -166,6 +234,8 @@ class NativeLoader:
         img_ptr = images.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
         lbl_ptr = labels.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
         while True:
+            if self._handle is None:  # close()d mid-iteration
+                raise RuntimeError("loader is closed")
             n = self._lib.pddl_loader_next(self._handle, img_ptr, lbl_ptr)
             if n <= 0:
                 return

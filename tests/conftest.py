@@ -22,7 +22,38 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+# The suite's wall-clock is dominated by XLA:CPU compiles of the sharded
+# train steps. Persist them: a warm cache cuts a full run by minutes.
+_CACHE_DIR = os.environ.get(
+    "PDDL_TEST_COMPILE_CACHE", os.path.join("/tmp", "pddl_tpu_xla_cache")
+)
+jax.config.update("jax_compilation_cache_dir", _CACHE_DIR)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+
 import pytest  # noqa: E402
+
+
+def native_build_error(tfrecord: bool = False) -> str:
+    """Build the native library if missing; '' on success, else the error.
+
+    Shared by the native-loader and TFRecord test modules so a missing
+    toolchain produces one self-explanatory skip reason. Only TFRecord
+    tests (``tfrecord=True``) additionally require the ``pddl_tfr_*``
+    symbols, so a prebuilt pre-TFRecord library still runs the
+    packed-loader tests.
+    """
+    try:
+        from pddl_tpu.data.native_loader import build_native
+
+        build_native()  # no-op when the .so is already fresh
+        if tfrecord:
+            from pddl_tpu.data.tfrecord import _tfr_lib
+
+            _tfr_lib()  # raises if a stale pre-TFRecord .so got loaded
+        return ""
+    except Exception as e:  # noqa: BLE001 - any failure means "skip"
+        return str(e)
 
 
 @pytest.fixture(scope="session")

@@ -5,25 +5,13 @@ integration (the in-repo replacement for tf.data's C++ runtime, SURVEY.md
 import numpy as np
 import pytest
 
+from conftest import native_build_error
 from pddl_tpu.data.native_loader import (
     NativeLoader,
-    build_native,
     write_packed,
 )
 
-
-def _ensure_built() -> str:
-    """Build the library if missing (g++ is in the image). Returns an
-    empty string on success, the build error otherwise — so a toolchain
-    failure produces a self-explanatory skip reason."""
-    try:
-        build_native()  # no-op when the .so already exists
-        return ""
-    except Exception as e:
-        return str(e)
-
-
-_BUILD_ERROR = _ensure_built()
+_BUILD_ERROR = native_build_error()
 pytestmark = pytest.mark.skipif(
     bool(_BUILD_ERROR), reason=f"native library unbuildable: {_BUILD_ERROR}"
 )

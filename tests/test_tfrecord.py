@@ -1,0 +1,218 @@
+"""TFRecord layer tests: CRC parity (Python vs native vs TF), framing
+round-trips, TF interop both directions, sharding, shuffle determinism,
+and corruption detection (the record-level slice of tf.data's C++ runtime,
+SURVEY.md §2b C15 — /root/reference/imagenet-resnet50.py:20-34)."""
+
+import struct
+
+import pytest
+
+from pddl_tpu.data.tfrecord import (
+    TFRecordReader,
+    crc32c,
+    masked_crc32c,
+    open_tfrecords,
+    read_tfrecord,
+    write_tfrecord,
+)
+from conftest import native_build_error
+
+_BUILD_ERROR = native_build_error(tfrecord=True)
+pytestmark = pytest.mark.skipif(
+    bool(_BUILD_ERROR), reason=f"native library unbuildable: {_BUILD_ERROR}"
+)
+
+
+def _records(n=20, seed=1):
+    # Variable lengths to exercise the max-length buffer path.
+    return [bytes([(seed * 31 + i + j) % 256 for j in range(5 + 13 * i)])
+            for i in range(n)]
+
+
+def test_crc32c_known_vector():
+    # RFC 3720 check value for "123456789".
+    assert crc32c(b"123456789") == 0xE3069283
+    assert crc32c(b"") == 0
+
+
+def test_crc_native_matches_python():
+    from pddl_tpu.data.tfrecord import native_crc32c, native_masked_crc32c
+
+    for data in (b"", b"a", b"123456789", bytes(range(256)) * 7):
+        assert native_crc32c(data) == crc32c(data)
+        assert native_masked_crc32c(data) == masked_crc32c(data)
+
+
+def test_python_roundtrip(tmp_path):
+    path = str(tmp_path / "a.tfrecord")
+    recs = _records()
+    assert write_tfrecord(path, recs) == len(recs)
+    assert list(read_tfrecord(path)) == recs
+
+
+def test_native_reader_sequential(tmp_path):
+    path = str(tmp_path / "a.tfrecord")
+    recs = _records()
+    write_tfrecord(path, recs)
+    reader = TFRecordReader([path])
+    assert reader.num_records == len(recs)
+    assert list(reader) == recs
+    # Re-iterable: second epoch identical without shuffle.
+    assert list(reader) == recs
+    reader.close()
+
+
+def test_tf_interop_both_directions(tmp_path):
+    tf = pytest.importorskip("tensorflow")
+    recs = _records()
+
+    ours = str(tmp_path / "ours.tfrecord")
+    write_tfrecord(ours, recs)
+    via_tf = [t.numpy() for t in tf.data.TFRecordDataset(ours)]
+    assert via_tf == recs
+
+    theirs = str(tmp_path / "tf.tfrecord")
+    with tf.io.TFRecordWriter(theirs) as w:
+        for r in recs:
+            w.write(r)
+    assert list(TFRecordReader([theirs])) == recs
+
+
+def test_sharding_partitions_global_sequence(tmp_path):
+    paths = []
+    recs = _records(n=30)
+    for fi in range(3):
+        p = str(tmp_path / f"s{fi}.tfrecord")
+        write_tfrecord(p, recs[fi * 10:(fi + 1) * 10])
+        paths.append(p)
+
+    shards = [list(TFRecordReader(paths, shard_index=i, shard_count=4))
+              for i in range(4)]
+    # Every record exactly once across shards; each shard takes every 4th.
+    assert sorted(b for s in shards for b in s) == sorted(recs)
+    assert shards[0] == recs[0::4]
+    assert shards[3] == recs[3::4]
+    r = TFRecordReader(paths, shard_index=1, shard_count=4)
+    assert r.total_records == 30 and r.num_records == len(shards[1])
+
+
+def test_shuffle_deterministic_and_reshuffled(tmp_path):
+    path = str(tmp_path / "a.tfrecord")
+    recs = _records(n=64)
+    write_tfrecord(path, recs)
+
+    r1 = TFRecordReader([path], shuffle=True, seed=7)
+    r2 = TFRecordReader([path], shuffle=True, seed=7)
+    e1, e2 = list(r1), list(r2)
+    assert e1 == e2  # same seed, same epoch -> same order
+    assert sorted(e1) == sorted(recs)
+    assert e1 != recs  # actually shuffled (64! leaves ~0 chance)
+    assert list(r1) != e1  # epoch 2 reshuffles...
+    assert list(TFRecordReader([path], shuffle=True, seed=8)) != e1
+
+
+def test_zero_length_records_roundtrip(tmp_path):
+    # Empty payloads are legal TFRecord framing and must not be mistaken
+    # for the end-of-epoch sentinel.
+    path = str(tmp_path / "a.tfrecord")
+    recs = [b"", b"x", b"", b"yz"]
+    write_tfrecord(path, recs)
+    assert list(read_tfrecord(path)) == recs
+    reader = TFRecordReader([path])
+    assert list(reader) == recs
+    assert list(reader) == recs  # second epoch too
+    reader.close()
+
+
+def test_corrupt_payload_detected(tmp_path):
+    path = str(tmp_path / "a.tfrecord")
+    recs = _records(n=4)
+    write_tfrecord(path, recs)
+    with open(path, "r+b") as f:
+        f.seek(12 + 2)  # inside record 0's payload
+        b = f.read(1)
+        f.seek(12 + 2)
+        f.write(bytes([b[0] ^ 0xFF]))
+
+    with pytest.raises(IOError):
+        list(read_tfrecord(path))
+    with pytest.raises(IOError):
+        list(TFRecordReader([path]))
+    # verify=False skips payload CRCs: the flipped byte flows through.
+    got = list(TFRecordReader([path], verify=False))
+    assert len(got) == 4 and got[1:] == recs[1:] and got[0] != recs[0]
+
+
+def test_corrupt_length_rejected_at_open(tmp_path):
+    path = str(tmp_path / "a.tfrecord")
+    write_tfrecord(path, _records(n=2))
+    with open(path, "r+b") as f:
+        f.seek(0)
+        f.write(struct.pack("<Q", 1 << 40))  # garbage length, bad CRC
+
+    with pytest.raises(FileNotFoundError):
+        TFRecordReader([path])
+    with pytest.raises(IOError):
+        list(read_tfrecord(path))
+
+
+def test_pack_imagenet_tfrecords_to_native_loader(tmp_path):
+    tf = pytest.importorskip("tensorflow")
+    import numpy as np
+
+    from pddl_tpu.data.native_loader import NativeLoader
+    from pddl_tpu.data.pack import pack_imagenet_tfrecords
+
+    rng = np.random.default_rng(0)
+    n, size = 12, 16
+    images = rng.integers(0, 255, (n, size, size, 3), np.uint8)
+    paths = []
+    for fi in range(2):
+        p = str(tmp_path / f"train-{fi}.tfrecord")
+        with tf.io.TFRecordWriter(p) as w:
+            for i in range(fi * 6, fi * 6 + 6):
+                ex = tf.train.Example(features=tf.train.Features(feature={
+                    # PNG (lossless) so content checks are exact; the
+                    # converter's decode_image handles JPEG identically.
+                    "image/encoded": tf.train.Feature(bytes_list=tf.train.BytesList(
+                        value=[tf.io.encode_png(images[i]).numpy()])),
+                    "image/class/label": tf.train.Feature(int64_list=tf.train.Int64List(
+                        value=[i + 1])),
+                }))
+                w.write(ex.SerializeToString())
+        paths.append(p)
+
+    out = str(tmp_path / "train.pdl1")
+    wrote = pack_imagenet_tfrecords(paths, out, image_size=size,
+                                    label_offset=-1)
+    assert wrote == n
+
+    loader = NativeLoader([out], batch_size=4, shuffle=False,
+                          drop_remainder=False)
+    batches = list(loader)
+    got_labels = sorted(int(l) for b in batches for l in b["label"])
+    assert got_labels == list(range(n))
+    assert batches[0]["image"].shape == (4, size, size, 3)
+    first_label = int(batches[0]["label"][0])
+    np.testing.assert_array_equal(batches[0]["image"][0],
+                                  images[first_label])
+    loader.close()
+
+    # Sharded packing partitions the global record sequence.
+    s0 = str(tmp_path / "s0.pdl1")
+    s1 = str(tmp_path / "s1.pdl1")
+    n0 = pack_imagenet_tfrecords(paths, s0, image_size=size,
+                                 shard_index=0, shard_count=2)
+    n1 = pack_imagenet_tfrecords(paths, s1, image_size=size,
+                                 shard_index=1, shard_count=2)
+    assert n0 + n1 == n
+
+
+def test_open_tfrecords_fallback(tmp_path):
+    path = str(tmp_path / "a.tfrecord")
+    recs = _records(n=6)
+    write_tfrecord(path, recs)
+    assert list(open_tfrecords([path])) == recs
+    assert list(open_tfrecords([path], native=False)) == recs
+    with pytest.raises(RuntimeError):
+        open_tfrecords([path], native=False, shuffle=True)
