@@ -143,7 +143,6 @@ class TFRecordReader {
 
  private:
   bool index() {
-    std::vector<uint8_t> buf;
     for (uint32_t fi = 0; fi < paths_.size(); ++fi) {
       FILE* f = std::fopen(paths_[fi].c_str(), "rb");
       if (!f) return false;

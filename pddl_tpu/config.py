@@ -50,6 +50,11 @@ class ExperimentConfig:
     optimizer: str = "adam"
     learning_rate: float = 1e-3  # Keras Adam default (compile at :62)
     scale_lr: bool = False  # Horovod's 0.1*size rule (-hvd.py:99)
+    # compiled step->LR schedule (train/state.py make_schedule); None keeps
+    # the reference's callback-driven LR control
+    lr_schedule: Optional[str] = None
+    lr_schedule_options: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    ema_decay: Optional[float] = None  # EMA of params; eval uses the shadow
     epochs: int = 50  # reference (imagenet-resnet50.py:67)
     steps_per_epoch: Optional[int] = None
     warmup_epochs: int = 0  # hvd preset: 3 (-hvd.py:114)

@@ -246,8 +246,22 @@ def open_tfrecords(paths: Sequence[str], *, native: Optional[bool] = None,
         )
 
     class _PyReader:
+        """Sequential fallback with the TFRecordReader surface."""
+
         def __iter__(self):
             for p in paths:
                 yield from read_tfrecord(p, verify=kwargs.get("verify", True))
+
+        @property
+        def num_records(self):
+            return sum(1 for _ in self)
+
+        total_records = num_records
+
+        def __len__(self):
+            return self.num_records
+
+        def close(self):
+            pass
 
     return _PyReader()

@@ -114,4 +114,6 @@ class ParameterServerStrategy(Strategy):
             params=params_sh,
             batch_stats=jax.tree.map(lambda _: repl, state.batch_stats),
             opt_state=opt_sh,
+            # EMA shadows live wherever their parameters live.
+            ema_params=jax.tree.map(shard_leaf, state.ema_params),
         )

@@ -172,6 +172,8 @@ class TensorParallelStrategy(Strategy):
             params=tree_sharding(state.params),
             batch_stats=jax.tree.map(lambda _: repl, state.batch_stats),
             opt_state=tree_sharding(state.opt_state),
+            # EMA shadows inherit the TP layout of their parameters.
+            ema_params=tree_sharding(state.ema_params),
         )
 
 

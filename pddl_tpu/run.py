@@ -43,12 +43,29 @@ def build_trainer(cfg: ExperimentConfig, strategy=None):
     if cfg.scale_lr:  # Horovod's 0.1*size (imagenet-resnet50-hvd.py:99)
         lr = strategy.scale_learning_rate(lr)
 
+    schedule_options = dict(cfg.lr_schedule_options)
+    if cfg.lr_schedule and "decay_steps" not in schedule_options:
+        if cfg.steps_per_epoch:
+            # Default horizon: the full run.
+            schedule_options["decay_steps"] = cfg.steps_per_epoch * cfg.epochs
+        elif cfg.lr_schedule not in ("constant", "piecewise"):
+            # Fail here with guidance, not deep inside optax: with real
+            # data the per-epoch step count isn't known until iteration.
+            raise ValueError(
+                f"--lr-schedule {cfg.lr_schedule} needs a decay horizon: "
+                "pass --lr-decay-steps, or set --steps-per-epoch so it "
+                "defaults to epochs*steps_per_epoch"
+            )
+
     if _is_lm(cfg.model):
         # Language models: token batches, no image augmentation.
         trainer = Trainer(
             model, optimizer=cfg.optimizer, learning_rate=lr,
             strategy=strategy, seed=cfg.seed,
             input_key="tokens", target_key="targets",
+            lr_schedule=cfg.lr_schedule,
+            lr_schedule_options=schedule_options,
+            ema_decay=cfg.ema_decay,
         )
     else:
         # Crop never exceeds the input (the reference's RandomCrop(244) on
@@ -64,14 +81,19 @@ def build_trainer(cfg: ExperimentConfig, strategy=None):
             seed=cfg.seed,
             augment=standard_augment(crop=crop, flip=cfg.flip),
             eval_transform=standard_eval_transform(crop=crop),
+            lr_schedule=cfg.lr_schedule,
+            lr_schedule_options=schedule_options,
+            ema_decay=cfg.ema_decay,
         )
 
     callbacks = []
-    if cfg.reduce_lr_on_plateau:  # defaults = reference's (:64)
+    # A compiled schedule owns the LR; callback-driven LR control would be
+    # overwritten every step, so it is disabled alongside one.
+    if cfg.reduce_lr_on_plateau and not cfg.lr_schedule:  # reference's (:64)
         callbacks.append(cb.ReduceLROnPlateau())
     if cfg.early_stopping:  # (:65)
         callbacks.append(cb.EarlyStopping())
-    if cfg.warmup_epochs:
+    if cfg.warmup_epochs and not cfg.lr_schedule:
         callbacks.append(cb.LearningRateWarmup(warmup_epochs=cfg.warmup_epochs))
     callbacks.append(cb.Timing())
     if cfg.checkpoint_dir:
@@ -201,14 +223,21 @@ def run_experiment(cfg: ExperimentConfig, steps_per_epoch: Optional[int] = None,
         # fixed by construction).
         from pddl_tpu.ckpt.keras_import import export_keras_style_h5
 
+        # With EMA enabled, the shadow weights are what eval ran on —
+        # export those (standard EMA serving practice).
+        export_params = (
+            trainer.state.ema_params
+            if trainer.state.ema_params is not None and trainer.eval_with_ema
+            else trainer.state.params
+        )
         if cfg.save_path.endswith(".h5") and cfg.model.startswith("resnet"):
-            variables = {"params": trainer.state.params,
+            variables = {"params": export_params,
                          "batch_stats": trainer.state.batch_stats}
             export_keras_style_h5(cfg.save_path, variables)
         else:
             from pddl_tpu.ckpt.checkpoint import save_params_npz
 
-            save_params_npz(cfg.save_path, trainer.state.params)
+            save_params_npz(cfg.save_path, export_params)
     return history
 
 
@@ -250,6 +279,18 @@ def main(argv=None) -> int:
     p.add_argument("--steps-per-epoch", type=int, default=None)
     p.add_argument("--batch", type=int, default=None, help="per-replica batch")
     p.add_argument("--lr", type=float, default=None)
+    p.add_argument("--lr-schedule", default=None,
+                   choices=["cosine", "warmup_cosine", "exponential",
+                            "linear", "piecewise", "constant"],
+                   help="compiled step->LR schedule (disables plateau/"
+                        "warmup callbacks); decay horizon = "
+                        "--lr-decay-steps, or epochs*steps_per_epoch when "
+                        "--steps-per-epoch is set")
+    p.add_argument("--lr-decay-steps", type=int, default=None)
+    p.add_argument("--lr-warmup-steps", type=int, default=None)
+    p.add_argument("--ema-decay", type=float, default=None,
+                   help="exponential moving average of params; eval/"
+                        "export use the shadow weights")
     p.add_argument("--image-size", type=int, default=None)
     p.add_argument("--crop", type=int, default=None)
     p.add_argument("--num-classes", type=int, default=None)
@@ -283,10 +324,18 @@ def main(argv=None) -> int:
         "checkpoint_dir": args.checkpoint_dir,
         "save_path": args.save_path, "seed": args.seed,
         "verbose": args.verbose,
+        "lr_schedule": args.lr_schedule, "ema_decay": args.ema_decay,
     }
     for field, value in mapping.items():
         if value is not None:
             overrides[field] = value
+    schedule_opts = {}
+    if args.lr_decay_steps is not None:
+        schedule_opts["decay_steps"] = args.lr_decay_steps
+    if args.lr_warmup_steps is not None:
+        schedule_opts["warmup_steps"] = args.lr_warmup_steps
+    if schedule_opts:
+        overrides["lr_schedule_options"] = schedule_opts
     if args.resume:
         overrides["resume"] = True
     if args.synthetic:

@@ -6,7 +6,13 @@ surface is a custom SPMD loop: a jitted ``train_step``/``eval_step`` over a
 mesh, an epoch driver, and a Keras-compatible callback engine.
 """
 
-from pddl_tpu.train.state import TrainState, make_optimizer, get_learning_rate, set_learning_rate
+from pddl_tpu.train.state import (
+    TrainState,
+    make_optimizer,
+    make_schedule,
+    get_learning_rate,
+    set_learning_rate,
+)
 from pddl_tpu.train.loop import Trainer
 from pddl_tpu.train.history import History
 from pddl_tpu.train import callbacks
@@ -19,6 +25,7 @@ __all__ = [
     "callbacks",
     "metrics",
     "make_optimizer",
+    "make_schedule",
     "get_learning_rate",
     "set_learning_rate",
 ]

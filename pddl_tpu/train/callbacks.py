@@ -24,6 +24,7 @@ from hooks (LR changes are state edits, not attribute pokes) and set
 from __future__ import annotations
 
 import math
+import os
 import sys
 import time
 from typing import Dict, List, Optional
@@ -114,6 +115,7 @@ class EarlyStopping(Callback):
         self.best = math.inf if mode == "min" else -math.inf
         self.wait = 0
         self.best_params = None
+        self.best_ema = None
         self.stopped_epoch: Optional[int] = None
 
     def _improved(self, current: float) -> bool:
@@ -130,14 +132,18 @@ class EarlyStopping(Callback):
             if self.restore_best_weights:
                 # Deep-copy: the live params buffers are donated by the next
                 # jitted train step and would be deleted under our feet.
+                # The EMA shadows are what eval ran on (when enabled), so
+                # they are part of "the best weights" and roll back too.
                 self.best_params = jax.tree.map(jnp.copy, state.params)
+                self.best_ema = jax.tree.map(jnp.copy, state.ema_params)
             return None
         self.wait += 1
         if self.wait >= self.patience:
             self.stopped_epoch = epoch
             self.trainer.stop_training = True
             if self.restore_best_weights and self.best_params is not None:
-                return state.replace(params=self.best_params)
+                return state.replace(params=self.best_params,
+                                     ema_params=self.best_ema)
         return None
 
 
@@ -232,6 +238,72 @@ class CSVLogger(Callback):
         if self._file is not None:
             self._file.close()
             self._file = None
+        return None
+
+
+class TensorBoard(Callback):
+    """Epoch metrics (and LR) as TensorBoard event files, coordinator-only.
+
+    The reference's only observability is Keras ``verbose`` console lines
+    (``imagenet-resnet50.py:67``); this writes the standard event-file
+    format instead. ``train``/``validation`` subdirectories mirror Keras's
+    TensorBoard callback: ``val_``-prefixed metrics land in ``validation``
+    under their bare name, so both curves overlay on one chart.
+
+    Uses TensorFlow's (CPU) summary writer; raises at train start if TF is
+    unavailable rather than silently logging nothing.
+    """
+
+    def __init__(self, log_dir: str, write_lr: bool = True):
+        self.log_dir = log_dir
+        self.write_lr = write_lr
+        self._writers = None
+
+    def on_train_begin(self, state):
+        from pddl_tpu.core import dist
+
+        if not dist.is_coordinator():
+            return None
+        import tensorflow as tf  # CPU-only build; summary writer lives here
+
+        self._writers = {
+            split: tf.summary.create_file_writer(
+                os.path.join(self.log_dir, split)
+            )
+            for split in ("train", "validation")
+        }
+        return None
+
+    def on_epoch_end(self, epoch, state, logs):
+        if self._writers is None:
+            return None
+        import tensorflow as tf
+
+        by_split = {"train": {}, "validation": {}}
+        for key, value in logs.items():
+            if key.startswith("val_"):
+                by_split["validation"][key[4:]] = value
+            else:
+                by_split["train"][key] = value
+        if self.write_lr:
+            try:
+                by_split["train"]["learning_rate"] = get_learning_rate(state)
+            except ValueError:  # optimizer without injected LR
+                pass
+        for split, metrics in by_split.items():
+            if not metrics:
+                continue
+            with self._writers[split].as_default(step=epoch):
+                for key, value in metrics.items():
+                    tf.summary.scalar(key, float(value))
+            self._writers[split].flush()
+        return None
+
+    def on_train_end(self, state, logs):
+        if self._writers is not None:
+            for w in self._writers.values():
+                w.close()
+            self._writers = None
         return None
 
 

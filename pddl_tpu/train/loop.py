@@ -65,12 +65,21 @@ class Trainer:
         donate_state: bool = True,
         input_key: str = "image",   # batch keys; the GPT family uses
         target_key: str = "label",  # tokens/targets (models/gpt.py)
+        lr_schedule: Optional[str | Callable] = None,
+        lr_schedule_options: Optional[Dict[str, Any]] = None,
+        ema_decay: Optional[float] = None,
+        eval_with_ema: bool = True,  # evaluate on EMA weights when enabled
     ):
         self.model = model
         self.input_key = input_key
         self.target_key = target_key
         self.strategy = strategy or SingleDeviceStrategy()
-        self.tx = make_optimizer(optimizer, learning_rate)
+        self.tx = make_optimizer(
+            optimizer, learning_rate,
+            schedule=lr_schedule, schedule_options=lr_schedule_options,
+        )
+        self.ema_decay = ema_decay
+        self.eval_with_ema = eval_with_ema
         self.eval_transform = eval_transform
         self.loss_fn = metrics_lib.resolve_loss(loss)
         self.metric_fns = dict(metrics_lib.resolve_metric(m) for m in metrics)
@@ -107,6 +116,7 @@ class Trainer:
                 params=params,
                 batch_stats=batch_stats,
                 opt_state=self.tx.init(params),
+                ema_params=params if self.ema_decay else None,
             )
 
         abstract = jax.eval_shape(_init, rng)
@@ -163,7 +173,8 @@ class Trainer:
                 loss_of, has_aux=True
             )(state.params)
             new_state = state.apply_gradients(
-                self.tx, grads, updates.get("batch_stats", state.batch_stats)
+                self.tx, grads, updates.get("batch_stats", state.batch_stats),
+                ema_decay=self.ema_decay,
             )
             logs = {"loss": loss}
             for name, fn in self.metric_fns.items():
@@ -174,8 +185,14 @@ class Trainer:
             images, labels = batch[self.input_key], batch[self.target_key]
             if self.eval_transform is not None:
                 images = self.eval_transform(images)
+            # Structural (trace-time) choice: EMA weights when enabled.
+            eval_params = (
+                state.ema_params
+                if self.eval_with_ema and state.ema_params is not None
+                else state.params
+            )
             (logits, updates) = self._apply(
-                state.params, state.batch_stats, images, train=False,
+                eval_params, state.batch_stats, images, train=False,
                 mutable=True,
             )
             loss = self.loss_fn(logits, labels)

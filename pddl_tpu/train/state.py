@@ -35,16 +35,28 @@ class TrainState(struct.PyTreeNode):
     params: PyTree
     batch_stats: PyTree
     opt_state: optax.OptState
+    # Shadow parameters for exponential moving averaging (None = disabled).
+    # Evaluating/serving with the EMA weights is standard large-batch
+    # practice; the reference has no analogue (Keras Adam only).
+    ema_params: PyTree = None
 
     def apply_gradients(self, tx: optax.GradientTransformation, grads: PyTree,
-                        new_batch_stats: PyTree | None = None) -> "TrainState":
+                        new_batch_stats: PyTree | None = None,
+                        ema_decay: float | None = None) -> "TrainState":
         updates, new_opt_state = tx.update(grads, self.opt_state, self.params)
         new_params = optax.apply_updates(self.params, updates)
+        new_ema = self.ema_params
+        if new_ema is not None and ema_decay is not None:
+            new_ema = jax.tree.map(
+                lambda e, p: e * ema_decay + (1.0 - ema_decay) * p,
+                new_ema, new_params,
+            )
         return self.replace(
             step=self.step + 1,
             params=new_params,
             batch_stats=new_batch_stats if new_batch_stats is not None else self.batch_stats,
             opt_state=new_opt_state,
+            ema_params=new_ema,
         )
 
 
@@ -60,15 +72,88 @@ _OPTIMIZERS: dict[str, Callable[..., optax.GradientTransformation]] = {
 }
 
 
+def make_schedule(
+    name: str | Callable[[jnp.ndarray], jnp.ndarray],
+    learning_rate: float,
+    *,
+    decay_steps: Optional[int] = None,
+    warmup_steps: int = 0,
+    alpha: float = 0.0,
+    decay_rate: float = 0.96,
+    boundaries_and_scales: Optional[dict] = None,
+    end_value: float = 0.0,
+) -> Callable[[jnp.ndarray], jnp.ndarray]:
+    """Build a compiled LR schedule (an ``optax`` step→LR function).
+
+    Schedules run *inside* the jitted step — no host round-trip per epoch,
+    unlike the reference's callback-driven LR control
+    (``imagenet-resnet50.py:64``, ``imagenet-resnet50-hvd.py:114``), which
+    remains available for plateau-style adaptive control.
+
+    Names: ``cosine`` (optionally warmed up), ``exponential``, ``linear``,
+    ``piecewise`` (step decay via ``boundaries_and_scales``), ``constant``.
+    """
+    if callable(name):
+        return name
+    kind = name.lower()
+    if kind in ("cosine", "warmup_cosine"):
+        if decay_steps is None:
+            raise ValueError(f"{kind!r} schedule requires decay_steps")
+        if kind == "warmup_cosine" and not warmup_steps:
+            raise ValueError(
+                "'warmup_cosine' requires warmup_steps > 0; use 'cosine' "
+                "for no warmup"
+            )
+        if warmup_steps:
+            return optax.warmup_cosine_decay_schedule(
+                init_value=0.0, peak_value=learning_rate,
+                warmup_steps=warmup_steps, decay_steps=decay_steps,
+                end_value=alpha * learning_rate,
+            )
+        return optax.cosine_decay_schedule(learning_rate, decay_steps, alpha)
+    if kind == "exponential":
+        if decay_steps is None:
+            raise ValueError("'exponential' schedule requires decay_steps")
+        sched = optax.exponential_decay(learning_rate, decay_steps, decay_rate)
+    elif kind == "linear":
+        if decay_steps is None:
+            raise ValueError("'linear' schedule requires decay_steps")
+        sched = optax.linear_schedule(learning_rate, end_value, decay_steps)
+    elif kind == "piecewise":
+        sched = optax.piecewise_constant_schedule(
+            learning_rate, boundaries_and_scales or {}
+        )
+    elif kind == "constant":
+        sched = optax.constant_schedule(learning_rate)
+    else:
+        raise ValueError(
+            f"unknown schedule {name!r}; known: cosine, warmup_cosine, "
+            "exponential, linear, piecewise, constant"
+        )
+    if warmup_steps:
+        warmup = optax.linear_schedule(0.0, learning_rate, warmup_steps)
+        sched = optax.join_schedules([warmup, sched], [warmup_steps])
+    return sched
+
+
 def make_optimizer(
     name: str | optax.GradientTransformation = "adam",
     learning_rate: float = 1e-3,  # Keras Adam default, as compiled at :62
     *,
+    schedule: Optional[str | Callable] = None,
+    schedule_options: Optional[dict] = None,
     weight_decay: Optional[float] = None,
     grad_clip_norm: Optional[float] = None,
     **kwargs,
 ) -> optax.GradientTransformation:
-    """Build an optimizer with a state-injected (callback-adjustable) LR."""
+    """Build an optimizer with a state-injected (callback-adjustable) LR.
+
+    With ``schedule`` set, the LR is a compiled step→value function
+    (:func:`make_schedule`); ``inject_hyperparams`` still exposes the
+    current value in the optimizer state, so ``get_learning_rate`` keeps
+    working (callback writes would be overwritten each step — pick
+    schedule OR plateau-callback control, not both).
+    """
     if isinstance(name, optax.GradientTransformation):
         return name
     try:
@@ -84,7 +169,10 @@ def make_optimizer(
                 "silently ignored); use 'adamw'/'lamb', or pass a prebuilt "
                 "optax.GradientTransformation with optax.add_decayed_weights"
             )
-    tx = optax.inject_hyperparams(factory)(learning_rate=learning_rate, **kwargs)
+    lr: Any = learning_rate
+    if schedule is not None:
+        lr = make_schedule(schedule, learning_rate, **(schedule_options or {}))
+    tx = optax.inject_hyperparams(factory)(learning_rate=lr, **kwargs)
     if grad_clip_norm is not None:
         tx = optax.chain(optax.clip_by_global_norm(grad_clip_norm), tx)
     return tx
