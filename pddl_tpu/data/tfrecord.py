@@ -87,6 +87,21 @@ def read_tfrecord(path: str, verify: bool = True) -> Iterator[bytes]:
             yield payload
 
 
+def count_records(path: str) -> int:
+    """Record count by hopping frame lengths (no payload reads/CRCs)."""
+    n = 0
+    with open(path, "rb") as f:
+        while True:
+            hdr = f.read(12)
+            if not hdr:
+                return n
+            if len(hdr) != 12:
+                raise IOError(f"{path}: truncated record header")
+            (length,) = struct.unpack("<Q", hdr[:8])
+            f.seek(length + 4, 1)
+            n += 1
+
+
 # ---------------------------------------------------------------------------
 # Native reader binding.
 
@@ -252,9 +267,15 @@ def open_tfrecords(paths: Sequence[str], *, native: Optional[bool] = None,
             for p in paths:
                 yield from read_tfrecord(p, verify=kwargs.get("verify", True))
 
+        _count = None
+
         @property
         def num_records(self):
-            return sum(1 for _ in self)
+            # Counted once, by frame-length seeks only — len() must not
+            # cost a full verified dataset scan.
+            if self._count is None:
+                self._count = sum(count_records(p) for p in paths)
+            return self._count
 
         total_records = num_records
 

@@ -28,15 +28,25 @@ class MultiWorkerMirroredStrategy(Strategy):
 
     def __init__(self, coordinator_address: Optional[str] = None,
                  num_processes: Optional[int] = None,
-                 process_id: Optional[int] = None):
+                 process_id: Optional[int] = None,
+                 hybrid: bool = False):
         super().__init__(MeshConfig())
         self._bootstrap = (coordinator_address, num_processes, process_id)
+        self._hybrid = hybrid
         self.cluster: Optional[dist.ClusterSpec] = None
 
     def setup(self):
         if self._mesh is None:
             self.cluster = dist.initialize(*self._bootstrap)
-            self._mesh = build_mesh(MeshConfig())
+            if self._hybrid:
+                # Multi-slice job: slice-major data axis so the gradient
+                # all-reduce is hierarchical (ICI within a slice, one DCN
+                # hop between slices) — core/mesh.py build_hybrid_mesh.
+                from pddl_tpu.core.mesh import build_hybrid_mesh
+
+                self._mesh = build_hybrid_mesh(MeshConfig())
+            else:
+                self._mesh = build_mesh(MeshConfig())
         return self._mesh
 
     @property

@@ -261,7 +261,15 @@ def _load_pretrained(trainer, cfg: ExperimentConfig, train_data) -> None:
         lambda new, old: jax.device_put(new, old.sharding),
         loaded.get("batch_stats", {}), trainer.state.batch_stats,
     )
-    trainer.state = trainer.state.replace(params=params, batch_stats=stats)
+    # EMA shadows must restart from the loaded weights, not the random
+    # init they were seeded with (eval/export run on the shadows).
+    ema = trainer.state.ema_params
+    if ema is not None:
+        ema = jax.tree.map(
+            lambda new, old: jax.device_put(new, old.sharding), params, ema
+        )
+    trainer.state = trainer.state.replace(params=params, batch_stats=stats,
+                                          ema_params=ema)
 
 
 def main(argv=None) -> int:
@@ -288,6 +296,9 @@ def main(argv=None) -> int:
                         "--steps-per-epoch is set")
     p.add_argument("--lr-decay-steps", type=int, default=None)
     p.add_argument("--lr-warmup-steps", type=int, default=None)
+    p.add_argument("--lr-boundaries", default=None,
+                   help="piecewise schedule: comma-separated step:scale "
+                        "pairs, e.g. 30000:0.1,60000:0.1")
     p.add_argument("--ema-decay", type=float, default=None,
                    help="exponential moving average of params; eval/"
                         "export use the shadow weights")
@@ -334,6 +345,14 @@ def main(argv=None) -> int:
         schedule_opts["decay_steps"] = args.lr_decay_steps
     if args.lr_warmup_steps is not None:
         schedule_opts["warmup_steps"] = args.lr_warmup_steps
+    if args.lr_boundaries:
+        try:
+            schedule_opts["boundaries_and_scales"] = {
+                int(pair.split(":")[0]): float(pair.split(":")[1])
+                for pair in args.lr_boundaries.split(",")
+            }
+        except (ValueError, IndexError):
+            p.error("--lr-boundaries must be step:scale[,step:scale...]")
     if schedule_opts:
         overrides["lr_schedule_options"] = schedule_opts
     if args.resume:

@@ -120,8 +120,14 @@ def make_schedule(
             raise ValueError("'linear' schedule requires decay_steps")
         sched = optax.linear_schedule(learning_rate, end_value, decay_steps)
     elif kind == "piecewise":
+        if not boundaries_and_scales:
+            raise ValueError(
+                "'piecewise' schedule requires boundaries_and_scales "
+                "({step: scale, ...}); without them it would silently be "
+                "a constant LR"
+            )
         sched = optax.piecewise_constant_schedule(
-            learning_rate, boundaries_and_scales or {}
+            learning_rate, boundaries_and_scales
         )
     elif kind == "constant":
         sched = optax.constant_schedule(learning_rate)

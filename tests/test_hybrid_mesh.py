@@ -1,0 +1,101 @@
+"""Multi-slice (DCN-aware) mesh construction.
+
+The reference splits collectives into NCCL intra-node rings + cross-host
+rings (``/root/reference/imagenet-resnet50-multiworkers.py:19-25``). The
+TPU analogue: non-DCN mesh axes must stay inside one slice (ICI); the DCN
+axis is laid out slice-major so its all-reduce is hierarchical. Slices are
+faked here by splitting the 8 fake CPU devices into groups."""
+
+import numpy as np
+import pytest
+
+from pddl_tpu.core.mesh import (
+    CANONICAL_AXES,
+    MeshConfig,
+    build_hybrid_mesh,
+    slice_groups,
+)
+
+
+def _slice_of(dev, groups):
+    for i, g in enumerate(groups):
+        if dev in g:
+            return i
+    raise AssertionError(f"{dev} in no slice")
+
+
+def test_slice_groups_fake_split(eight_devices):
+    groups = slice_groups(eight_devices, num_slices=2)
+    assert [len(g) for g in groups] == [4, 4]
+    assert groups[0] == list(eight_devices[:4])
+    with pytest.raises(ValueError):
+        slice_groups(eight_devices, num_slices=3)  # 8 % 3 != 0
+    # Without num_slices on an undifferentiated host: one slice.
+    assert len(slice_groups(eight_devices)) == 1
+
+
+def test_hybrid_mesh_data_axis_slice_major(eight_devices):
+    mesh = build_hybrid_mesh(MeshConfig(data=-1), num_slices=2,
+                             devices=eight_devices)
+    assert mesh.shape["data"] == 8
+    groups = slice_groups(eight_devices, num_slices=2)
+    flat = mesh.devices.reshape(8)
+    # Positions 0-3 are slice 0, 4-7 slice 1 (slice-major).
+    assert [_slice_of(d, groups) for d in flat] == [0] * 4 + [1] * 4
+
+
+def test_hybrid_mesh_model_axis_stays_intra_slice(eight_devices):
+    mesh = build_hybrid_mesh(MeshConfig(data=4, model=2), num_slices=2,
+                             devices=eight_devices)
+    groups = slice_groups(eight_devices, num_slices=2)
+    arr = mesh.devices.reshape(4, 2)  # (data, model)
+    for row in arr:
+        # Both tensor-parallel partners share a slice: their all-reduces
+        # ride ICI, never DCN.
+        assert _slice_of(row[0], groups) == _slice_of(row[1], groups)
+    # Data axis still slice-major at the granularity of per-slice share.
+    assert [_slice_of(r[0], groups) for r in arr] == [0, 0, 1, 1]
+
+
+def test_hybrid_mesh_rejects_oversized_intra_slice_axis(eight_devices):
+    # model=8 over 2 slices would have to cross DCN; it surfaces as the
+    # data axis (1) not being divisible by the slice count.
+    with pytest.raises(ValueError, match="not divisible"):
+        build_hybrid_mesh(MeshConfig(data=1, model=8), num_slices=2,
+                          devices=eight_devices)
+    with pytest.raises(ValueError, match="not divisible"):
+        # data=2 cannot span 4 slices (2 % 4 != 0).
+        build_hybrid_mesh(MeshConfig(data=2, model=4), num_slices=4,
+                          devices=eight_devices)
+
+
+def test_hybrid_mesh_single_slice_degenerates(eight_devices):
+    from pddl_tpu.core.mesh import build_mesh
+
+    hybrid = build_hybrid_mesh(MeshConfig(data=-1), devices=eight_devices)
+    plain = build_mesh(MeshConfig(data=-1), devices=eight_devices)
+    assert (hybrid.devices == plain.devices).all()
+    assert hybrid.axis_names == plain.axis_names == CANONICAL_AXES
+
+
+def test_training_on_hybrid_mesh(eight_devices):
+    """One compiled DP x TP train step over a faked 2-slice mesh."""
+    from pddl_tpu.data.synthetic import SyntheticImageClassification
+    from pddl_tpu.models.vit import ViT
+    from pddl_tpu.parallel.tensor_parallel import TensorParallelStrategy
+    from pddl_tpu.train.loop import Trainer
+
+    strategy = TensorParallelStrategy(model_parallel=2)
+    strategy._mesh = build_hybrid_mesh(
+        MeshConfig(data=4, model=2), num_slices=2, devices=eight_devices
+    )
+    vit = ViT(patch_size=4, embed_dim=32, depth=1, num_heads=4,
+              num_classes=10, attention="reference")
+    trainer = Trainer(vit, optimizer="adamw", learning_rate=1e-3,
+                      strategy=strategy)
+    data = SyntheticImageClassification(
+        batch_size=strategy.scale_batch_size(2), image_size=32,
+        num_classes=10, seed=0,
+    )
+    trainer.fit(data, epochs=1, steps_per_epoch=2, verbose=0)
+    assert np.isfinite(trainer.history.history["loss"][-1])

@@ -213,6 +213,10 @@ def test_open_tfrecords_fallback(tmp_path):
     recs = _records(n=6)
     write_tfrecord(path, recs)
     assert list(open_tfrecords([path])) == recs
-    assert list(open_tfrecords([path], native=False)) == recs
+    py = open_tfrecords([path], native=False)
+    assert list(py) == recs
+    # Fallback mirrors the native reader surface.
+    assert len(py) == py.num_records == py.total_records == 6
+    py.close()
     with pytest.raises(RuntimeError):
         open_tfrecords([path], native=False, shuffle=True)

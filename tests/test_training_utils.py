@@ -64,6 +64,8 @@ def test_make_schedule_shapes():
     with pytest.raises(ValueError):
         make_schedule("cosine", 1.0)  # decay_steps required
     with pytest.raises(ValueError):
+        make_schedule("piecewise", 1.0)  # would silently be constant
+    with pytest.raises(ValueError):
         make_schedule("warmup_cosine", 1.0, decay_steps=10)  # needs warmup
     with pytest.raises(ValueError):
         make_schedule("nope", 1.0)
