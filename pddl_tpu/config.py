@@ -55,6 +55,9 @@ class ExperimentConfig:
     lr_schedule: Optional[str] = None
     lr_schedule_options: Dict[str, Any] = dataclasses.field(default_factory=dict)
     ema_decay: Optional[float] = None  # EMA of params; eval uses the shadow
+    # average gradients over k micro-batches per optimizer update (large
+    # effective batch without the HBM)
+    gradient_accumulation_steps: Optional[int] = None
     epochs: int = 50  # reference (imagenet-resnet50.py:67)
     steps_per_epoch: Optional[int] = None
     warmup_epochs: int = 0  # hvd preset: 3 (-hvd.py:114)

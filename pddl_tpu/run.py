@@ -66,6 +66,7 @@ def build_trainer(cfg: ExperimentConfig, strategy=None):
             lr_schedule=cfg.lr_schedule,
             lr_schedule_options=schedule_options,
             ema_decay=cfg.ema_decay,
+            gradient_accumulation_steps=cfg.gradient_accumulation_steps,
         )
     else:
         # Crop never exceeds the input (the reference's RandomCrop(244) on
@@ -84,6 +85,7 @@ def build_trainer(cfg: ExperimentConfig, strategy=None):
             lr_schedule=cfg.lr_schedule,
             lr_schedule_options=schedule_options,
             ema_decay=cfg.ema_decay,
+            gradient_accumulation_steps=cfg.gradient_accumulation_steps,
         )
 
     callbacks = []
@@ -299,6 +301,9 @@ def main(argv=None) -> int:
     p.add_argument("--lr-boundaries", default=None,
                    help="piecewise schedule: comma-separated step:scale "
                         "pairs, e.g. 30000:0.1,60000:0.1")
+    p.add_argument("--grad-accum", type=int, default=None,
+                   help="average gradients over k micro-batches per "
+                        "optimizer update (large effective batch)")
     p.add_argument("--ema-decay", type=float, default=None,
                    help="exponential moving average of params; eval/"
                         "export use the shadow weights")
@@ -336,6 +341,7 @@ def main(argv=None) -> int:
         "save_path": args.save_path, "seed": args.seed,
         "verbose": args.verbose,
         "lr_schedule": args.lr_schedule, "ema_decay": args.ema_decay,
+        "gradient_accumulation_steps": args.grad_accum,
     }
     for field, value in mapping.items():
         if value is not None:

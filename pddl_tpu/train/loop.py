@@ -69,6 +69,7 @@ class Trainer:
         lr_schedule_options: Optional[Dict[str, Any]] = None,
         ema_decay: Optional[float] = None,
         eval_with_ema: bool = True,  # evaluate on EMA weights when enabled
+        gradient_accumulation_steps: Optional[int] = None,
     ):
         self.model = model
         self.input_key = input_key
@@ -77,6 +78,7 @@ class Trainer:
         self.tx = make_optimizer(
             optimizer, learning_rate,
             schedule=lr_schedule, schedule_options=lr_schedule_options,
+            accumulate_steps=gradient_accumulation_steps,
         )
         self.ema_decay = ema_decay
         self.eval_with_ema = eval_with_ema

@@ -47,10 +47,21 @@ class TrainState(struct.PyTreeNode):
         new_params = optax.apply_updates(self.params, updates)
         new_ema = self.ema_params
         if new_ema is not None and ema_decay is not None:
-            new_ema = jax.tree.map(
+            decayed = jax.tree.map(
                 lambda e, p: e * ema_decay + (1.0 - ema_decay) * p,
                 new_ema, new_params,
             )
+            if hasattr(new_opt_state, "mini_step"):
+                # optax.MultiSteps: mid-accumulation steps emit zero
+                # updates; decaying the EMA there would compound to
+                # decay^k per real update. mini_step wraps to 0 exactly
+                # when the averaged update was applied.
+                emit = new_opt_state.mini_step == 0
+                new_ema = jax.tree.map(
+                    lambda d, e: jnp.where(emit, d, e), decayed, new_ema
+                )
+            else:
+                new_ema = decayed
         return self.replace(
             step=self.step + 1,
             params=new_params,
@@ -150,6 +161,7 @@ def make_optimizer(
     schedule_options: Optional[dict] = None,
     weight_decay: Optional[float] = None,
     grad_clip_norm: Optional[float] = None,
+    accumulate_steps: Optional[int] = None,
     **kwargs,
 ) -> optax.GradientTransformation:
     """Build an optimizer with a state-injected (callback-adjustable) LR.
@@ -159,6 +171,13 @@ def make_optimizer(
     current value in the optimizer state, so ``get_learning_rate`` keeps
     working (callback writes would be overwritten each step — pick
     schedule OR plateau-callback control, not both).
+
+    ``accumulate_steps=k`` wraps the whole chain in ``optax.MultiSteps``:
+    gradients average over k consecutive micro-batches and the parameters
+    move once per k steps — how a reference global batch that exceeds HBM
+    at 32/replica (``imagenet-resnet50-mirror.py:54``) still trains with
+    identical optimizer math. Schedules then count *optimizer* updates,
+    not micro-steps.
     """
     if isinstance(name, optax.GradientTransformation):
         return name
@@ -181,6 +200,8 @@ def make_optimizer(
     tx = optax.inject_hyperparams(factory)(learning_rate=lr, **kwargs)
     if grad_clip_norm is not None:
         tx = optax.chain(optax.clip_by_global_norm(grad_clip_norm), tx)
+    if accumulate_steps is not None and accumulate_steps > 1:
+        tx = optax.MultiSteps(tx, every_k_schedule=accumulate_steps)
     return tx
 
 
@@ -188,6 +209,8 @@ def _find_hyperparams(opt_state) -> Optional[dict]:
     """Locate the inject_hyperparams dict inside a possibly-chained state."""
     if hasattr(opt_state, "hyperparams") and "learning_rate" in opt_state.hyperparams:
         return opt_state.hyperparams
+    # optax.MultiSteps needs no special case: MultiStepsState is a
+    # NamedTuple, so the tuple recursion reaches inner_opt_state.
     if isinstance(opt_state, tuple):
         for sub in opt_state:
             found = _find_hyperparams(sub)

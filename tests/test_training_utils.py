@@ -145,6 +145,76 @@ def test_ema_with_ps_sharded_state(mesh8):
     assert shard_of(trainer.state.ema_params) == shard_of(trainer.state.params)
 
 
+# ------------------------------------------------------- grad accumulation
+def test_gradient_accumulation_matches_large_batch():
+    """k micro-steps at accum=k == one step on the concatenated batch."""
+    import flax.linen as nn
+    import jax.numpy as jnp
+
+    class Tiny(nn.Module):
+        @nn.compact
+        def __call__(self, x, train=False):
+            return nn.Dense(8)(x.reshape(x.shape[0], -1))
+
+    rng = np.random.default_rng(0)
+    b1 = {"image": rng.normal(size=(4, 4, 4, 3)).astype(np.float32),
+          "label": rng.integers(0, 8, 4).astype(np.int32)}
+    b2 = {"image": rng.normal(size=(4, 4, 4, 3)).astype(np.float32),
+          "label": rng.integers(0, 8, 4).astype(np.int32)}
+    concat = {k: np.concatenate([b1[k], b2[k]]) for k in b1}
+
+    acc = Trainer(Tiny(), optimizer="sgd", learning_rate=0.1,
+                  gradient_accumulation_steps=2, seed=3)
+    acc.fit([b1, b2], epochs=1, verbose=0)
+
+    big = Trainer(Tiny(), optimizer="sgd", learning_rate=0.1, seed=3)
+    big.fit([concat], epochs=1, verbose=0)
+
+    for pa, pb in zip(jax.tree.leaves(acc.state.params),
+                      jax.tree.leaves(big.state.params)):
+        np.testing.assert_allclose(np.asarray(pa), np.asarray(pb),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_ema_with_accumulation_matches_big_batch_ema():
+    """EMA must decay once per optimizer update, not per micro-step."""
+    import flax.linen as nn
+
+    class Tiny(nn.Module):
+        @nn.compact
+        def __call__(self, x, train=False):
+            return nn.Dense(8)(x.reshape(x.shape[0], -1))
+
+    rng = np.random.default_rng(1)
+    b1 = {"image": rng.normal(size=(4, 4, 4, 3)).astype(np.float32),
+          "label": rng.integers(0, 8, 4).astype(np.int32)}
+    b2 = {"image": rng.normal(size=(4, 4, 4, 3)).astype(np.float32),
+          "label": rng.integers(0, 8, 4).astype(np.int32)}
+    concat = {k: np.concatenate([b1[k], b2[k]]) for k in b1}
+
+    acc = Trainer(Tiny(), optimizer="sgd", learning_rate=0.1,
+                  gradient_accumulation_steps=2, ema_decay=0.5, seed=3)
+    acc.fit([b1, b2], epochs=1, verbose=0)
+    big = Trainer(Tiny(), optimizer="sgd", learning_rate=0.1,
+                  ema_decay=0.5, seed=3)
+    big.fit([concat], epochs=1, verbose=0)
+    for ea, eb in zip(jax.tree.leaves(acc.state.ema_params),
+                      jax.tree.leaves(big.state.ema_params)):
+        np.testing.assert_allclose(np.asarray(ea), np.asarray(eb),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_lr_introspection_with_accumulation():
+    from pddl_tpu.train.state import set_learning_rate
+
+    trainer = Trainer(_tiny_model(), optimizer="adam", learning_rate=2e-3,
+                      gradient_accumulation_steps=2)
+    trainer.fit(_data(), epochs=1, steps_per_epoch=2, verbose=0)
+    assert get_learning_rate(trainer.state) == pytest.approx(2e-3)
+    trainer.state = set_learning_rate(trainer.state, 1e-4)
+    assert get_learning_rate(trainer.state) == pytest.approx(1e-4)
+
+
 # --------------------------------------------------------------------- CLI
 def test_cli_schedule_and_ema_flags():
     from pddl_tpu.run import main
@@ -154,7 +224,7 @@ def test_cli_schedule_and_ema_flags():
         "--num-classes", "8", "--image-size", "32", "--batch", "4",
         "--epochs", "1", "--steps-per-epoch", "2", "--verbose", "0",
         "--lr-schedule", "cosine", "--lr-decay-steps", "4",
-        "--ema-decay", "0.9",
+        "--ema-decay", "0.9", "--grad-accum", "2",
     ])
     assert rc == 0
 
