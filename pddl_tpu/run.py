@@ -132,11 +132,25 @@ def build_data(cfg: ExperimentConfig, strategy):
     )
     if _is_lm(cfg.model):
         if cfg.data_dir:
-            raise ValueError(
-                "text-corpus ingestion is not wired into the CLI yet; run "
-                "LM models with --synthetic (the deterministic next-token "
-                "task) or drive the Trainer via the library API"
+            from pddl_tpu.data.text import load_token_corpus, read_meta
+
+            n_procs = strategy.data_process_count
+            corpus = load_token_corpus(
+                cfg.data_dir, seq_len=cfg.seq_len,
+                train_batch_size=global_batch, val_batch_size=val_global,
+                seed=cfg.seed,
+                process_index=strategy.process_index if n_procs > 1 else 0,
+                process_count=n_procs,
             )
+            # Check AFTER loading: first runs from a raw train.txt only
+            # have a meta.json once preparation wrote it.
+            meta = read_meta(cfg.data_dir)
+            if meta and meta.get("vocab_size", 0) > cfg.num_classes:
+                raise ValueError(
+                    f"corpus vocab_size {meta['vocab_size']} exceeds model "
+                    f"vocab (--num-classes {cfg.num_classes})"
+                )
+            return corpus
         from pddl_tpu.data.synthetic import SyntheticLanguageModeling
 
         n_procs = strategy.data_process_count
@@ -310,6 +324,8 @@ def main(argv=None) -> int:
     p.add_argument("--image-size", type=int, default=None)
     p.add_argument("--crop", type=int, default=None)
     p.add_argument("--num-classes", type=int, default=None)
+    p.add_argument("--seq-len", type=int, default=None,
+                   help="LM sequence length (token-window size)")
     p.add_argument("--model", default=None)
     p.add_argument("--strategy", default=None,
                    choices=["single", "mirrored", "multiworker", "ps",
@@ -334,7 +350,7 @@ def main(argv=None) -> int:
         "steps_per_epoch": args.steps_per_epoch,
         "per_replica_batch": args.batch, "learning_rate": args.lr,
         "image_size": args.image_size, "crop": args.crop,
-        "num_classes": args.num_classes,
+        "num_classes": args.num_classes, "seq_len": args.seq_len,
         "model": args.model, "strategy": args.strategy,
         "pretrained_h5": args.pretrained_h5,
         "checkpoint_dir": args.checkpoint_dir,

@@ -180,7 +180,20 @@ def make_optimizer(
     not micro-steps.
     """
     if isinstance(name, optax.GradientTransformation):
-        return name
+        # A prebuilt transformation: chain-level options still compose;
+        # factory-level ones cannot be injected after the fact.
+        if schedule is not None or weight_decay is not None:
+            raise ValueError(
+                "schedule/weight_decay cannot be applied to a prebuilt "
+                "optax.GradientTransformation — build it with them, or "
+                "pass the optimizer by name"
+            )
+        tx = name
+        if grad_clip_norm is not None:
+            tx = optax.chain(optax.clip_by_global_norm(grad_clip_norm), tx)
+        if accumulate_steps is not None and accumulate_steps > 1:
+            tx = optax.MultiSteps(tx, every_k_schedule=accumulate_steps)
+        return tx
     try:
         factory = _OPTIMIZERS[name.lower()]
     except KeyError:

@@ -1,0 +1,122 @@
+"""Token-corpus pipeline (the LM analogue of the ImageNet ingest):
+byte-level preparation, memmap window batching, determinism, sharding,
+and the CLI path training a GPT on a real corpus directory."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from pddl_tpu.data.text import (
+    TokenFileDataset,
+    encode_text_file,
+    load_token_corpus,
+    read_meta,
+)
+
+
+def _corpus(tmp_path, text=None, split="train"):
+    text = text or ("hello tpu world. " * 200)
+    txt = tmp_path / f"{split}.txt"
+    txt.write_text(text)
+    return str(tmp_path)
+
+
+def test_encode_text_file_byte_level(tmp_path):
+    d = _corpus(tmp_path, text="abc")
+    n, vocab = encode_text_file(os.path.join(d, "train.txt"),
+                                os.path.join(d, "train.bin"))
+    assert (n, vocab) == (3, 256)
+    toks = np.fromfile(os.path.join(d, "train.bin"), dtype="<u2")
+    assert toks.tolist() == [ord("a"), ord("b"), ord("c")]
+    assert read_meta(d)["vocab_size"] == 256
+
+
+def test_token_dataset_shapes_and_shift(tmp_path):
+    d = _corpus(tmp_path)
+    train, _ = load_token_corpus(d, seq_len=16, train_batch_size=4,
+                                 val_batch_size=4)
+    batch = next(iter(train))
+    assert batch["tokens"].shape == (4, 16)
+    assert batch["targets"].shape == (4, 16)
+    # Next-token shift within every window.
+    np.testing.assert_array_equal(batch["tokens"][:, 1:],
+                                  batch["targets"][:, :-1])
+
+
+def test_determinism(tmp_path):
+    d = _corpus(tmp_path)
+    encode_text_file(os.path.join(d, "train.txt"),
+                     os.path.join(d, "train.bin"))
+    path = os.path.join(d, "train.bin")
+    a = TokenFileDataset(path, batch_size=2, seq_len=8, seed=5)
+    b = TokenFileDataset(path, batch_size=2, seq_len=8, seed=5)
+    ea = [x["tokens"] for x in a]
+    eb = [x["tokens"] for x in b]
+    assert all((x == y).all() for x, y in zip(ea, eb))
+    # Second epoch reshuffles the window order.
+    ea2 = [x["tokens"] for x in a]
+    assert not all((x == y).all() for x, y in zip(ea, ea2))
+    assert len(ea) == a.batches_per_epoch
+
+
+def test_sharding_partitions_windows(tmp_path):
+    d = _corpus(tmp_path)
+    encode_text_file(os.path.join(d, "train.txt"),
+                     os.path.join(d, "train.bin"))
+    path = os.path.join(d, "train.bin")
+    toks = np.fromfile(path, dtype="<u2").astype(np.int32)
+    shards = [
+        TokenFileDataset(path, batch_size=4, seq_len=8, shuffle=False,
+                         process_index=i, process_count=2)
+        for i in range(2)
+    ]
+    for proc, s in enumerate(shards):
+        rows = [row for batch in s for row in batch["tokens"]]
+        # Unshuffled shard p yields windows p, p+2, p+4, ... in order.
+        for j, row in enumerate(rows):
+            w = proc + 2 * j
+            np.testing.assert_array_equal(row, toks[w * 8:w * 8 + 8])
+    # Each shard yields its local share of the global batch.
+    first = next(iter(shards[0]))
+    assert first["tokens"].shape == (2, 8)
+
+
+def test_vocab_mismatch_rejected(tmp_path):
+    d = _corpus(tmp_path)
+    from pddl_tpu.config import get_preset
+    from pddl_tpu.run import build_data, build_trainer
+
+    cfg = get_preset("single").replace(
+        model="tiny_gpt", data_dir=d, num_classes=8, seq_len=8,
+        per_replica_batch=2,
+    )
+    trainer, _ = build_trainer(cfg)
+    # First run from a raw train.txt: preparation happens during
+    # build_data, and the guard must still fire (byte vocab 256 > 8).
+    with pytest.raises(ValueError, match="vocab"):
+        build_data(cfg, trainer.strategy)
+
+
+def test_refuses_mixing_token_spaces(tmp_path):
+    d = _corpus(tmp_path, split="val")
+    # Externally tokenized corpus: meta records a non-byte vocab.
+    with open(os.path.join(d, "meta.json"), "w") as f:
+        json.dump({"vocab_size": 50257, "vocab": "bpe"}, f)
+    np.zeros(100, dtype="<u2").tofile(os.path.join(d, "train.bin"))
+    with pytest.raises(ValueError, match="refusing to byte-encode"):
+        load_token_corpus(d, seq_len=8, train_batch_size=2,
+                          val_batch_size=2)
+
+
+def test_cli_trains_gpt_on_corpus(tmp_path):
+    d = _corpus(tmp_path)
+    from pddl_tpu.run import main
+
+    rc = main([
+        "--preset", "single", "--model", "tiny_gpt", "--data-dir", d,
+        "--num-classes", "256", "--batch", "4", "--epochs", "1",
+        "--steps-per-epoch", "2", "--verbose", "0",
+    ])
+    assert rc == 0
