@@ -50,10 +50,22 @@ def encode_text_file(
             f"{txt_path} into the same corpus"
         )
     data = np.fromfile(txt_path, dtype=np.uint8)
-    data.astype("<u2").tofile(out_path)
-    meta = {"vocab_size": 256, "n_tokens": int(data.size), "vocab": vocab}
-    with open(os.path.join(out_dir, META_FILE), "w") as f:
+    # Atomic publish: every process of a multi-host job runs preparation
+    # concurrently (run.py build_data); os.replace means no reader ever
+    # memmaps a half-written file, and identical writers race harmlessly.
+    tmp = f"{out_path}.tmp.{os.getpid()}"
+    data.astype("<u2").tofile(tmp)
+    os.replace(tmp, out_path)
+
+    split = os.path.basename(out_path).rsplit(".", 1)[0]
+    meta = existing or {"vocab_size": 256, "vocab": vocab, "n_tokens": {}}
+    if not isinstance(meta.get("n_tokens"), dict):  # legacy scalar field
+        meta["n_tokens"] = {}
+    meta["n_tokens"][split] = int(data.size)
+    meta_tmp = os.path.join(out_dir, f"{META_FILE}.tmp.{os.getpid()}")
+    with open(meta_tmp, "w") as f:
         json.dump(meta, f)
+    os.replace(meta_tmp, os.path.join(out_dir, META_FILE))
     return int(data.size), 256
 
 
@@ -106,9 +118,14 @@ class TokenFileDataset:
 
     @property
     def batches_per_epoch(self) -> int:
-        mine = len(range(self.process_index, self._n_windows,
-                         self.process_count))
-        return mine // self.local_batch_size
+        # Floor computed GLOBALLY (smallest shard's share): every process
+        # must run the same number of jitted SPMD steps per epoch or the
+        # job deadlocks in a collective at epoch end.
+        return (self._n_windows // self.process_count) // self.local_batch_size
+
+    def max_token(self) -> int:
+        """Largest token id in the file (vocab bound for meta-less .bins)."""
+        return int(self._tokens.max()) if len(self._tokens) else 0
 
     def __iter__(self) -> Iterator[Dict[str, np.ndarray]]:
         order = np.arange(self._n_windows)
@@ -119,7 +136,7 @@ class TokenFileDataset:
         mine = order[self.process_index::self.process_count]
         lb, S = self.local_batch_size, self.seq_len
         offsets = np.arange(S + 1)
-        for i in range(len(mine) // lb):
+        for i in range(self.batches_per_epoch):
             idxs = mine[i * lb:(i + 1) * lb]
             # One vectorized gather per batch (no per-row Python loop).
             chunks = self._tokens[idxs[:, None] * S + offsets].astype(np.int32)

@@ -143,12 +143,15 @@ def build_data(cfg: ExperimentConfig, strategy):
                 process_count=n_procs,
             )
             # Check AFTER loading: first runs from a raw train.txt only
-            # have a meta.json once preparation wrote it.
+            # have a meta.json once preparation wrote it. A .bin dropped
+            # in without a sidecar is bounded by scanning its ids once.
             meta = read_meta(cfg.data_dir)
-            if meta and meta.get("vocab_size", 0) > cfg.num_classes:
+            vocab = (meta["vocab_size"] if meta and "vocab_size" in meta
+                     else corpus[0].max_token() + 1)
+            if vocab > cfg.num_classes:
                 raise ValueError(
-                    f"corpus vocab_size {meta['vocab_size']} exceeds model "
-                    f"vocab (--num-classes {cfg.num_classes})"
+                    f"corpus vocab size {vocab} exceeds model vocab "
+                    f"(--num-classes {cfg.num_classes})"
                 )
             return corpus
         from pddl_tpu.data.synthetic import SyntheticLanguageModeling

@@ -83,6 +83,38 @@ def test_sharding_partitions_windows(tmp_path):
     assert first["tokens"].shape == (2, 8)
 
 
+def test_shards_yield_equal_batch_counts(tmp_path):
+    """SPMD safety: every process must see the same steps per epoch."""
+    # 101 windows over 2 processes would give 51/50 without the global
+    # floor — and a deadlocked collective on a real pod.
+    toks = np.arange(101 * 8 + 1, dtype="<u2") % 250
+    path = str(tmp_path / "train.bin")
+    toks.tofile(path)
+    shards = [
+        TokenFileDataset(path, batch_size=2, seq_len=8, shuffle=False,
+                         process_index=i, process_count=2)
+        for i in range(2)
+    ]
+    counts = [sum(1 for _ in s) for s in shards]
+    assert counts[0] == counts[1] == shards[0].batches_per_epoch == 50
+
+
+def test_binonly_corpus_vocab_guard(tmp_path):
+    """A .bin without meta.json is bounded by scanning its token ids."""
+    from pddl_tpu.config import get_preset
+    from pddl_tpu.run import build_data, build_trainer
+
+    toks = (np.arange(600, dtype="<u2") % 500)  # ids up to 499
+    toks.tofile(str(tmp_path / "train.bin"))
+    cfg = get_preset("single").replace(
+        model="tiny_gpt", data_dir=str(tmp_path), num_classes=256,
+        seq_len=8, per_replica_batch=2,
+    )
+    trainer, _ = build_trainer(cfg)
+    with pytest.raises(ValueError, match="vocab size 500"):
+        build_data(cfg, trainer.strategy)
+
+
 def test_vocab_mismatch_rejected(tmp_path):
     d = _corpus(tmp_path)
     from pddl_tpu.config import get_preset
