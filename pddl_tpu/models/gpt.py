@@ -170,8 +170,41 @@ class GPipeGPT(GPipeModel):
         )
 
 
+def sample_logits(rng, logits, *, temperature: float = 1.0,
+                  top_k: Optional[int] = None, top_p: Optional[float] = None):
+    """One sampling step over ``[B, V]`` logits (compiled-friendly).
+
+    Filters compose the standard way (matching common reference
+    implementations): temperature warps the distribution FIRST, then
+    top-k truncates, then nucleus (top-p) keeps the smallest set reaching
+    ``top_p`` of the *warped* mass, then one categorical draw. Static
+    shapes throughout — ``top_k`` uses ``lax.top_k``'s threshold,
+    ``top_p`` masks on the sorted CDF — so the whole step stays jittable.
+    """
+    logits = logits.astype(jnp.float32) / temperature
+    if top_k is not None:
+        kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if top_p is not None:
+        sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+        cdf = jnp.cumsum(jax.nn.softmax(sorted_logits, axis=-1), axis=-1)
+        # Smallest set whose mass >= top_p: keep entries whose CDF
+        # *before* them is < top_p (the first token is always kept).
+        keep_sorted = jnp.concatenate(
+            [jnp.zeros_like(cdf[..., :1]), cdf[..., :-1]], axis=-1
+        ) < top_p
+        # Threshold = lowest kept sorted logit, mapped back to vocab order.
+        threshold = jnp.min(
+            jnp.where(keep_sorted, sorted_logits, jnp.inf), axis=-1,
+            keepdims=True,
+        )
+        logits = jnp.where(logits < threshold, -jnp.inf, logits)
+    return jax.random.categorical(rng, logits, axis=-1)
+
+
 def generate(model: GPT, variables, prompt, max_new_tokens: int, *,
-             temperature: float = 0.0, rng=None):
+             temperature: float = 0.0, top_k: Optional[int] = None,
+             top_p: Optional[float] = None, rng=None):
     """Autoregressive sampling with a KV cache.
 
     Args:
@@ -181,7 +214,8 @@ def generate(model: GPT, variables, prompt, max_new_tokens: int, *,
       prompt: int32 ``[B, P]`` prompt tokens (``P >= 1``).
       max_new_tokens: tokens to append.
       temperature: 0 → greedy argmax; >0 → temperature sampling (``rng``
-        required).
+        required), optionally filtered by ``top_k`` and/or nucleus
+        ``top_p`` (:func:`sample_logits`).
 
     Returns int32 ``[B, P + max_new_tokens]`` (prompt + continuation).
     One jitted single-token step; the cache is donated so K/V update in
@@ -221,7 +255,8 @@ def generate(model: GPT, variables, prompt, max_new_tokens: int, *,
     for i in range(max_new_tokens):
         if temperature > 0:
             rng, sub = jax.random.split(rng)
-            nxt = jax.random.categorical(sub, logits / temperature, axis=-1)
+            nxt = sample_logits(sub, logits, temperature=temperature,
+                                top_k=top_k, top_p=top_p)
         else:
             nxt = jnp.argmax(logits, axis=-1)
         nxt = nxt[:, None].astype(jnp.int32)

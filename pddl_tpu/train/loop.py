@@ -442,10 +442,23 @@ class Trainer:
 
 
 def _mean_logs(logs_list) -> Dict[str, float]:
-    """Fetch once, average on host (one device sync per epoch)."""
+    """Fetch once, average on host (one device sync per epoch).
+
+    Perplexity aggregates geometrically: per-batch values are
+    exp(mean CE), and exp is convex, so an arithmetic mean would
+    overestimate (Jensen); the geometric mean over equal-size batches is
+    exactly exp(mean CE) over all tokens — the standard corpus number.
+    """
     fetched = jax.device_get(logs_list)
     keys = fetched[0].keys()
-    return {k: float(np.mean([d[k] for d in fetched])) for k in keys}
+    out = {}
+    for k in keys:
+        vals = np.asarray([d[k] for d in fetched], np.float64)
+        if k.endswith("perplexity"):
+            out[k] = float(np.exp(np.mean(np.log(np.maximum(vals, 1e-30)))))
+        else:
+            out[k] = float(np.mean(vals))
+    return out
 
 
 def _chain_first(first, rest: Iterator) -> Iterator:

@@ -56,9 +56,23 @@ def top_k_accuracy(k: int) -> MetricFn:
     return _top_k
 
 
+def perplexity(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
+    """exp(mean token cross-entropy) — the standard LM quality metric.
+
+    Works on ``[B, V]`` or ``[B, S, V]`` logits (mean over all tokens).
+    Per-batch values aggregate geometrically across an epoch (see the
+    Trainer's ``_mean_logs``), keeping the reported number equal to
+    exp(mean CE) over all tokens rather than a Jensen-biased mean of
+    exponentials.
+    """
+    ce = optax.softmax_cross_entropy_with_integer_labels(logits, labels)
+    return jnp.exp(jnp.mean(ce))
+
+
 METRICS: Dict[str, MetricFn] = {
     "accuracy": accuracy,
     "top_5_accuracy": top_k_accuracy(5),
+    "perplexity": perplexity,
 }
 
 

@@ -173,3 +173,88 @@ def test_gpt_under_tensor_parallel():
     # The Megatron rules hit the shared TransformerBlock param names.
     qk = tr.state.params["block0"]["attn"]["query"]["kernel"]
     assert qk.sharding.spec == P(None, MODEL_AXIS)
+
+
+def test_sample_logits_filters():
+    """top-k / top-p truncation semantics of the sampling step."""
+    from pddl_tpu.models.gpt import sample_logits
+
+    logits = jnp.log(jnp.asarray([[0.4, 0.3, 0.2, 0.05, 0.05]]))
+    rng = jax.random.key(0)
+
+    # top_k=2: only the two largest ids ever sampled.
+    draws = {
+        int(sample_logits(jax.random.fold_in(rng, i), logits, top_k=2)[0])
+        for i in range(64)
+    }
+    assert draws <= {0, 1} and len(draws) == 2
+
+    # top_p=0.65: the smallest prefix reaching 0.65 is {0.4, 0.3}.
+    draws = {
+        int(sample_logits(jax.random.fold_in(rng, i), logits, top_p=0.65)[0])
+        for i in range(64)
+    }
+    assert draws <= {0, 1} and len(draws) == 2
+
+    # top_p=0.95 keeps {0.4,0.3,0.2,0.05}: id 4 can appear, but after
+    # top_k=3 composes first it cannot.
+    draws = {
+        int(sample_logits(jax.random.fold_in(rng, i), logits,
+                          top_k=3, top_p=0.95)[0])
+        for i in range(200)
+    }
+    assert draws <= {0, 1, 2}
+
+    # Degenerate top_p keeps only the argmax; jittable end to end.
+    jitted = jax.jit(lambda r, l: sample_logits(r, l, top_p=0.01))
+    assert int(jitted(rng, logits)[0]) == 0
+
+
+def test_generate_with_sampling_filters():
+    from pddl_tpu.models.gpt import generate
+
+    model = tiny_gpt(vocab_size=16, max_len=48)
+    variables = model.init(jax.random.key(0),
+                           jnp.zeros((1, 4), jnp.int32), train=False)
+    prompt = jnp.asarray([[1, 2, 3, 4]], jnp.int32)
+    out = generate(model, {"params": variables["params"]}, prompt,
+                   max_new_tokens=6, temperature=0.8, top_k=4, top_p=0.9,
+                   rng=jax.random.key(1))
+    assert out.shape == (1, 10)
+    assert (np.asarray(out) >= 0).all() and (np.asarray(out) < 16).all()
+
+
+def test_perplexity_metric():
+    from pddl_tpu.train.metrics import perplexity
+
+    # Uniform logits over V -> perplexity V, for both 2D and 3D shapes.
+    v = 8
+    logits2 = jnp.zeros((5, v))
+    labels2 = jnp.arange(5) % v
+    np.testing.assert_allclose(float(perplexity(logits2, labels2)), v,
+                               rtol=1e-5)
+    logits3 = jnp.zeros((2, 3, v))
+    labels3 = jnp.zeros((2, 3), jnp.int32)
+    np.testing.assert_allclose(float(perplexity(logits3, labels3)), v,
+                               rtol=1e-5)
+
+    trainer = Trainer(tiny_gpt(vocab_size=16, max_len=48),
+                      optimizer="adamw", learning_rate=3e-3,
+                      metrics=["accuracy", "perplexity"],
+                      input_key="tokens", target_key="targets")
+    ds = SyntheticLanguageModeling(batch_size=8, seq_len=16, vocab_size=16,
+                                   seed=0)
+    trainer.fit(ds, epochs=2, steps_per_epoch=6, verbose=0)
+    ppl = trainer.history.history["perplexity"]
+    assert ppl[-1] < ppl[0] <= 16.5  # starts near uniform (16), improves
+
+
+def test_perplexity_aggregates_geometrically():
+    """Epoch perplexity must equal exp(mean CE), not mean(exp(CE))."""
+    from pddl_tpu.train.loop import _mean_logs
+
+    logs = [{"perplexity": np.exp(1.0), "loss": 1.0},
+            {"perplexity": np.exp(3.0), "loss": 3.0}]
+    out = _mean_logs(logs)
+    np.testing.assert_allclose(out["perplexity"], np.exp(2.0), rtol=1e-6)
+    np.testing.assert_allclose(out["loss"], 2.0, rtol=1e-6)
