@@ -125,6 +125,41 @@ def allreduce(value, average: bool = True):
     return jax.tree.map(_one, value)
 
 
+def allgather(value):
+    """Concatenate each process's value along axis 0 (``hvd.allgather``).
+
+    Host-side utility like :func:`allreduce`; per-process arrays must
+    share their trailing dimensions. Scalars gather to a ``[size]``
+    vector.
+    """
+    _require_init()
+    if jax.process_count() == 1:
+        return jax.tree.map(
+            lambda x: np.asarray(x)[None] if np.ndim(x) == 0 else np.asarray(x),
+            value,
+        )
+    from jax.experimental import multihost_utils  # noqa: PLC0415
+
+    def _one(x):
+        x = np.asarray(x)
+        if x.ndim == 0:
+            return multihost_utils.process_allgather(x)  # [n_procs]
+        # hvd.allgather concatenates RAGGED per-process arrays along axis
+        # 0 (its primary use: variable-length per-rank results). The
+        # underlying gather needs uniform shapes, so: exchange lengths,
+        # pad to the max, gather, then slice each block back.
+        lengths = multihost_utils.process_allgather(np.asarray(len(x)))
+        max_len = int(lengths.max())
+        padded = np.zeros((max_len,) + x.shape[1:], x.dtype)
+        padded[: len(x)] = x
+        gathered = multihost_utils.process_allgather(padded)  # [P, max, ...]
+        return np.concatenate(
+            [gathered[p, : int(lengths[p])] for p in range(len(lengths))]
+        )
+
+    return jax.tree.map(_one, value)
+
+
 def broadcast(value, root_rank: int = 0):
     """Broadcast host-``root_rank``'s value to every process."""
     _require_init()

@@ -181,7 +181,11 @@ def sample_logits(rng, logits, *, temperature: float = 1.0,
     shapes throughout — ``top_k`` uses ``lax.top_k``'s threshold,
     ``top_p`` masks on the sorted CDF — so the whole step stays jittable.
     """
-    logits = logits.astype(jnp.float32) / temperature
+    logits = logits.astype(jnp.float32)
+    if temperature <= 0:
+        # Greedy limit (filters never change the argmax); avoids the /0.
+        return jnp.argmax(logits, axis=-1)
+    logits = logits / temperature
     if top_k is not None:
         kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
         logits = jnp.where(logits < kth, -jnp.inf, logits)

@@ -444,10 +444,11 @@ class Trainer:
 def _mean_logs(logs_list) -> Dict[str, float]:
     """Fetch once, average on host (one device sync per epoch).
 
-    Perplexity aggregates geometrically: per-batch values are
-    exp(mean CE), and exp is convex, so an arithmetic mean would
-    overestimate (Jensen); the geometric mean over equal-size batches is
-    exactly exp(mean CE) over all tokens — the standard corpus number.
+    Perplexity keys are logged per batch in log space (mean CE — see
+    ``metrics.log_perplexity``); exponentiating AFTER the average yields
+    exactly exp(mean CE) over all tokens (the standard corpus number),
+    where a mean of per-batch exponentials would be Jensen-biased high
+    and could overflow.
     """
     fetched = jax.device_get(logs_list)
     keys = fetched[0].keys()
@@ -455,7 +456,7 @@ def _mean_logs(logs_list) -> Dict[str, float]:
     for k in keys:
         vals = np.asarray([d[k] for d in fetched], np.float64)
         if k.endswith("perplexity"):
-            out[k] = float(np.exp(np.mean(np.log(np.maximum(vals, 1e-30)))))
+            out[k] = float(np.exp(np.mean(vals)))
         else:
             out[k] = float(np.mean(vals))
     return out

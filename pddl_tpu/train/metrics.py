@@ -56,23 +56,35 @@ def top_k_accuracy(k: int) -> MetricFn:
     return _top_k
 
 
-def perplexity(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
-    """exp(mean token cross-entropy) — the standard LM quality metric.
+def log_perplexity(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
+    """Mean token cross-entropy (what the ``perplexity`` metric logs).
 
-    Works on ``[B, V]`` or ``[B, S, V]`` logits (mean over all tokens).
-    Per-batch values aggregate geometrically across an epoch (see the
-    Trainer's ``_mean_logs``), keeping the reported number equal to
-    exp(mean CE) over all tokens rather than a Jensen-biased mean of
-    exponentials.
+    The registry maps ``"perplexity"`` to THIS log-space value: it is
+    overflow-free on device (exp(CE) hits float32 inf at CE ≈ 88.7) and
+    averaging it across batches then exponentiating once — which the
+    Trainer's ``_mean_logs`` does for perplexity keys — is exactly
+    exp(mean CE) over all tokens, the standard corpus number, rather
+    than a Jensen-biased mean of exponentials. Per-BATCH callback logs
+    therefore carry the log-space value.
     """
     ce = optax.softmax_cross_entropy_with_integer_labels(logits, labels)
-    return jnp.exp(jnp.mean(ce))
+    return jnp.mean(ce)
+
+
+def perplexity(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
+    """exp(mean token cross-entropy) — for direct one-shot use.
+
+    Works on ``[B, V]`` or ``[B, S, V]`` logits (mean over all tokens).
+    The Trainer metric named ``"perplexity"`` logs :func:`log_perplexity`
+    per batch and exponentiates after epoch averaging instead.
+    """
+    return jnp.exp(log_perplexity(logits, labels))
 
 
 METRICS: Dict[str, MetricFn] = {
     "accuracy": accuracy,
     "top_5_accuracy": top_k_accuracy(5),
-    "perplexity": perplexity,
+    "perplexity": log_perplexity,
 }
 
 

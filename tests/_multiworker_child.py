@@ -65,6 +65,17 @@ def main() -> None:
     )(batch["image"])
     np.testing.assert_allclose(np.asarray(mean), 0.5, atol=1e-6)
 
+    # hvd-shim host collectives across the two real processes.
+    from pddl_tpu.compat import hvd
+
+    hvd._mesh = mesh  # the cluster is already up via dist.initialize
+    summed = hvd.allreduce(np.float32(jax.process_index()), average=False)
+    np.testing.assert_allclose(np.asarray(summed), 1.0)  # 0 + 1
+    gathered = hvd.allgather(np.full((2,), float(jax.process_index()),
+                                     np.float32))
+    np.testing.assert_array_equal(np.asarray(gathered),
+                                  np.asarray([0.0, 0.0, 1.0, 1.0]))
+
     # One real training step through the Trainer (grad all-reduce across
     # both processes compiled into the step).
     from pddl_tpu.data.synthetic import SyntheticImageClassification

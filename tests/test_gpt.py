@@ -209,6 +209,9 @@ def test_sample_logits_filters():
     jitted = jax.jit(lambda r, l: sample_logits(r, l, top_p=0.01))
     assert int(jitted(rng, logits)[0]) == 0
 
+    # temperature=0 is the greedy limit, not a division by zero.
+    assert int(sample_logits(rng, logits, temperature=0.0)[0]) == 0
+
 
 def test_generate_with_sampling_filters():
     from pddl_tpu.models.gpt import generate
@@ -253,8 +256,12 @@ def test_perplexity_aggregates_geometrically():
     """Epoch perplexity must equal exp(mean CE), not mean(exp(CE))."""
     from pddl_tpu.train.loop import _mean_logs
 
-    logs = [{"perplexity": np.exp(1.0), "loss": 1.0},
-            {"perplexity": np.exp(3.0), "loss": 3.0}]
+    # Per-batch perplexity logs in LOG space (mean CE); aggregation
+    # exponentiates once -> exp(mean CE), overflow-free at any CE.
+    logs = [{"perplexity": 1.0, "loss": 1.0},
+            {"perplexity": 3.0, "loss": 3.0}]
     out = _mean_logs(logs)
     np.testing.assert_allclose(out["perplexity"], np.exp(2.0), rtol=1e-6)
     np.testing.assert_allclose(out["loss"], 2.0, rtol=1e-6)
+    huge = _mean_logs([{"perplexity": 100.0}, {"perplexity": 200.0}])
+    assert np.isfinite(huge["perplexity"]) and huge["perplexity"] > 1e60

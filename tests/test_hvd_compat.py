@@ -40,6 +40,11 @@ def test_allreduce_and_broadcast_single_process_identity():
     np.testing.assert_array_equal(out["a"], x["a"])
     out = hvd.broadcast(x)
     np.testing.assert_array_equal(out["a"], x["a"])
+    # allgather: single process concatenates to itself; scalars become
+    # a [size]-vector (hvd semantics).
+    out = hvd.allgather(x)
+    np.testing.assert_array_equal(out["a"], x["a"])
+    np.testing.assert_array_equal(out["b"], np.asarray([3.0]))
 
 
 def test_distributed_optimizer_pmeans_gradients_in_shard_map(mesh8):
