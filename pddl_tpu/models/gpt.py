@@ -208,7 +208,7 @@ def sample_logits(rng, logits, *, temperature: float = 1.0,
 
 def generate(model: GPT, variables, prompt, max_new_tokens: int, *,
              temperature: float = 0.0, top_k: Optional[int] = None,
-             top_p: Optional[float] = None, rng=None):
+             top_p: Optional[float] = None, rng=None, strategy=None):
     """Autoregressive sampling with a KV cache.
 
     Args:
@@ -220,6 +220,13 @@ def generate(model: GPT, variables, prompt, max_new_tokens: int, *,
       temperature: 0 → greedy argmax; >0 → temperature sampling (``rng``
         required), optionally filtered by ``top_k`` and/or nucleus
         ``top_p`` (:func:`sample_logits`).
+      strategy: optional :class:`~pddl_tpu.parallel.tensor_parallel.
+        TensorParallelStrategy` (mesh already set up) for SHARDED
+        inference: weights lay out Megatron-style over the ``model``
+        axis, the KV cache splits by head alongside its q/k/v shards,
+        and each decode step compiles with the two per-block
+        all-reduces on ICI — models too big for one chip generate
+        without any model change.
 
     Returns int32 ``[B, P + max_new_tokens]`` (prompt + continuation).
     One jitted single-token step; the cache is donated so K/V update in
@@ -235,22 +242,39 @@ def generate(model: GPT, variables, prompt, max_new_tokens: int, *,
         raise ValueError("temperature sampling needs an rng key")
     dec = model.clone(decode=True)
     params = variables["params"]
+    if strategy is not None:
+        # One batched transfer for the whole tree.
+        params = jax.device_put(params, strategy.tree_sharding(params))
     # The fresh cache is all zeros by construction; eval_shape over init
     # gets its structure without materializing (and discarding) a full
     # random parameter set.
     cache_shapes = jax.eval_shape(
         lambda: dec.init(jax.random.key(0), prompt[:, :1], train=False)
     )["cache"]
-    cache = jax.tree.map(lambda sd: jnp.zeros(sd.shape, sd.dtype),
-                         cache_shapes)
 
-    @functools.partial(jax.jit, donate_argnums=(0,))
-    def step(cache, tok):
+    def fresh_cache():
+        return jax.tree.map(lambda sd: jnp.zeros(sd.shape, sd.dtype),
+                            cache_shapes)
+
+    def step_fn(cache, tok):
         logits, mutated = dec.apply(
             {"params": params, "cache": cache}, tok,
             train=False, mutable=["cache"],
         )
         return mutated["cache"], logits[:, -1]
+
+    if strategy is None:
+        cache = fresh_cache()
+        step = jax.jit(step_fn, donate_argnums=(0,))
+    else:
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        cache_sh = strategy.decode_cache_sharding(cache_shapes)
+        repl = NamedSharding(strategy.mesh, PartitionSpec())
+        cache = jax.jit(fresh_cache, out_shardings=cache_sh)()
+        step = jax.jit(step_fn, donate_argnums=(0,),
+                       in_shardings=(cache_sh, repl),
+                       out_shardings=(cache_sh, repl))
 
     # Batched prefill: the whole prompt in ONE call (causal within the
     # block), then one token per step — no wasted final step.

@@ -148,32 +148,61 @@ class TensorParallelStrategy(Strategy):
                 return PartitionSpec(*axes)
         return PartitionSpec()
 
-    def state_sharding(self, state: PyTree) -> PyTree:
+    def tree_sharding(self, tree: PyTree) -> PyTree:
+        """Shardings for any param-shaped tree via the path rules.
+
+        Public so inference paths (sharded GPT generation) can lay out
+        raw parameter trees without a TrainState.
+        """
         mesh = self.mesh
+        flat, treedef = jax.tree_util.tree_flatten_with_path(tree)
+        out = []
+        for keypath, leaf in flat:
+            path = "/" + "/".join(
+                str(getattr(k, "key", getattr(k, "name", k)))
+                for k in keypath
+            )
+            if hasattr(leaf, "shape") and leaf.ndim > 0:
+                spec = self._spec_for(path, tuple(leaf.shape))
+            else:
+                spec = PartitionSpec()
+            out.append(NamedSharding(mesh, spec))
+        return jax.tree_util.tree_unflatten(treedef, out)
 
-        def tree_sharding(tree):
-            flat, treedef = jax.tree_util.tree_flatten_with_path(tree)
-            out = []
-            for keypath, leaf in flat:
-                path = "/" + "/".join(
-                    str(getattr(k, "key", getattr(k, "name", k)))
-                    for k in keypath
-                )
-                if hasattr(leaf, "shape") and leaf.ndim > 0:
-                    spec = self._spec_for(path, tuple(leaf.shape))
-                else:
-                    spec = PartitionSpec()
-                out.append(NamedSharding(mesh, spec))
-            return jax.tree_util.tree_unflatten(treedef, out)
+    def decode_cache_sharding(self, cache: PyTree) -> PyTree:
+        """Shardings for a decode KV cache: heads over ``model``.
 
+        Cache leaves are ``cached_key``/``cached_value`` of shape
+        ``[B, H, L, D]`` (pddl_tpu/models/vit.py MultiHeadAttention);
+        splitting H over the ``model`` axis co-locates each head's K/V
+        with its column-parallel q/k/v projection shards, so decode steps
+        need no cross-device K/V movement. Indices and any non-4D leaves
+        stay replicated.
+        """
+        mesh = self.mesh
+        mp = mesh.shape[MODEL_AXIS]
         repl = NamedSharding(mesh, PartitionSpec())
+        head_sh = NamedSharding(mesh, PartitionSpec(None, MODEL_AXIS))
+
+        def leaf_sharding(keypath, leaf):
+            name = str(getattr(keypath[-1], "key", keypath[-1]))
+            if (name in ("cached_key", "cached_value")
+                    and getattr(leaf, "ndim", 0) == 4
+                    and mp > 1 and leaf.shape[1] % mp == 0):
+                return head_sh
+            return repl
+
+        return jax.tree_util.tree_map_with_path(leaf_sharding, cache)
+
+    def state_sharding(self, state: PyTree) -> PyTree:
+        repl = NamedSharding(self.mesh, PartitionSpec())
         return state.replace(
             step=repl,
-            params=tree_sharding(state.params),
+            params=self.tree_sharding(state.params),
             batch_stats=jax.tree.map(lambda _: repl, state.batch_stats),
-            opt_state=tree_sharding(state.opt_state),
+            opt_state=self.tree_sharding(state.opt_state),
             # EMA shadows inherit the TP layout of their parameters.
-            ema_params=tree_sharding(state.ema_params),
+            ema_params=self.tree_sharding(state.ema_params),
         )
 
 

@@ -98,6 +98,11 @@ def resolve_loss(loss: str | MetricFn) -> MetricFn:
 
 
 def resolve_metric(metric: str | MetricFn) -> tuple[str, MetricFn]:
+    if metric is perplexity:
+        # The public exp-space helper is for one-shot use; as a Trainer
+        # metric it must log in log space (the '*perplexity' keys are
+        # exponentiated once after epoch averaging — loop._mean_logs).
+        return "perplexity", log_perplexity
     if callable(metric):
         return getattr(metric, "__name__", "metric"), metric
     try:
