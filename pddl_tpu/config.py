@@ -71,6 +71,8 @@ class ExperimentConfig:
     checkpoint_dir: Optional[str] = None
     resume: bool = False
     save_path: Optional[str] = None  # final export (model.save analogue :69-72)
+    # observability
+    profile_dir: Optional[str] = None  # jax.profiler traces (utils/profiling)
     # misc
     seed: int = 0
     verbose: int = 2  # reference verbose=2 (:67)

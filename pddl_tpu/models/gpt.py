@@ -181,6 +181,10 @@ def sample_logits(rng, logits, *, temperature: float = 1.0,
     shapes throughout — ``top_k`` uses ``lax.top_k``'s threshold,
     ``top_p`` masks on the sorted CDF — so the whole step stays jittable.
     """
+    if top_k is not None and top_k < 1:
+        raise ValueError(f"top_k must be >= 1, got {top_k}")
+    if top_p is not None and not 0.0 < top_p <= 1.0:
+        raise ValueError(f"top_p must be in (0, 1], got {top_p}")
     logits = logits.astype(jnp.float32)
     if temperature <= 0:
         # Greedy limit (filters never change the argmax); avoids the /0.
@@ -240,6 +244,11 @@ def generate(model: GPT, variables, prompt, max_new_tokens: int, *,
         raise ValueError(f"prompt+new tokens {total} exceed max_len {model.max_len}")
     if temperature > 0 and rng is None:
         raise ValueError("temperature sampling needs an rng key")
+    if temperature <= 0 and (top_k is not None or top_p is not None):
+        raise ValueError(
+            "top_k/top_p require temperature > 0 (greedy decoding would "
+            "silently ignore them)"
+        )
     dec = model.clone(decode=True)
     params = variables["params"]
     if strategy is not None:

@@ -98,6 +98,10 @@ def build_trainer(cfg: ExperimentConfig, strategy=None):
     if cfg.warmup_epochs and not cfg.lr_schedule:
         callbacks.append(cb.LearningRateWarmup(warmup_epochs=cfg.warmup_epochs))
     callbacks.append(cb.Timing())
+    if cfg.profile_dir:
+        from pddl_tpu.utils.profiling import Profiler
+
+        callbacks.append(Profiler(cfg.profile_dir))
     if cfg.checkpoint_dir:
         if cfg.resume:
             # Restores newest checkpoint at train start + rolls a backup
@@ -249,7 +253,25 @@ def run_experiment(cfg: ExperimentConfig, steps_per_epoch: Optional[int] = None,
             if trainer.state.ema_params is not None and trainer.eval_with_ema
             else trainer.state.params
         )
-        if cfg.save_path.endswith(".h5") and cfg.model.startswith("resnet"):
+        if cfg.save_path.endswith(".shlo"):
+            # Serialized StableHLO inference artifact (ckpt/export.py):
+            # the compiled forward itself, loadable by any XLA runtime.
+            import jax
+
+            from pddl_tpu.ckpt.export import save_inference_artifact
+
+            if _is_lm(cfg.model):
+                shape: tuple = (1, cfg.seq_len)
+                dtype = "int32"
+            else:
+                shape = (1, cfg.image_size, cfg.image_size, 3)
+                dtype = "float32"
+            save_inference_artifact(
+                cfg.save_path, trainer.model,
+                jax.device_get(export_params), shape, input_dtype=dtype,
+                batch_stats=jax.device_get(trainer.state.batch_stats),
+            )
+        elif cfg.save_path.endswith(".h5") and cfg.model.startswith("resnet"):
             variables = {"params": export_params,
                          "batch_stats": trainer.state.batch_stats}
             export_keras_style_h5(cfg.save_path, variables)
@@ -343,6 +365,9 @@ def main(argv=None) -> int:
     p.add_argument("--checkpoint-dir", default=None)
     p.add_argument("--resume", action="store_true")
     p.add_argument("--save", dest="save_path", default=None)
+    p.add_argument("--profile-dir", default=None,
+                   help="write jax.profiler traces here (view in "
+                        "TensorBoard's profile plugin)")
     p.add_argument("--seed", type=int, default=None)
     p.add_argument("--verbose", type=int, default=None)
     args = p.parse_args(argv)
@@ -358,7 +383,7 @@ def main(argv=None) -> int:
         "pretrained_h5": args.pretrained_h5,
         "checkpoint_dir": args.checkpoint_dir,
         "save_path": args.save_path, "seed": args.seed,
-        "verbose": args.verbose,
+        "verbose": args.verbose, "profile_dir": args.profile_dir,
         "lr_schedule": args.lr_schedule, "ema_decay": args.ema_decay,
         "gradient_accumulation_steps": args.grad_accum,
     }

@@ -455,7 +455,10 @@ def _mean_logs(logs_list) -> Dict[str, float]:
     out = {}
     for k in keys:
         vals = np.asarray([d[k] for d in fetched], np.float64)
-        if k.endswith("perplexity"):
+        # Exact key only (evaluate() adds its val_ prefix after this
+        # aggregation): user metrics with "perplexity" in their name are
+        # not assumed to be log-space.
+        if k == "perplexity":
             out[k] = float(np.exp(np.mean(vals)))
         else:
             out[k] = float(np.mean(vals))

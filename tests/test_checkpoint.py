@@ -6,6 +6,8 @@ pretrained import is its ``weights='imagenet'`` mode
 (`imagenet-pretrained-resnet50.py:56`).
 """
 
+import os
+
 import jax
 import numpy as np
 import pytest
@@ -181,3 +183,34 @@ def test_layer_map_covers_resnet50():
     # 1 stem + 48 block convs + 4 shortcuts = 53 convs, same count of BNs.
     assert len(convs) == 53
     assert len(bns) == 53
+
+
+def test_stablehlo_export_roundtrip(tmp_path):
+    """Serialize the compiled forward as StableHLO; reload and match."""
+    import jax.numpy as jnp
+
+    from pddl_tpu.ckpt.export import (
+        load_inference_artifact,
+        save_inference_artifact,
+    )
+    from pddl_tpu.models.resnet import ResNet
+
+    model = ResNet(stage_sizes=(1,), num_classes=8, width_multiplier=0.25,
+                   small_input_stem=True)
+    x = jnp.linspace(0, 1, 1 * 16 * 16 * 3).reshape(1, 16, 16, 3)
+    variables = model.init(jax.random.key(0), x, train=False)
+
+    path = str(tmp_path / "resnet.shlo")
+    save_inference_artifact(
+        path, model, variables["params"], (1, 16, 16, 3),
+        batch_stats=variables.get("batch_stats"),
+    )
+    assert os.path.getsize(path) > 0
+
+    call, exported = load_inference_artifact(path)
+    got = call(x)
+    want = model.apply(variables, x, train=False)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-6, atol=1e-6)
+    # The artifact records its input contract.
+    assert exported.in_avals[0].shape == (1, 16, 16, 3)

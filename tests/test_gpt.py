@@ -11,6 +11,7 @@ next-token task, and Megatron TP applies unchanged (shared block names).
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 from jax.sharding import PartitionSpec as P
 
 from pddl_tpu.core.mesh import MODEL_AXIS, MeshConfig, build_mesh
@@ -299,3 +300,21 @@ def test_perplexity_callable_metric_resolves_to_log_space():
 
     name, fn = M.resolve_metric(M.perplexity)
     assert name == "perplexity" and fn is M.log_perplexity
+
+
+def test_sampling_misuse_raises():
+    from pddl_tpu.models.gpt import generate, sample_logits
+
+    logits = jnp.zeros((1, 8))
+    with pytest.raises(ValueError, match="top_p"):
+        sample_logits(jax.random.key(0), logits, top_p=0.0)
+    with pytest.raises(ValueError, match="top_k"):
+        sample_logits(jax.random.key(0), logits, top_k=0)
+
+    model = tiny_gpt(vocab_size=16, max_len=48)
+    v = model.init(jax.random.key(0), jnp.zeros((1, 2), jnp.int32),
+                   train=False)
+    with pytest.raises(ValueError, match="temperature"):
+        generate(model, {"params": v["params"]},
+                 jnp.asarray([[1, 2]], jnp.int32), max_new_tokens=2,
+                 top_k=4)  # greedy default would silently drop the filter
