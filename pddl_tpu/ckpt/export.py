@@ -29,13 +29,17 @@ def export_inference_fn(
     input_dtype: Any = jnp.float32,
     batch_stats: Optional[PyTree] = None,
     apply_kwargs: Optional[dict] = None,
+    platforms: Optional[Sequence[str]] = None,
 ) -> bytes:
     """Serialize ``model.apply`` (inference mode, weights baked in).
 
-    Returns portable StableHLO bytes: the traced forward pass closed
-    over ``params`` (weights become constants in the artifact, so a
-    serving runtime needs nothing else). ``input_shape`` includes the
-    batch dimension.
+    Returns StableHLO bytes: the traced forward pass closed over
+    ``params`` (weights become constants in the artifact, so a serving
+    runtime needs nothing else). ``input_shape`` includes the batch
+    dimension. The artifact records its target platforms and loaders
+    enforce a match — by default only the platform exporting it; pass
+    ``platforms=("tpu", "cpu")`` to lower for several and serve the same
+    bytes anywhere among them.
     """
     variables = {"params": params}
     if batch_stats:
@@ -47,7 +51,8 @@ def export_inference_fn(
         return model.apply(variables, x, **kwargs)
 
     spec = jax.ShapeDtypeStruct(tuple(input_shape), input_dtype)
-    exported = jax_export.export(jax.jit(forward))(spec)
+    kw = {"platforms": tuple(platforms)} if platforms else {}
+    exported = jax_export.export(jax.jit(forward), **kw)(spec)
     return exported.serialize()
 
 

@@ -62,10 +62,12 @@ def log_perplexity(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
     The registry maps ``"perplexity"`` to THIS log-space value: it is
     overflow-free on device (exp(CE) hits float32 inf at CE ≈ 88.7) and
     averaging it across batches then exponentiating once — which the
-    Trainer's ``_mean_logs`` does for perplexity keys — is exactly
-    exp(mean CE) over all tokens, the standard corpus number, rather
-    than a Jensen-biased mean of exponentials. Per-BATCH callback logs
-    therefore carry the log-space value.
+    Trainer's ``_mean_logs`` does for the exact key ``"perplexity"``
+    only — is exactly exp(mean CE) over all tokens, the standard corpus
+    number, rather than a Jensen-biased mean of exponentials. Per-BATCH
+    callback logs therefore carry the log-space value. Logged under any
+    OTHER key (e.g. ``metrics=[log_perplexity]`` → ``"log_perplexity"``)
+    the epoch value stays an averaged log-space number.
     """
     ce = optax.softmax_cross_entropy_with_integer_labels(logits, labels)
     return jnp.mean(ce)
@@ -100,7 +102,7 @@ def resolve_loss(loss: str | MetricFn) -> MetricFn:
 def resolve_metric(metric: str | MetricFn) -> tuple[str, MetricFn]:
     if metric is perplexity:
         # The public exp-space helper is for one-shot use; as a Trainer
-        # metric it must log in log space (the '*perplexity' keys are
+        # metric it must log in log space (the exact "perplexity" key is
         # exponentiated once after epoch averaging — loop._mean_logs).
         return "perplexity", log_perplexity
     if callable(metric):

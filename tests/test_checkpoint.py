@@ -214,3 +214,27 @@ def test_stablehlo_export_roundtrip(tmp_path):
                                rtol=1e-6, atol=1e-6)
     # The artifact records its input contract.
     assert exported.in_avals[0].shape == (1, 16, 16, 3)
+
+
+def test_stablehlo_export_multi_platform(tmp_path):
+    """platforms=... records several targets in one artifact."""
+    import jax.numpy as jnp
+
+    from pddl_tpu.ckpt.export import (
+        export_inference_fn,
+        load_inference_artifact,
+    )
+    from pddl_tpu.models.resnet import ResNet
+
+    model = ResNet(stage_sizes=(1,), num_classes=4, width_multiplier=0.25,
+                   small_input_stem=True)
+    x = jnp.zeros((1, 8, 8, 3))
+    variables = model.init(jax.random.key(0), x, train=False)
+    data = export_inference_fn(
+        model, variables["params"], (1, 8, 8, 3),
+        batch_stats=variables.get("batch_stats"),
+        platforms=("cpu", "tpu"),
+    )
+    call, exported = load_inference_artifact(data)
+    assert set(p.lower() for p in exported.platforms) == {"cpu", "tpu"}
+    assert np.asarray(call(np.asarray(x))).shape == (1, 4)

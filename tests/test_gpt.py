@@ -318,3 +318,12 @@ def test_sampling_misuse_raises():
         generate(model, {"params": v["params"]},
                  jnp.asarray([[1, 2]], jnp.int32), max_new_tokens=2,
                  top_k=4)  # greedy default would silently drop the filter
+
+
+def test_sample_logits_traced_filters_stay_jittable():
+    from pddl_tpu.models.gpt import sample_logits
+
+    logits = jnp.log(jnp.asarray([[0.7, 0.2, 0.1]]))
+    f = jax.jit(lambda r, l, p: sample_logits(r, l, top_p=p))
+    tok = int(f(jax.random.key(0), logits, jnp.float32(0.9))[0])
+    assert 0 <= tok < 3

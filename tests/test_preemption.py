@@ -115,3 +115,58 @@ def test_handlers_restored_after_train(tmp_path):
     tr.fit(ds, epochs=1, steps_per_epoch=2, verbose=0,
            callbacks=[PreemptionCheckpoint(str(tmp_path / "c"))])
     assert signal.getsignal(signal.SIGTERM) is prev
+
+
+def test_cli_process_kill_and_resume(tmp_path):
+    """The full operational story as real processes: a CLI training run is
+    SIGTERMed mid-flight (Cloud-TPU eviction), exits cleanly after a
+    consistent save, and a second --resume invocation picks up from it."""
+    import subprocess
+    import sys
+    import time
+
+    ckpt_dir = str(tmp_path / "run")
+    env = dict(os.environ)
+    env.update(JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=1")
+    cmd = [sys.executable, "-m", "pddl_tpu", "--preset", "single",
+           "--synthetic", "--model", "tiny_resnet", "--num-classes", "8",
+           "--image-size", "32", "--batch", "4", "--steps-per-epoch", "5",
+           "--verbose", "0", "--checkpoint-dir", ckpt_dir, "--resume",
+           "--epochs", "500"]
+    child = subprocess.Popen(cmd, env=env, cwd=os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))),
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+    try:
+        # Wait until at least one epoch checkpoint landed on disk.
+        deadline = time.time() + 120
+        from pddl_tpu.ckpt.checkpoint import latest_epoch
+
+        while time.time() < deadline:
+            if child.poll() is not None:
+                out = child.stdout.read().decode()
+                raise AssertionError(f"child exited early:\n{out[-2000:]}")
+            if latest_epoch(ckpt_dir) is not None:
+                break
+            time.sleep(1.0)
+        else:
+            raise AssertionError("no checkpoint appeared within 120s")
+
+        child.send_signal(signal.SIGTERM)
+        out, _ = child.communicate(timeout=120)
+        assert child.returncode == 0, out.decode()[-2000:]
+    finally:
+        if child.poll() is None:
+            child.kill()
+
+    stopped_at = latest_epoch(ckpt_dir)
+    assert stopped_at is not None
+
+    # Second invocation resumes and completes the (short) remaining run.
+    resume_epochs = max(stopped_at + 2, 2)
+    cmd[cmd.index("--epochs") + 1] = str(resume_epochs)
+    done = subprocess.run(cmd, env=env, cwd=os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))),
+        capture_output=True, timeout=240)
+    assert done.returncode == 0, done.stdout.decode()[-2000:]
+    assert latest_epoch(ckpt_dir) >= resume_epochs - 1

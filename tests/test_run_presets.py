@@ -137,11 +137,14 @@ def test_cli_profile_and_stablehlo_export(tmp_path):
         "--save", shlo, "--profile-dir", prof,
     ])
     assert rc == 0
-    # Profiler wrote a trace and the artifact reloads + runs.
+    # Profiler wrote trace artifacts under the plugin layout.
     import glob as _glob
 
-    assert _glob.glob(os.path.join(prof, "**", "*.trace*", "**", "*"),
-                      recursive=True) or os.listdir(prof)
+    assert os.path.isdir(prof), "profiler never created its log dir"
+    traces = _glob.glob(os.path.join(prof, "**", "*.trace.json*"),
+                        recursive=True) + _glob.glob(
+        os.path.join(prof, "**", "*.xplane.pb"), recursive=True)
+    assert traces, f"no trace files under {prof}: {os.listdir(prof)}"
     from pddl_tpu.ckpt.export import load_inference_artifact
 
     call, exported = load_inference_artifact(shlo)
