@@ -181,11 +181,14 @@ def sample_logits(rng, logits, *, temperature: float = 1.0,
     shapes throughout — ``top_k`` uses ``lax.top_k``'s threshold,
     ``top_p`` masks on the sorted CDF — so the whole step stays jittable.
     """
-    # Validate only CONCRETE values — a traced top_k/top_p under jit
-    # stays dynamic and skips the check rather than breaking the trace.
-    if isinstance(top_k, int) and top_k < 1:
+    # Validate every CONCRETE value (Python, NumPy, or device scalar); a
+    # TRACED top_p under jit stays dynamic and skips the check rather
+    # than breaking the trace. (top_k is necessarily static: lax.top_k
+    # needs a concrete k.)
+    if top_k is not None and int(top_k) < 1:
         raise ValueError(f"top_k must be >= 1, got {top_k}")
-    if isinstance(top_p, (int, float)) and not 0.0 < top_p <= 1.0:
+    if (top_p is not None and not isinstance(top_p, jax.core.Tracer)
+            and not 0.0 < float(top_p) <= 1.0):
         raise ValueError(f"top_p must be in (0, 1], got {top_p}")
     logits = logits.astype(jnp.float32)
     if temperature <= 0:

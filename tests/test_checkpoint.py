@@ -216,7 +216,7 @@ def test_stablehlo_export_roundtrip(tmp_path):
     assert exported.in_avals[0].shape == (1, 16, 16, 3)
 
 
-def test_stablehlo_export_multi_platform(tmp_path):
+def test_stablehlo_export_multi_platform():
     """platforms=... records several targets in one artifact."""
     import jax.numpy as jnp
 

@@ -310,6 +310,11 @@ def test_sampling_misuse_raises():
         sample_logits(jax.random.key(0), logits, top_p=0.0)
     with pytest.raises(ValueError, match="top_k"):
         sample_logits(jax.random.key(0), logits, top_k=0)
+    # NumPy/device scalars are concrete too — still validated.
+    with pytest.raises(ValueError, match="top_p"):
+        sample_logits(jax.random.key(0), logits, top_p=np.float32(1.5))
+    with pytest.raises(ValueError, match="top_k"):
+        sample_logits(jax.random.key(0), logits, top_k=np.int64(0))
 
     model = tiny_gpt(vocab_size=16, max_len=48)
     v = model.init(jax.random.key(0), jnp.zeros((1, 2), jnp.int32),

@@ -59,3 +59,46 @@ def test_two_process_bootstrap_and_training():
     for pid, (p, out) in enumerate(zip(procs, outputs)):
         assert p.returncode == 0, f"child {pid} failed:\n{out}"
         assert f"child {pid} OK" in out, out
+
+
+def test_two_process_cli_multiworker_preset():
+    """The multiworker preset end to end as TWO real CLI processes: the
+    reference's `srun python imagenet-resnet50-multiworkers.py` moment
+    (one command per host, SLURM-style env discovery), but through
+    `python -m pddl_tpu` with PDDL_* bootstrap vars."""
+    port = _free_port()
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env_base = {
+        k: v for k, v in os.environ.items()
+        if k not in ("XLA_FLAGS", "JAX_PLATFORMS")
+    }
+    env_base["PYTHONPATH"] = repo_root + os.pathsep + env_base.get(
+        "PYTHONPATH", "")
+    # Each "host" owns 2 fake CPU devices; gloo stands in for ICI/DCN.
+    env_base["JAX_PLATFORMS"] = "cpu"
+    env_base["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    cmd = [sys.executable, "-m", "pddl_tpu", "--preset", "multiworker",
+           "--synthetic", "--model", "tiny_resnet", "--num-classes", "8",
+           "--image-size", "32", "--batch", "2", "--epochs", "1",
+           "--steps-per-epoch", "3", "--verbose", "0"]
+    procs = []
+    try:
+        for pid in range(2):
+            env = dict(
+                env_base,
+                PDDL_COORDINATOR=f"127.0.0.1:{port}",
+                PDDL_NUM_PROCESSES="2",
+                PDDL_PROCESS_ID=str(pid),
+            )
+            procs.append(subprocess.Popen(
+                cmd, env=env, cwd=repo_root,
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            ))
+        outputs = [p.communicate(timeout=570)[0] for p in procs]
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+    for pid, (p, out) in enumerate(zip(procs, outputs)):
+        assert p.returncode == 0, f"CLI worker {pid} failed:\n{out[-3000:]}"

@@ -158,6 +158,9 @@ def test_cli_process_kill_and_resume(tmp_path):
     finally:
         if child.poll() is None:
             child.kill()
+            child.wait()
+        if child.stdout is not None:
+            child.stdout.close()
 
     stopped_at = latest_epoch(ckpt_dir)
     assert stopped_at is not None
