@@ -33,6 +33,9 @@ class ExperimentConfig:
     pretrained_h5: Optional[str] = None  # weights='imagenet' analogue: local .h5
     bn_mode: str = "train"  # "frozen" reproduces the reference's training=False
     compute_dtype: str = "bfloat16"
+    # transformer families only: activation rematerialization policy
+    # ("none" | "dots" | "full" — models/vit.py REMAT_POLICIES)
+    remat: Optional[str] = None
     # data
     data_dir: Optional[str] = None  # None → synthetic
     image_size: int = 224

@@ -25,7 +25,7 @@ import jax.numpy as jnp
 from flax import linen as nn
 
 from pddl_tpu.models.gpipe import GPipeModel
-from pddl_tpu.models.vit import TransformerBlock
+from pddl_tpu.models.vit import TransformerBlock, remat_block
 
 
 class GPT(nn.Module):
@@ -42,6 +42,7 @@ class GPT(nn.Module):
     dropout: float = 0.0
     moe_experts: int = 0
     moe_every: int = 2
+    remat: str = "none"  # "none" | "dots" | "full" (vit.REMAT_POLICIES)
     decode: bool = False  # KV-cache generation mode (see generate())
     dtype: Any = jnp.float32
     param_dtype: Any = jnp.float32
@@ -56,16 +57,21 @@ class GPT(nn.Module):
         nn.share_scope(self, embed)
         x = embed(tokens)
 
+        # Decode mutates the KV cache — remat would replay the mutation;
+        # generation steps are tiny anyway, so remat only applies to the
+        # training/full-forward path.
+        block_cls = (TransformerBlock if self.decode
+                     else remat_block(TransformerBlock, self.remat))
         for i in range(self.depth):
             moe = (self.moe_experts
                    if (self.depth - 1 - i) % self.moe_every == 0 else 0)
-            x = TransformerBlock(
+            x = block_cls(
                 num_heads=self.num_heads, mlp_ratio=self.mlp_ratio,
                 attention=self.attention, mesh=self.mesh, causal=True,
                 decode=self.decode, max_decode_len=self.max_len,
                 dropout=self.dropout, moe_experts=moe, dtype=self.dtype,
                 param_dtype=self.param_dtype, name=f"block{i}",
-            )(x, train=train)
+            )(x, train)  # positional: remat keeps arg 2 static
 
         # Head shared with GPipeGPT (ln_final/lm_head names preserved).
         head = _GPTHead(vocab_size=self.vocab_size, dtype=self.dtype,

@@ -147,7 +147,7 @@ class TransformerBlock(nn.Module):
     param_dtype: Any = jnp.float32
 
     @nn.compact
-    def __call__(self, x, *, train: bool):
+    def __call__(self, x, train: bool = True):
         e = x.shape[-1]
         # Pre-LN (f32 for stability even under bf16 compute).
         h = nn.LayerNorm(dtype=jnp.float32, param_dtype=self.param_dtype,
@@ -182,6 +182,33 @@ class TransformerBlock(nn.Module):
         return x + h
 
 
+# Rematerialization policies for the transformer families: trade FLOPs
+# for HBM so longer sequences / deeper stacks fit (SURVEY has no analogue;
+# this is the jax.checkpoint lever the TPU build exposes).
+#   none  — store all activations (fastest, most memory)
+#   dots  — save matmul outputs, recompute elementwise (the usual sweet
+#           spot: most of the win, little recompute)
+#   full  — save only block boundaries, recompute everything inside
+REMAT_POLICIES = {
+    "none": "none",  # sentinel: no wrapping at all
+    "dots": jax.checkpoint_policies.checkpoint_dots,
+    "full": None,  # jax.checkpoint default: save nothing inside the block
+}
+
+
+def remat_block(block_cls, remat: str):
+    """Wrap a transformer block class per the named remat policy."""
+    if remat not in REMAT_POLICIES:
+        raise ValueError(
+            f"unknown remat policy {remat!r}; known: {sorted(REMAT_POLICIES)}"
+        )
+    if remat == "none":
+        return block_cls
+    # train (arg index 2, after self/x) is a Python bool — keep it static.
+    return nn.remat(block_cls, policy=REMAT_POLICIES[remat],
+                    prevent_cse=False, static_argnums=(2,))
+
+
 class ViT(nn.Module):
     """Vision Transformer (patch embed → blocks → mean-pool → head).
 
@@ -200,6 +227,7 @@ class ViT(nn.Module):
     dropout: float = 0.0
     moe_experts: int = 0  # >0: every `moe_every`-th block uses Switch-MoE
     moe_every: int = 2
+    remat: str = "none"  # "none" | "dots" | "full" (REMAT_POLICIES)
     dtype: Any = jnp.float32
     param_dtype: Any = jnp.float32
 
@@ -213,18 +241,19 @@ class ViT(nn.Module):
         nn.share_scope(self, embed)
         x = embed(x)
 
+        block_cls = remat_block(TransformerBlock, self.remat)
         for i in range(self.depth):
             # Interleave MoE FFN blocks (every moe_every-th, from the back
             # so depth=1 test models still get one) with dense MLP blocks —
             # the standard Switch/GShard placement.
             moe = (self.moe_experts
                    if (self.depth - 1 - i) % self.moe_every == 0 else 0)
-            x = TransformerBlock(
+            x = block_cls(
                 num_heads=self.num_heads, mlp_ratio=self.mlp_ratio,
                 attention=self.attention, mesh=self.mesh,
                 dropout=self.dropout, moe_experts=moe, dtype=self.dtype,
                 param_dtype=self.param_dtype, name=f"block{i}",
-            )(x, train=train)
+            )(x, train)  # positional: remat keeps arg 2 static
 
         # Head shared with GPipeViT (ln_final/head names preserved).
         head = _ViTHead(num_classes=self.num_classes, dtype=self.dtype,

@@ -32,12 +32,16 @@ def build_trainer(cfg: ExperimentConfig, strategy=None):
     from pddl_tpu.train.loop import Trainer
 
     strategy = strategy or get_strategy(cfg.strategy, **cfg.strategy_options)
-    model = registry.get_model(
-        cfg.model,
+    model_kwargs = dict(
         num_classes=cfg.num_classes,
         dtype=jnp.bfloat16 if cfg.compute_dtype == "bfloat16" else jnp.float32,
         bn_mode=cfg.bn_mode,
     )
+    # Transformer families only (ResNets take no remat arg); an explicit
+    # "none" is the default and must not be forwarded either.
+    if cfg.remat and cfg.remat != "none":
+        model_kwargs["remat"] = cfg.remat
+    model = registry.get_model(cfg.model, **model_kwargs)
 
     lr = cfg.learning_rate
     if cfg.scale_lr:  # Horovod's 0.1*size (imagenet-resnet50-hvd.py:99)
@@ -351,6 +355,9 @@ def main(argv=None) -> int:
     p.add_argument("--num-classes", type=int, default=None)
     p.add_argument("--seq-len", type=int, default=None,
                    help="LM sequence length (token-window size)")
+    p.add_argument("--remat", default=None, choices=["none", "dots", "full"],
+                   help="activation rematerialization for transformer "
+                        "models (trade recompute for HBM)")
     p.add_argument("--model", default=None)
     p.add_argument("--strategy", default=None,
                    choices=["single", "mirrored", "multiworker", "ps",
@@ -379,6 +386,7 @@ def main(argv=None) -> int:
         "per_replica_batch": args.batch, "learning_rate": args.lr,
         "image_size": args.image_size, "crop": args.crop,
         "num_classes": args.num_classes, "seq_len": args.seq_len,
+        "remat": args.remat,
         "model": args.model, "strategy": args.strategy,
         "pretrained_h5": args.pretrained_h5,
         "checkpoint_dir": args.checkpoint_dir,

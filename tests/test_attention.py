@@ -197,3 +197,48 @@ def test_vit_registry_and_config_path():
     )
     hist = run_experiment(cfg, steps_per_epoch=2, validation_steps=1)
     assert np.isfinite(hist.history["loss"][-1])
+
+
+def test_remat_policies_numerics_and_grads():
+    """Remat must change memory, never numbers: forward and gradients
+    identical across none/dots/full for ViT and GPT."""
+    import jax
+    import jax.numpy as jnp
+
+    from pddl_tpu.models.gpt import tiny_gpt
+    from pddl_tpu.models.vit import ViT
+
+    x_img = jnp.linspace(0, 1, 2 * 16 * 16 * 3).reshape(2, 16, 16, 3)
+    tokens = jnp.arange(2 * 16, dtype=jnp.int32).reshape(2, 16) % 32
+
+    def check(make, inp):
+        base = make("none")
+        variables = base.init(jax.random.key(0), inp, train=False)
+
+        def loss(m):
+            def f(params):
+                out = m.apply({"params": params}, inp, train=True)
+                return jnp.sum(out.astype(jnp.float32) ** 2)
+            return f
+
+        ref_val, ref_grad = jax.value_and_grad(loss(base))(variables["params"])
+        for policy in ("dots", "full"):
+            m = make(policy)
+            val, grad = jax.value_and_grad(loss(m))(variables["params"])
+            np.testing.assert_allclose(float(val), float(ref_val),
+                                       rtol=1e-5)
+            for a, b in zip(jax.tree.leaves(grad),
+                            jax.tree.leaves(ref_grad)):
+                np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                           rtol=1e-4, atol=1e-5)
+
+    check(lambda r: ViT(patch_size=4, embed_dim=32, depth=2, num_heads=4,
+                        num_classes=8, attention="reference", remat=r),
+          x_img)
+    check(lambda r: tiny_gpt(vocab_size=32, max_len=32, remat=r), tokens)
+
+    import pytest
+
+    with pytest.raises(ValueError, match="remat"):
+        from pddl_tpu.models.vit import remat_block, TransformerBlock
+        remat_block(TransformerBlock, "bogus")
