@@ -131,7 +131,7 @@ class _GPTStage(nn.Module):
                 num_heads=self.num_heads, mlp_ratio=self.mlp_ratio,
                 attention=self.attention, causal=True, dtype=self.dtype,
                 param_dtype=self.param_dtype, name=f"block{i}",
-            )(x, train=False)
+            )(x, False)
         return x
 
 

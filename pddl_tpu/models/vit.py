@@ -147,7 +147,10 @@ class TransformerBlock(nn.Module):
     param_dtype: Any = jnp.float32
 
     @nn.compact
-    def __call__(self, x, train: bool = True):
+    def __call__(self, x, train: bool = True, /):
+        # train is positional-ONLY: under nn.remat, static_argnums points
+        # at position 2, and a keyword `train=` would silently shift past
+        # it — better a loud TypeError at every call site.
         e = x.shape[-1]
         # Pre-LN (f32 for stability even under bf16 compute).
         h = nn.LayerNorm(dtype=jnp.float32, param_dtype=self.param_dtype,
@@ -197,7 +200,15 @@ REMAT_POLICIES = {
 
 
 def remat_block(block_cls, remat: str):
-    """Wrap a transformer block class per the named remat policy."""
+    """Wrap a transformer block class per the named remat policy.
+
+    Call wrapped blocks with ``train`` POSITIONAL (``block(x, train)``):
+    ``static_argnums`` counts positional args, and flax's lifted remat
+    appends keywords after them, so a ``train=`` keyword fails at init
+    with jax's static_argnums ValueError (loudly, but cryptically — the
+    unwrapped block's positional-only signature gives the clear
+    TypeError).
+    """
     if remat not in REMAT_POLICIES:
         raise ValueError(
             f"unknown remat policy {remat!r}; known: {sorted(REMAT_POLICIES)}"
@@ -205,8 +216,11 @@ def remat_block(block_cls, remat: str):
     if remat == "none":
         return block_cls
     # train (arg index 2, after self/x) is a Python bool — keep it static.
+    # prevent_cse stays at its True default: the blocks run Python-unrolled
+    # under jit (not scan), where XLA CSE would otherwise eliminate the
+    # recompute and silently restore the saved activations.
     return nn.remat(block_cls, policy=REMAT_POLICIES[remat],
-                    prevent_cse=False, static_argnums=(2,))
+                    static_argnums=(2,))
 
 
 class ViT(nn.Module):
@@ -307,7 +321,7 @@ class _ViTStage(nn.Module):
                 num_heads=self.num_heads, mlp_ratio=self.mlp_ratio,
                 attention=self.attention, dtype=self.dtype,
                 param_dtype=self.param_dtype, name=f"block{i}",
-            )(x, train=False)
+            )(x, False)
         return x
 
 

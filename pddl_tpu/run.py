@@ -37,9 +37,15 @@ def build_trainer(cfg: ExperimentConfig, strategy=None):
         dtype=jnp.bfloat16 if cfg.compute_dtype == "bfloat16" else jnp.float32,
         bn_mode=cfg.bn_mode,
     )
-    # Transformer families only (ResNets take no remat arg); an explicit
-    # "none" is the default and must not be forwarded either.
+    # Transformer families only; an explicit "none" is the default and is
+    # not forwarded. Other families fail HERE with guidance, not with a
+    # model-constructor TypeError.
     if cfg.remat and cfg.remat != "none":
+        if not any(t in cfg.model for t in ("vit", "gpt")):
+            raise ValueError(
+                f"--remat applies to transformer models (vit*/gpt*), not "
+                f"{cfg.model!r}"
+            )
         model_kwargs["remat"] = cfg.remat
     model = registry.get_model(cfg.model, **model_kwargs)
 

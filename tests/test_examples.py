@@ -1,0 +1,32 @@
+"""The examples/ scripts must actually run (on the fake CPU mesh)."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_EXAMPLES = sorted(
+    f for f in os.listdir(os.path.join(_ROOT, "examples"))
+    if f.endswith(".py")
+)
+
+
+@pytest.mark.parametrize("script", _EXAMPLES)
+def test_example_runs(script, tmp_path):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    # A site plugin inherited via PYTHONPATH (e.g. a TPU tunnel's
+    # sitecustomize) can pin the platform and defeat JAX_PLATFORMS; an
+    # empty sitecustomize FIRST on the path shadows it so the child
+    # really runs the 8-device CPU mesh.
+    (tmp_path / "sitecustomize.py").write_text("")
+    env["PYTHONPATH"] = (str(tmp_path) + os.pathsep + _ROOT + os.pathsep
+                         + env.get("PYTHONPATH", ""))
+    out = subprocess.run(
+        [sys.executable, os.path.join(_ROOT, "examples", script)],
+        env=env, cwd=_ROOT, capture_output=True, text=True, timeout=560,
+    )
+    assert out.returncode == 0, f"{script} failed:\n{out.stderr[-3000:]}"
