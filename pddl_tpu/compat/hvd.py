@@ -42,7 +42,7 @@ import numpy as np
 import optax
 
 from pddl_tpu.core import dist
-from pddl_tpu.core.mesh import DATA_AXIS, MeshConfig, build_mesh
+from pddl_tpu.core.mesh import MeshConfig, build_mesh
 from pddl_tpu.train.callbacks import Callback, LearningRateWarmup
 
 PyTree = Any

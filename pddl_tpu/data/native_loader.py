@@ -28,7 +28,7 @@ import ctypes
 import os
 import struct
 import subprocess
-from typing import Dict, Iterator, Optional, Sequence
+from typing import Dict, Iterator, Sequence
 
 import numpy as np
 

@@ -21,7 +21,6 @@ them into the surrounding step with no extra HBM round-trips.
 
 from __future__ import annotations
 
-import functools
 from typing import Callable, Optional
 
 import jax

@@ -32,7 +32,6 @@ from jax.sharding import NamedSharding, PartitionSpec
 
 from pddl_tpu.core import dist
 from pddl_tpu.core.mesh import (
-    DATA_AXIS,
     EXPERT_AXIS,
     MODEL_AXIS,
     MeshConfig,

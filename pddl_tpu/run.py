@@ -14,7 +14,6 @@ with working flags (the reference's own argparse attempt used broken names
 from __future__ import annotations
 
 import argparse
-import dataclasses
 import sys
 from typing import Optional
 

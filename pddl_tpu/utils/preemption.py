@@ -19,7 +19,6 @@ from __future__ import annotations
 
 import logging
 import signal
-from typing import Optional
 
 from pddl_tpu.train.callbacks import Callback
 

@@ -3,8 +3,7 @@
 import os
 import subprocess
 import sys
-
-import pytest
+import time
 
 _ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 _EXAMPLES = sorted(
@@ -13,20 +12,45 @@ _EXAMPLES = sorted(
 )
 
 
-@pytest.mark.parametrize("script", _EXAMPLES)
-def test_example_runs(script, tmp_path):
+def test_examples_run(tmp_path):
+    """All examples, launched CONCURRENTLY (each is import+compile bound;
+    running them in parallel takes the wall-clock of the slowest one)."""
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
     # A site plugin inherited via PYTHONPATH (e.g. a TPU tunnel's
     # sitecustomize) can pin the platform and defeat JAX_PLATFORMS; an
-    # empty sitecustomize FIRST on the path shadows it so the child
-    # really runs the 8-device CPU mesh.
+    # empty sitecustomize FIRST on the path shadows it so the children
+    # really run the 8-device CPU mesh.
     (tmp_path / "sitecustomize.py").write_text("")
     env["PYTHONPATH"] = (str(tmp_path) + os.pathsep + _ROOT + os.pathsep
                          + env.get("PYTHONPATH", ""))
-    out = subprocess.run(
-        [sys.executable, os.path.join(_ROOT, "examples", script)],
-        env=env, cwd=_ROOT, capture_output=True, text=True, timeout=560,
-    )
-    assert out.returncode == 0, f"{script} failed:\n{out.stderr[-3000:]}"
+    procs = {
+        script: subprocess.Popen(
+            [sys.executable, os.path.join(_ROOT, "examples", script)],
+            env=env, cwd=_ROOT, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True,
+        )
+        for script in _EXAMPLES
+    }
+    failures = []
+    deadline = time.monotonic() + 540  # shared: children run concurrently
+    try:
+        for script, p in procs.items():
+            try:
+                out, _ = p.communicate(
+                    timeout=max(1.0, deadline - time.monotonic())
+                )
+            except subprocess.TimeoutExpired:
+                p.kill()
+                out, _ = p.communicate()
+                failures.append(f"{script} timed out:\n{out[-3000:]}")
+                continue
+            if p.returncode != 0:
+                failures.append(f"{script} (rc={p.returncode}):\n{out[-3000:]}")
+    finally:
+        for p in procs.values():
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+    assert not failures, "\n\n".join(failures)
