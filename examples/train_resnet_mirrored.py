@@ -15,9 +15,9 @@ from pddl_tpu.parallel import MirroredStrategy
 from pddl_tpu.train import Trainer
 from pddl_tpu.train.callbacks import EarlyStopping, ReduceLROnPlateau
 
-# Smoke config unless the user explicitly opts into the full run — ALSO
-# when imported (an import must never kick off a 50-epoch training).
-SMOKE = "--full" not in __import__("sys").argv
+# Smoke config unless this file is RUN with --full: imports are always
+# smoke-only (never a 50-epoch training), regardless of the host argv.
+SMOKE = not (__name__ == "__main__" and "--full" in __import__("sys").argv)
 
 strategy = MirroredStrategy()
 model = tiny_resnet(num_classes=10) if SMOKE else ResNet50(num_classes=1000)

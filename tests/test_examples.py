@@ -25,27 +25,29 @@ def test_examples_run(tmp_path):
     (tmp_path / "sitecustomize.py").write_text("")
     env["PYTHONPATH"] = (str(tmp_path) + os.pathsep + _ROOT + os.pathsep
                          + env.get("PYTHONPATH", ""))
-    procs = {
-        script: subprocess.Popen(
+    # Children write to FILES, not pipes: a pipe drained sequentially
+    # would stall any child emitting more than the OS buffer while an
+    # earlier sibling is being waited on.
+    procs = {}
+    logs = {}
+    for script in _EXAMPLES:
+        logs[script] = open(tmp_path / f"{script}.log", "w+")
+        procs[script] = subprocess.Popen(
             [sys.executable, os.path.join(_ROOT, "examples", script)],
-            env=env, cwd=_ROOT, stdout=subprocess.PIPE,
+            env=env, cwd=_ROOT, stdout=logs[script],
             stderr=subprocess.STDOUT, text=True,
         )
-        for script in _EXAMPLES
-    }
     failures = []
     deadline = time.monotonic() + 540  # shared: children run concurrently
     try:
         for script, p in procs.items():
             try:
-                out, _ = p.communicate(
-                    timeout=max(1.0, deadline - time.monotonic())
-                )
+                p.wait(timeout=max(1.0, deadline - time.monotonic()))
             except subprocess.TimeoutExpired:
                 p.kill()
-                out, _ = p.communicate()
-                failures.append(f"{script} timed out:\n{out[-3000:]}")
-                continue
+                p.wait()
+            logs[script].seek(0)
+            out = logs[script].read()
             if p.returncode != 0:
                 failures.append(f"{script} (rc={p.returncode}):\n{out[-3000:]}")
     finally:
@@ -53,4 +55,6 @@ def test_examples_run(tmp_path):
             if p.poll() is None:
                 p.kill()
                 p.wait()
+        for f in logs.values():
+            f.close()
     assert not failures, "\n\n".join(failures)
