@@ -149,8 +149,9 @@ def flash_attention(
     causal): fwd+bwd 12.5 ms at S=2048 vs 17.8 ms for the fused-XLA
     reference and 5x faster than 128x128 blocks at S=8192 — where the
     reference's O(S²) scores no longer fit HBM at all. Shorter sequences
-    clamp the blocks (``_largest_dividing_block``), so small shapes tile
-    rather than falling back.
+    clamp the blocks (``_largest_dividing_block``) and keep tiling down
+    to S >= 8; below that (single-token decode, tiny test shapes) the
+    reference fallback described above applies.
 
     Under ``jax.grad`` the forward additionally saves per-row LSE and the
     backward recomputes score blocks in VMEM (two fused kernels for dq and
@@ -184,9 +185,10 @@ def _largest_dividing_block(n: int, want: int) -> int:
 
     Sequences shorter than the (large, v5e-tuned) defaults clamp to the
     full length and run as a single block — e.g. ViT's 196 tokens become
-    one 196-wide block under want=512. Only degenerate cases (prime-ish
-    lengths ABOVE the block size, where the largest divisor is tiny)
-    fall through to the ``bq < 8`` reference fallback at the call site."""
+    one 196-wide block under want=512. The ``bq < 8`` reference fallback
+    at the call site then fires for sequences shorter than 8 (decode
+    steps, tiny test shapes) and for degenerate tilings (prime-ish
+    lengths above the block size whose largest divisor is tiny)."""
     for b in range(min(want, n), 0, -1):
         if n % b == 0:
             return b

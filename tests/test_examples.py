@@ -41,14 +41,18 @@ def test_examples_run(tmp_path):
     deadline = time.monotonic() + 540  # shared: children run concurrently
     try:
         for script, p in procs.items():
+            timed_out = False
             try:
                 p.wait(timeout=max(1.0, deadline - time.monotonic()))
             except subprocess.TimeoutExpired:
+                timed_out = True
                 p.kill()
                 p.wait()
             logs[script].seek(0)
             out = logs[script].read()
-            if p.returncode != 0:
+            if timed_out:
+                failures.append(f"{script} timed out:\n{out[-3000:]}")
+            elif p.returncode != 0:
                 failures.append(f"{script} (rc={p.returncode}):\n{out[-3000:]}")
     finally:
         for p in procs.values():
