@@ -1,0 +1,166 @@
+"""Executable SURVEY.md §2 parity manifest.
+
+One assertion per reference component/constant, with the reference
+citation inline — so "does the framework cover SURVEY's inventory?" is a
+test run, not a reading exercise. Structural checks only (surfaces,
+registry names, reference-exact defaults); behavior is covered by the
+per-component test files each assertion names.
+"""
+
+import numpy as np
+import pytest
+
+
+# --------------------------------------------------------- §2a components
+def test_c1_to_c6_strategy_presets_cover_all_eight_scripts():
+    """C1-C6: every reference script has a named preset (SURVEY §2a)."""
+    from pddl_tpu.config import PRESETS
+
+    assert set(PRESETS) == {
+        "single", "single-pretrained",                 # imagenet-resnet50[-pretrained].py
+        "mirrored", "mirrored-pretrained",             # -mirror variants
+        "multiworker", "multiworker-pretrained",       # -multiworkers variants
+        "hvd",                                         # -hvd.py
+        "ps",                                          # -ps.py
+    }
+
+
+def test_strategy_registry_names():
+    from pddl_tpu.parallel.base import _STRATEGIES
+
+    for name in ("single", "mirrored", "multiworker", "ps",
+                 "tensor_parallel", "expert_parallel", "pipeline"):
+        assert name in _STRATEGIES, name
+
+
+def test_reference_batch_arithmetic():
+    """32 x replicas (mirror.py:54); 128/256 x n (multiworkers.py:70-72)."""
+    from pddl_tpu.config import PRESETS
+
+    assert PRESETS["single"].per_replica_batch == 32
+    assert PRESETS["mirrored"].per_replica_batch == 32
+    assert PRESETS["multiworker"].per_replica_batch == 128
+    assert PRESETS["multiworker"].val_per_replica_batch == 256
+    assert PRESETS["multiworker-pretrained"].per_replica_batch == 32
+
+
+def test_hvd_preset_reproduces_script_observables():
+    """LR 0.1 x size + 3-epoch warmup + post-batch shard + crop 160
+    (imagenet-resnet50-hvd.py:77-81,89,99,114)."""
+    from pddl_tpu.config import PRESETS
+
+    hvd = PRESETS["hvd"]
+    assert hvd.learning_rate == pytest.approx(0.1)
+    assert hvd.scale_lr and hvd.warmup_epochs == 3
+    assert hvd.data_shard == "batch" and hvd.crop == 160
+
+
+def test_pretrained_presets_freeze_bn():
+    """base_model(training=False) (imagenet-pretrained-resnet50.py:57)."""
+    from pddl_tpu.config import PRESETS
+
+    for name in ("single-pretrained", "mirrored-pretrained",
+                 "multiworker-pretrained"):
+        assert PRESETS[name].bn_mode == "frozen", name
+
+
+def test_c9_model_zoo_and_keras_parity_surface():
+    """C9: ResNet-50 exact-arch parity + .h5 import (the weights='imagenet'
+    mode, imagenet-pretrained-resnet50.py:56); behavior in
+    test_keras_parity.py / test_checkpoint.py."""
+    from pddl_tpu.ckpt import load_keras_resnet50_h5  # noqa: F401
+    from pddl_tpu.ckpt.keras_import import export_keras_style_h5  # noqa: F401
+    from pddl_tpu.models.registry import list_models
+
+    models = set(list_models())
+    assert {"resnet18", "resnet34", "resnet50", "resnet101",
+            "resnet152"} <= models
+    # Beyond-parity families present too.
+    assert {"vit_s16", "vit_b16", "vit_l16", "gpt_small"} <= models
+
+
+def test_c10_callbacks_reference_defaults():
+    """ReduceLROnPlateau(0.1, patience 5, min_lr 1e-5) + EarlyStopping
+    (min_delta 1e-3, patience 10) on val_loss (imagenet-resnet50.py:64-65)."""
+    from pddl_tpu.train.callbacks import EarlyStopping, ReduceLROnPlateau
+
+    r = ReduceLROnPlateau()
+    assert (r.monitor, r.factor, r.patience, r.min_lr) == \
+        ("val_loss", 0.1, 5, 1e-5)
+    e = EarlyStopping()
+    assert (e.monitor, e.min_delta, e.patience) == ("val_loss", 0.001, 10)
+
+
+# ------------------------------------------------ §2b native substrate map
+def test_c13_hvd_shim_surface():
+    """C13: the Horovod symbols the reference script calls
+    (imagenet-resnet50-hvd.py:16,28,41,99,101,111-115)."""
+    from pddl_tpu.compat import hvd
+
+    for sym in ("init", "rank", "size", "local_rank", "allreduce",
+                "allgather", "broadcast", "DistributedOptimizer"):
+        assert callable(getattr(hvd, sym)), sym
+    for cb in ("BroadcastGlobalVariablesCallback", "MetricAverageCallback",
+               "LearningRateWarmupCallback"):
+        assert hasattr(hvd.callbacks, cb), cb
+
+
+def test_c14_min_size_partitioner_reference_default():
+    """256 KiB min shard, the reference's value
+    (imagenet-resnet50-ps.py:75-78)."""
+    from pddl_tpu.core.sharding import MinSizePartitioner
+
+    assert MinSizePartitioner().min_shard_bytes == 256 * 1024
+
+
+def test_c15_native_runtime_symbols():
+    """C15: own C++ loader + TFRecord record layer (tf.data analogue)."""
+    from conftest import native_build_error
+
+    err = native_build_error(tfrecord=True)
+    if err:
+        pytest.skip(f"native library unbuildable: {err}")
+    from pddl_tpu.data.native_loader import _load_lib
+
+    lib = _load_lib()
+    for sym in ("pddl_loader_open", "pddl_loader_next", "pddl_tfr_open",
+                "pddl_tfr_next", "pddl_crc32c"):
+        assert hasattr(lib, sym), sym
+
+
+def test_c16_kernels_and_collectives_surface():
+    """C16 + C11/C12: Pallas kernels and named-axis collectives."""
+    from pddl_tpu.core import collectives
+    from pddl_tpu.ops.attention import attention_reference, flash_attention  # noqa: F401
+    from pddl_tpu.ops.ring_attention import ring_attention  # noqa: F401
+
+    for sym in ("psum", "pmean", "broadcast", "all_gather",
+                "reduce_scatter", "ppermute_ring"):
+        assert callable(getattr(collectives, sym, None)), sym
+
+
+# ----------------------------------------------- §2c parallelism checklist
+def test_parallelism_checklist_importable():
+    """Every §2c row (incl. beyond-parity TP/SP/EP/PP) has a surface."""
+    from pddl_tpu.models.gpipe import GPipeModel  # noqa: F401  (PP)
+    from pddl_tpu.ops.moe import SwitchFFN  # noqa: F401  (EP)
+    from pddl_tpu.ops.pipeline import gpipe_apply  # noqa: F401
+    from pddl_tpu.ops.ring_attention import sequence_parallel_attention  # noqa: F401  (SP)
+    from pddl_tpu.parallel import (  # noqa: F401
+        MirroredStrategy,                 # DP single host
+        MultiWorkerMirroredStrategy,      # DP multi host
+        ParameterServerStrategy,          # PS / ZeRO-style sharded state
+        PipelineStrategy,                 # PP
+        TensorParallelStrategy,           # TP
+    )
+    from pddl_tpu.parallel.tensor_parallel import ExpertParallelStrategy  # noqa: F401
+
+
+def test_scaling_rules_are_linear():
+    """scale_batch_size = b x replicas; scale_learning_rate = lr x size."""
+    from pddl_tpu.parallel.mirrored import MirroredStrategy
+
+    s = MirroredStrategy()
+    s.setup()  # public path; conftest provides the 8 fake devices
+    assert s.scale_batch_size(32) == 256
+    assert np.isclose(s.scale_learning_rate(0.1), 0.8)
