@@ -1,8 +1,9 @@
 """End-to-end GPT training throughput on one chip (tokens/sec, MFU).
 
 The harness behind the architecture doc's long-context numbers
-(v5e, GPT-2-small shape, B8 S2048 bf16 flash: ~92.6k tokens/s, ≈46% MFU
-by the 6ND estimate against the 197 TFLOP/s bf16 peak).
+(v5e, GPT-2-small shape, B8 S2048 bf16 flash: ~86-93k tokens/s across
+runs, ≈43-46% MFU by the 6ND estimate against the 197 TFLOP/s bf16
+peak — chip-state variance of a few percent per run is normal).
 
     PYTHONPATH=. python benchmarks/gpt_train_bench.py [--seq 2048 --batch 8]
 """

@@ -72,6 +72,7 @@ def build_trainer(cfg: ExperimentConfig, strategy=None):
             model, optimizer=cfg.optimizer, learning_rate=lr,
             strategy=strategy, seed=cfg.seed,
             input_key="tokens", target_key="targets",
+            metrics=["accuracy", "perplexity"],  # the standard LM pair
             lr_schedule=cfg.lr_schedule,
             lr_schedule_options=schedule_options,
             ema_decay=cfg.ema_decay,
