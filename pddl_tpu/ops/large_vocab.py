@@ -1,0 +1,167 @@
+"""Chunked large-vocab softmax cross-entropy: loss without the logits.
+
+For a big-vocab LM the logits tensor dominates peak memory: GPT-2's
+50257-way head at B8 S2048 is a 3.3 GB f32 array that exists only to be
+consumed by the loss (the reference tops out at a 1000-way Dense,
+``/root/reference/imagenet-resnet50.py:60`` — this is a beyond-parity,
+TPU-memory-shaped op). :func:`chunked_cross_entropy` fuses the head
+matmul into the loss: it scans the vocab in chunks, keeping a running
+online logsumexp (the flash-attention trick applied to the classifier),
+and the backward recomputes each chunk's logits from the saved LSE — so
+peak extra memory is ``[tokens, chunk_size]`` instead of
+``[tokens, vocab]``, at the cost of one extra pass of head-matmul FLOPs
+in the backward.
+
+Integration: apply the transformer WITHOUT its lm_head (features
+``[B, S, E]``), keep the head kernel/bias as ordinary params, and make
+this op the loss — gradients flow to features, kernel, and bias exactly
+as if the full logits had been built (verified bitwise-close in
+``tests/test_large_vocab.py``, which also shows the
+``capture_intermediates`` integration pattern on the GPT family).
+
+Measured on v5e (GPT-2-small shape, B8 S2048 V50257, chunk 4096,
+loss+grad step — ``benchmarks/large_vocab_bench.py``): identical loss
+and wall-clock to the logits path (~193 ms/step both) with 0.8 GB lower
+peak temp allocation; the win is headroom — larger batches/sequences
+fit before the loss becomes the memory ceiling.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def _pad_vocab(kernel, bias, chunk_size):
+    """Pad V up to a chunk multiple; padded classes get bias -1e30 (their
+    exp underflows to exactly 0 in the sumexp, and labels never point at
+    them)."""
+    v = kernel.shape[-1]
+    pad = (-v) % chunk_size
+    if pad:
+        kernel = jnp.pad(kernel, ((0, 0), (0, pad)))
+        bias = jnp.pad(bias, (0, pad), constant_values=-1e30)
+    return kernel, bias, v + pad
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4,))
+def _chunked_ce(features, kernel, bias, labels, chunk_size):
+    loss, _ = _forward(features, kernel, bias, labels, chunk_size)
+    return loss
+
+
+def _forward(features, kernel, bias, labels, chunk_size):
+    n, e = features.shape
+    kernel_p, bias_p, v_pad = _pad_vocab(kernel, bias, chunk_size)
+    n_chunks = v_pad // chunk_size
+    # Scan carries: running max, normalized sumexp, label logit.
+    f32 = features.astype(jnp.float32)
+
+    def body(carry, ci):
+        m, s, lab = carry
+        k_c = jax.lax.dynamic_slice_in_dim(
+            kernel_p, ci * chunk_size, chunk_size, axis=1)
+        b_c = jax.lax.dynamic_slice_in_dim(
+            bias_p, ci * chunk_size, chunk_size, axis=0)
+        logits = f32 @ k_c.astype(jnp.float32) + b_c.astype(jnp.float32)
+        m_new = jnp.maximum(m, jnp.max(logits, axis=-1))
+        s = s * jnp.exp(m - m_new) + jnp.sum(
+            jnp.exp(logits - m_new[:, None]), axis=-1)
+        local = labels - ci * chunk_size
+        in_chunk = (local >= 0) & (local < chunk_size)
+        gathered = jnp.take_along_axis(
+            logits, jnp.clip(local, 0, chunk_size - 1)[:, None], axis=1
+        )[:, 0]
+        lab = jnp.where(in_chunk, gathered, lab)
+        return (m_new, s, lab), None
+
+    init = (jnp.full((n,), -jnp.inf, jnp.float32),
+            jnp.zeros((n,), jnp.float32),
+            jnp.zeros((n,), jnp.float32))
+    (m, s, lab), _ = jax.lax.scan(body, init, jnp.arange(n_chunks))
+    lse = m + jnp.log(s)
+    loss = jnp.mean(lse - lab)
+    return loss, lse
+
+
+def _fwd(features, kernel, bias, labels, chunk_size):
+    loss, lse = _forward(features, kernel, bias, labels, chunk_size)
+    return loss, (features, kernel, bias, labels, lse)
+
+
+def _bwd(chunk_size, res, g):
+    features, kernel, bias, labels, lse = res
+    n, e = features.shape
+    kernel_p, bias_p, v_pad = _pad_vocab(kernel, bias, chunk_size)
+    n_chunks = v_pad // chunk_size
+    f32 = features.astype(jnp.float32)
+    scale = g / n  # d(mean)/d(token)
+
+    def body(carry, ci):
+        dfeat = carry
+        k_c = jax.lax.dynamic_slice_in_dim(
+            kernel_p, ci * chunk_size, chunk_size, axis=1).astype(jnp.float32)
+        b_c = jax.lax.dynamic_slice_in_dim(
+            bias_p, ci * chunk_size, chunk_size, axis=0).astype(jnp.float32)
+        # Recompute this chunk's probabilities from the saved LSE.
+        p = jnp.exp(f32 @ k_c + b_c - lse[:, None])  # [N, C]
+        local = labels - ci * chunk_size
+        in_chunk = (local >= 0) & (local < chunk_size)
+        onehot = (jnp.clip(local, 0, chunk_size - 1)[:, None]
+                  == jnp.arange(chunk_size)[None, :]) & in_chunk[:, None]
+        delta = (p - onehot) * scale                  # [N, C]
+        dfeat = dfeat + delta @ k_c.T                 # [N, E]
+        dk_c = f32.T @ delta                          # [E, C]
+        db_c = jnp.sum(delta, axis=0)                 # [C]
+        return dfeat, (dk_c, db_c)
+
+    dfeat0 = jnp.zeros((n, e), jnp.float32)
+    dfeat, (dk_chunks, db_chunks) = jax.lax.scan(
+        body, dfeat0, jnp.arange(n_chunks))
+    # [n_chunks, E, C] -> [E, V_pad] -> trim padding.
+    dk = dk_chunks.transpose(1, 0, 2).reshape(e, v_pad)
+    db = db_chunks.reshape(v_pad)
+    v = kernel.shape[-1]
+    return (dfeat.astype(features.dtype), dk[:, :v].astype(kernel.dtype),
+            db[:v].astype(bias.dtype), None)
+
+
+_chunked_ce.defvjp(_fwd, _bwd)
+
+
+def chunked_cross_entropy(
+    features: jnp.ndarray,
+    kernel: jnp.ndarray,
+    labels: jnp.ndarray,
+    bias: Optional[jnp.ndarray] = None,
+    *,
+    chunk_size: int = 8192,
+) -> jnp.ndarray:
+    """Mean token CE of ``softmax(features @ kernel + bias)`` vs ``labels``
+    without materializing the logits.
+
+    Args:
+      features: ``[..., E]`` pre-head activations (any leading dims).
+      kernel: ``[E, V]`` lm-head weight.
+      labels: integer ``[...]`` matching the leading dims.
+      bias: optional ``[V]``.
+      chunk_size: vocab slab per scan step; peak extra memory is
+        ``tokens x chunk_size`` floats. V is padded internally to a
+        multiple.
+
+    Returns the scalar mean cross-entropy (f32). Gradients flow to
+    features/kernel/bias via a custom VJP that recomputes per-chunk
+    logits from the saved logsumexp.
+    """
+    e = features.shape[-1]
+    flat = features.reshape(-1, e)
+    flat_labels = labels.reshape(-1).astype(jnp.int32)
+    if bias is None:
+        bias = jnp.zeros((kernel.shape[-1],), jnp.float32)
+    # Never scan wider than the vocab: a small head would otherwise pad
+    # up to a full default-width chunk and waste the extra matmul FLOPs.
+    chunk_size = min(chunk_size, kernel.shape[-1])
+    return _chunked_ce(flat, kernel, bias, flat_labels, chunk_size)
