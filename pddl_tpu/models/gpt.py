@@ -43,6 +43,11 @@ class GPT(nn.Module):
     moe_experts: int = 0
     moe_every: int = 2
     remat: str = "none"  # "none" | "dots" | "full" (vit.REMAT_POLICIES)
+    # Pad the embedding/head vocab dim up to a multiple (Megatron's
+    # convention, typically 128): vocab-parallel TP needs V divisible by
+    # the model axis, and real vocabs (GPT-2's 50257) divide nothing.
+    # Logits are sliced back to vocab_size — numerics are unchanged.
+    vocab_multiple: int = 1
     decode: bool = False  # KV-cache generation mode (see generate())
     dtype: Any = jnp.float32
     param_dtype: Any = jnp.float32
@@ -53,6 +58,7 @@ class GPT(nn.Module):
         # (token_embed/pos_embed) at this module's top level.
         embed = _GPTEmbed(vocab_size=self.vocab_size, max_len=self.max_len,
                           embed_dim=self.embed_dim, decode=self.decode,
+                          vocab_multiple=self.vocab_multiple,
                           dtype=self.dtype, param_dtype=self.param_dtype)
         nn.share_scope(self, embed)
         x = embed(tokens)
@@ -74,8 +80,9 @@ class GPT(nn.Module):
             )(x, train)  # positional: remat keeps arg 2 static
 
         # Head shared with GPipeGPT (ln_final/lm_head names preserved).
-        head = _GPTHead(vocab_size=self.vocab_size, dtype=self.dtype,
-                        param_dtype=self.param_dtype)
+        head = _GPTHead(vocab_size=self.vocab_size,
+                        vocab_multiple=self.vocab_multiple,
+                        dtype=self.dtype, param_dtype=self.param_dtype)
         nn.share_scope(self, head)
         return head(x)
 
@@ -90,6 +97,7 @@ class _GPTEmbed(nn.Module):
     max_len: int
     embed_dim: int
     decode: bool = False
+    vocab_multiple: int = 1
     dtype: Any = jnp.float32
     param_dtype: Any = jnp.float32
 
@@ -98,7 +106,8 @@ class _GPTEmbed(nn.Module):
         b, s = tokens.shape
         if s > self.max_len:
             raise ValueError(f"sequence {s} exceeds max_len {self.max_len}")
-        x = nn.Embed(self.vocab_size, self.embed_dim,
+        padded_v = -(-self.vocab_size // self.vocab_multiple) * self.vocab_multiple
+        x = nn.Embed(padded_v, self.embed_dim,
                      dtype=self.dtype, param_dtype=self.param_dtype,
                      name="token_embed")(tokens)
         pos = self.param("pos_embed", nn.initializers.normal(0.02),
@@ -139,6 +148,7 @@ class _GPTHead(nn.Module):
     """Final LN + LM head (the post-pipeline projection to vocab)."""
 
     vocab_size: int
+    vocab_multiple: int = 1
     dtype: Any = jnp.float32
     param_dtype: Any = jnp.float32
 
@@ -146,9 +156,13 @@ class _GPTHead(nn.Module):
     def __call__(self, x):
         x = nn.LayerNorm(dtype=jnp.float32, param_dtype=self.param_dtype,
                          name="ln_final")(x)
-        logits = nn.Dense(self.vocab_size, dtype=self.dtype,
+        padded_v = -(-self.vocab_size // self.vocab_multiple) * self.vocab_multiple
+        logits = nn.Dense(padded_v, dtype=self.dtype,
                           param_dtype=self.param_dtype, name="lm_head")(x)
-        return logits.astype(jnp.float32)
+        # Slice the padding classes away: the function computed is exactly
+        # the unpadded head's (padded kernel columns never reach the loss
+        # or sampling).
+        return logits[..., :self.vocab_size].astype(jnp.float32)
 
 
 class GPipeGPT(GPipeModel):

@@ -79,6 +79,17 @@ VIT_TP_RULES: Sequence[Rule] = (
     (r"/mlp1/bias", _shard_dim(0)),                       # (4E,)
     (r"/mlp2/kernel", _shard_dim(0)),                     # row-parallel (4E, E)
     (r"/mlp2/bias", lambda s: PartitionSpec()),
+    # Vocab-parallel embedding + LM head (the GPT family's largest
+    # leaves: [V, E] and [E, V] at V=50k dwarf any block weight).
+    # Embedding lookups gather from the vocab-sharded table; the head
+    # matmul produces vocab-sharded logits that XLA all-gathers (or
+    # keeps sharded into the loss reduction). Megatron's layout. Real
+    # vocabs divide nothing (50257 = 29 x 1733) — build the model with
+    # GPT(vocab_multiple=...) so the padded V tiles over the axis;
+    # otherwise the divisibility fallback replicates these leaves.
+    (r"/token_embed/embedding", _shard_dim(0)),
+    (r"/lm_head/kernel", _shard_dim(1)),
+    (r"/lm_head/bias", _shard_dim(0)),
 )
 
 # Expert parallelism: Switch-MoE expert-major weights (pddl_tpu/ops/moe.py,
