@@ -37,8 +37,8 @@ class GPT(nn.Module):
     depth: int = 4
     num_heads: int = 4
     mlp_ratio: int = 4
-    attention: str = "flash"  # "flash" | "reference" | "ring"
-    mesh: Optional[Any] = None  # required for "ring"
+    attention: str = "flash"  # "flash" | "reference" | "ring" | "ring_flash"
+    mesh: Optional[Any] = None  # required for "ring"/"ring_flash"
     dropout: float = 0.0
     moe_experts: int = 0
     moe_every: int = 2

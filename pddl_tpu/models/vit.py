@@ -16,7 +16,8 @@ TPU-first choices:
 - bf16 compute / f32 params, f32 LayerNorm and softmax (numerics).
 - ``attention="flash"`` routes through the Pallas kernel on TPU and the
   reference path elsewhere; ``attention="ring"`` shard-maps over the
-  ``seq`` mesh axis for sequence-parallel long-context runs.
+  ``seq`` mesh axis for sequence-parallel long-context runs
+  (``"ring_flash"``: same, with the flash kernel per rotation).
 - no data-dependent control flow; everything jits to one XLA program.
 """
 
@@ -45,7 +46,7 @@ class MultiHeadAttention(nn.Module):
     """
 
     num_heads: int
-    attention: str = "flash"  # "flash" | "reference" | "ring"
+    attention: str = "flash"  # "flash" | "reference" | "ring" | "ring_flash"
     mesh: Optional[Any] = None  # required for "ring"
     causal: bool = False  # decoder-style masking (the GPT family)
     decode: bool = False  # KV-cache single-token decoding
@@ -75,13 +76,14 @@ class MultiHeadAttention(nn.Module):
             o = flash_attention(q, k, v, causal=self.causal)
         elif self.attention == "reference":
             o = attention_reference(q, k, v, causal=self.causal)
-        elif self.attention == "ring":
+        elif self.attention in ("ring", "ring_flash"):
             from pddl_tpu.ops.ring_attention import sequence_parallel_attention
 
             if self.mesh is None:
-                raise ValueError('attention="ring" needs the mesh')
-            o = sequence_parallel_attention(q, k, v, self.mesh,
-                                            causal=self.causal)
+                raise ValueError(f'attention={self.attention!r} needs the mesh')
+            o = sequence_parallel_attention(
+                q, k, v, self.mesh, causal=self.causal,
+                use_flash=self.attention == "ring_flash")
         else:
             raise ValueError(f"unknown attention {self.attention!r}")
 

@@ -30,7 +30,7 @@ from __future__ import annotations
 
 import functools
 import math
-from typing import Optional
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -366,6 +366,17 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, di_ref,
 
 
 def _flash_bwd(causal, scale, block_q, block_k, interpret, res, g):
+    return _flash_bwd_impl(causal, scale, block_q, block_k, interpret, res,
+                           g, dlse=None)
+
+
+def _flash_bwd_impl(causal, scale, block_q, block_k, interpret, res, g,
+                    dlse=None):
+    """Shared fused backward. ``dlse`` (``[b, h, sq]`` or None) is the LSE
+    output's cotangent for the (o, lse) variant: since
+    d(lse)/d(s) = p, it enters every kernel as ``ds = p·(dp − di + dlse)``
+    — folded here as ``di − dlse`` so the kernels stay untouched. dv has
+    no lse term (lse is a function of q/k only)."""
     q, k, v, out, lse_packed = res
     b, h, sq, d = q.shape
     sk = k.shape[-2]
@@ -381,11 +392,11 @@ def _flash_bwd(causal, scale, block_q, block_k, interpret, res, g):
     # lane-replicated for the kernels (transient buffers, freed after the
     # two pallas calls; everything O(S²) stays inside the kernels).
     lse = jnp.broadcast_to(lse_packed[..., None], (b * h, sq, LANES))
-    di = jnp.broadcast_to(
-        jnp.sum(dof.astype(jnp.float32) * out.astype(jnp.float32),
-                axis=-1, keepdims=True),
-        (b * h, sq, LANES),
-    )
+    di_rows = jnp.sum(dof.astype(jnp.float32) * out.astype(jnp.float32),
+                      axis=-1, keepdims=True)
+    if dlse is not None:
+        di_rows = di_rows - dlse.reshape(b * h, sq, 1).astype(jnp.float32)
+    di = jnp.broadcast_to(di_rows, (b * h, sq, LANES))
 
     sds = _sds_like(qf)
     q_spec = pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0))
@@ -435,3 +446,71 @@ def _flash_bwd(causal, scale, block_q, block_k, interpret, res, g):
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+# ------------------------------------------------------- (o, lse) variant
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_lse(q, k, v, causal, scale, block_q, block_k, interpret):
+    (o, lse), _ = _flash_lse_fwd(q, k, v, causal, scale, block_q, block_k,
+                                 interpret)
+    return o, lse
+
+
+def _flash_lse_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+    b, h, sq, d = q.shape
+    out, lse = _flash_forward_call(q, k, v, causal, scale, block_q, block_k,
+                                   interpret, want_lse=True)
+    lse_rows = lse[..., 0]
+    return ((out.reshape(b, h, sq, d), lse_rows.reshape(b, h, sq)),
+            (q, k, v, out, lse_rows))
+
+
+def _flash_lse_bwd(causal, scale, block_q, block_k, interpret, res, g):
+    do, dlse = g
+    return _flash_bwd_impl(causal, scale, block_q, block_k, interpret, res,
+                           do, dlse=dlse)
+
+
+_flash_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
+
+
+def _attention_reference_lse(q, k, v, causal, scale):
+    """O(S²) (o, lse) fallback with the reference's exact masking."""
+    s = scale * jnp.einsum(
+        "...qd,...kd->...qk", q.astype(jnp.float32), k.astype(jnp.float32))
+    if causal:
+        sq, sk = s.shape[-2], s.shape[-1]
+        mask = jnp.arange(sq)[:, None] >= jnp.arange(sk)[None, :]
+        s = jnp.where(mask, s, NEG_INF)
+    lse = jax.scipy.special.logsumexp(s, axis=-1)
+    p = jnp.exp(s - lse[..., None])
+    o = jnp.einsum("...qk,...kd->...qd", p, v.astype(jnp.float32))
+    return o.astype(q.dtype), lse
+
+
+def flash_attention_lse(
+    q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+    *, causal: bool = False, scale: Optional[float] = None,
+    block_q: int = 512, block_k: int = 1024,
+    interpret: Optional[bool] = None,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """:func:`flash_attention` that ALSO returns per-row logsumexp.
+
+    ``(o [B,H,S,D], lse [B,H,S])`` — the pair needed to merge partial
+    attention over key/value blocks held elsewhere (ring attention's
+    flash path): normalized partials combine as
+    ``o = Σᵢ oᵢ·exp(lseᵢ − m) / Σᵢ exp(lseᵢ − m)``. Fully differentiable
+    including through ``lse`` (the cotangent folds into the fused
+    backward's row term). Falls back to an O(S²) reference when shapes
+    don't tile, exactly like :func:`flash_attention`.
+    """
+    *_, sq, d = q.shape
+    sk = k.shape[-2]
+    scale_v = (1.0 / math.sqrt(d)) if scale is None else scale
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    bq = _largest_dividing_block(sq, block_q)
+    bk = _largest_dividing_block(sk, block_k)
+    if bq < 8 or bk < 8:
+        return _attention_reference_lse(q, k, v, causal, scale_v)
+    return _flash_lse(q, k, v, causal, scale_v, bq, bk, bool(interpret))

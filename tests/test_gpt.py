@@ -332,3 +332,18 @@ def test_sample_logits_traced_filters_stay_jittable():
     f = jax.jit(lambda r, l, p: sample_logits(r, l, top_p=p))
     tok = int(f(jax.random.key(0), logits, jnp.float32(0.9))[0])
     assert 0 <= tok < 3
+
+
+def test_ring_flash_gpt_matches_reference(mesh8):
+    """attention="ring_flash": flash-kernel rotations over the seq axis
+    reproduce the reference transformer exactly."""
+    mesh = build_mesh(MeshConfig(data=1, seq=8))
+    ref_model = tiny_gpt(attention="reference")
+    x = _tokens(b=1, s=64)
+    variables = ref_model.init(jax.random.key(1), x, train=False)
+    ref = ref_model.apply(variables, x, train=False)
+    ring_model = tiny_gpt(attention="ring_flash", mesh=mesh)
+    out = jax.jit(lambda v, xx: ring_model.apply(v, xx, train=False))(
+        variables, x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-4, rtol=2e-4)
