@@ -182,11 +182,12 @@ def make_optimizer(
     if isinstance(name, optax.GradientTransformation):
         # A prebuilt transformation: chain-level options still compose;
         # factory-level ones cannot be injected after the fact.
-        if schedule is not None or weight_decay is not None:
+        if (schedule is not None or weight_decay is not None
+                or "decay_mask" in kwargs):
             raise ValueError(
-                "schedule/weight_decay cannot be applied to a prebuilt "
-                "optax.GradientTransformation — build it with them, or "
-                "pass the optimizer by name"
+                "schedule/weight_decay/decay_mask cannot be applied to a "
+                "prebuilt optax.GradientTransformation — build it with "
+                "them, or pass the optimizer by name"
             )
         tx = name
         if grad_clip_norm is not None:
@@ -198,19 +199,36 @@ def make_optimizer(
         factory = _OPTIMIZERS[name.lower()]
     except KeyError:
         raise ValueError(f"unknown optimizer {name!r}; known: {sorted(_OPTIMIZERS)}") from None
+    has_decay_mask = "decay_mask" in kwargs
+    decay_mask = kwargs.pop("decay_mask", None)
+    if (weight_decay is not None or has_decay_mask) and name.lower() not in (
+            "adamw", "lamb"):
+        raise ValueError(
+            f"weight_decay/decay_mask are not supported for {name!r} (they "
+            "would be silently ignored); use 'adamw'/'lamb', or pass a "
+            "prebuilt optax.GradientTransformation with "
+            "optax.add_decayed_weights"
+        )
     if weight_decay is not None:
-        if name.lower() in ("adamw", "lamb"):
-            kwargs["weight_decay"] = weight_decay
+        kwargs["weight_decay"] = weight_decay
+        # Standard practice: decay matrices only — biases, LayerNorm/BN
+        # scales and other 1D leaves are excluded (decaying them hurts and
+        # no major recipe does it). decay_mask overrides (an optax mask
+        # pytree/callable; None = decay everything).
+        if has_decay_mask:
+            if decay_mask is not None:
+                kwargs["mask"] = decay_mask
         else:
-            raise ValueError(
-                f"weight_decay is not supported for {name!r} (it would be "
-                "silently ignored); use 'adamw'/'lamb', or pass a prebuilt "
-                "optax.GradientTransformation with optax.add_decayed_weights"
-            )
+            kwargs["mask"] = lambda params: jax.tree.map(
+                lambda p: p.ndim > 1, params)
     lr: Any = learning_rate
     if schedule is not None:
         lr = make_schedule(schedule, learning_rate, **(schedule_options or {}))
-    tx = optax.inject_hyperparams(factory)(learning_rate=lr, **kwargs)
+    # `mask` must be declared static: inject_hyperparams otherwise treats
+    # any callable kwarg as a step->value schedule.
+    inject = (optax.inject_hyperparams(factory, static_args=("mask",))
+              if "mask" in kwargs else optax.inject_hyperparams(factory))
+    tx = inject(learning_rate=lr, **kwargs)
     if grad_clip_norm is not None:
         tx = optax.chain(optax.clip_by_global_norm(grad_clip_norm), tx)
     if accumulate_steps is not None and accumulate_steps > 1:

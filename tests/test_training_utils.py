@@ -253,3 +253,41 @@ def test_tensorboard_callback_writes_events(tmp_path):
                     tags[split].add(v.tag)
     assert {"loss", "accuracy", "learning_rate"} <= tags["train"]
     assert {"loss", "accuracy"} <= tags["validation"]
+
+
+def test_weight_decay_masks_biases_and_norms():
+    """AdamW decay applies to matrices only: a zero-gradient step shrinks
+    kernels but leaves biases/scales untouched (standard recipe)."""
+    import jax.numpy as jnp
+
+    from pddl_tpu.train.state import make_optimizer
+
+    params = {
+        "dense": {"kernel": jnp.ones((4, 4)), "bias": jnp.ones((4,))},
+        "ln": {"scale": jnp.ones((4,))},
+    }
+    tx = make_optimizer("adamw", 1e-2, weight_decay=0.1)
+    state = tx.init(params)
+    zero_g = jax.tree.map(jnp.zeros_like, params)
+    updates, _ = tx.update(zero_g, state, params)
+    new = jax.tree.map(lambda p, u: p + u, params, updates)
+    assert float(jnp.max(jnp.abs(new["dense"]["bias"] - 1))) == 0.0
+    assert float(jnp.max(jnp.abs(new["ln"]["scale"] - 1))) == 0.0
+    assert float(jnp.max(jnp.abs(new["dense"]["kernel"] - 1))) > 0.0
+
+    # Explicit decay_mask=None restores decay-everything.
+    tx_all = make_optimizer("adamw", 1e-2, weight_decay=0.1, decay_mask=None)
+    u_all, _ = tx_all.update(zero_g, tx_all.init(params), params)
+    new_all = jax.tree.map(lambda p, u: p + u, params, u_all)
+    assert float(jnp.max(jnp.abs(new_all["dense"]["bias"] - 1))) > 0.0
+
+
+def test_decay_mask_misuse_raises():
+    import optax
+
+    from pddl_tpu.train.state import make_optimizer
+
+    with pytest.raises(ValueError, match="decay_mask"):
+        make_optimizer("adam", 1e-3, decay_mask=lambda p: p)
+    with pytest.raises(ValueError, match="decay_mask"):
+        make_optimizer(optax.sgd(0.1), decay_mask=lambda p: p)
