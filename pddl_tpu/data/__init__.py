@@ -9,20 +9,39 @@ sharding for every scheme the reference uses (auto-shard DATA, post-batch
 rank sharding, none).
 """
 
-from pddl_tpu.data.synthetic import SyntheticImageClassification
+from pddl_tpu.data.synthetic import (
+    SyntheticImageClassification,
+    SyntheticLanguageModeling,
+)
 
 __all__ = [
     "SyntheticImageClassification",
+    "SyntheticLanguageModeling",
     "ImageNetConfig",
     "ImageNetDataset",
     "load_imagenet",
+    "NativeLoader",
+    "TFRecordReader",
+    "TokenFileDataset",
+    "load_token_corpus",
 ]
 
 
-def __getattr__(name):
-    # Lazy: the ImageNet pipeline pulls in TensorFlow only when used.
-    if name in ("ImageNetConfig", "ImageNetDataset", "load_imagenet"):
-        from pddl_tpu.data import imagenet
+_LAZY = {
+    # Lazy: tf.data pulls in TensorFlow, the native loaders build the C++
+    # library — both only when actually used.
+    "ImageNetConfig": "imagenet", "ImageNetDataset": "imagenet",
+    "load_imagenet": "imagenet",
+    "NativeLoader": "native_loader",
+    "TFRecordReader": "tfrecord",
+    "TokenFileDataset": "text", "load_token_corpus": "text",
+}
 
-        return getattr(imagenet, name)
+
+def __getattr__(name):
+    if name in _LAZY:
+        import importlib
+
+        mod = importlib.import_module(f"pddl_tpu.data.{_LAZY[name]}")
+        return getattr(mod, name)
     raise AttributeError(name)

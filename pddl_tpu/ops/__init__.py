@@ -9,16 +9,25 @@ live in :mod:`pddl_tpu.ops.ring_attention`.
 """
 
 from pddl_tpu.ops import augment
-from pddl_tpu.ops.attention import attention_reference, flash_attention
+from pddl_tpu.ops.attention import (
+    attention_reference,
+    flash_attention,
+    flash_attention_lse,
+)
+from pddl_tpu.ops.large_vocab import chunked_cross_entropy
 from pddl_tpu.ops.ring_attention import (
     ring_attention,
+    ring_attention_flash,
     sequence_parallel_attention,
 )
 
 __all__ = [
     "augment",
     "attention_reference",
+    "chunked_cross_entropy",
     "flash_attention",
+    "flash_attention_lse",
     "ring_attention",
+    "ring_attention_flash",
     "sequence_parallel_attention",
 ]

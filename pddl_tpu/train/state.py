@@ -201,19 +201,14 @@ def make_optimizer(
         raise ValueError(f"unknown optimizer {name!r}; known: {sorted(_OPTIMIZERS)}") from None
     has_decay_mask = "decay_mask" in kwargs
     decay_mask = kwargs.pop("decay_mask", None)
-    if (weight_decay is not None or has_decay_mask) and name.lower() not in (
-            "adamw", "lamb"):
-        raise ValueError(
-            f"weight_decay/decay_mask are not supported for {name!r} (they "
-            "would be silently ignored); use 'adamw'/'lamb', or pass a "
-            "prebuilt optax.GradientTransformation with "
-            "optax.add_decayed_weights"
-        )
-    if weight_decay is not None:
-        kwargs["weight_decay"] = weight_decay
+    if name.lower() in ("adamw", "lamb"):
+        if weight_decay is not None:
+            kwargs["weight_decay"] = weight_decay
         # Standard practice: decay matrices only — biases, LayerNorm/BN
         # scales and other 1D leaves are excluded (decaying them hurts and
-        # no major recipe does it). decay_mask overrides (an optax mask
+        # no major recipe does it). This applies to the optimizer's OWN
+        # default decay too (optax.adamw defaults to 1e-4), not just an
+        # explicit weight_decay. decay_mask overrides (an optax mask
         # pytree/callable; None = decay everything).
         if has_decay_mask:
             if decay_mask is not None:
@@ -221,6 +216,13 @@ def make_optimizer(
         else:
             kwargs["mask"] = lambda params: jax.tree.map(
                 lambda p: p.ndim > 1, params)
+    elif weight_decay is not None or has_decay_mask:
+        raise ValueError(
+            f"weight_decay/decay_mask are not supported for {name!r} (they "
+            "would be silently ignored); use 'adamw'/'lamb', or pass a "
+            "prebuilt optax.GradientTransformation with "
+            "optax.add_decayed_weights"
+        )
     lr: Any = learning_rate
     if schedule is not None:
         lr = make_schedule(schedule, learning_rate, **(schedule_options or {}))

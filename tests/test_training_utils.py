@@ -275,6 +275,21 @@ def test_weight_decay_masks_biases_and_norms():
     assert float(jnp.max(jnp.abs(new["ln"]["scale"] - 1))) == 0.0
     assert float(jnp.max(jnp.abs(new["dense"]["kernel"] - 1))) > 0.0
 
+    # Plain "adamw" (no explicit weight_decay): optax's built-in default
+    # decay (1e-4) must be masked identically.
+    tx_plain = make_optimizer("adamw", 1e-2)
+    u_p, _ = tx_plain.update(zero_g, tx_plain.init(params), params)
+    new_p = jax.tree.map(lambda p, u: p + u, params, u_p)
+    assert float(jnp.max(jnp.abs(new_p["dense"]["bias"] - 1))) == 0.0
+    assert float(jnp.max(jnp.abs(new_p["dense"]["kernel"] - 1))) > 0.0
+
+    # decay_mask alone (no explicit weight_decay) must also engage.
+    only_kernel = lambda p: jax.tree.map(lambda x: x.ndim > 1, p)  # noqa: E731
+    tx_m = make_optimizer("adamw", 1e-2, decay_mask=only_kernel)
+    u_m, _ = tx_m.update(zero_g, tx_m.init(params), params)
+    new_m = jax.tree.map(lambda p, u: p + u, params, u_m)
+    assert float(jnp.max(jnp.abs(new_m["dense"]["bias"] - 1))) == 0.0
+
     # Explicit decay_mask=None restores decay-everything.
     tx_all = make_optimizer("adamw", 1e-2, weight_decay=0.1, decay_mask=None)
     u_all, _ = tx_all.update(zero_g, tx_all.init(params), params)
