@@ -45,6 +45,9 @@ class ExperimentConfig:
     # language-model runs (models named gpt_*): sequence length of the
     # synthetic next-token task; vocab comes from num_classes.
     seq_len: int = 64
+    # pad the LM embed/head vocab dim to a multiple (Megatron convention;
+    # needed for vocab-parallel TP on real vocab sizes)
+    vocab_multiple: int = 1
     # strategy
     strategy: str = "single"  # single|mirrored|multiworker|ps|
     #                           tensor_parallel|expert_parallel|pipeline

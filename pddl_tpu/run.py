@@ -39,6 +39,13 @@ def build_trainer(cfg: ExperimentConfig, strategy=None):
     # Transformer families only; an explicit "none" is the default and is
     # not forwarded. Other families fail HERE with guidance, not with a
     # model-constructor TypeError.
+    if cfg.vocab_multiple > 1:
+        if not _is_lm(cfg.model):
+            raise ValueError(
+                f"--vocab-multiple applies to language models (gpt*), not "
+                f"{cfg.model!r}"
+            )
+        model_kwargs["vocab_multiple"] = cfg.vocab_multiple
     if cfg.remat and cfg.remat != "none":
         if not any(t in cfg.model for t in ("vit", "gpt")):
             raise ValueError(
@@ -361,6 +368,9 @@ def main(argv=None) -> int:
     p.add_argument("--num-classes", type=int, default=None)
     p.add_argument("--seq-len", type=int, default=None,
                    help="LM sequence length (token-window size)")
+    p.add_argument("--vocab-multiple", type=int, default=None,
+                   help="pad the LM vocab dim to a multiple (enables "
+                        "vocab-parallel TP on real vocab sizes)")
     p.add_argument("--remat", default=None, choices=["none", "dots", "full"],
                    help="activation rematerialization for transformer "
                         "models (trade recompute for HBM)")
@@ -392,6 +402,7 @@ def main(argv=None) -> int:
         "per_replica_batch": args.batch, "learning_rate": args.lr,
         "image_size": args.image_size, "crop": args.crop,
         "num_classes": args.num_classes, "seq_len": args.seq_len,
+        "vocab_multiple": args.vocab_multiple,
         "remat": args.remat,
         "model": args.model, "strategy": args.strategy,
         "pretrained_h5": args.pretrained_h5,
