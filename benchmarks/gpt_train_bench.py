@@ -5,6 +5,10 @@ The harness behind the architecture doc's long-context numbers
 runs, ≈43-46% MFU by the 6ND estimate against the 197 TFLOP/s bf16
 peak — chip-state variance of a few percent per run is normal).
 
+Long context on ONE chip (``--remat dots``): S=8192 at ~32k tokens/s,
+S=16384 at ~22k tokens/s (B1), where the materialized-scores attention
+could not even hold a single layer's S² matrix.
+
     PYTHONPATH=. python benchmarks/gpt_train_bench.py [--seq 2048 --batch 8]
 """
 
@@ -32,12 +36,15 @@ def main() -> None:
     p.add_argument("--heads", type=int, default=12)
     p.add_argument("--vocab", type=int, default=50257)
     p.add_argument("--steps", type=int, default=10)
+    p.add_argument("--remat", default="none",
+                   choices=["none", "dots", "full"],
+                   help="activation checkpointing (long sequences: dots)")
     args = p.parse_args()
 
     model = GPT(vocab_size=args.vocab, max_len=args.seq,
                 embed_dim=args.width, depth=args.depth,
                 num_heads=args.heads, attention="flash",
-                dtype=jnp.bfloat16)
+                remat=args.remat, dtype=jnp.bfloat16)
     B, S = args.batch, args.seq
     tokens = jax.random.randint(jax.random.key(0), (B, S), 0, args.vocab)
     targets = jax.random.randint(jax.random.key(1), (B, S), 0, args.vocab)
