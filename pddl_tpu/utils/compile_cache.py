@@ -1,0 +1,30 @@
+"""Shared persistent XLA compile cache configuration.
+
+The CPU fake-mesh world (SURVEY.md §4's testing recipe) spends most of its
+wall-clock in XLA:CPU compiles of sharded train steps. Both the test suite
+(``tests/conftest.py``) and the driver's multichip gate
+(``__graft_entry__.dryrun_multichip``) persist those compiles to one shared
+on-disk cache so either one warms the other.
+"""
+
+from __future__ import annotations
+
+import os
+
+DEFAULT_CACHE_DIR = os.path.join("/tmp", "pddl_tpu_xla_cache")
+CACHE_DIR_ENV = "PDDL_TEST_COMPILE_CACHE"
+
+
+def enable_persistent_compile_cache() -> str:
+    """Point jax at the shared on-disk compile cache; return the cache dir.
+
+    Honors the ``PDDL_TEST_COMPILE_CACHE`` env override. Safe to call before
+    or after backend initialization (the config only affects future compiles).
+    """
+    import jax
+
+    cache_dir = os.environ.get(CACHE_DIR_ENV, DEFAULT_CACHE_DIR)
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    return cache_dir

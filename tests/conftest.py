@@ -23,13 +23,11 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 
 # The suite's wall-clock is dominated by XLA:CPU compiles of the sharded
-# train steps. Persist them: a warm cache cuts a full run by minutes.
-_CACHE_DIR = os.environ.get(
-    "PDDL_TEST_COMPILE_CACHE", os.path.join("/tmp", "pddl_tpu_xla_cache")
-)
-jax.config.update("jax_compilation_cache_dir", _CACHE_DIR)
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
-jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+# train steps. Persist them (shared with the driver's multichip gate):
+# a warm cache cuts a full run by minutes.
+from pddl_tpu.utils.compile_cache import enable_persistent_compile_cache  # noqa: E402
+
+enable_persistent_compile_cache()
 
 import pytest  # noqa: E402
 
