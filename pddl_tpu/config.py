@@ -121,19 +121,23 @@ PRESETS: Dict[str, ExperimentConfig] = {
         per_replica_batch=32, bn_mode="frozen",
     ),
     # imagenet-resnet50-hvd.py — DP with hvd semantics: LR 0.1×size,
-    # 3-epoch warmup, post-batch sharding, crop 160 (:89,99,114,77-81)
+    # 3-epoch warmup, post-batch sharding, crop 160 (:89,99,114,77-81).
+    # ReduceLROnPlateau + EarlyStopping run alongside the warmup callbacks
+    # exactly as in the reference's callback list (:106-107); warmup owns
+    # the LR for epochs 0-2 (it re-sets it every batch), plateau reductions
+    # stick only once warmup releases — see
+    # tests/test_callbacks.py::test_warmup_and_plateau_compose.
     "hvd": ExperimentConfig(
         name="ResNet50_ImageNet_hvd", strategy="multiworker",
         per_replica_batch=32, data_shard="batch", learning_rate=0.1,
         scale_lr=True, warmup_epochs=3, crop=160,
-        reduce_lr_on_plateau=False, early_stopping=False,
     ),
     # imagenet-resnet50-ps.py — sharded-state PS analogue, repeated stream
     # with fixed steps/epoch (:118-119,142-143 — we default to data-derived
-    # steps rather than the reference's wrong 312500)
+    # steps rather than the reference's wrong 312500). The reference PS
+    # script keeps both val_loss callbacks too (:139-140).
     "ps": ExperimentConfig(
         name="ResNet50_ImageNet_ps", strategy="ps", per_replica_batch=32,
-        reduce_lr_on_plateau=False, early_stopping=False,
     ),
 }
 

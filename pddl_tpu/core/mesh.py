@@ -151,7 +151,12 @@ def slice_groups(
     devices = list(devices)
     keys = {getattr(d, "slice_index", None) for d in devices}
     if keys != {None}:
-        key = lambda d: getattr(d, "slice_index", 0)  # noqa: E731
+        # Heterogeneous sets can expose slice_index on only some devices
+        # (int and None mixed); -1 keeps the group keys sortable instead
+        # of sorted() raising TypeError on None < int.
+        def key(d):
+            si = getattr(d, "slice_index", None)
+            return -1 if si is None else si
     elif len({d.process_index for d in devices}) > 1:
         key = lambda d: d.process_index  # noqa: E731
     else:

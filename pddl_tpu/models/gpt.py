@@ -219,19 +219,22 @@ def sample_logits(rng, logits, *, temperature: float = 1.0,
         kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
         logits = jnp.where(logits < kth, -jnp.inf, logits)
     if top_p is not None:
-        sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+        sort_idx = jnp.argsort(-logits, axis=-1)  # stable descending
+        sorted_logits = jnp.take_along_axis(logits, sort_idx, axis=-1)
         cdf = jnp.cumsum(jax.nn.softmax(sorted_logits, axis=-1), axis=-1)
         # Smallest set whose mass >= top_p: keep entries whose CDF
         # *before* them is < top_p (the first token is always kept).
         keep_sorted = jnp.concatenate(
             [jnp.zeros_like(cdf[..., :1]), cdf[..., :-1]], axis=-1
         ) < top_p
-        # Threshold = lowest kept sorted logit, mapped back to vocab order.
-        threshold = jnp.min(
-            jnp.where(keep_sorted, sorted_logits, jnp.inf), axis=-1,
-            keepdims=True,
-        )
-        logits = jnp.where(logits < threshold, -jnp.inf, logits)
+        # Scatter the keep mask back to vocab order through the inverse
+        # permutation. A value threshold would instead keep EVERY token
+        # tied with the boundary logit, exceeding the nucleus; the stable
+        # descending argsort resolves boundary ties toward lower vocab
+        # ids, so the kept set is exactly the smallest one reaching top_p.
+        inv_idx = jnp.argsort(sort_idx, axis=-1)
+        keep = jnp.take_along_axis(keep_sorted, inv_idx, axis=-1)
+        logits = jnp.where(keep, logits, -jnp.inf)
     return jax.random.categorical(rng, logits, axis=-1)
 
 

@@ -62,8 +62,14 @@ def build_trainer(cfg: ExperimentConfig, strategy=None):
     schedule_options = dict(cfg.lr_schedule_options)
     if cfg.lr_schedule and "decay_steps" not in schedule_options:
         if cfg.steps_per_epoch:
-            # Default horizon: the full run.
-            schedule_options["decay_steps"] = cfg.steps_per_epoch * cfg.epochs
+            # Default horizon: the full run, counted in OPTIMIZER updates —
+            # with --grad-accum k, optax.MultiSteps advances the schedule
+            # once per k micro-batches, so the micro-step total over-counts
+            # the horizon k-fold.
+            accum = cfg.gradient_accumulation_steps or 1
+            schedule_options["decay_steps"] = max(
+                1, cfg.steps_per_epoch * cfg.epochs // accum
+            )
         elif cfg.lr_schedule not in ("constant", "piecewise"):
             # Fail here with guidance, not deep inside optax: with real
             # data the per-epoch step count isn't known until iteration.
@@ -352,8 +358,13 @@ def main(argv=None) -> int:
                         "warmup callbacks); decay horizon = "
                         "--lr-decay-steps, or epochs*steps_per_epoch when "
                         "--steps-per-epoch is set")
-    p.add_argument("--lr-decay-steps", type=int, default=None)
-    p.add_argument("--lr-warmup-steps", type=int, default=None)
+    p.add_argument("--lr-decay-steps", type=int, default=None,
+                   help="schedule horizon in OPTIMIZER updates (with "
+                        "--grad-accum k that is one per k micro-batches); "
+                        "includes --lr-warmup-steps")
+    p.add_argument("--lr-warmup-steps", type=int, default=None,
+                   help="linear warmup, in optimizer updates; counted "
+                        "inside --lr-decay-steps")
     p.add_argument("--lr-boundaries", default=None,
                    help="piecewise schedule: comma-separated step:scale "
                         "pairs, e.g. 30000:0.1,60000:0.1")

@@ -68,7 +68,10 @@ class Trainer:
         lr_schedule: Optional[str | Callable] = None,
         lr_schedule_options: Optional[Dict[str, Any]] = None,
         ema_decay: Optional[float] = None,
-        eval_with_ema: bool = True,  # evaluate on EMA weights when enabled
+        # Evaluate on the EMA weights when ema_decay is set. Intended for
+        # the normalization-free families: BatchNorm models eval EMA params
+        # against the LIVE batch_stats (a warning fires at build time).
+        eval_with_ema: bool = True,
         gradient_accumulation_steps: Optional[int] = None,
     ):
         self.model = model
@@ -152,6 +155,22 @@ class Trainer:
         batch_sh = self.strategy.batch_sharding()
         state_sh = self._state_shardings
         base_rng = jax.random.key(self.seed + 1)
+
+        if (self.eval_with_ema and self.ema_decay
+                and jax.tree.leaves(self.state.batch_stats)):
+            import warnings
+
+            # EMA shadows cover params only; batch_stats stay the live
+            # moving statistics accumulated under the RAW params, which can
+            # skew BatchNorm eval metrics. EMA eval is designed for the
+            # normalization-free families (ViT/GPT); for BN models either
+            # accept the mismatch or pass eval_with_ema=False.
+            warnings.warn(
+                "eval_with_ema: evaluating EMA params against live (non-"
+                "averaged) BatchNorm statistics; pass eval_with_ema=False "
+                "for BN models if eval metrics look skewed",
+                stacklevel=2,
+            )
 
         def train_step(state: TrainState, batch):
             images, labels = batch[self.input_key], batch[self.target_key]

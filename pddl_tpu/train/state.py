@@ -103,6 +103,17 @@ def make_schedule(
 
     Names: ``cosine`` (optionally warmed up), ``exponential``, ``linear``,
     ``piecewise`` (step decay via ``boundaries_and_scales``), ``constant``.
+
+    One convention for ``warmup_steps`` across every schedule (the one
+    ``optax.warmup_cosine_decay_schedule`` uses): ``decay_steps`` is the
+    TOTAL schedule horizon INCLUDING warmup, so horizon-style schedules
+    (``cosine``, ``linear``) finish decaying exactly at step
+    ``decay_steps``, with the decay portion running over
+    ``decay_steps - warmup_steps``. ``exponential``'s ``decay_steps`` is a
+    rate constant (multiply by ``decay_rate`` per ``decay_steps`` updates
+    after warmup), not a horizon. ``piecewise`` boundaries are absolute
+    step indices whether or not warmup is present (each must be >=
+    ``warmup_steps``).
     """
     if callable(name):
         return name
@@ -115,6 +126,12 @@ def make_schedule(
                 "'warmup_cosine' requires warmup_steps > 0; use 'cosine' "
                 "for no warmup"
             )
+        if warmup_steps and decay_steps <= warmup_steps:
+            raise ValueError(
+                f"cosine schedule needs decay_steps > warmup_steps (total "
+                f"horizon includes warmup); got {decay_steps} <= "
+                f"{warmup_steps}"
+            )
         if warmup_steps:
             return optax.warmup_cosine_decay_schedule(
                 init_value=0.0, peak_value=learning_rate,
@@ -125,11 +142,24 @@ def make_schedule(
     if kind == "exponential":
         if decay_steps is None:
             raise ValueError("'exponential' schedule requires decay_steps")
+        # decay_steps is a RATE constant here (transition steps per
+        # decay_rate application), not a horizon — warmup subtraction
+        # would silently change the decay rate.
         sched = optax.exponential_decay(learning_rate, decay_steps, decay_rate)
     elif kind == "linear":
         if decay_steps is None:
             raise ValueError("'linear' schedule requires decay_steps")
-        sched = optax.linear_schedule(learning_rate, end_value, decay_steps)
+        if warmup_steps and decay_steps <= warmup_steps:
+            raise ValueError(
+                f"'linear' schedule needs decay_steps > warmup_steps "
+                f"(total horizon includes warmup); got {decay_steps} <= "
+                f"{warmup_steps}"
+            )
+        # Total-horizon convention: the decay leg covers what remains of
+        # decay_steps after warmup, so LR hits end_value at decay_steps.
+        sched = optax.linear_schedule(
+            learning_rate, end_value, decay_steps - warmup_steps
+        )
     elif kind == "piecewise":
         if not boundaries_and_scales:
             raise ValueError(
@@ -137,6 +167,18 @@ def make_schedule(
                 "({step: scale, ...}); without them it would silently be "
                 "a constant LR"
             )
+        if warmup_steps:
+            if any(b < warmup_steps for b in boundaries_and_scales):
+                raise ValueError(
+                    "'piecewise' boundaries are absolute step indices and "
+                    f"must be >= warmup_steps={warmup_steps}; got "
+                    f"{sorted(boundaries_and_scales)}"
+                )
+            # join_schedules rebases the tail to (step - warmup_steps);
+            # shift the boundaries so they stay absolute for the caller.
+            boundaries_and_scales = {
+                b - warmup_steps: s for b, s in boundaries_and_scales.items()
+            }
         sched = optax.piecewise_constant_schedule(
             learning_rate, boundaries_and_scales
         )

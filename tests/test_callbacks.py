@@ -85,6 +85,57 @@ def test_warmup_ramps_to_target():
     assert all(b >= a - 1e-9 for a, b in zip(lrs, lrs[1:]))
 
 
+def test_warmup_and_plateau_compose():
+    """Warmup owns epochs 0-2; plateau reductions stick only after release.
+
+    The reference hvd script runs ReduceLROnPlateau in the same callback list
+    as the warmup callback (`/root/reference/imagenet-resnet50-hvd.py:106,114`).
+    The runtime behavior to preserve: while warmup is ramping it re-sets the
+    LR every batch, so a plateau reduction fired mid-warmup is transient and
+    the ramp still reaches the full target; once warmup releases (after
+    warmup_epochs), plateau's multiplicative reductions persist.
+    """
+    noise = SyntheticImageClassification(
+        batch_size=16, image_size=32, num_classes=10, signal_strength=0.0
+    )
+    tr = _trainer(learning_rate=0.8)
+    # min_delta so large nothing ever improves: plateau fires at the end of
+    # EVERY epoch from epoch 1 on — including inside the warmup window.
+    plateau = ReduceLROnPlateau(patience=1, factor=0.1, min_delta=10.0,
+                                min_lr=1e-6)
+    warmup = LearningRateWarmup(warmup_epochs=3, verbose=0)
+    lrs = []
+    spy = LambdaCallback(
+        on_train_batch_end=lambda step, state, logs: lrs.append(
+            get_learning_rate(state)
+        )
+    )
+    # Reference order: plateau first, warmup after (:106 vs :114).
+    tr.fit(noise, epochs=5, steps_per_epoch=2, validation_data=noise,
+           validation_steps=1, callbacks=[plateau, warmup, spy], verbose=0)
+    # Epochs 0-2 (6 batches): the pure linear ramp to 0.8, unperturbed by the
+    # plateau reductions fired at the ends of epochs 1 and 2.
+    ramp = [0.8 * (k + 1) / 6 for k in range(6)]
+    assert np.allclose(lrs[:6], ramp, rtol=1e-5), lrs[:6]
+    # Warmup released at 0.8; epoch-2-end plateau cut it to 0.08, and nothing
+    # restores it during epoch 3.
+    assert np.allclose(lrs[6:8], 0.08, rtol=1e-5), lrs[6:8]
+    # Epoch-3-end and epoch-4-end reductions compound: 0.8 -> 0.08 -> 0.008
+    # -> 0.0008 persists in the final state.
+    assert np.isclose(get_learning_rate(tr.state), 8e-4, rtol=1e-5)
+
+
+def test_hvd_and_ps_presets_keep_reference_callbacks():
+    """The hvd/ps presets must not drop the reference's val_loss callbacks
+    (`imagenet-resnet50-hvd.py:106-107`, `imagenet-resnet50-ps.py:139-140`)."""
+    from pddl_tpu.config import get_preset
+
+    for preset in ("hvd", "ps"):
+        cfg = get_preset(preset)
+        assert cfg.reduce_lr_on_plateau, preset
+        assert cfg.early_stopping, preset
+
+
 def test_csv_logger(tmp_path):
     path = tmp_path / "history.csv"
     tr = _trainer()

@@ -206,6 +206,19 @@ def test_sample_logits_filters():
     }
     assert draws <= {0, 1, 2}
 
+    # Boundary ties: probs [0.4, 0.3, 0.3, ...]; at top_p=0.5 the smallest
+    # set reaching 0.5 is {0.4, one 0.3} — a value-threshold formulation
+    # would keep BOTH tied 0.3s. The stable descending argsort breaks the
+    # tie toward the lower vocab id, so id 2 must never be drawn.
+    tie_logits = jnp.log(
+        jnp.asarray([[0.4, 0.3, 0.3, 1e-9]], dtype=jnp.float32)
+    )
+    draws = {
+        int(sample_logits(jax.random.fold_in(rng, i), tie_logits, top_p=0.5)[0])
+        for i in range(128)
+    }
+    assert draws <= {0, 1} and len(draws) == 2, draws
+
     # Degenerate top_p keeps only the argmax; jittable end to end.
     jitted = jax.jit(lambda r, l: sample_logits(r, l, top_p=0.01))
     assert int(jitted(rng, logits)[0]) == 0

@@ -34,6 +34,29 @@ def test_slice_groups_fake_split(eight_devices):
     assert len(slice_groups(eight_devices)) == 1
 
 
+def test_slice_groups_mixed_slice_index(eight_devices):
+    """Heterogeneous sets: some devices expose slice_index=int, others None.
+
+    The group keys must stay sortable (None maps to -1) instead of
+    sorted() raising TypeError on None < int."""
+
+    class _Dev:
+        def __init__(self, dev, slice_index):
+            self._dev = dev
+            self.process_index = dev.process_index
+            if slice_index is not None:
+                self.slice_index = slice_index
+
+        # slice_index intentionally absent when None: getattr default path.
+
+    mixed = [_Dev(d, 1 if i < 4 else None) for i, d in enumerate(eight_devices)]
+    groups = slice_groups(mixed)
+    assert [len(g) for g in groups] == [4, 4]
+    # The sentinel -1 sorts the index-less group first.
+    assert all(not hasattr(d, "slice_index") for d in groups[0])
+    assert all(getattr(d, "slice_index", None) == 1 for d in groups[1])
+
+
 def test_hybrid_mesh_data_axis_slice_major(eight_devices):
     mesh = build_hybrid_mesh(MeshConfig(data=-1), num_slices=2,
                              devices=eight_devices)

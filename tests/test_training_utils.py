@@ -75,6 +75,38 @@ def test_make_schedule_shapes():
     assert make_schedule(f, 1.0) is f
 
 
+def test_make_schedule_warmup_convention_total_horizon():
+    """decay_steps counts the TOTAL horizon including warmup, uniformly.
+
+    The optax building blocks disagree (warmup_cosine_decay_schedule's
+    decay_steps includes warmup; a joined linear tail would not) — the
+    factory normalizes to the include-warmup convention for every
+    horizon-style schedule."""
+    # linear: ends exactly at decay_steps, not decay_steps + warmup_steps.
+    lin = make_schedule("linear", 1.0, decay_steps=10, warmup_steps=4,
+                        end_value=0.0)
+    assert float(lin(4)) == pytest.approx(1.0)   # warmup peak
+    assert float(lin(7)) == pytest.approx(0.5)   # halfway through the tail
+    assert float(lin(10)) == pytest.approx(0.0)  # done at the total horizon
+    assert float(lin(14)) == pytest.approx(0.0)
+
+    # piecewise: boundaries stay ABSOLUTE step indices under warmup.
+    piece = make_schedule("piecewise", 1.0, warmup_steps=4,
+                          boundaries_and_scales={6: 0.1})
+    assert float(piece(5)) == pytest.approx(1.0)
+    assert float(piece(7)) == pytest.approx(0.1)
+
+    # Horizon-style schedules reject decay_steps <= warmup_steps ...
+    with pytest.raises(ValueError):
+        make_schedule("cosine", 1.0, decay_steps=4, warmup_steps=4)
+    with pytest.raises(ValueError):
+        make_schedule("linear", 1.0, decay_steps=3, warmup_steps=4)
+    # ... and piecewise rejects boundaries inside the warmup window.
+    with pytest.raises(ValueError):
+        make_schedule("piecewise", 1.0, warmup_steps=4,
+                      boundaries_and_scales={3: 0.1})
+
+
 def test_trainer_with_cosine_schedule_decays_lr():
     trainer = Trainer(
         _tiny_model(), optimizer="sgd", learning_rate=0.1,
