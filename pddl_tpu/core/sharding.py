@@ -15,6 +15,7 @@ This is the honest TPU analogue (sync SPMD rather than async RPC; SURVEY.md
 from __future__ import annotations
 
 import dataclasses
+import functools
 import math
 from typing import Any, Optional
 
@@ -44,6 +45,22 @@ def tree_shardings(mesh: Mesh, tree: PyTree, spec_fn) -> PyTree:
     )
 
 
+@functools.lru_cache(maxsize=None)
+def _factored_mesh(mesh: Mesh, axis_name: str, k: int) -> Mesh:
+    """A two-axis view of ``mesh``'s devices: ``k``-way shard × replicate.
+
+    Same devices in the same order, reshaped ``(k, n_devices // k)`` with
+    axis names ``_<axis>_shard`` / ``_<axis>_repl``. A ``NamedSharding``
+    over this mesh placing a tensor dimension on ``_<axis>_shard`` lowers to
+    GSPMD's ``last_tile_dim_replicate`` layout — ``k``-way sharded, each
+    shard replicated over a contiguous run of ``n // k`` devices — which is
+    how a 2..N-way shard count rides an N-device axis. jit accepts it
+    alongside shardings of the parent mesh (same device assignment).
+    """
+    devices = np.asarray(mesh.devices).reshape(k, -1)
+    return Mesh(devices, (f"_{axis_name}_shard", f"_{axis_name}_repl"))
+
+
 @dataclasses.dataclass(frozen=True)
 class MinSizePartitioner:
     """Shard tensors along one dimension of a mesh axis, min-size gated.
@@ -54,13 +71,15 @@ class MinSizePartitioner:
     ``min_shard_bytes`` and the split does not exceed ``max_shards``;
     otherwise it stays whole (replicated).
 
-    Mapping note: TF returns a free shard *count* (1..max_shards) consumed by
-    the PS runtime; XLA's ``NamedSharding`` tiles a dimension uniformly over
-    the *whole* mesh axis. So sharding here is all-or-nothing per tensor: a
-    tensor is laid out split ``axis_size`` ways exactly when the TF
-    partitioner would have produced ≥ ``axis_size`` shards (which guarantees
-    the per-shard minimum), and is replicated otherwise. ``num_shards``
-    reports the TF-equivalent count for parity checks.
+    Mapping note: TF returns a free shard *count* (1..max_shards) consumed
+    by the PS runtime; XLA requires uniform tiling. The mapping: the TF
+    count is rounded DOWN to the largest divisor of the axis size that also
+    divides one of the tensor's dimensions. A full-axis count shards over
+    the named mesh axis; an intermediate count (2..N-1) shards over a
+    factored sub-axis view of the same devices
+    (``k``-way split, each shard replicated over ``N/k`` neighbours — see
+    :func:`_factored_mesh`); a count of 1 replicates. ``num_shards``
+    reports the raw TF-equivalent count for parity checks.
     """
 
     min_shard_bytes: int = 256 << 10  # 256 KiB, the reference's value (:77)
@@ -78,34 +97,81 @@ class MinSizePartitioner:
         by_size = max(1, nbytes // self.min_shard_bytes)
         return int(min(by_size, limit, max(shape)))
 
-    def spec(self, shape: tuple[int, ...], dtype, axis_size: int) -> PartitionSpec:
-        """PartitionSpec for one tensor: shard its largest dim if it pays.
+    def feasible_shards(
+        self, shape: tuple[int, ...], dtype, axis_size: int
+    ) -> tuple[int, Optional[int]]:
+        """``(count, dim)`` achievable under XLA's even tiling.
 
-        Shards only when splitting ``axis_size`` ways keeps every shard at or
-        above ``min_shard_bytes`` and ``max_shards`` permits ``axis_size``
-        pieces (see class docstring for the TF→XLA mapping).
+        The largest divisor of ``axis_size`` that is ≤ the TF shard count
+        and evenly divides some tensor dimension (largest dimension
+        preferred), with the dimension it splits. ``(1, None)`` when no
+        such split exists.
         """
-        if self.num_shards(shape, dtype, axis_size) < axis_size:
-            return REPLICATED
-        # Shard the largest dimension that tiles the axis evenly; XLA
-        # requires uniform tiling for NamedSharding.
+        n_tf = self.num_shards(shape, dtype, axis_size)
+        if n_tf <= 1:
+            return 1, None
         dims_by_size = sorted(range(len(shape)), key=lambda d: -shape[d])
-        for d in dims_by_size:
-            if shape[d] % axis_size == 0:
-                spec = [None] * (d + 1)
-                spec[d] = self.axis_name
-                return PartitionSpec(*spec)
-        return REPLICATED
+        for n in range(axis_size, 1, -1):
+            if axis_size % n or n > n_tf:
+                continue
+            for d in dims_by_size:
+                if shape[d] % n == 0:
+                    return n, d
+        return 1, None
+
+    def spec(self, shape: tuple[int, ...], dtype, axis_size: int) -> PartitionSpec:
+        """PartitionSpec for one tensor on the NAMED axis (full-axis only).
+
+        ``PartitionSpec`` can only express whole-axis tiling; intermediate
+        shard counts need :meth:`sharding` (which can return a factored
+        sub-axis layout). Kept for callers that must stay on the parent
+        mesh's axis names.
+        """
+        n, d = self.feasible_shards(shape, dtype, axis_size)
+        if n != axis_size:
+            return REPLICATED
+        spec = [None] * (d + 1)
+        spec[d] = self.axis_name
+        return PartitionSpec(*spec)
+
+    def sharding(self, mesh: Mesh, shape: tuple[int, ...], dtype) -> NamedSharding:
+        """The tensor's placement on ``mesh`` — the real partitioner API.
+
+        Full-axis counts tile the named axis; intermediate counts (the
+        reference's 2..N-way middle ground, ``imagenet-resnet50-ps.py:78``)
+        shard a factored view of the same devices; count 1 replicates.
+        Sub-axis layouts require every other mesh axis to be size 1 (pure
+        data-parallel mesh — the PS topology); otherwise the intermediate
+        count falls back to replication.
+        """
+        axis_size = mesh.shape[self.axis_name]
+        n, d = self.feasible_shards(tuple(shape), dtype, axis_size)
+        if n == 1:
+            return NamedSharding(mesh, REPLICATED)
+        if n == axis_size:
+            spec = [None] * (d + 1)
+            spec[d] = self.axis_name
+            return NamedSharding(mesh, PartitionSpec(*spec))
+        if any(s > 1 for a, s in mesh.shape.items() if a != self.axis_name):
+            # Factoring the whole device set would fold other parallelism
+            # axes into the replica groups; stay whole instead.
+            return NamedSharding(mesh, REPLICATED)
+        sub = _factored_mesh(mesh, self.axis_name, n)
+        spec = [None] * (d + 1)
+        spec[d] = f"_{self.axis_name}_shard"
+        return NamedSharding(sub, PartitionSpec(*spec))
 
     def tree_specs(self, tree: PyTree, axis_size: int) -> PyTree:
-        """PartitionSpecs for a whole pytree (params or optimizer state)."""
+        """PartitionSpecs for a whole pytree (full-axis projection)."""
         return jax.tree.map(
             lambda leaf: self.spec(tuple(leaf.shape), leaf.dtype, axis_size), tree
         )
 
     def tree_shardings(self, mesh: Mesh, tree: PyTree) -> PyTree:
-        specs = self.tree_specs(tree, mesh.shape[self.axis_name])
-        return jax.tree.map(lambda spec: NamedSharding(mesh, spec), specs)
+        return jax.tree.map(
+            lambda leaf: self.sharding(mesh, tuple(leaf.shape), leaf.dtype),
+            tree,
+        )
 
 
 def shard_tree(tree: PyTree, shardings: PyTree) -> PyTree:

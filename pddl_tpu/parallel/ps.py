@@ -10,8 +10,12 @@ TPU-native mapping (SURVEY.md §7 "PS capability mapping", documented
 semantic difference): there is no async RPC push/pull on TPU — the analogue
 is **sharded state under sync SPMD**. Variables and optimizer state that
 cross ``min_shard_bytes`` are laid out split along the ``data`` axis
-(ZeRO-style); XLA materializes the all-gather (the "pull") before use and
-the reduce-scatter (the "push") on update, riding ICI instead of gRPC.
+(ZeRO-style) — at the exact shard count the reference's partitioner would
+pick (rounded to a divisor of the axis): full-axis tiling for the big
+tensors, a factored ``k``-way-shard × replicate layout for the 2..N-1
+middle ground (:meth:`MinSizePartitioner.sharding`). XLA materializes the
+all-gather (the "pull") before use and the reduce-scatter (the "push") on
+update, riding ICI instead of gRPC.
 Capability observables preserved: min-size-gated sharding, shard count
 scaling with ``num_ps``, small variables replicated. Semantics are
 synchronous, which strictly strengthens the reference's consistency model.
@@ -81,19 +85,19 @@ class ParameterServerStrategy(Strategy):
         part = self.partitioner
         repl = NamedSharding(mesh, PartitionSpec())
         axis_size = mesh.shape[DATA_AXIS]
-        capped = [0]  # leaves TF would shard but XLA's uniform tiling can't
+        capped = [0]  # leaves TF would shard but XLA's even tiling can't
 
         def shard_leaf(leaf):
             if not hasattr(leaf, "shape") or not hasattr(leaf, "dtype"):
                 return repl
-            n = part.num_shards(tuple(leaf.shape), leaf.dtype, axis_size)
-            spec = part.spec(tuple(leaf.shape), leaf.dtype, axis_size)
-            # TF's partitioner would split this leaf (n > 1) but uniform
-            # XLA tiling can't (shard count capped below the axis size, or
-            # no dimension divides the axis evenly) — it stays replicated.
-            if n > 1 and spec == PartitionSpec():
+            sh = part.sharding(mesh, tuple(leaf.shape), leaf.dtype)
+            # TF's partitioner would split this leaf (count > 1) but no
+            # divisor of the axis size divides any of its dimensions —
+            # even sub-axis tiling can't place it, so it stays whole.
+            if (part.num_shards(tuple(leaf.shape), leaf.dtype, axis_size) > 1
+                    and sh.is_fully_replicated):
                 capped[0] += 1
-            return NamedSharding(mesh, spec)
+            return sh
 
         params_sh = jax.tree.map(shard_leaf, state.params)
         if self.shard_optimizer_state:
@@ -102,12 +106,13 @@ class ParameterServerStrategy(Strategy):
             opt_sh = jax.tree.map(lambda _: repl, state.opt_state)
         if capped[0]:
             log.warning(
-                "%d variable(s) would shard %s-ways under the reference's "
-                "MinSizePartitioner but stay REPLICATED here: NamedSharding "
-                "tiles uniformly over the full %d-device data axis, and "
-                "num_ps/min_shard_bytes cap the shard count below that. "
-                "Raise num_ps (or lower min_shard_bytes) to shard them.",
-                capped[0], f"<{axis_size}", axis_size,
+                "%d variable(s) would shard under the reference's "
+                "MinSizePartitioner but stay REPLICATED here: no even "
+                "split is feasible — no divisor of the %d-device data "
+                "axis that respects the num_ps cap divides any of their "
+                "dimensions (or the mesh has other live axes). Raising "
+                "num_ps or lowering min_shard_bytes may shard them.",
+                capped[0], axis_size,
             )
         return state.replace(
             step=repl,

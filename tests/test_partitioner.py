@@ -1,5 +1,10 @@
 """MinSizePartitioner parity with the reference's PS variable sharding
-(`/root/reference/imagenet-resnet50-ps.py:75-78`)."""
+(`/root/reference/imagenet-resnet50-ps.py:75-78`).
+
+The reference partitioner returns a free shard COUNT in 1..max_shards; the
+XLA mapping realizes that count exactly when it divides the mesh axis
+(full-axis tiling at N, a factored shard×replicate layout for 2..N-1),
+rounding down to the nearest feasible divisor otherwise."""
 
 import jax
 import jax.numpy as jnp
@@ -15,6 +20,7 @@ def test_small_tensor_replicated():
     # MinSizePartitioner returning 1 partition.
     assert part.spec((64,), np.float32, axis_size=8) == P()
     assert part.num_shards((64,), np.float32, 8) == 1
+    assert part.feasible_shards((64,), np.float32, 8) == (1, None)
 
 
 def test_large_tensor_sharded_on_largest_dim():
@@ -23,40 +29,131 @@ def test_large_tensor_sharded_on_largest_dim():
     spec = part.spec((2048, 1024), np.float32, axis_size=8)
     assert spec == P("data")
     assert part.num_shards((2048, 1024), np.float32, 8) == 8
+    assert part.feasible_shards((2048, 1024), np.float32, 8) == (8, 0)
 
 
-def test_max_shards_cap():
+def test_max_shards_cap_shards_subaxis(mesh8):
+    # The reference's max_shards is a free count (:78): a 2-shard cap on an
+    # 8-wide axis must yield a 2-way split (each shard replicated over 4
+    # devices), not replication.
     part = MinSizePartitioner(min_shard_bytes=1, max_shards=2)
     assert part.num_shards((1024, 1024), np.float32, 8) == 2
-    # XLA tiles over the whole axis or not at all: a 2-shard cap on an
-    # 8-wide axis means the tensor stays replicated (never over-sharded).
-    assert part.spec((1024, 1024), np.float32, axis_size=8) == P()
+    assert part.feasible_shards((1024, 1024), np.float32, 8) == (2, 0)
+    sh = part.sharding(mesh8, (1024, 1024), np.float32)
+    placed = jax.device_put(jnp.zeros((1024, 1024)), sh)
+    shard_shapes = {s.data.shape for s in placed.addressable_shards}
+    assert shard_shapes == {(512, 1024)}
+    # Each half lives on a contiguous 4-device run: 8 addressable shards,
+    # 2 distinct halves.
+    starts = {s.index[0].start or 0 for s in placed.addressable_shards}
+    assert starts == {0, 512}
 
 
-def test_min_shard_bytes_floor_respected():
-    # 512 KiB tensor, 256 KiB floor, 8-wide axis: TF would make 2 shards;
-    # tiling 8 ways would give 64 KiB shards (< floor) -> replicate.
+def test_min_shard_bytes_floor_respected(mesh8):
     part = MinSizePartitioner(min_shard_bytes=256 << 10)
     assert part.spec((512 << 8, 512), np.float32, axis_size=2) == P("data")
+    # 512 KiB tensor, 256 KiB floor, 8-wide axis: TF makes 2 shards; the
+    # XLA mapping now realizes exactly that (2-way sub-axis split) instead
+    # of replicating.
+    assert part.feasible_shards((1024, 128), np.float32, 8) == (2, 0)
+    sh = part.sharding(mesh8, (1024, 128), np.float32)
+    placed = jax.device_put(jnp.zeros((1024, 128)), sh)
+    assert {s.data.shape for s in placed.addressable_shards} == {(512, 128)}
+    # The full-axis PartitionSpec projection still can't express it.
     assert part.spec((1024, 128), np.float32, axis_size=8) == P()
 
 
-def test_indivisible_dim_falls_back_replicated():
+def test_intermediate_count_rounds_to_divisor(mesh8):
+    # TF count 6 on an 8-wide axis: 6 doesn't divide 8 -> round down to 4.
+    part = MinSizePartitioner(min_shard_bytes=1, max_shards=6)
+    assert part.num_shards((64, 64), np.float32, 8) == 6
+    assert part.feasible_shards((64, 64), np.float32, 8) == (4, 0)
+    sh = part.sharding(mesh8, (64, 64), np.float32)
+    placed = jax.device_put(jnp.zeros((64, 64)), sh)
+    assert {s.data.shape for s in placed.addressable_shards} == {(16, 64)}
+
+
+def test_indivisible_dim_falls_back_replicated(mesh8):
     part = MinSizePartitioner(min_shard_bytes=1)
-    # 1001 and 3 not divisible by 8 on any dim -> replicate rather than pad.
+    # 1001 and 3 share no factor with 8 on any dim -> replicate, not pad.
     assert part.spec((1001, 3), np.float32, axis_size=8) == P()
+    assert part.feasible_shards((1001, 3), np.float32, 8) == (1, None)
+    assert part.sharding(mesh8, (1001, 3), np.float32).is_fully_replicated
+
+
+def test_odd_dim_picks_divisible_smaller_dim(mesh8):
+    # Largest dim 1000 is not divisible by 8 but is by 4... 1000 = 8*125,
+    # actually divisible; use 999 (27*37): falls through to dim 1 (64).
+    part = MinSizePartitioner(min_shard_bytes=1)
+    n, d = part.feasible_shards((999, 64), np.float32, 8)
+    assert (n, d) == (8, 1)
+    sh = part.sharding(mesh8, (999, 64), np.float32)
+    placed = jax.device_put(jnp.zeros((999, 64)), sh)
+    assert {s.data.shape for s in placed.addressable_shards} == {(999, 8)}
+
+
+def test_subaxis_disabled_on_mixed_mesh(mesh4x2):
+    # A mesh with a live model axis: factoring the whole device set would
+    # fold the model axis into replica groups -> intermediate counts stay
+    # replicated (full-axis tiling still fine).
+    part = MinSizePartitioner(min_shard_bytes=1, max_shards=2)
+    sh = part.sharding(mesh4x2, (64, 64), np.float32)
+    assert sh.is_fully_replicated
+    full = MinSizePartitioner(min_shard_bytes=1)
+    assert part.spec((64, 64), np.float32, 4) == P()
+    assert full.sharding(mesh4x2, (64, 64), np.float32).spec == P("data")
 
 
 def test_tree_shardings_place_params(mesh8):
     part = MinSizePartitioner(min_shard_bytes=1 << 10)
     tree = {
-        "big": jnp.zeros((1024, 64)),  # 256KB -> sharded
-        "tiny": jnp.zeros((16,)),  # 64B -> replicated
+        "big": jnp.zeros((1024, 64)),  # 256KB -> sharded 8-ways
+        "mid": jnp.zeros((512,)),      # 2KB -> TF count 2 -> 2-way split
+        "tiny": jnp.zeros((16,)),      # 64B -> replicated
     }
     shardings = part.tree_shardings(mesh8, tree)
     placed = shard_tree(tree, shardings)
     assert placed["big"].sharding.spec == P("data")
     assert placed["tiny"].sharding.spec == P()
-    # The big leaf is physically split 8 ways.
-    shard_shapes = {s.data.shape for s in placed["big"].addressable_shards}
-    assert shard_shapes == {(128, 64)}
+    assert {s.data.shape for s in placed["big"].addressable_shards} == {(128, 64)}
+    assert {s.data.shape for s in placed["mid"].addressable_shards} == {(256,)}
+
+
+def test_ps_training_converges_with_subaxis_shards(mesh8):
+    """VERDICT r1 #5 done-criterion: a PS config where middle-ground
+    tensors shard 2..N-1 ways on an 8-device mesh and training converges."""
+    from pddl_tpu.data.synthetic import SyntheticImageClassification
+    from pddl_tpu.models.resnet import tiny_resnet
+    from pddl_tpu.parallel.ps import ParameterServerStrategy
+    from pddl_tpu.train.loop import Trainer
+
+    # At 1 KiB the tiny model's params spread over the whole range:
+    # replicated, 2-way, 4-way (sub-axis), and 8-way (full-axis).
+    strategy = ParameterServerStrategy(min_shard_bytes=1 << 10)
+    strategy._mesh = mesh8
+    trainer = Trainer(
+        tiny_resnet(num_classes=10), learning_rate=1e-2, strategy=strategy,
+    )
+    ds = SyntheticImageClassification(
+        batch_size=strategy.scale_batch_size(2), image_size=32,
+        num_classes=10, signal_strength=3.0,
+    )
+    h = trainer.fit(ds, epochs=2, steps_per_epoch=4, verbose=0)
+    losses = h.history["loss"]
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]  # learning under the mixed layout
+
+    # The layout actually contains intermediate shard counts: at least one
+    # parameter leaf is neither replicated nor full-axis (its sharding
+    # mesh carries the factored _data_shard axis).
+    subaxis = [
+        leaf for leaf in jax.tree.leaves(trainer.state.params)
+        if "_data_shard" in leaf.sharding.mesh.axis_names
+        and not leaf.sharding.is_fully_replicated
+    ]
+    assert subaxis, "expected some 2..N-1-way sharded parameters"
+    full = [
+        leaf for leaf in jax.tree.leaves(trainer.state.params)
+        if "data" in jax.tree.leaves(tuple(leaf.sharding.spec))
+    ]
+    assert full, "expected some full-axis sharded parameters"
