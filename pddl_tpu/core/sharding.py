@@ -119,6 +119,13 @@ class MinSizePartitioner:
                     return n, d
         return 1, None
 
+    @staticmethod
+    def _dim_spec(d: int, axis: str) -> PartitionSpec:
+        """PartitionSpec placing tensor dimension ``d`` on mesh axis ``axis``."""
+        spec = [None] * (d + 1)
+        spec[d] = axis
+        return PartitionSpec(*spec)
+
     def spec(self, shape: tuple[int, ...], dtype, axis_size: int) -> PartitionSpec:
         """PartitionSpec for one tensor on the NAMED axis (full-axis only).
 
@@ -130,9 +137,7 @@ class MinSizePartitioner:
         n, d = self.feasible_shards(shape, dtype, axis_size)
         if n != axis_size:
             return REPLICATED
-        spec = [None] * (d + 1)
-        spec[d] = self.axis_name
-        return PartitionSpec(*spec)
+        return self._dim_spec(d, self.axis_name)
 
     def sharding(self, mesh: Mesh, shape: tuple[int, ...], dtype) -> NamedSharding:
         """The tensor's placement on ``mesh`` — the real partitioner API.
@@ -149,17 +154,13 @@ class MinSizePartitioner:
         if n == 1:
             return NamedSharding(mesh, REPLICATED)
         if n == axis_size:
-            spec = [None] * (d + 1)
-            spec[d] = self.axis_name
-            return NamedSharding(mesh, PartitionSpec(*spec))
+            return NamedSharding(mesh, self._dim_spec(d, self.axis_name))
         if any(s > 1 for a, s in mesh.shape.items() if a != self.axis_name):
             # Factoring the whole device set would fold other parallelism
             # axes into the replica groups; stay whole instead.
             return NamedSharding(mesh, REPLICATED)
         sub = _factored_mesh(mesh, self.axis_name, n)
-        spec = [None] * (d + 1)
-        spec[d] = f"_{self.axis_name}_shard"
-        return NamedSharding(sub, PartitionSpec(*spec))
+        return NamedSharding(sub, self._dim_spec(d, f"_{self.axis_name}_shard"))
 
     def tree_specs(self, tree: PyTree, axis_size: int) -> PyTree:
         """PartitionSpecs for a whole pytree (full-axis projection)."""

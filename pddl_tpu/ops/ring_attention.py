@@ -113,45 +113,52 @@ def ring_attention_flash(
     n = lax.axis_size(axis_name)
     my = lax.axis_index(axis_name)
     scale_v = (1.0 / math.sqrt(d)) if scale is None else scale
+    perm = [(j, (j + 1) % n) for j in range(n)]
 
-    def step(i, carry):
-        m, s, acc, kc, vc = carry
-        src = (my - i) % n
-
-        def diag(_):
-            return flash_attention_lse(q, kc, vc, causal=True, scale=scale_v)
-
-        def full(_):
-            return flash_attention_lse(q, kc, vc, causal=False, scale=scale_v)
-
-        def skip(_):
-            return (jnp.zeros((b, h, s_local, d), q.dtype),
-                    jnp.full((b, h, s_local), NEG_INF, jnp.float32))
-
-        if causal:
-            o_i, lse_i = lax.cond(
-                src == my, diag,
-                lambda _: lax.cond(src < my, full, skip, None), None)
-        else:
-            o_i, lse_i = full(None)
-
+    def merge(m, s, acc, o_i, lse_i):
         m_new = jnp.maximum(m, lse_i)
         alpha = jnp.exp(m - m_new)
         w = jnp.exp(lse_i - m_new)
         s = s * alpha + w
         acc = acc * alpha[..., None] + o_i.astype(jnp.float32) * w[..., None]
-        perm = [(j, (j + 1) % n) for j in range(n)]
+        return m_new, s, acc
+
+    def step(i, carry):
+        m, s, acc, kc, vc = carry
+        # The visiting shard originated on src = my - i (mod n); for
+        # i >= 1 it is never the diagonal: strictly past iff my >= i.
+        o_i, lse_i = flash_attention_lse(q, kc, vc, causal=False,
+                                         scale=scale_v)
+        if causal:
+            keep = (my - i) % n < my
+            # Future shards contribute nothing: -inf lse makes their merge
+            # weight w == 0, which also zeroes o_i. The kernel still runs
+            # on those devices — the per-rotation ppermute barrier means
+            # the busiest device sets each rotation's wall-clock, so the
+            # wasted flops cost no time. Masking instead of lax.cond also
+            # removes one of the two check_vma blockers; the kernel's own
+            # internals remain the other (see sequence_parallel_attention).
+            lse_i = jnp.where(keep, lse_i, NEG_INF)
+        m, s, acc = merge(m, s, acc, o_i, lse_i)
         kc = lax.ppermute(kc, axis_name, perm)
         vc = lax.ppermute(vc, axis_name, perm)
-        return m_new, s, acc, kc, vc
+        return m, s, acc, kc, vc
 
     def _vary(x):
         return lax.pcast(x, axis_name, to="varying")
 
+    # Rotation 0 always sees the device's own K/V shard (src == my). Under
+    # causal that is the diagonal block, which needs row-level masking
+    # INSIDE the kernel — selecting the causal kernel statically here
+    # removes the data-dependent branch entirely.
+    o0, lse0 = flash_attention_lse(q, k, v, causal=causal, scale=scale_v)
     m0 = _vary(jnp.full((b, h, s_local), NEG_INF, jnp.float32))
     s0 = _vary(jnp.zeros((b, h, s_local), jnp.float32))
     acc0 = _vary(jnp.zeros((b, h, s_local, d), jnp.float32))
-    m, s, acc, _, _ = lax.fori_loop(0, n, step, (m0, s0, acc0, k, v))
+    m, s, acc = merge(m0, s0, acc0, o0, lse0)
+    kc = lax.ppermute(k, axis_name, perm)
+    vc = lax.ppermute(v, axis_name, perm)
+    m, s, acc, _, _ = lax.fori_loop(1, n, step, (m, s, acc, kc, vc))
     return (acc / jnp.maximum(s, 1e-30)[..., None]).astype(q.dtype)
 
 
@@ -174,9 +181,14 @@ def sequence_parallel_attention(
     inner = ring_attention_flash if use_flash else ring_attention
     fn = functools.partial(inner, axis_name=axis_name,
                            causal=causal, scale=scale)
-    # check_vma: the varying-manual-axes checker rejects the pallas call
-    # inside the flash path's lax.cond (kernel-internal slices mix varying
-    # and invariant operands); the computation itself is per-shard pure.
+    # check_vma: the flash ring is branch-free (the former lax.cond around
+    # the pallas call is gone), but the varying-axes checker still cannot
+    # see through the pallas kernel itself: its internal dynamic_slices mix
+    # varying ref data with invariant grid indices, and jax's own error
+    # says to "pass the check_vma=False argument" until that propagation
+    # exists. tests/test_attention.py::test_flash_ring_check_vma_limitation
+    # pins the exact failure so a jax upgrade that fixes it flips this
+    # flag. The XLA ring path runs fully checked.
     return jax.shard_map(
         fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
         check_vma=not use_flash,
