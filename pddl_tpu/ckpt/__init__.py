@@ -16,6 +16,9 @@ package provides that and the mid-training story the reference lacks
   callbacks for periodic saving and crash-resume.
 - :func:`load_keras_resnet50_h5` — imports ``tf.keras.applications``
   ResNet-50 ``.h5`` weights into the Flax model for the pretrained mode.
+- :func:`fetch_keras_resnet50_weights` — resolves (and, on explicit
+  opt-in, downloads) the official keras-applications weight file with MD5
+  verification, making ``weights='imagenet'`` runnable end to end.
 """
 
 from pddl_tpu.ckpt.checkpoint import (
@@ -24,6 +27,7 @@ from pddl_tpu.ckpt.checkpoint import (
     ModelCheckpoint,
     latest_epoch,
 )
+from pddl_tpu.ckpt.fetch import fetch_keras_resnet50_weights
 from pddl_tpu.ckpt.keras_import import load_keras_resnet50_h5
 
 __all__ = [
@@ -31,5 +35,6 @@ __all__ = [
     "ModelCheckpoint",
     "BackupAndRestore",
     "latest_epoch",
+    "fetch_keras_resnet50_weights",
     "load_keras_resnet50_h5",
 ]

@@ -31,6 +31,13 @@ class ExperimentConfig:
     model: str = "resnet50"
     num_classes: int = 1000
     pretrained_h5: Optional[str] = None  # weights='imagenet' analogue: local .h5
+    # The reference's weights='imagenet' itself
+    # (imagenet-pretrained-resnet50.py:56): when set and pretrained_h5 is
+    # not, the official keras-applications file is resolved from the local
+    # cache (ckpt/fetch.py), downloading only with download_weights=True —
+    # TPU hosts can't be assumed to have egress.
+    weights: Optional[str] = None
+    download_weights: bool = False  # explicit opt-in (--download-weights)
     bn_mode: str = "train"  # "frozen" reproduces the reference's training=False
     compute_dtype: str = "bfloat16"
     # transformer families only: activation rematerialization policy
@@ -87,9 +94,10 @@ class ExperimentConfig:
         return dataclasses.replace(self, **kw)
 
 
-# One preset per reference script. `weights_required` marks the pretrained
-# variants (they need --pretrained-h5 since TPU hosts can't download Keras
-# weights implicitly).
+# One preset per reference script. The pretrained variants carry
+# weights="imagenet" like the reference; the file resolves from the local
+# cache (or --pretrained-h5 / --download-weights — TPU hosts can't download
+# Keras weights implicitly).
 PRESETS: Dict[str, ExperimentConfig] = {
     # imagenet-resnet50.py — single device, from scratch
     "single": ExperimentConfig(
@@ -97,7 +105,7 @@ PRESETS: Dict[str, ExperimentConfig] = {
     ),
     # imagenet-pretrained-resnet50.py — single device, frozen-BN fine-tune
     "single-pretrained": ExperimentConfig(
-        name="ResNet50_ImageNet_pretrained", strategy="single",
+        name="ResNet50_ImageNet_pretrained", weights="imagenet", strategy="single",
         per_replica_batch=32, bn_mode="frozen",
     ),
     # imagenet-resnet50-mirror.py — single-host sync DP, 32×replicas
@@ -107,7 +115,7 @@ PRESETS: Dict[str, ExperimentConfig] = {
     ),
     # imagenet-pretrained-resnet50-mirror.py
     "mirrored-pretrained": ExperimentConfig(
-        name="ResNet50_ImageNet_mirror_pretrained", strategy="mirrored",
+        name="ResNet50_ImageNet_mirror_pretrained", weights="imagenet", strategy="mirrored",
         per_replica_batch=32, bn_mode="frozen",
     ),
     # imagenet-resnet50-multiworkers.py — multi-host DP, 128×n train/256×n val
@@ -117,7 +125,7 @@ PRESETS: Dict[str, ExperimentConfig] = {
     ),
     # imagenet-pretrained-resnet50-multiworkers.py — 32×n both, frozen BN
     "multiworker-pretrained": ExperimentConfig(
-        name="ResNet50_ImageNet_multiworker_pretrained", strategy="multiworker",
+        name="ResNet50_ImageNet_multiworker_pretrained", weights="imagenet", strategy="multiworker",
         per_replica_batch=32, bn_mode="frozen",
     ),
     # imagenet-resnet50-hvd.py — DP with hvd semantics: LR 0.1×size,

@@ -230,13 +230,27 @@ def run_experiment(cfg: ExperimentConfig, steps_per_epoch: Optional[int] = None,
     data → model → strategy → fit(callbacks) → save. Returns the History."""
     from pddl_tpu.train.loop import Trainer  # noqa: F401 (import check)
 
+    # weights='imagenet' mode: an explicit local .h5 wins; otherwise the
+    # preset's weights="imagenet" resolves the official keras-applications
+    # file for cfg.model from the cache (ckpt/fetch.py — download only on
+    # explicit opt-in, with the offline procedure in the error text
+    # otherwise). Resolved FIRST: a missing file must fail in under a
+    # second, not after minutes of multi-host mesh/data setup.
+    h5_path = cfg.pretrained_h5
+    if not h5_path and cfg.weights == "imagenet":
+        from pddl_tpu.ckpt.fetch import fetch_keras_resnet50_weights
+
+        h5_path = fetch_keras_resnet50_weights(
+            model=cfg.model, download=cfg.download_weights
+        )
+
     trainer, callbacks = build_trainer(cfg)
     strategy = trainer.strategy
     strategy.setup()
     train, val = build_data(cfg, strategy)
 
-    if cfg.pretrained_h5:  # weights='imagenet' mode, from a local file
-        _load_pretrained(trainer, cfg, train)
+    if h5_path:
+        _load_pretrained(trainer, cfg, train, h5_path)
 
     initial_epoch = 0
     if cfg.resume and cfg.checkpoint_dir:
@@ -305,7 +319,8 @@ def run_experiment(cfg: ExperimentConfig, steps_per_epoch: Optional[int] = None,
     return history
 
 
-def _load_pretrained(trainer, cfg: ExperimentConfig, train_data) -> None:
+def _load_pretrained(trainer, cfg: ExperimentConfig, train_data,
+                     h5_path: str) -> None:
     """Init state then overwrite backbone params from the Keras .h5."""
     import jax
 
@@ -315,7 +330,14 @@ def _load_pretrained(trainer, cfg: ExperimentConfig, train_data) -> None:
     trainer.init_state(first)
     variables = {"params": trainer.state.params,
                  "batch_stats": trainer.state.batch_stats}
-    loaded = load_keras_resnet50_h5(cfg.pretrained_h5, variables)
+    # Block counts per family so resnet101/152 imports map the right tree
+    # (models/resnet.py:208-209).
+    stage_sizes = {
+        "resnet101": (3, 4, 23, 3),
+        "resnet152": (3, 8, 36, 3),
+    }.get(cfg.model, (3, 4, 6, 3))
+    loaded = load_keras_resnet50_h5(h5_path, variables,
+                                    stage_sizes=stage_sizes)
     # Re-place with the strategy's shardings preserved.
     params = jax.tree.map(
         lambda new, old: jax.device_put(new, old.sharding),
@@ -395,7 +417,14 @@ def main(argv=None) -> int:
                    help="TP degree (tensor_parallel/expert_parallel only)")
     p.add_argument("--expert-parallel", type=int, default=None,
                    help="EP degree (expert_parallel only)")
-    p.add_argument("--pretrained-h5", default=None)
+    p.add_argument("--pretrained-h5", default=None,
+                   help="local keras-style weight .h5; overrides the "
+                        "preset's weights='imagenet' cache lookup")
+    p.add_argument("--download-weights", action="store_true",
+                   help="allow fetching the official keras-applications "
+                        "weight file into the cache when absent "
+                        "(ckpt/fetch.py; off by default — TPU hosts may "
+                        "have no egress)")
     p.add_argument("--checkpoint-dir", default=None)
     p.add_argument("--resume", action="store_true")
     p.add_argument("--save", dest="save_path", default=None)
@@ -443,6 +472,8 @@ def main(argv=None) -> int:
         overrides["lr_schedule_options"] = schedule_opts
     if args.resume:
         overrides["resume"] = True
+    if args.download_weights:
+        overrides["download_weights"] = True
     if args.synthetic:
         overrides["data_dir"] = None
 

@@ -1,0 +1,105 @@
+"""Pretrained-weight acquisition (`ckpt/fetch.py`) — the explicit-opt-in
+analogue of the reference's implicit ``weights='imagenet'`` download
+(`/root/reference/imagenet-pretrained-resnet50.py:56`)."""
+
+import hashlib
+
+import pytest
+
+from pddl_tpu.ckpt.fetch import (
+    KERAS_RESNET_WEIGHTS,
+    fetch_keras_resnet50_weights,
+)
+
+
+def test_missing_file_error_is_the_offline_procedure(tmp_path):
+    with pytest.raises(FileNotFoundError) as ei:
+        fetch_keras_resnet50_weights(cache_dir=str(tmp_path))
+    msg = str(ei.value)
+    # The error must hand the user the exact acquisition command.
+    assert "curl" in msg
+    assert "resnet50_weights_tf_dim_ordering_tf_kernels_notop.h5" in msg
+    assert "storage.googleapis.com" in msg
+    assert KERAS_RESNET_WEIGHTS["resnet50"]["notop"][1] in msg  # the MD5
+
+
+def test_cached_file_verified_and_returned(tmp_path, monkeypatch):
+    payload = b"pretend-weights"
+    name = "resnet50_weights_tf_dim_ordering_tf_kernels_notop.h5"
+    (tmp_path / name).write_bytes(payload)
+    # A wrong file must not be silently accepted.
+    with pytest.raises(ValueError, match="MD5 mismatch"):
+        fetch_keras_resnet50_weights(cache_dir=str(tmp_path))
+    # With the published hash patched to the payload's, the cache hit wins
+    # (no network involved).
+    monkeypatch.setitem(
+        KERAS_RESNET_WEIGHTS["resnet50"],
+        "notop", (name, hashlib.md5(payload).hexdigest()),
+    )
+    path = fetch_keras_resnet50_weights(cache_dir=str(tmp_path))
+    assert path == str(tmp_path / name)
+    # verify=False skips hashing entirely (restore the real constant).
+    monkeypatch.setitem(
+        KERAS_RESNET_WEIGHTS["resnet50"],
+        "notop", (name, "0" * 32),
+    )
+    assert fetch_keras_resnet50_weights(
+        cache_dir=str(tmp_path), verify=False
+    ) == str(tmp_path / name)
+
+
+def test_download_opt_in(tmp_path, monkeypatch):
+    payload = b"downloaded-weights"
+    name = "resnet50_weights_tf_dim_ordering_tf_kernels_notop.h5"
+    monkeypatch.setitem(
+        KERAS_RESNET_WEIGHTS["resnet50"],
+        "notop", (name, hashlib.md5(payload).hexdigest()),
+    )
+    fetched_urls = []
+
+    def fake_urlretrieve(url, dst):
+        fetched_urls.append(url)
+        with open(dst, "wb") as f:
+            f.write(payload)
+
+    monkeypatch.setattr("urllib.request.urlretrieve", fake_urlretrieve)
+    path = fetch_keras_resnet50_weights(
+        cache_dir=str(tmp_path), download=True
+    )
+    assert (tmp_path / name).read_bytes() == payload
+    assert fetched_urls == [
+        "https://storage.googleapis.com/tensorflow/keras-applications/"
+        "resnet/" + name
+    ]
+    # Second call: cache hit, no new fetch.
+    fetch_keras_resnet50_weights(cache_dir=str(tmp_path), download=True)
+    assert len(fetched_urls) == 1
+    assert path == str(tmp_path / name)
+
+
+def test_unknown_variant_raises():
+    with pytest.raises(ValueError, match="unknown weights"):
+        fetch_keras_resnet50_weights(variant="bottom")
+    with pytest.raises(ValueError, match="unknown weights"):
+        fetch_keras_resnet50_weights(model="resnet34")
+
+
+def test_pretrained_preset_resolves_through_fetch(tmp_path, monkeypatch):
+    """run_experiment on a pretrained preset reaches the fetch helper and
+    surfaces its offline procedure when the cache is cold (wiring check for
+    `--preset single-pretrained` from a clean machine)."""
+    from pddl_tpu.config import get_preset
+    from pddl_tpu.run import run_experiment
+
+    monkeypatch.setenv("PDDL_TPU_CACHE", str(tmp_path))
+    cfg = get_preset("single-pretrained", steps_per_epoch=1, epochs=1,
+                     verbose=0)
+    assert cfg.weights == "imagenet"
+    # Resolution is hoisted above model/mesh/data construction, so the
+    # cold-cache failure is immediate (no ResNet-50 init happens first).
+    with pytest.raises(FileNotFoundError, match="curl"):
+        run_experiment(cfg)
+    # Families without published keras weights fail with a clear error
+    # instead of silently fetching the ResNet-50 file.
+    with pytest.raises(ValueError, match="unknown weights"):
+        run_experiment(cfg.replace(model="tiny_resnet", num_classes=10))
