@@ -36,16 +36,28 @@ class Checkpointer:
     """
 
     def __init__(self, directory: str, max_to_keep: Optional[int] = 5,
-                 async_save: bool = True):
+                 async_save: bool = True, read_only: bool = False):
         ocp = _ocp()
         self.directory = os.path.abspath(directory)
-        self._mngr = ocp.CheckpointManager(
-            self.directory,
-            options=ocp.CheckpointManagerOptions(
+        if read_only:
+            # Readers must never mutate a (possibly live) directory: no tmp
+            # cleanup, no retention GC, no writes. A second writing manager
+            # on the same directory races the real one's in-flight saves.
+            options = ocp.CheckpointManagerOptions(read_only=True)
+        else:
+            options = ocp.CheckpointManagerOptions(
                 max_to_keep=max_to_keep,
                 enable_async_checkpointing=async_save,
-            ),
-        )
+                # A crash/SIGKILL mid-save leaves
+                # '<step>.orbax-checkpoint-tmp' behind; a resumed run
+                # re-saves the SAME step (it restores the epoch the crash
+                # interrupted), and writing into the stale tmp dir races to
+                # FileNotFoundError. Clean leftovers at init (primary-gated,
+                # awaited before the first save). Caught by the
+                # multi-process kill/resume test.
+                cleanup_tmp_directories=True,
+            )
+        self._mngr = ocp.CheckpointManager(self.directory, options=options)
 
     # ---------------------------------------------------------------- save
     def save(self, state: PyTree, epoch: Optional[int] = None,
@@ -114,7 +126,7 @@ def latest_epoch(directory: str) -> Optional[int]:
     computing ``initial_epoch`` on resume), or None if no checkpoint."""
     if not os.path.isdir(directory):
         return None
-    ckpt = Checkpointer(directory, async_save=False)
+    ckpt = Checkpointer(directory, async_save=False, read_only=True)
     try:
         if ckpt.latest_step() is None:
             return None

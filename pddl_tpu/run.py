@@ -14,6 +14,7 @@ with working flags (the reference's own argparse attempt used broken names
 from __future__ import annotations
 
 import argparse
+import os
 import sys
 from typing import Optional
 
@@ -244,9 +245,15 @@ def run_experiment(cfg: ExperimentConfig, steps_per_epoch: Optional[int] = None,
             model=cfg.model, download=cfg.download_weights
         )
 
-    trainer, callbacks = build_trainer(cfg)
-    strategy = trainer.strategy
+    # The strategy bootstraps FIRST: jax.distributed.initialize (inside
+    # setup) must run before anything that can initialize the XLA backend,
+    # and build_trainer's checkpoint-callback branch imports orbax, which
+    # does. Caught by the multi-process kill/resume test.
+    from pddl_tpu.parallel.base import get_strategy
+
+    strategy = get_strategy(cfg.strategy, **cfg.strategy_options)
     strategy.setup()
+    trainer, callbacks = build_trainer(cfg, strategy)
     train, val = build_data(cfg, strategy)
 
     if h5_path:
@@ -359,6 +366,17 @@ def _load_pretrained(trainer, cfg: ExperimentConfig, train_data,
 
 
 def main(argv=None) -> int:
+    # Honor the standard JAX_PLATFORMS env contract even when a site
+    # plugin (e.g. a test-harness sitecustomize) pinned jax_platforms in
+    # config at interpreter boot — config beats env in jax, so without
+    # this a worker launched with JAX_PLATFORMS=cpu silently lands on the
+    # pinned platform, with the wrong device count AND process_index=0 on
+    # every host (which breaks any primary-host-gated coordination, e.g.
+    # orbax checkpoint finalization). Must run before backend init.
+    if os.environ.get("JAX_PLATFORMS"):
+        import jax
+
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
     p = argparse.ArgumentParser(
         prog="pddl_tpu",
         description="TPU-native ResNet/ImageNet distributed training "

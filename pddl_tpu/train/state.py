@@ -314,7 +314,15 @@ def set_learning_rate(state: TrainState, value: float) -> TrainState:
         if hasattr(opt_state, "hyperparams") and "learning_rate" in opt_state.hyperparams:
             old = opt_state.hyperparams["learning_rate"]
             new_hp = dict(opt_state.hyperparams)
-            new_hp["learning_rate"] = jnp.asarray(value, dtype=jnp.asarray(old).dtype)
+            # A HOST (numpy) scalar, not jnp: a device scalar created here
+            # is host-local (SingleDeviceSharding), which a multi-host
+            # checkpoint save rejects; every process computes the same
+            # value, and the jitted step re-places it per the state
+            # sharding anyway.
+            import numpy as _np
+
+            new_hp["learning_rate"] = _np.asarray(
+                value, dtype=jnp.asarray(old).dtype)
             return opt_state._replace(hyperparams=new_hp)
         if isinstance(opt_state, tuple):
             subs = [_set(s) for s in opt_state]

@@ -1,10 +1,11 @@
-"""Child process for the multi-process bootstrap test (not a pytest file).
+"""Child process for the multi-process bootstrap tests (not a pytest file).
 
-Each of the 2 processes owns 2 fake CPU devices; together they form the
-4-device global mesh. This is the JAX analogue of the reference's
-in-process gRPC cluster trick (``/root/reference/imagenet-resnet50-ps.py:31-65``)
-— a genuine multi-process topology on one machine, no hardware needed
-(SURVEY.md §4 mechanism 1).
+Each process owns ``PDDL_TEST_LOCAL_DEVICES`` (default 2) fake CPU devices;
+``PDDL_NUM_PROCESSES`` of them form the global mesh. This is the JAX
+analogue of the reference's in-process gRPC cluster trick
+(``/root/reference/imagenet-resnet50-ps.py:31-65``) — a genuine
+multi-process topology on one machine, no hardware needed (SURVEY.md §4
+mechanism 1).
 
 Run by tests/test_multiprocess.py with PDDL_COORDINATOR / PDDL_NUM_PROCESSES
 / PDDL_PROCESS_ID set; exits non-zero on any assertion failure.
@@ -13,9 +14,11 @@ Run by tests/test_multiprocess.py with PDDL_COORDINATOR / PDDL_NUM_PROCESSES
 import os
 import sys
 
+_LOCAL = int(os.environ.get("PDDL_TEST_LOCAL_DEVICES", "2"))
+
 os.environ["XLA_FLAGS"] = (
     os.environ.get("XLA_FLAGS", "")
-    + " --xla_force_host_platform_device_count=2"
+    + f" --xla_force_host_platform_device_count={_LOCAL}"
 )
 
 import jax  # noqa: E402
@@ -29,13 +32,16 @@ import numpy as np  # noqa: E402
 def main() -> None:
     from pddl_tpu.core import dist
 
+    n_procs = int(os.environ["PDDL_NUM_PROCESSES"])
+    world = n_procs * _LOCAL
+
     # Bootstrap purely from PDDL_* env (discovery order step 2 in core/dist).
     spec = dist.initialize()
     assert spec.is_multiprocess, spec
-    assert spec.num_processes == 2, spec
-    assert jax.process_count() == 2
-    assert len(jax.local_devices()) == 2
-    assert len(jax.devices()) == 4
+    assert spec.num_processes == n_procs, spec
+    assert jax.process_count() == n_procs
+    assert len(jax.local_devices()) == _LOCAL
+    assert len(jax.devices()) == world
     assert dist.is_coordinator() == (jax.process_index() == 0)
 
     # The multiworker strategy over the global mesh (idempotent re-init).
@@ -43,41 +49,43 @@ def main() -> None:
 
     strategy = MultiWorkerMirroredStrategy()
     mesh = strategy.setup()
-    assert mesh.devices.size == 4
-    assert strategy.num_workers == 2
-    assert strategy.num_replicas_in_sync == 4
+    assert mesh.devices.size == world
+    assert strategy.num_workers == n_procs
+    assert strategy.num_replicas_in_sync == world
     # Reference batch arithmetic at multi-host scale: 32 * replicas
     # (imagenet-resnet50-multiworkers.py:70).
-    assert strategy.scale_batch_size(32) == 128
+    assert strategy.scale_batch_size(32) == 32 * world
 
-    # DATA-sharded feeding: each process contributes its local half; the
-    # assembled array is the 4-row global batch.
-    local = np.full((2, 3), float(jax.process_index()), np.float32)
+    # DATA-sharded feeding: each process contributes its local rows; the
+    # assembled array is the world-sized global batch.
+    local = np.full((_LOCAL, 3), float(jax.process_index()), np.float32)
     batch = strategy.distribute_batch({"image": local})
-    assert batch["image"].shape == (4, 3)
+    assert batch["image"].shape == (world, 3)
 
     # A real cross-process collective (the NCCL-allreduce moment): global
-    # mean over the whole array = mean of process ids = 0.5.
+    # mean over the whole array = mean of process ids.
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     mean = jax.jit(
         jnp.mean, out_shardings=NamedSharding(mesh, P())
     )(batch["image"])
-    np.testing.assert_allclose(np.asarray(mean), 0.5, atol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(mean), (n_procs - 1) / 2.0, atol=1e-6)
 
-    # hvd-shim host collectives across the two real processes.
+    # hvd-shim host collectives across the real processes.
     from pddl_tpu.compat import hvd
 
     hvd._mesh = mesh  # the cluster is already up via dist.initialize
     summed = hvd.allreduce(np.float32(jax.process_index()), average=False)
-    np.testing.assert_allclose(np.asarray(summed), 1.0)  # 0 + 1
+    np.testing.assert_allclose(
+        np.asarray(summed), n_procs * (n_procs - 1) / 2.0)
     gathered = hvd.allgather(np.full((2,), float(jax.process_index()),
                                      np.float32))
-    np.testing.assert_array_equal(np.asarray(gathered),
-                                  np.asarray([0.0, 0.0, 1.0, 1.0]))
+    expect = np.repeat(np.arange(n_procs, dtype=np.float32), 2)
+    np.testing.assert_array_equal(np.asarray(gathered), expect)
 
     # One real training step through the Trainer (grad all-reduce across
-    # both processes compiled into the step).
+    # all processes compiled into the step).
     from pddl_tpu.data.synthetic import SyntheticImageClassification
     from pddl_tpu.models.resnet import tiny_resnet
     from pddl_tpu.train.loop import Trainer
