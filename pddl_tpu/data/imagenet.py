@@ -77,7 +77,13 @@ class ImageNetConfig:
     shuffle_buffer: int = 2048
     seed: int = 0
     drop_remainder: bool = True  # reference batches drop_remainder=True (:46)
-    repeat: bool = False  # .repeat()ed streams à la the PS script (:118-119)
+    # .repeat()ed streams à la the PS script (:118-119). Usually
+    # unnecessary: under steps_per_epoch the Trainer already re-iterates a
+    # finite dataset when it drains (fresh __iter__ per pass). With
+    # repeat=True the tf.data stream is endless, so the trainer-level
+    # re-iteration never engages and epoch-boundary reshuffling is tf's
+    # reshuffle_each_iteration instead of a fresh pipeline pass.
+    repeat: bool = False
     cache: bool = False
     dtype: str = "float32"
 

@@ -323,8 +323,25 @@ class Trainer:
                     # Continuous stream: ONE persistent feed across epochs
                     # (recreating it each epoch would drop the batches the
                     # prefetcher already pulled from the shared iterator).
+                    # A finite RE-ITERABLE dataset repeats when it drains —
+                    # the reference's own `.repeat()` + fixed steps_per_epoch
+                    # pattern (imagenet-resnet50-ps.py:118-119,143) without
+                    # the caller spelling it; each re-pass is a fresh
+                    # __iter__ (so per-epoch reshuffles apply). One-shot
+                    # iterators still just end.
                     if continuous_feed is None:
-                        continuous_feed = make_feed(train_iter)
+                        def _repeating(first_iter, data=train_data):
+                            it = first_iter
+                            while True:
+                                yielded = False
+                                for b in it:
+                                    yielded = True
+                                    yield b
+                                if isinstance(data, Iterator) or not yielded:
+                                    return
+                                it = iter(data)
+
+                        continuous_feed = make_feed(_repeating(train_iter))
                     feed = continuous_feed
                 elif epoch == initial_epoch:
                     # First epoch must include the batch consumed by

@@ -18,6 +18,8 @@ def test_examples_run(tmp_path):
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    # Scripts with a full-scale default (real_data_convergence) run tiny.
+    env["PDDL_EXAMPLE_SMOKE"] = "1"
     # A site plugin inherited via PYTHONPATH (e.g. a TPU tunnel's
     # sitecustomize) can pin the platform and defeat JAX_PLATFORMS; an
     # empty sitecustomize FIRST on the path shadows it so the children
@@ -32,8 +34,12 @@ def test_examples_run(tmp_path):
     logs = {}
     for script in _EXAMPLES:
         logs[script] = open(tmp_path / f"{script}.log", "w+")
+        # Isolate mutable state per test run: the convergence example's
+        # default work dir is a fixed /tmp path shared across sessions.
+        extra = (["--work-dir", str(tmp_path / "real_data_work")]
+                 if script == "real_data_convergence.py" else [])
         procs[script] = subprocess.Popen(
-            [sys.executable, os.path.join(_ROOT, "examples", script)],
+            [sys.executable, os.path.join(_ROOT, "examples", script), *extra],
             env=env, cwd=_ROOT, stdout=logs[script],
             stderr=subprocess.STDOUT, text=True,
         )

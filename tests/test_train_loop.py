@@ -140,6 +140,35 @@ def test_one_shot_iterator_multi_epoch_raises():
         tr.fit(iter([ds.batch(0), ds.batch(1)]), epochs=2, verbose=0)
 
 
+def test_finite_reiterable_repeats_under_steps_per_epoch():
+    """A finite re-iterable dataset + steps_per_epoch repeats implicitly:
+    the reference's `.repeat()` + fixed-steps pattern
+    (imagenet-resnet50-ps.py:118-119,143). 4 epochs x 3 steps = 12 steps
+    must train through a 5-batch dataset (2.4 passes)."""
+    ds = _dataset(16)
+    passes = []
+
+    class Finite:
+        def __iter__(self):
+            passes.append(len(passes))
+            return iter([ds.batch(i) for i in range(5)])
+
+    tr = Trainer(tiny_resnet(num_classes=10), learning_rate=1e-2,
+                 strategy=SingleDeviceStrategy())
+    h = tr.fit(Finite(), epochs=4, steps_per_epoch=3, verbose=0)
+    assert int(jax.device_get(tr.state.step)) == 12
+    assert len(h.epoch) == 4
+    assert len(passes) >= 3  # the dataset really was re-iterated
+
+    # A one-shot ITERATOR under steps_per_epoch still just ends: the epoch
+    # that receives nothing raises rather than silently spinning.
+    tr2 = Trainer(tiny_resnet(num_classes=10), learning_rate=1e-2,
+                  strategy=SingleDeviceStrategy())
+    with pytest.raises(ValueError, match="empty training dataset"):
+        tr2.fit(iter([ds.batch(i) for i in range(4)]), epochs=3,
+                steps_per_epoch=3, verbose=0)
+
+
 def test_determinism_same_seed_bitwise():
     """Same seed -> bitwise-equal params after N steps (SURVEY.md §5 race
     detection: functional purity + fixed PRNG keys replace TSAN)."""
