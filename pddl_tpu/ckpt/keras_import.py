@@ -105,11 +105,32 @@ def _set_leaf(tree: dict, path: Tuple[str, ...], leaf_name: str,
         )
     old = node[leaf_name]
     if tuple(old.shape) != tuple(value.shape):
-        raise ValueError(
-            f"importing {source} -> {'/'.join(path)}/{leaf_name}: shape "
-            f"{tuple(value.shape)} != model's {tuple(old.shape)} — "
-            "architecture mismatch (wrong depth/width or not a v1 ResNet?)"
-        )
+        if (leaf_name == "kernel" and tuple(value.shape[:2]) == (7, 7)
+                and tuple(old.shape) == (4, 4, 4 * value.shape[2],
+                                         value.shape[3])):
+            # Space-to-depth stem variant: the 7x7 Keras stem kernel maps
+            # EXACTLY onto the 4x4x(4C) kernel (same function — see
+            # models/resnet.py s2d_stem_kernel).
+            from pddl_tpu.models.resnet import s2d_stem_kernel
+
+            value = np.asarray(s2d_stem_kernel(value))
+        elif (leaf_name == "kernel" and tuple(value.shape[:2]) == (4, 4)
+                and tuple(old.shape) == (7, 7, value.shape[2] // 4,
+                                         value.shape[3])):
+            # The reverse direction: an .h5 exported from an s2d-stem
+            # model loads back into the Keras-shaped stem (exact for
+            # transformed kernels; trained s2d kernels lose the taps
+            # outside the 7x7 window — see s2d_stem_kernel_inverse).
+            from pddl_tpu.models.resnet import s2d_stem_kernel_inverse
+
+            value = np.asarray(s2d_stem_kernel_inverse(value))
+        else:
+            raise ValueError(
+                f"importing {source} -> {'/'.join(path)}/{leaf_name}: shape "
+                f"{tuple(value.shape)} != model's {tuple(old.shape)} — "
+                "architecture mismatch (wrong depth/width or not a v1 "
+                "ResNet?)"
+            )
     node[leaf_name] = value.astype(np.asarray(old).dtype)
 
 

@@ -39,6 +39,11 @@ class ExperimentConfig:
     weights: Optional[str] = None
     download_weights: bool = False  # explicit opt-in (--download-weights)
     bn_mode: str = "train"  # "frozen" reproduces the reference's training=False
+    # ResNet stem: "keras" (exact keras.applications shape) or
+    # "space_to_depth" (MLPerf-style throughput variant, same function —
+    # models/resnet.py; pretrained .h5 stems import via the exact kernel
+    # transform either way).
+    stem: str = "keras"
     compute_dtype: str = "bfloat16"
     # transformer families only: activation rematerialization policy
     # ("none" | "dots" | "full" — models/vit.py REMAT_POLICIES)

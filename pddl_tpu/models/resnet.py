@@ -131,6 +131,15 @@ class ResNet(nn.Module):
     width_multiplier: float = 1.0
     stride_in_3x3: bool = False  # False = Keras v1 parity
     small_input_stem: bool = False  # 3x3/s1 stem, no maxpool (CIFAR/tests)
+    # "keras" (7x7/s2, exact keras.applications parity) or
+    # "space_to_depth": the MLPerf-style stem — block-2 space-to-depth on
+    # the padded input followed by a 4x4/s1 VALID conv. The 4x4x12 kernel
+    # space EQUALS the zero-padded-8x8x3 kernel space, so this computes
+    # exactly the padded 7x7/s2 stem (see s2d_stem_kernel for the exact
+    # Keras-weight transform) while feeding the MXU 12 input channels
+    # instead of 3 and halving the stem's activation traffic. Opt-in:
+    # throughput variant; the default stays import-parity-shaped.
+    stem: str = "keras"
     dtype: Any = jnp.float32  # compute dtype; bfloat16 for TPU speed
     param_dtype: Any = jnp.float32
     bn_mode: str = "train"  # "train" | "frozen"
@@ -158,11 +167,39 @@ class ResNet(nn.Module):
         )
         width = lambda f: max(8, int(f * self.width_multiplier))
 
+        if self.small_input_stem and self.stem != "keras":
+            raise ValueError(
+                f"small_input_stem=True conflicts with stem={self.stem!r}: "
+                "the small 3x3/s1 stem would silently win; pick one"
+            )
         x = x.astype(self.dtype)
         if self.small_input_stem:
             x = conv(width(64), (3, 3), padding="SAME", name="stem_conv")(x)
             x = norm(name="stem_bn")(x)
             x = nn.relu(x)
+        elif self.stem == "space_to_depth":
+            # Same function as the Keras stem below: with X the 3-padded
+            # input, out(i,j) = sum_{u,v<7} X(2i+u,2j+v)K(u,v). Splitting
+            # u=2a+p, v=2b+q (p,q in {0,1}) turns that into a 4x4 STRIDE-1
+            # conv over the block-2 space-to-depth view Y(r,c,(p,q,ch)) =
+            # X(2r+p,2c+q,ch) with kernel K2(a,b,(p,q,ch)) = K8(2a+p,2b+q,
+            # ch), K8 = K zero-padded to 8x8 — so the trainable 4x4x12
+            # kernel spans exactly the padded-7x7x3 function space.
+            x = jnp.pad(x, ((0, 0), (3, 3), (3, 3), (0, 0)))
+            b, h, w, c = x.shape
+            if h % 2 or w % 2:
+                raise ValueError(
+                    f"space_to_depth stem needs even padded input dims, "
+                    f"got {h}x{w} (input {h - 6}x{w - 6})"
+                )
+            x = x.reshape(b, h // 2, 2, w // 2, 2, c)
+            x = x.transpose(0, 1, 3, 2, 4, 5).reshape(b, h // 2, w // 2, 4 * c)
+            x = conv(width(64), (4, 4), strides=(1, 1), padding="VALID",
+                     name="stem_conv")(x)
+            x = norm(name="stem_bn")(x)
+            x = nn.relu(x)
+            x = jnp.pad(x, ((0, 0), (1, 1), (1, 1), (0, 0)))
+            x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
         else:
             # Keras: ZeroPadding(3) -> 7x7/2 valid conv -> BN -> ReLU
             #        -> ZeroPadding(1) -> 3x3/2 valid maxpool.
@@ -200,6 +237,43 @@ class ResNet(nn.Module):
                 name="head",
             )(x)
         return x.astype(jnp.float32)  # logits/features in f32 for stable loss
+
+
+def s2d_stem_kernel(k7: jnp.ndarray) -> jnp.ndarray:
+    """Exact transform of a Keras stem kernel to the space-to-depth stem.
+
+    ``[7, 7, C, F] -> [4, 4, 4C, F]``: zero-pad the kernel to 8x8 at the
+    trailing edge, then regroup ``K8(2a+p, 2b+q, ch)`` into
+    ``K2(a, b, (p, q, ch))`` — the inverse of the activation regrouping in
+    :class:`ResNet`'s ``space_to_depth`` stem, so
+    ``conv_s2d(s2d(x), s2d_stem_kernel(K)) == conv_7x7_s2(x, K)`` exactly.
+    Used by the ``.h5`` import path to load pretrained Keras weights into
+    the throughput variant.
+    """
+    kh, kw, c, f = k7.shape
+    if (kh, kw) != (7, 7):
+        raise ValueError(f"expected a 7x7 stem kernel, got {k7.shape}")
+    k8 = jnp.pad(k7, ((0, 1), (0, 1), (0, 0), (0, 0)))
+    k2 = k8.reshape(4, 2, 4, 2, c, f).transpose(0, 2, 1, 3, 4, 5)
+    return k2.reshape(4, 4, 4 * c, f)
+
+
+def s2d_stem_kernel_inverse(k2: jnp.ndarray) -> jnp.ndarray:
+    """Inverse of :func:`s2d_stem_kernel`: ``[4, 4, 4C, F] -> [7, 7, C, F]``.
+
+    Lets an ``.h5`` exported from a space-to-depth model load back into
+    the Keras-shaped stem (and into real Keras via ``by_name``). The
+    padded row/column (kernel taps 7 in each spatial dim) is sliced away;
+    for kernels produced by :func:`s2d_stem_kernel` those taps are zero,
+    and for TRAINED s2d kernels they carry the weights of input pixels the
+    7x7 stem cannot see — dropping them is the closest 7x7 function.
+    """
+    kh, kw, c4, f = k2.shape
+    if (kh, kw) != (4, 4) or c4 % 4:
+        raise ValueError(f"expected a 4x4x(4C) s2d stem kernel, got {k2.shape}")
+    c = c4 // 4
+    k8 = k2.reshape(4, 4, 2, 2, c, f).transpose(0, 2, 1, 3, 4, 5)
+    return k8.reshape(8, 8, c, f)[:7, :7]
 
 
 ResNet18 = functools.partial(ResNet, stage_sizes=(2, 2, 2, 2), block_cls=BasicBlock)

@@ -54,6 +54,12 @@ def build_trainer(cfg: ExperimentConfig, strategy=None):
                 f"{cfg.model!r}"
             )
         model_kwargs["remat"] = cfg.remat
+    if cfg.stem != "keras":
+        if "resnet" not in cfg.model:
+            raise ValueError(
+                f"--stem applies to the resnet family, not {cfg.model!r}"
+            )
+        model_kwargs["stem"] = cfg.stem
     model = registry.get_model(cfg.model, **model_kwargs)
 
     lr = cfg.learning_rate
@@ -426,6 +432,11 @@ def main(argv=None) -> int:
                    help="activation rematerialization for transformer "
                         "models (trade recompute for HBM)")
     p.add_argument("--model", default=None)
+    p.add_argument("--stem", default=None,
+                   choices=["keras", "space_to_depth"],
+                   help="resnet stem variant: exact keras.applications "
+                        "shape, or the MLPerf-style space-to-depth "
+                        "throughput form (same function)")
     p.add_argument("--strategy", default=None,
                    choices=["single", "mirrored", "multiworker", "ps",
                             "tensor_parallel", "expert_parallel"])
@@ -461,7 +472,7 @@ def main(argv=None) -> int:
         "image_size": args.image_size, "crop": args.crop,
         "num_classes": args.num_classes, "seq_len": args.seq_len,
         "vocab_multiple": args.vocab_multiple,
-        "remat": args.remat,
+        "remat": args.remat, "stem": args.stem,
         "model": args.model, "strategy": args.strategy,
         "pretrained_h5": args.pretrained_h5,
         "checkpoint_dir": args.checkpoint_dir,

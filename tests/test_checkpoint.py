@@ -238,3 +238,36 @@ def test_stablehlo_export_multi_platform():
     call, exported = load_inference_artifact(data)
     assert set(p.lower() for p in exported.platforms) == {"cpu", "tpu"}
     assert np.asarray(call(np.asarray(x))).shape == (1, 4)
+
+
+def test_keras_h5_import_into_s2d_stem(tmp_path):
+    """A Keras-stem .h5 loads into the space-to-depth variant through the
+    exact 7x7 -> 4x4x12 kernel transform; both models then compute the
+    same logits on the same input."""
+    kw = dict(stage_sizes=(2, 2), num_classes=10, width_multiplier=0.125)
+    src = ResNet(**kw)
+    dst = ResNet(**kw, stem="space_to_depth")
+    rng = jax.random.key(0)
+    x = jax.random.normal(jax.random.key(2), (1, 64, 64, 3))
+    v_src = src.init(rng, x, train=False)
+    v_dst = dst.init(jax.random.key(1), x, train=False)
+
+    path = str(tmp_path / "w.h5")
+    export_keras_style_h5(path, v_src, stage_sizes=(2, 2))
+    v_loaded = load_keras_resnet50_h5(path, v_dst, stage_sizes=(2, 2))
+
+    y_src = src.apply(v_src, x, train=False)
+    y_dst = dst.apply(v_loaded, x, train=False)
+    np.testing.assert_allclose(np.asarray(y_dst), np.asarray(y_src),
+                               atol=1e-4, rtol=2e-3)
+
+    # And the reverse: an .h5 exported FROM the s2d model loads back into
+    # the keras-shaped stem (full round trip through both transforms).
+    back_path = str(tmp_path / "w_s2d.h5")
+    export_keras_style_h5(back_path, v_loaded, stage_sizes=(2, 2))
+    v_back = load_keras_resnet50_h5(
+        back_path, src.init(jax.random.key(3), x, train=False),
+        stage_sizes=(2, 2))
+    y_back = src.apply(v_back, x, train=False)
+    np.testing.assert_allclose(np.asarray(y_back), np.asarray(y_src),
+                               atol=1e-4, rtol=2e-3)

@@ -77,3 +77,36 @@ def test_bfloat16_compute_f32_logits():
     assert out.dtype == jnp.float32
     # params stay f32
     assert all(v.dtype == jnp.float32 for v in jax.tree.leaves(variables["params"]))
+
+
+def test_space_to_depth_stem_exact_equivalence():
+    """The s2d stem + exact kernel transform computes the SAME function as
+    the Keras 7x7/s2 stem (models/resnet.py derivation): full-model logits
+    agree up to conv-reassociation noise."""
+    kw = dict(stage_sizes=(2, 2), num_classes=10, width_multiplier=0.25)
+    m_ref = resnet.ResNet(**kw)
+    m_s2d = resnet.ResNet(**kw, stem="space_to_depth")
+    x = jax.random.normal(jax.random.key(0), (2, 64, 64, 3))
+    v = m_ref.init(jax.random.key(1), x, train=False)
+
+    p2 = jax.tree.map(lambda a: a, v["params"])
+    p2 = dict(p2)
+    p2["stem_conv"] = dict(p2["stem_conv"])
+    p2["stem_conv"]["kernel"] = resnet.s2d_stem_kernel(
+        v["params"]["stem_conv"]["kernel"])
+    assert p2["stem_conv"]["kernel"].shape == (4, 4, 12, 16)
+
+    y_ref = m_ref.apply(v, x, train=False)
+    y_s2d = m_s2d.apply({"params": p2, "batch_stats": v["batch_stats"]},
+                        x, train=False)
+    np.testing.assert_allclose(np.asarray(y_s2d), np.asarray(y_ref),
+                               atol=1e-4, rtol=2e-3)
+
+
+def test_space_to_depth_stem_rejects_odd_input():
+    m = resnet.ResNet(stage_sizes=(1,), num_classes=4,
+                      width_multiplier=0.125, stem="space_to_depth")
+    import pytest
+
+    with pytest.raises(ValueError, match="even padded"):
+        m.init(jax.random.key(0), jnp.zeros((1, 65, 65, 3)), train=False)
