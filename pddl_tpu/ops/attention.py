@@ -136,22 +136,56 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
             lse_ref[0] = m_ref[:] + jnp.log(jnp.maximum(l_ref[:], 1e-30))
 
 
+# Measured (block_q, block_k) per TPU generation, keyed on
+# jax device_kind. Only v5e has been benchmarked on hardware (see the
+# flash_attention docstring); other generations inherit those values —
+# safe everywhere (the f32 score block 512x1024x4B = 2 MB plus q/k/v/acc
+# tiles sits well inside the ~16 MB/core VMEM on every generation) but
+# not re-tuned. To tune a new chip: run benchmarks/attention_bench.py
+# (it sweeps block pairs) and add the winner here.
+TUNED_BLOCKS: dict[str, tuple[int, int]] = {
+    "TPU v5 lite": (512, 1024),  # measured
+    "TPU v5e": (512, 1024),      # measured (alternate kind string)
+}
+_DEFAULT_BLOCKS = (512, 1024)
+
+
+def tuned_blocks(device=None) -> tuple[int, int]:
+    """(block_q, block_k) for the local (or given) device's generation."""
+    if device is None:
+        device = jax.devices()[0]
+    return TUNED_BLOCKS.get(getattr(device, "device_kind", ""),
+                            _DEFAULT_BLOCKS)
+
+
+def _resolve_blocks(block_q: Optional[int],
+                    block_k: Optional[int]) -> tuple[int, int]:
+    """Fill None block sizes from the local device's tuned pair."""
+    if block_q is None or block_k is None:
+        tq, tk = tuned_blocks()
+        block_q = block_q if block_q is not None else tq
+        block_k = block_k if block_k is not None else tk
+    return block_q, block_k
+
+
 def flash_attention(
     q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     *, causal: bool = False, scale: Optional[float] = None,
-    block_q: int = 512, block_k: int = 1024,
+    block_q: Optional[int] = None, block_k: Optional[int] = None,
     interpret: Optional[bool] = None,
     fused_backward: bool = True,
 ) -> jnp.ndarray:
     """Flash attention, fused Pallas forward AND backward (see module docs).
 
-    Default blocks (512, 1024) are tuned on TPU v5e (B4 H16 D64 bf16
-    causal): fwd+bwd 12.5 ms at S=2048 vs 17.8 ms for the fused-XLA
-    reference and 5x faster than 128x128 blocks at S=8192 — where the
-    reference's O(S²) scores no longer fit HBM at all. Shorter sequences
-    clamp the blocks (``_largest_dividing_block``) and keep tiling down
-    to S >= 8; below that (single-token decode, tiny test shapes) the
-    reference fallback described above applies.
+    ``block_q``/``block_k`` default to the local device generation's tuned
+    pair (:func:`tuned_blocks`; re-tune a new chip with
+    ``benchmarks/attention_bench.py``). The v5e entry (512, 1024) was
+    measured (B4 H16 D64 bf16 causal): fwd+bwd 12.5 ms at S=2048 vs
+    17.8 ms for the fused-XLA reference and 5x faster than 128x128 blocks
+    at S=8192 — where the reference's O(S²) scores no longer fit HBM at
+    all. Shorter sequences clamp the blocks (``_largest_dividing_block``)
+    and keep tiling down to S >= 8; below that (single-token decode, tiny
+    test shapes) the reference fallback described above applies.
 
     Under ``jax.grad`` the forward additionally saves per-row LSE and the
     backward recomputes score blocks in VMEM (two fused kernels for dq and
@@ -171,6 +205,7 @@ def flash_attention(
         return attention_reference(q, k, v, causal=causal, scale=scale_v)
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
+    block_q, block_k = _resolve_blocks(block_q, block_k)
     bq = _largest_dividing_block(sq, block_q)
     bk = _largest_dividing_block(sk, block_k)
     if bq < 8 or bk < 8:
@@ -491,7 +526,7 @@ def _attention_reference_lse(q, k, v, causal, scale):
 def flash_attention_lse(
     q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     *, causal: bool = False, scale: Optional[float] = None,
-    block_q: int = 512, block_k: int = 1024,
+    block_q: Optional[int] = None, block_k: Optional[int] = None,
     interpret: Optional[bool] = None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """:func:`flash_attention` that ALSO returns per-row logsumexp.
@@ -509,6 +544,7 @@ def flash_attention_lse(
     scale_v = (1.0 / math.sqrt(d)) if scale is None else scale
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
+    block_q, block_k = _resolve_blocks(block_q, block_k)
     bq = _largest_dividing_block(sq, block_q)
     bk = _largest_dividing_block(sk, block_k)
     if bq < 8 or bk < 8:

@@ -343,3 +343,22 @@ def test_flash_ring_check_vma_limitation():
     )
     with pytest.raises(ValueError, match="varying manual axes"):
         jax.jit(checked)(q, k, v)
+
+
+def test_tuned_blocks_resolution():
+    """Defaults resolve per device generation; explicit args still win."""
+    from pddl_tpu.ops.attention import TUNED_BLOCKS, tuned_blocks
+
+    bq, bk = tuned_blocks()
+    assert bq >= 8 and bk >= 8
+    # Unknown generations (this CPU test backend included) fall back to
+    # the measured v5e pair rather than failing.
+    assert (bq, bk) == TUNED_BLOCKS.get(
+        jax.devices()[0].device_kind, (512, 1024))
+
+    # None-defaulted call == explicit tuned call, bitwise.
+    q, k, v = (jax.random.normal(jax.random.key(i), (1, 2, 256, 16))
+               for i in range(3))
+    auto = flash_attention(q, k, v, causal=True)
+    explicit = flash_attention(q, k, v, causal=True, block_q=bq, block_k=bk)
+    np.testing.assert_array_equal(np.asarray(auto), np.asarray(explicit))
