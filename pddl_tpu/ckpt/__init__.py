@@ -28,6 +28,7 @@ from pddl_tpu.ckpt.checkpoint import (
     latest_epoch,
 )
 from pddl_tpu.ckpt.fetch import fetch_keras_resnet50_weights
+from pddl_tpu.ckpt.hf_import import load_hf_gpt2
 from pddl_tpu.ckpt.keras_import import load_keras_resnet50_h5
 
 __all__ = [
@@ -36,5 +37,6 @@ __all__ = [
     "BackupAndRestore",
     "latest_epoch",
     "fetch_keras_resnet50_weights",
+    "load_hf_gpt2",
     "load_keras_resnet50_h5",
 ]

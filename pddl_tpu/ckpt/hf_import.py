@@ -1,0 +1,159 @@
+"""HuggingFace GPT-2 weight import — the LM-family ``weights='imagenet'``.
+
+The reference's pretrained mode loads published backbone weights into the
+vision model (``/root/reference/imagenet-pretrained-resnet50.py:56``);
+this is the same capability for the causal-LM family: map a
+``transformers`` GPT-2 checkpoint (``GPT2LMHeadModel``) onto
+:class:`pddl_tpu.models.gpt.GPT`'s parameter tree. The architectures
+correspond exactly — pre-LN blocks, tanh-approximate GELU (HF
+``gelu_new`` == flax ``nn.gelu(approximate=True)``), learned positional
+embeddings, weight-tied LM head — so imported logits match the torch
+model (``tests/test_hf_import.py``).
+
+Name map (HF ``transformer.*`` → ours)::
+
+    wte.weight            token_embed.embedding            [V, E]
+    wpe.weight            pos_embed                        [1, P, E]
+    h.<i>.ln_1.*          block<i>.ln1.{scale,bias}
+    h.<i>.attn.c_attn.*   block<i>.attn.{query,key,value}  (split 3x, [E,H,D])
+    h.<i>.attn.c_proj.*   block<i>.attn.out                [H*D, E]
+    h.<i>.ln_2.*          block<i>.ln2.{scale,bias}
+    h.<i>.mlp.c_fc.*      block<i>.mlp1                    [E, 4E]
+    h.<i>.mlp.c_proj.*    block<i>.mlp2                    [4E, E]
+    ln_f.*                ln_final.{scale,bias}
+    (tied wte)            lm_head.kernel = wteᵀ, bias = 0
+
+HF's ``Conv1D`` stores kernels as ``[in, out]`` already — no transposes
+beyond the head split. A ``vocab_multiple``-padded model accepts a
+smaller HF vocab: the real rows fill, padding rows keep their init (they
+are unreachable — the head slices them away, ``models/gpt.py``).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any
+
+import jax
+import numpy as np
+
+from pddl_tpu.ckpt.keras_import import _as_mutable
+
+PyTree = Any
+
+
+def _np(t) -> np.ndarray:
+    return t.detach().cpu().numpy() if hasattr(t, "detach") else np.asarray(t)
+
+
+def load_hf_gpt2(model_or_dir, variables: PyTree) -> PyTree:
+    """Load a HF GPT-2 checkpoint into a GPT variables tree.
+
+    Args:
+      model_or_dir: a ``transformers.GPT2LMHeadModel`` (or any object with
+        its ``state_dict()``), or a local checkpoint directory/name to
+        pass to ``GPT2LMHeadModel.from_pretrained`` (no implicit network
+        access beyond what transformers itself does for a local path).
+      variables: ``{"params": ...}`` from ``GPT.init``; returned updated,
+        input untouched.
+    """
+    if isinstance(model_or_dir, str):
+        from transformers import GPT2LMHeadModel  # noqa: PLC0415
+
+        model_or_dir = GPT2LMHeadModel.from_pretrained(model_or_dir)
+    sd = {k: _np(v) for k, v in model_or_dir.state_dict().items()}
+    # Tolerate both "transformer.wte..." (LMHead model) and bare keys.
+    prefix = "transformer." if any(k.startswith("transformer.") for k in sd) \
+        else ""
+
+    # Fresh mutable numpy tree (tree.map builds new containers;
+    # _as_mutable unfreezes FrozenDict levels like keras_import does).
+    params = jax.tree.map(np.asarray, _as_mutable(variables["params"]))
+
+    def leaf(path: str):
+        node = params
+        *parents, name = path.split("/")
+        for p in parents:
+            node = node[p]
+        return node, name
+
+    def put(path: str, value: np.ndarray, allow_vocab_pad: bool = False):
+        node, name = leaf(path)
+        old = node[name]
+        if allow_vocab_pad and value.shape != old.shape:
+            # vocab_multiple padding: fill the real slice, keep the rest.
+            merged = np.array(old)
+            if value.ndim == 1:
+                merged[: value.shape[0]] = value
+            elif value.shape[0] != old.shape[0]:   # [V, E] rows
+                merged[: value.shape[0], ...] = value
+            else:                                  # [E, V] columns
+                merged[:, : value.shape[1]] = value
+            value = merged
+        if value.shape != old.shape:
+            raise ValueError(
+                f"hf import {path}: shape {value.shape} != model's "
+                f"{old.shape} (wrong depth/width/heads?)"
+            )
+        node[name] = value.astype(old.dtype)
+
+    wte = sd[f"{prefix}wte.weight"]
+    put("token_embed/embedding", wte, allow_vocab_pad=True)
+    wpe = sd[f"{prefix}wpe.weight"]
+    pos_old = params["pos_embed"]
+    if wpe.shape[0] != pos_old.shape[1]:
+        raise ValueError(
+            f"hf import: positions {wpe.shape[0]} != model max_len "
+            f"{pos_old.shape[1]}"
+        )
+    params["pos_embed"] = wpe[None].astype(pos_old.dtype)
+
+    n_blocks = sum(1 for k in params if k.startswith("block"))
+    n_hf = 1 + max(
+        (int(m.group(1)) for m in
+         (re.match(rf"{re.escape(prefix)}h\.(\d+)\.", k) for k in sd) if m),
+        default=-1,
+    )
+    if n_hf != n_blocks:
+        raise ValueError(
+            f"hf import: checkpoint has {n_hf} transformer layers but the "
+            f"model has {n_blocks} — depths must match (a deeper checkpoint "
+            "would silently drop layers)"
+        )
+    e = wte.shape[1]
+    for i in range(n_blocks):
+        hf = f"{prefix}h.{i}."
+        put(f"block{i}/ln1/scale", sd[hf + "ln_1.weight"])
+        put(f"block{i}/ln1/bias", sd[hf + "ln_1.bias"])
+        put(f"block{i}/ln2/scale", sd[hf + "ln_2.weight"])
+        put(f"block{i}/ln2/bias", sd[hf + "ln_2.bias"])
+
+        qkv_k = sd[hf + "attn.c_attn.weight"]  # [E, 3E] (Conv1D: [in, out])
+        qkv_b = sd[hf + "attn.c_attn.bias"]    # [3E]
+        h = params[f"block{i}"]["attn"]["query"]["kernel"].shape[1]
+        d = e // h
+        for j, name in enumerate(("query", "key", "value")):
+            put(f"block{i}/attn/{name}/kernel",
+                qkv_k[:, j * e:(j + 1) * e].reshape(e, h, d))
+            put(f"block{i}/attn/{name}/bias",
+                qkv_b[j * e:(j + 1) * e].reshape(h, d))
+        put(f"block{i}/attn/out/kernel", sd[hf + "attn.c_proj.weight"])
+        put(f"block{i}/attn/out/bias", sd[hf + "attn.c_proj.bias"])
+
+        put(f"block{i}/mlp1/kernel", sd[hf + "mlp.c_fc.weight"])
+        put(f"block{i}/mlp1/bias", sd[hf + "mlp.c_fc.bias"])
+        put(f"block{i}/mlp2/kernel", sd[hf + "mlp.c_proj.weight"])
+        put(f"block{i}/mlp2/bias", sd[hf + "mlp.c_proj.bias"])
+
+    put("ln_final/scale", sd[f"{prefix}ln_f.weight"])
+    put("ln_final/bias", sd[f"{prefix}ln_f.bias"])
+    # GPT-2 ties the LM head to wte; ours is an explicit Dense [E, V(+pad)].
+    put("lm_head/kernel", wte.T, allow_vocab_pad=True)
+    lm_bias = params["lm_head"]["bias"]
+    lm_bias = np.array(lm_bias)
+    lm_bias[: wte.shape[0]] = 0.0
+    params["lm_head"]["bias"] = lm_bias
+
+    out = dict(variables)
+    out["params"] = params
+    return out

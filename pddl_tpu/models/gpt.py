@@ -49,6 +49,7 @@ class GPT(nn.Module):
     # Logits are sliced back to vocab_size — numerics are unchanged.
     vocab_multiple: int = 1
     decode: bool = False  # KV-cache generation mode (see generate())
+    ln_eps: float = 1e-6  # HF GPT-2 checkpoints: pass 1e-5 (ckpt/hf_import)
     dtype: Any = jnp.float32
     param_dtype: Any = jnp.float32
 
@@ -75,13 +76,15 @@ class GPT(nn.Module):
                 num_heads=self.num_heads, mlp_ratio=self.mlp_ratio,
                 attention=self.attention, mesh=self.mesh, causal=True,
                 decode=self.decode, max_decode_len=self.max_len,
-                dropout=self.dropout, moe_experts=moe, dtype=self.dtype,
+                dropout=self.dropout, moe_experts=moe, ln_eps=self.ln_eps,
+                dtype=self.dtype,
                 param_dtype=self.param_dtype, name=f"block{i}",
             )(x, train)  # positional: remat keeps arg 2 static
 
         # Head shared with GPipeGPT (ln_final/lm_head names preserved).
         head = _GPTHead(vocab_size=self.vocab_size,
                         vocab_multiple=self.vocab_multiple,
+                        ln_eps=self.ln_eps,
                         dtype=self.dtype, param_dtype=self.param_dtype)
         nn.share_scope(self, head)
         return head(x)
@@ -130,6 +133,7 @@ class _GPTStage(nn.Module):
     blocks: int
     mlp_ratio: int = 4
     attention: str = "reference"
+    ln_eps: float = 1e-6
     dtype: Any = jnp.float32
     param_dtype: Any = jnp.float32
 
@@ -138,7 +142,8 @@ class _GPTStage(nn.Module):
         for i in range(self.blocks):
             x = TransformerBlock(
                 num_heads=self.num_heads, mlp_ratio=self.mlp_ratio,
-                attention=self.attention, causal=True, dtype=self.dtype,
+                attention=self.attention, causal=True, ln_eps=self.ln_eps,
+                dtype=self.dtype,
                 param_dtype=self.param_dtype, name=f"block{i}",
             )(x, False)
         return x
@@ -149,13 +154,14 @@ class _GPTHead(nn.Module):
 
     vocab_size: int
     vocab_multiple: int = 1
+    ln_eps: float = 1e-6
     dtype: Any = jnp.float32
     param_dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, x):
-        x = nn.LayerNorm(dtype=jnp.float32, param_dtype=self.param_dtype,
-                         name="ln_final")(x)
+        x = nn.LayerNorm(epsilon=self.ln_eps, dtype=jnp.float32,
+                         param_dtype=self.param_dtype, name="ln_final")(x)
         padded_v = -(-self.vocab_size // self.vocab_multiple) * self.vocab_multiple
         logits = nn.Dense(padded_v, dtype=self.dtype,
                           param_dtype=self.param_dtype, name="lm_head")(x)
@@ -175,7 +181,7 @@ class GPipeGPT(GPipeModel):
                  blocks_per_stage: int, n_microbatches: int, mesh,
                  max_len: int = 1024, embed_dim: int = 256,
                  num_heads: int = 4, mlp_ratio: int = 4,
-                 attention: str = "reference",
+                 attention: str = "reference", ln_eps: float = 1e-6,
                  dtype: Any = jnp.float32, param_dtype: Any = jnp.float32):
         super().__init__(
             embed=_GPTEmbed(vocab_size=vocab_size, max_len=max_len,
@@ -183,8 +189,9 @@ class GPipeGPT(GPipeModel):
                             param_dtype=param_dtype),
             stage=_GPTStage(num_heads=num_heads, blocks=blocks_per_stage,
                             mlp_ratio=mlp_ratio, attention=attention,
+                            ln_eps=ln_eps,
                             dtype=dtype, param_dtype=param_dtype),
-            head=_GPTHead(vocab_size=vocab_size, dtype=dtype,
+            head=_GPTHead(vocab_size=vocab_size, ln_eps=ln_eps, dtype=dtype,
                           param_dtype=param_dtype),
             n_stages=n_stages, n_microbatches=n_microbatches, mesh=mesh,
         )

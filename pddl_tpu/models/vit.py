@@ -145,6 +145,7 @@ class TransformerBlock(nn.Module):
     max_decode_len: int = 1024
     dropout: float = 0.0
     moe_experts: int = 0  # >0: Switch-MoE FFN instead of the dense MLP
+    ln_eps: float = 1e-6  # flax default; HF GPT-2 checkpoints use 1e-5
     dtype: Any = jnp.float32
     param_dtype: Any = jnp.float32
 
@@ -155,8 +156,8 @@ class TransformerBlock(nn.Module):
         # it — better a loud TypeError at every call site.
         e = x.shape[-1]
         # Pre-LN (f32 for stability even under bf16 compute).
-        h = nn.LayerNorm(dtype=jnp.float32, param_dtype=self.param_dtype,
-                         name="ln1")(x)
+        h = nn.LayerNorm(epsilon=self.ln_eps, dtype=jnp.float32,
+                         param_dtype=self.param_dtype, name="ln1")(x)
         h = MultiHeadAttention(
             num_heads=self.num_heads, attention=self.attention,
             mesh=self.mesh, causal=self.causal, decode=self.decode,
@@ -167,8 +168,8 @@ class TransformerBlock(nn.Module):
             h = nn.Dropout(self.dropout, deterministic=not train)(h)
         x = x + h
 
-        h = nn.LayerNorm(dtype=jnp.float32, param_dtype=self.param_dtype,
-                         name="ln2")(x)
+        h = nn.LayerNorm(epsilon=self.ln_eps, dtype=jnp.float32,
+                         param_dtype=self.param_dtype, name="ln2")(x)
         if self.moe_experts:
             from pddl_tpu.ops.moe import SwitchFFN
 

@@ -127,6 +127,10 @@ def build_trainer(cfg: ExperimentConfig, strategy=None):
         callbacks.append(cb.EarlyStopping())
     if cfg.warmup_epochs and not cfg.lr_schedule:
         callbacks.append(cb.LearningRateWarmup(warmup_epochs=cfg.warmup_epochs))
+    if cfg.verbose:
+        # The reference's rank-0 print(model.summary())
+        # (imagenet-resnet50-hvd.py:95-96), for every preset.
+        callbacks.append(cb.ModelSummary())
     callbacks.append(cb.Timing())
     if cfg.profile_dir:
         from pddl_tpu.utils.profiling import Profiler

@@ -186,6 +186,21 @@ class LearningRateWarmup(Callback):
         return new_state
 
 
+class ModelSummary(Callback):
+    """Print the parameter table once at train start, coordinator-only —
+    the reference's rank-0 ``print(model.summary())``
+    (``imagenet-resnet50-hvd.py:95-96``)."""
+
+    def on_train_begin(self, state):
+        from pddl_tpu.core import dist
+        from pddl_tpu.utils.summary import param_summary
+
+        if dist.is_coordinator():
+            print(param_summary(state.params, state.batch_stats),
+                  file=sys.stderr)
+        return None
+
+
 class LambdaCallback(Callback):
     def __init__(self, on_epoch_end=None, on_train_batch_end=None,
                  on_train_begin=None, on_train_end=None):

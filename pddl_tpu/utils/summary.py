@@ -1,0 +1,44 @@
+"""Parameter summary: the ``model.summary()`` moment.
+
+The reference prints Keras's layer table on rank 0
+(``/root/reference/imagenet-resnet50-hvd.py:95-96``). The functional
+analogue summarizes the initialized parameter tree — per-top-level-module
+parameter counts, dtypes, and totals — which works uniformly across the
+model families (ResNet/ViT/GPT) without re-tracing the model.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import numpy as np
+
+PyTree = Any
+
+
+def param_summary(params: PyTree, batch_stats: PyTree | None = None) -> str:
+    """Human-readable per-module parameter table + totals."""
+    by_module: dict[str, int] = {}
+    total = 0
+    total_bytes = 0
+    for path, leaf in jax.tree_util.tree_leaves_with_path(params):
+        top = str(getattr(path[0], "key", path[0])) if path else "<root>"
+        n = int(np.prod(leaf.shape)) if leaf.shape else 1
+        by_module[top] = by_module.get(top, 0) + n
+        total += n
+        total_bytes += n * np.dtype(leaf.dtype).itemsize
+    lines = ["Model parameters:"]
+    width = max((len(k) for k in by_module), default=10)
+    for name in sorted(by_module):
+        lines.append(f"  {name:<{width}}  {by_module[name]:>14,}")
+    lines.append(f"  {'TOTAL':<{width}}  {total:>14,}  "
+                 f"({total_bytes / 1e6:.1f} MB)")
+    if batch_stats is not None:
+        n_stats = sum(
+            int(np.prod(leaf.shape)) if leaf.shape else 1
+            for leaf in jax.tree.leaves(batch_stats)
+        )
+        if n_stats:
+            lines.append(f"  {'(batch stats)':<{width}}  {n_stats:>14,}")
+    return "\n".join(lines)

@@ -151,3 +151,20 @@ def test_timing_callback():
     cb = Timing(verbose=0)
     tr.fit(_ds(), epochs=1, steps_per_epoch=2, callbacks=[cb], verbose=0)
     assert cb.total is not None and cb.total > 0
+
+
+def test_model_summary_prints_param_table(capsys):
+    """The rank-0 model.summary() analogue (imagenet-resnet50-hvd.py:95-96)."""
+    from pddl_tpu.train.callbacks import ModelSummary
+
+    tr = _trainer()
+    tr.fit(_ds(), epochs=1, steps_per_epoch=1, callbacks=[ModelSummary()],
+           verbose=0)
+    err = capsys.readouterr().err
+    assert "Model parameters:" in err
+    assert "TOTAL" in err
+    # Totals are real: match the state's actual parameter count.
+    import jax
+
+    n = sum(x.size for x in jax.tree.leaves(tr.state.params))
+    assert f"{n:,}" in err

@@ -1,0 +1,91 @@
+"""HF GPT-2 weight import: cross-framework logits parity.
+
+The LM analogue of test_keras_parity.py: a genuine ``transformers``
+GPT-2 (random-init — no network access) converts into our GPT tree, and
+both frameworks produce the same logits on the same tokens.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+from pddl_tpu.ckpt.hf_import import load_hf_gpt2  # noqa: E402
+from pddl_tpu.models.gpt import GPT  # noqa: E402
+
+V, P, E, L, H = 97, 64, 32, 2, 2
+
+
+def _hf_model(vocab=V):
+    cfg = transformers.GPT2Config(
+        vocab_size=vocab, n_positions=P, n_embd=E, n_layer=L, n_head=H,
+        resid_pdrop=0.0, embd_pdrop=0.0, attn_pdrop=0.0,
+    )
+    torch.manual_seed(0)
+    return transformers.GPT2LMHeadModel(cfg).eval()
+
+
+def _tokens(batch=2, seq=17, vocab=V):
+    return np.asarray(
+        jax.random.randint(jax.random.key(3), (batch, seq), 0, vocab),
+        np.int32,
+    )
+
+
+def test_hf_gpt2_logits_match():
+    hf = _hf_model()
+    ours = GPT(vocab_size=V, max_len=P, embed_dim=E, depth=L, num_heads=H,
+               attention="reference", ln_eps=1e-5)  # HF GPT-2 epsilon
+    tokens = _tokens()
+    v = ours.init(jax.random.key(0), tokens, train=False)
+    v = load_hf_gpt2(hf, v)
+
+    with torch.no_grad():
+        ref = hf(torch.from_numpy(tokens.astype(np.int64))).logits.numpy()
+    got = np.asarray(ours.apply(v, jnp.asarray(tokens), train=False))
+    np.testing.assert_allclose(got, ref, atol=2e-4, rtol=2e-4)
+
+
+def test_hf_gpt2_import_into_padded_vocab():
+    """vocab_multiple padding: the HF vocab fills the real slice; padded
+    classes stay sliced away by the head, so logits still match."""
+    hf = _hf_model()
+    ours = GPT(vocab_size=V, max_len=P, embed_dim=E, depth=L, num_heads=H,
+               attention="reference", vocab_multiple=32, ln_eps=1e-5)  # 97 -> 128
+    tokens = _tokens()
+    v = ours.init(jax.random.key(0), tokens, train=False)
+    v = load_hf_gpt2(hf, v)
+
+    with torch.no_grad():
+        ref = hf(torch.from_numpy(tokens.astype(np.int64))).logits.numpy()
+    got = np.asarray(ours.apply(v, jnp.asarray(tokens), train=False))
+    assert got.shape[-1] == V  # padding sliced away
+    np.testing.assert_allclose(got, ref, atol=2e-4, rtol=2e-4)
+
+
+def test_hf_gpt2_wrong_shape_raises():
+    hf = _hf_model()
+    wrong_depth = GPT(vocab_size=V, max_len=P, embed_dim=E, depth=L + 1,
+                      num_heads=H, attention="reference")
+    v = wrong_depth.init(jax.random.key(0), _tokens(), train=False)
+    with pytest.raises(ValueError, match="depths must match"):
+        load_hf_gpt2(hf, v)
+    wrong_pos = GPT(vocab_size=V, max_len=P * 2, embed_dim=E, depth=L,
+                    num_heads=H, attention="reference")
+    v = wrong_pos.init(jax.random.key(0), _tokens(), train=False)
+    with pytest.raises(ValueError, match="positions"):
+        load_hf_gpt2(hf, v)
+
+
+def test_hf_gpt2_deeper_checkpoint_raises():
+    """A checkpoint with MORE layers than the model must not import
+    silently (the dropped-layers case)."""
+    hf = _hf_model()  # 2 layers
+    shallow = GPT(vocab_size=V, max_len=P, embed_dim=E, depth=1,
+                  num_heads=H, attention="reference")
+    v = shallow.init(jax.random.key(0), _tokens(), train=False)
+    with pytest.raises(ValueError, match="depths must match"):
+        load_hf_gpt2(hf, v)
