@@ -268,8 +268,10 @@ def generate(model: GPT, variables, prompt, max_new_tokens: int, *,
         without any model change.
 
     Returns int32 ``[B, P + max_new_tokens]`` (prompt + continuation).
-    One jitted single-token step; the cache is donated so K/V update in
-    place in HBM across steps.
+    Execution model: one jitted batched prefill over the whole prompt,
+    then the ENTIRE decode as a single on-device ``lax.scan`` dispatch
+    (sampling included); parameters are jit arguments, so new checkpoints
+    of the same shape reuse the compiled program.
     """
     b, p = prompt.shape
     total = p + max_new_tokens
@@ -286,9 +288,12 @@ def generate(model: GPT, variables, prompt, max_new_tokens: int, *,
         )
     dec = model.clone(decode=True)
     params = variables["params"]
+    param_sh = None
     if strategy is not None:
-        # One batched transfer for the whole tree.
-        params = jax.device_put(params, strategy.tree_sharding(params))
+        # One batched transfer for the whole tree; the same sharding tree
+        # feeds the jits' in_shardings below.
+        param_sh = strategy.tree_sharding(params)
+        params = jax.device_put(params, param_sh)
     # The fresh cache is all zeros by construction; eval_shape over init
     # gets its structure without materializing (and discarding) a full
     # random parameter set.
@@ -300,42 +305,74 @@ def generate(model: GPT, variables, prompt, max_new_tokens: int, *,
         return jax.tree.map(lambda sd: jnp.zeros(sd.shape, sd.dtype),
                             cache_shapes)
 
-    def step_fn(cache, tok):
+    # params is an ARGUMENT of every jitted function below, never a
+    # closure: closed-over arrays become program CONSTANTS, which bakes
+    # the full parameter set into the executable — gigabyte compile
+    # payloads (remote-compile transports reject them outright) and a
+    # recompile for every new checkpoint.
+    def step_fn(params, cache, tok):
         logits, mutated = dec.apply(
             {"params": params, "cache": cache}, tok,
             train=False, mutable=["cache"],
         )
         return mutated["cache"], logits[:, -1]
 
+    # The prefill step runs ONCE (decode then scans on device) — no
+    # donation: donating the just-created zero cache is never usable.
     if strategy is None:
         cache = fresh_cache()
-        step = jax.jit(step_fn, donate_argnums=(0,))
+        step = jax.jit(step_fn)
     else:
         from jax.sharding import NamedSharding, PartitionSpec
 
         cache_sh = strategy.decode_cache_sharding(cache_shapes)
         repl = NamedSharding(strategy.mesh, PartitionSpec())
         cache = jax.jit(fresh_cache, out_shardings=cache_sh)()
-        step = jax.jit(step_fn, donate_argnums=(0,),
-                       in_shardings=(cache_sh, repl),
+        step = jax.jit(step_fn,
+                       in_shardings=(param_sh, cache_sh, repl),
                        out_shardings=(cache_sh, repl))
 
     # Batched prefill: the whole prompt in ONE call (causal within the
-    # block), then one token per step — no wasted final step.
-    cache, logits = step(cache, prompt)
-    tokens = [prompt]
-    for i in range(max_new_tokens):
+    # block); then the ENTIRE decode runs as one compiled lax.scan — a
+    # single dispatch for all max_new_tokens steps. A host-side
+    # token-at-a-time loop costs one (or more) host→device round trips
+    # per token, which dominates wall-clock wherever dispatch has
+    # latency (remote/tunneled transports, busy hosts); on-device scan
+    # makes generation latency the compute itself.
+    cache, logits = step(params, cache, prompt)
+
+    def sample_next(logits, rng):
         if temperature > 0:
             rng, sub = jax.random.split(rng)
             nxt = sample_logits(sub, logits, temperature=temperature,
                                 top_k=top_k, top_p=top_p)
         else:
             nxt = jnp.argmax(logits, axis=-1)
-        nxt = nxt[:, None].astype(jnp.int32)
-        tokens.append(nxt)
-        if i + 1 < max_new_tokens:
-            cache, logits = step(cache, nxt)
-    return jnp.concatenate(tokens, axis=1)
+        return nxt.astype(jnp.int32), rng
+
+    def decode_all(params, cache, logits, rng):
+        def body(carry, _):
+            cache, logits, rng = carry
+            nxt, rng = sample_next(logits, rng)
+            tok = nxt[:, None]
+            cache, logits = step_fn(params, cache, tok)
+            return (cache, logits, rng), tok
+
+        # The final iteration's step_fn is one token of dead compute (its
+        # logits are never sampled) — the price of a uniform scan body.
+        _, toks = jax.lax.scan(
+            body, (cache, logits, rng), None, length=max_new_tokens)
+        return jnp.moveaxis(toks[..., 0], 0, 1)  # [T, B, 1] -> [B, T]
+
+    if rng is None:
+        rng = jax.random.key(0)  # unused under greedy; scan needs a value
+    if strategy is None:
+        run = jax.jit(decode_all, donate_argnums=(1,))
+    else:
+        run = jax.jit(decode_all, donate_argnums=(1,),
+                      in_shardings=(param_sh, cache_sh, repl, repl),
+                      out_shardings=repl)
+    return jnp.concatenate([prompt, run(params, cache, logits, rng)], axis=1)
 
 
 GPT_Small = functools.partial(GPT, embed_dim=768, depth=12, num_heads=12)

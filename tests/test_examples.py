@@ -34,10 +34,11 @@ def test_examples_run(tmp_path):
     logs = {}
     for script in _EXAMPLES:
         logs[script] = open(tmp_path / f"{script}.log", "w+")
-        # Isolate mutable state per test run: the convergence example's
-        # default work dir is a fixed /tmp path shared across sessions.
-        extra = (["--work-dir", str(tmp_path / "real_data_work")]
-                 if script == "real_data_convergence.py" else [])
+        # Isolate mutable state per test run: these examples default to a
+        # fixed /tmp work dir shared across sessions.
+        extra = (["--work-dir", str(tmp_path / f"work_{script}")]
+                 if script in ("real_data_convergence.py",
+                               "generate_python.py") else [])
         procs[script] = subprocess.Popen(
             [sys.executable, os.path.join(_ROOT, "examples", script), *extra],
             env=env, cwd=_ROOT, stdout=logs[script],
