@@ -34,6 +34,14 @@ own target; the live cost analysis is printed alongside for comparison.
 vs_baseline = achieved / (0.7 * roofline), 0.7 per the BASELINE.json
 north star ("≥70% of reference images/sec/chip").
 
+Round-over-round comparability: round 1 graded against an ASSUMED fixed
+3000 img/s MLPerf-class reference (vs_baseline = achieved / (0.7*3000));
+round 2 switched the denominator to the physics roofline above. So both
+ratios are emitted — ``vs_baseline`` (roofline, the headline) and
+``vs_baseline_mlperf3000`` (the round-1 convention, kept so the series
+BENCH_r01→rNN stays interpretable) — plus the ``stem`` used, since the
+default stem also changed (keras → space_to_depth, measured neutral).
+
 Tuning history (measured on one v5e chip, batch 256): rematerialization
 variants (full-block and save-convs-only nn.remat) both LOSE (~2330 ->
 ~1920/~2020 img/s) — XLA's schedule already trades FLOPs for bytes better
@@ -204,6 +212,11 @@ def main() -> None:
         "unit": "images/sec/chip",
         "vs_baseline": round(
             images_per_sec / (TARGET_FRACTION * roofline), 4),
+        # Round-1 convention (assumed 3000 img/s reference) so the
+        # BENCH_r* series stays comparable across the denominator change.
+        "vs_baseline_mlperf3000": round(
+            images_per_sec / (TARGET_FRACTION * 3000.0), 4),
+        "stem": stem,
     }))
 
 

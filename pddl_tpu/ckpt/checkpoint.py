@@ -10,6 +10,7 @@ can overlap the next training step (``async_save``).
 
 from __future__ import annotations
 
+import logging
 import os
 from typing import Any, Dict, Optional
 
@@ -19,6 +20,7 @@ import numpy as np
 from pddl_tpu.train.callbacks import Callback
 
 PyTree = Any
+log = logging.getLogger(__name__)
 
 
 def _ocp():
@@ -101,10 +103,37 @@ class Checkpointer:
             if isinstance(x, jax.Array) else x,
             target,
         )
-        out = self._mngr.restore(
-            step,
-            args=ocp.args.Composite(state=ocp.args.StandardRestore(abstract)),
-        )
+        try:
+            out = self._mngr.restore(
+                step,
+                args=ocp.args.Composite(
+                    state=ocp.args.StandardRestore(abstract)),
+            )
+        except (ValueError, KeyError) as e:
+            # Migration: checkpoints written before TrainState grew
+            # ema_batch_stats lack that subtree, so a template containing
+            # it fails the structure match. Retry without it and seed the
+            # shadow from the restored live stats — exactly its value at
+            # init time. Guarded on the error actually naming the subtree
+            # so an unrelated restore failure (wrong model shapes, say)
+            # surfaces as itself, not as a bogus migration message.
+            ema_bs = getattr(abstract, "ema_batch_stats", None)
+            if (ema_bs is None or not jax.tree.leaves(ema_bs)
+                    or "ema_batch_stats" not in str(e)):
+                raise
+            out = self._mngr.restore(
+                step,
+                args=ocp.args.Composite(state=ocp.args.StandardRestore(
+                    abstract.replace(ema_batch_stats=None))),
+            )
+            log.warning(
+                "restore: checkpoint predates ema_batch_stats; seeded the "
+                "EMA stats shadow from the restored batch_stats",
+            )
+            restored = out["state"]
+            # No copy needed: jax arrays are immutable, and init seeds the
+            # shadow from the same live tree (train/loop.py).
+            return restored.replace(ema_batch_stats=restored.batch_stats)
         return out["state"]
 
     def metadata(self, step: Optional[int] = None) -> Dict[str, Any]:

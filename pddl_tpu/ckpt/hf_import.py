@@ -46,7 +46,8 @@ def _np(t) -> np.ndarray:
     return t.detach().cpu().numpy() if hasattr(t, "detach") else np.asarray(t)
 
 
-def load_hf_gpt2(model_or_dir, variables: PyTree) -> PyTree:
+def load_hf_gpt2(model_or_dir, variables: PyTree, *,
+                 model=None, expected_ln_eps: float | None = None) -> PyTree:
     """Load a HF GPT-2 checkpoint into a GPT variables tree.
 
     Args:
@@ -56,11 +57,32 @@ def load_hf_gpt2(model_or_dir, variables: PyTree) -> PyTree:
         access beyond what transformers itself does for a local path).
       variables: ``{"params": ...}`` from ``GPT.init``; returned updated,
         input untouched.
+      model: the :class:`~pddl_tpu.models.gpt.GPT` the variables were
+        built for, if available. LayerNorm epsilon is a module attribute,
+        invisible in ``variables`` — without it an import into a model
+        left at the default ``ln_eps=1e-6`` succeeds but drifts from the
+        torch logits (HF GPT-2 uses 1e-5). Pass the model (or
+        ``expected_ln_eps``) so the mismatch raises instead.
+      expected_ln_eps: the ``ln_eps`` the target model was built with;
+        overrides ``model.ln_eps`` if both are given.
     """
     if isinstance(model_or_dir, str):
         from transformers import GPT2LMHeadModel  # noqa: PLC0415
 
         model_or_dir = GPT2LMHeadModel.from_pretrained(model_or_dir)
+    if expected_ln_eps is None and model is not None:
+        expected_ln_eps = getattr(model, "ln_eps", None)
+    if expected_ln_eps is not None:
+        cfg = getattr(model_or_dir, "config", None)
+        hf_eps = getattr(cfg, "layer_norm_epsilon", 1e-5)
+        if not np.isclose(expected_ln_eps, hf_eps, rtol=1e-3):
+            raise ValueError(
+                f"hf import: model was built with ln_eps={expected_ln_eps} "
+                f"but the checkpoint uses layer_norm_epsilon={hf_eps} — "
+                f"build the GPT with ln_eps={hf_eps} (epsilon is baked "
+                "into the module, not the weights, so the import would "
+                "silently produce wrong logits)"
+            )
     sd = {k: _np(v) for k, v in model_or_dir.state_dict().items()}
     # Tolerate both "transformer.wte..." (LMHead model) and bare keys.
     prefix = "transformer." if any(k.startswith("transformer.") for k in sd) \

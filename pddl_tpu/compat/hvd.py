@@ -161,19 +161,26 @@ def allgather(value):
 
 
 def broadcast(value, root_rank: int = 0):
-    """Broadcast host-``root_rank``'s value to every process."""
+    """Broadcast host-``root_rank``'s value to every process.
+
+    The reference only ever broadcasts from 0
+    (``imagenet-resnet50-hvd.py:111``); any root is supported anyway —
+    ``is_source`` selects whose value the one-to-all collective carries.
+    """
     _require_init()
+    if not 0 <= root_rank < jax.process_count():
+        raise ValueError(
+            f"root_rank={root_rank} out of range for "
+            f"{jax.process_count()} processes"
+        )
     if jax.process_count() == 1:
         return value
     from jax.experimental import multihost_utils  # noqa: PLC0415
 
-    if root_rank != 0:
-        raise NotImplementedError(
-            "only root_rank=0 broadcast is supported (the reference only "
-            "ever broadcasts from 0, imagenet-resnet50-hvd.py:111)"
-        )
     return jax.tree.map(
-        lambda x: multihost_utils.broadcast_one_to_all(np.asarray(x)), value
+        lambda x: multihost_utils.broadcast_one_to_all(
+            np.asarray(x), is_source=jax.process_index() == root_rank),
+        value,
     )
 
 
@@ -218,13 +225,20 @@ class BroadcastGlobalVariablesCallback(Callback):
     """
 
     def __init__(self, root_rank: int = 0):
-        if root_rank != 0:
-            raise NotImplementedError("only root_rank=0 is supported")
+        self.root_rank = root_rank
 
     def on_train_begin(self, state):
+        # Validate even single-process (a typo'd root should fail the dev
+        # run, not explode later on the cluster) — directly, so the
+        # single-process path keeps working without hvd.init().
+        if not 0 <= self.root_rank < jax.process_count():
+            raise ValueError(
+                f"root_rank={self.root_rank} out of range for "
+                f"{jax.process_count()} processes"
+            )
         if jax.process_count() == 1:
             return None
-        return broadcast(state)
+        return broadcast(state, self.root_rank)
 
 
 class MetricAverageCallback(Callback):

@@ -45,7 +45,7 @@ def tree_shardings(mesh: Mesh, tree: PyTree, spec_fn) -> PyTree:
     )
 
 
-@functools.lru_cache(maxsize=None)
+@functools.lru_cache(maxsize=32)  # bounded: keys hold Mesh/device handles
 def _factored_mesh(mesh: Mesh, axis_name: str, k: int) -> Mesh:
     """A two-axis view of ``mesh``'s devices: ``k``-way shard × replicate.
 

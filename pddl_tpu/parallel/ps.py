@@ -121,4 +121,6 @@ class ParameterServerStrategy(Strategy):
             opt_state=opt_sh,
             # EMA shadows live wherever their parameters live.
             ema_params=jax.tree.map(shard_leaf, state.ema_params),
+            ema_batch_stats=jax.tree.map(lambda _: repl,
+                                         state.ema_batch_stats),
         )

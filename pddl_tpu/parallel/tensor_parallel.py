@@ -213,6 +213,8 @@ class TensorParallelStrategy(Strategy):
             opt_state=self.tree_sharding(state.opt_state),
             # EMA shadows inherit the TP layout of their parameters.
             ema_params=self.tree_sharding(state.ema_params),
+            ema_batch_stats=jax.tree.map(lambda _: repl,
+                                         state.ema_batch_stats),
         )
 
 

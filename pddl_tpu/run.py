@@ -301,11 +301,17 @@ def run_experiment(cfg: ExperimentConfig, steps_per_epoch: Optional[int] = None,
         from pddl_tpu.ckpt.keras_import import export_keras_style_h5
 
         # With EMA enabled, the shadow weights are what eval ran on —
-        # export those (standard EMA serving practice).
+        # export those (standard EMA serving practice), together with the
+        # EMA-shadowed BN statistics they were evaluated against.
+        use_ema = (trainer.state.ema_params is not None
+                   and trainer.eval_with_ema)
         export_params = (
-            trainer.state.ema_params
-            if trainer.state.ema_params is not None and trainer.eval_with_ema
-            else trainer.state.params
+            trainer.state.ema_params if use_ema else trainer.state.params
+        )
+        export_stats = (
+            trainer.state.ema_batch_stats
+            if use_ema and trainer.state.ema_batch_stats is not None
+            else trainer.state.batch_stats
         )
         if cfg.save_path.endswith(".shlo"):
             # Serialized StableHLO inference artifact (ckpt/export.py):
@@ -323,11 +329,11 @@ def run_experiment(cfg: ExperimentConfig, steps_per_epoch: Optional[int] = None,
             save_inference_artifact(
                 cfg.save_path, trainer.model,
                 jax.device_get(export_params), shape, input_dtype=dtype,
-                batch_stats=jax.device_get(trainer.state.batch_stats),
+                batch_stats=jax.device_get(export_stats),
             )
         elif cfg.save_path.endswith(".h5") and cfg.model.startswith("resnet"):
             variables = {"params": export_params,
-                         "batch_stats": trainer.state.batch_stats}
+                         "batch_stats": export_stats}
             export_keras_style_h5(cfg.save_path, variables)
         else:
             from pddl_tpu.ckpt.checkpoint import save_params_npz
@@ -365,14 +371,22 @@ def _load_pretrained(trainer, cfg: ExperimentConfig, train_data,
         loaded.get("batch_stats", {}), trainer.state.batch_stats,
     )
     # EMA shadows must restart from the loaded weights, not the random
-    # init they were seeded with (eval/export run on the shadows).
+    # init they were seeded with (eval/export run on the shadows) — the
+    # batch_stats shadow likewise, or EMA eval pairs imported weights
+    # with mean=0/var=1 init statistics.
     ema = trainer.state.ema_params
     if ema is not None:
         ema = jax.tree.map(
             lambda new, old: jax.device_put(new, old.sharding), params, ema
         )
+    ema_bs = trainer.state.ema_batch_stats
+    if ema_bs is not None:
+        ema_bs = jax.tree.map(
+            lambda new, old: jax.device_put(new, old.sharding), stats, ema_bs
+        )
     trainer.state = trainer.state.replace(params=params, batch_stats=stats,
-                                          ema_params=ema)
+                                          ema_params=ema,
+                                          ema_batch_stats=ema_bs)
 
 
 def main(argv=None) -> int:

@@ -116,6 +116,7 @@ class EarlyStopping(Callback):
         self.wait = 0
         self.best_params = None
         self.best_ema = None
+        self.best_ema_bs = None
         self.stopped_epoch: Optional[int] = None
 
     def _improved(self, current: float) -> bool:
@@ -133,9 +134,13 @@ class EarlyStopping(Callback):
                 # Deep-copy: the live params buffers are donated by the next
                 # jitted train step and would be deleted under our feet.
                 # The EMA shadows are what eval ran on (when enabled), so
-                # they are part of "the best weights" and roll back too.
+                # they — params AND batch_stats shadows, which move on the
+                # same cadence — are part of "the best weights" and roll
+                # back together.
                 self.best_params = jax.tree.map(jnp.copy, state.params)
                 self.best_ema = jax.tree.map(jnp.copy, state.ema_params)
+                self.best_ema_bs = jax.tree.map(jnp.copy,
+                                                state.ema_batch_stats)
             return None
         self.wait += 1
         if self.wait >= self.patience:
@@ -143,7 +148,8 @@ class EarlyStopping(Callback):
             self.trainer.stop_training = True
             if self.restore_best_weights and self.best_params is not None:
                 return state.replace(params=self.best_params,
-                                     ema_params=self.best_ema)
+                                     ema_params=self.best_ema,
+                                     ema_batch_stats=self.best_ema_bs)
         return None
 
 

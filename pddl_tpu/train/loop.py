@@ -22,6 +22,7 @@ augmentation in the model graph for the same reason,
 
 from __future__ import annotations
 
+import logging
 import sys
 import time
 from typing import Any, Callable, Dict, Iterable, Iterator, Optional, Sequence
@@ -38,6 +39,7 @@ from pddl_tpu.train.history import History
 from pddl_tpu.train.state import TrainState, make_optimizer
 
 PyTree = Any
+log = logging.getLogger(__name__)
 
 
 class Trainer:
@@ -68,11 +70,18 @@ class Trainer:
         lr_schedule: Optional[str | Callable] = None,
         lr_schedule_options: Optional[Dict[str, Any]] = None,
         ema_decay: Optional[float] = None,
-        # Evaluate on the EMA weights when ema_decay is set. Intended for
-        # the normalization-free families: BatchNorm models eval EMA params
-        # against the LIVE batch_stats (a warning fires at build time).
+        # Evaluate on the EMA weights when ema_decay is set. BN models
+        # evaluate against the EMA-shadowed batch_stats (TrainState.
+        # ema_batch_stats), averaged on the same cadence as the params.
         eval_with_ema: bool = True,
         gradient_accumulation_steps: Optional[int] = None,
+        # Add the global gradient L2 norm to the train logs — cheap (one
+        # fused reduction in the compiled step) and the observable the
+        # multichip equivalence gate compares: unlike per-leaf gradients
+        # (ill-conditioned through BN backward), the norm separates fp
+        # reduction noise (~1e-3 relative) from semantic errors like a
+        # psum-where-pmean-belongs (device_count x).
+        log_grad_norm: bool = False,
     ):
         self.model = model
         self.input_key = input_key
@@ -91,6 +100,7 @@ class Trainer:
         self.seed = seed
         self.augment = augment
         self.donate_state = donate_state
+        self.log_grad_norm = log_grad_norm
 
         self.state: Optional[TrainState] = None
         self.stop_training = False
@@ -122,6 +132,7 @@ class Trainer:
                 batch_stats=batch_stats,
                 opt_state=self.tx.init(params),
                 ema_params=params if self.ema_decay else None,
+                ema_batch_stats=batch_stats if self.ema_decay else None,
             )
 
         abstract = jax.eval_shape(_init, rng)
@@ -156,22 +167,6 @@ class Trainer:
         state_sh = self._state_shardings
         base_rng = jax.random.key(self.seed + 1)
 
-        if (self.eval_with_ema and self.ema_decay
-                and jax.tree.leaves(self.state.batch_stats)):
-            import warnings
-
-            # EMA shadows cover params only; batch_stats stay the live
-            # moving statistics accumulated under the RAW params, which can
-            # skew BatchNorm eval metrics. EMA eval is designed for the
-            # normalization-free families (ViT/GPT); for BN models either
-            # accept the mismatch or pass eval_with_ema=False.
-            warnings.warn(
-                "eval_with_ema: evaluating EMA params against live (non-"
-                "averaged) BatchNorm statistics; pass eval_with_ema=False "
-                "for BN models if eval metrics look skewed",
-                stacklevel=2,
-            )
-
         def train_step(state: TrainState, batch):
             images, labels = batch[self.input_key], batch[self.target_key]
             rng = jax.random.fold_in(base_rng, state.step)
@@ -198,6 +193,10 @@ class Trainer:
                 ema_decay=self.ema_decay,
             )
             logs = {"loss": loss}
+            if self.log_grad_norm:
+                import optax
+
+                logs["grad_norm"] = optax.global_norm(grads)
             for name, fn in self.metric_fns.items():
                 logs[name] = fn(logits, labels)
             return new_state, logs
@@ -206,14 +205,18 @@ class Trainer:
             images, labels = batch[self.input_key], batch[self.target_key]
             if self.eval_transform is not None:
                 images = self.eval_transform(images)
-            # Structural (trace-time) choice: EMA weights when enabled.
-            eval_params = (
-                state.ema_params
-                if self.eval_with_ema and state.ema_params is not None
-                else state.params
+            # Structural (trace-time) choice: EMA weights when enabled —
+            # and the EMA-shadowed batch_stats with them, so BN models
+            # see statistics averaged on the same cadence as the params.
+            use_ema = self.eval_with_ema and state.ema_params is not None
+            eval_params = state.ema_params if use_ema else state.params
+            eval_stats = (
+                state.ema_batch_stats
+                if use_ema and state.ema_batch_stats is not None
+                else state.batch_stats
             )
             (logits, updates) = self._apply(
-                eval_params, state.batch_stats, images, train=False,
+                eval_params, eval_stats, images, train=False,
                 mutable=True,
             )
             loss = self.loss_fn(logits, labels)
@@ -332,13 +335,27 @@ class Trainer:
                     if continuous_feed is None:
                         def _repeating(first_iter, data=train_data):
                             it = first_iter
+                            batches = 0
+                            repassed = False
                             while True:
                                 yielded = False
                                 for b in it:
                                     yielded = True
+                                    batches += 1
                                     yield b
                                 if isinstance(data, Iterator) or not yielded:
                                     return
+                                if not repassed:
+                                    # Loud once: a mis-sized pipeline (e.g. a
+                                    # glob matching too few files) would
+                                    # otherwise repeat data silently.
+                                    repassed = True
+                                    log.warning(
+                                        "steps_per_epoch outlives the "
+                                        "dataset (%d batches/pass); "
+                                        "re-iterating (reference .repeat() "
+                                        "semantics)", batches,
+                                    )
                                 it = iter(data)
 
                         continuous_feed = make_feed(_repeating(train_iter))

@@ -39,6 +39,10 @@ class TrainState(struct.PyTreeNode):
     # Evaluating/serving with the EMA weights is standard large-batch
     # practice; the reference has no analogue (Keras Adam only).
     ema_params: PyTree = None
+    # Shadow of batch_stats under EMA, so BatchNorm models evaluate EMA
+    # weights against statistics averaged on the SAME cadence — evaluating
+    # EMA params against the live stats skews BN eval metrics.
+    ema_batch_stats: PyTree = None
 
     def apply_gradients(self, tx: optax.GradientTransformation, grads: PyTree,
                         new_batch_stats: PyTree | None = None,
@@ -46,28 +50,41 @@ class TrainState(struct.PyTreeNode):
         updates, new_opt_state = tx.update(grads, self.opt_state, self.params)
         new_params = optax.apply_updates(self.params, updates)
         new_ema = self.ema_params
-        if new_ema is not None and ema_decay is not None:
-            decayed = jax.tree.map(
-                lambda e, p: e * ema_decay + (1.0 - ema_decay) * p,
-                new_ema, new_params,
-            )
-            if hasattr(new_opt_state, "mini_step"):
-                # optax.MultiSteps: mid-accumulation steps emit zero
-                # updates; decaying the EMA there would compound to
-                # decay^k per real update. mini_step wraps to 0 exactly
-                # when the averaged update was applied.
-                emit = new_opt_state.mini_step == 0
-                new_ema = jax.tree.map(
-                    lambda d, e: jnp.where(emit, d, e), decayed, new_ema
+        new_ema_bs = self.ema_batch_stats
+        if ema_decay is not None:
+            # optax.MultiSteps: mid-accumulation steps emit zero updates;
+            # decaying the EMA there would compound to decay^k per real
+            # update. mini_step wraps to 0 exactly when the averaged
+            # update was applied. batch_stats shadow on the same cadence.
+            emit = (new_opt_state.mini_step == 0
+                    if hasattr(new_opt_state, "mini_step") else None)
+
+            def shadowed(shadow: PyTree, live: PyTree) -> PyTree:
+                decayed = jax.tree.map(
+                    lambda e, p: e * ema_decay + (1.0 - ema_decay) * p,
+                    shadow, live,
                 )
-            else:
-                new_ema = decayed
+                if emit is None:
+                    return decayed
+                return jax.tree.map(
+                    lambda d, e: jnp.where(emit, d, e), decayed, shadow
+                )
+
+            if new_ema is not None:
+                new_ema = shadowed(new_ema, new_params)
+            if new_ema_bs is not None:
+                new_ema_bs = shadowed(
+                    new_ema_bs,
+                    new_batch_stats if new_batch_stats is not None
+                    else self.batch_stats,
+                )
         return self.replace(
             step=self.step + 1,
             params=new_params,
             batch_stats=new_batch_stats if new_batch_stats is not None else self.batch_stats,
             opt_state=new_opt_state,
             ema_params=new_ema,
+            ema_batch_stats=new_ema_bs,
         )
 
 

@@ -83,6 +83,11 @@ def main() -> None:
                                      np.float32))
     expect = np.repeat(np.arange(n_procs, dtype=np.float32), 2)
     np.testing.assert_array_equal(np.asarray(gathered), expect)
+    # broadcast from a NON-zero root: every process must receive the last
+    # rank's value (full hvd surface — root_rank is not pinned to 0).
+    root = n_procs - 1
+    got = hvd.broadcast(np.float32(jax.process_index()), root_rank=root)
+    np.testing.assert_allclose(np.asarray(got), float(root))
 
     # One real training step through the Trainer (grad all-reduce across
     # all processes compiled into the step).

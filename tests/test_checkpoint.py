@@ -271,3 +271,27 @@ def test_keras_h5_import_into_s2d_stem(tmp_path):
     y_back = src.apply(v_back, x, train=False)
     np.testing.assert_allclose(np.asarray(y_back), np.asarray(y_src),
                                atol=1e-4, rtol=2e-3)
+
+
+def test_restore_pre_ema_batch_stats_checkpoint(tmp_path):
+    """Migration: a checkpoint written before TrainState grew
+    ema_batch_stats (r2 layout) restores into a BN+EMA trainer — the
+    stats shadow is seeded from the restored live batch_stats instead of
+    failing the orbax structure match."""
+    old = _trainer(ema_decay=0.9)
+    old.fit(_dataset(), epochs=1, steps_per_epoch=2, verbose=0)
+    legacy_state = old.state.replace(ema_batch_stats=None)  # r2 tree shape
+    ckpt = Checkpointer(str(tmp_path / "ck"), async_save=False)
+    ckpt.save(legacy_state, epoch=0)
+    ckpt.wait()
+
+    new = _trainer(ema_decay=0.9)
+    new.init_state(next(iter(_dataset())))
+    assert jax.tree.leaves(new.state.ema_batch_stats)  # BN model, shadow on
+    restored = ckpt.restore(new.state)
+    ckpt.close()
+
+    _assert_tree_equal(restored.params, old.state.params)
+    _assert_tree_equal(restored.batch_stats, old.state.batch_stats)
+    # Shadow seeded from the restored stats (its init-time value).
+    _assert_tree_equal(restored.ema_batch_stats, old.state.batch_stats)

@@ -80,6 +80,25 @@ def test_hf_gpt2_wrong_shape_raises():
         load_hf_gpt2(hf, v)
 
 
+def test_hf_gpt2_ln_eps_mismatch_raises():
+    """ln_eps is a module attribute, invisible in the variables tree: a
+    model left at the default 1e-6 must not import HF weights (1e-5)
+    silently — logits would drift with no error."""
+    hf = _hf_model()
+    default_eps = GPT(vocab_size=V, max_len=P, embed_dim=E, depth=L,
+                      num_heads=H, attention="reference")  # ln_eps=1e-6
+    v = default_eps.init(jax.random.key(0), _tokens(), train=False)
+    with pytest.raises(ValueError, match="ln_eps"):
+        load_hf_gpt2(hf, v, model=default_eps)
+    with pytest.raises(ValueError, match="ln_eps"):
+        load_hf_gpt2(hf, v, expected_ln_eps=1e-6)
+    # Matching epsilon passes the gate (model= form).
+    ok = GPT(vocab_size=V, max_len=P, embed_dim=E, depth=L, num_heads=H,
+             attention="reference", ln_eps=1e-5)
+    v_ok = ok.init(jax.random.key(0), _tokens(), train=False)
+    load_hf_gpt2(hf, v_ok, model=ok)
+
+
 def test_hf_gpt2_deeper_checkpoint_raises():
     """A checkpoint with MORE layers than the model must not import
     silently (the dropped-layers case)."""

@@ -45,6 +45,12 @@ def test_allreduce_and_broadcast_single_process_identity():
     out = hvd.allgather(x)
     np.testing.assert_array_equal(out["a"], x["a"])
     np.testing.assert_array_equal(out["b"], np.asarray([3.0]))
+    # Any in-range root is accepted (real cross-process check lives in
+    # tests/_multiworker_child.py); out-of-range raises.
+    import pytest
+
+    with pytest.raises(ValueError, match="root_rank"):
+        hvd.broadcast(x, root_rank=1)  # only 1 process here
 
 
 def test_distributed_optimizer_pmeans_gradients_in_shard_map(mesh8):

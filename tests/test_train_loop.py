@@ -140,7 +140,7 @@ def test_one_shot_iterator_multi_epoch_raises():
         tr.fit(iter([ds.batch(0), ds.batch(1)]), epochs=2, verbose=0)
 
 
-def test_finite_reiterable_repeats_under_steps_per_epoch():
+def test_finite_reiterable_repeats_under_steps_per_epoch(caplog):
     """A finite re-iterable dataset + steps_per_epoch repeats implicitly:
     the reference's `.repeat()` + fixed-steps pattern
     (imagenet-resnet50-ps.py:118-119,143). 4 epochs x 3 steps = 12 steps
@@ -159,6 +159,12 @@ def test_finite_reiterable_repeats_under_steps_per_epoch():
     assert int(jax.device_get(tr.state.step)) == 12
     assert len(h.epoch) == 4
     assert len(passes) >= 3  # the dataset really was re-iterated
+    # The first re-pass announces itself ONCE with the observed pass size
+    # (a mis-sized pipeline must not repeat silently).
+    msgs = [r.getMessage() for r in caplog.records
+            if "outlives the dataset" in r.getMessage()]
+    assert len(msgs) == 1
+    assert "5 batches/pass" in msgs[0]
 
     # A one-shot ITERATOR under steps_per_epoch still just ends: the epoch
     # that receives nothing raises rather than silently spinning.
@@ -167,6 +173,22 @@ def test_finite_reiterable_repeats_under_steps_per_epoch():
     with pytest.raises(ValueError, match="empty training dataset"):
         tr2.fit(iter([ds.batch(i) for i in range(4)]), epochs=3,
                 steps_per_epoch=3, verbose=0)
+
+
+def test_log_grad_norm_in_history():
+    """log_grad_norm=True adds the global gradient L2 norm to the train
+    logs (the observable the multichip equivalence gate compares)."""
+    tr = Trainer(tiny_resnet(num_classes=10), learning_rate=1e-2,
+                 strategy=SingleDeviceStrategy(), log_grad_norm=True)
+    tr.fit(_dataset(16), epochs=2, steps_per_epoch=1, verbose=0)
+    norms = tr.history.history["grad_norm"]
+    assert len(norms) == 2
+    assert all(np.isfinite(n) and n > 0 for n in norms)
+    # Off by default: no spurious key in the logs.
+    tr2 = Trainer(tiny_resnet(num_classes=10), learning_rate=1e-2,
+                  strategy=SingleDeviceStrategy())
+    tr2.fit(_dataset(16), epochs=1, steps_per_epoch=1, verbose=0)
+    assert "grad_norm" not in tr2.history.history
 
 
 def test_determinism_same_seed_bitwise():
