@@ -1,9 +1,11 @@
 """End-to-end GPT training throughput on one chip (tokens/sec, MFU).
 
 The harness behind the architecture doc's long-context numbers
-(v5e, GPT-2-small shape, B8 S2048 bf16 flash: ~86-93k tokens/s across
-runs, ≈43-46% MFU by the 6ND estimate against the 197 TFLOP/s bf16
-peak — chip-state variance of a few percent per run is normal).
+(v5e, GPT-2-small shape, B8 S2048 bf16 flash + fused-CE head:
+~95k tokens/s, ≈47.5% MFU by the 6ND estimate against the 197 TFLOP/s
+bf16 peak — chip-state variance of a few percent per run is normal;
+decomposition of the remainder: docs/ARCHITECTURE.md §7b and
+artifacts/gpt_bench/r03_ablation.json).
 
 Long context on ONE chip (``--remat dots``): S=8192 at ~32k tokens/s,
 S=16384 at ~22k tokens/s (B1), where the materialized-scores attention
@@ -15,13 +17,15 @@ could not even hold a single layer's S² matrix.
 from __future__ import annotations
 
 import argparse
+import json
+import os
 import time
 
 import jax
 import jax.numpy as jnp
 import optax
 
-from pddl_tpu.models.gpt import GPT
+from pddl_tpu.models.gpt import GPT, fused_lm_loss
 from pddl_tpu.train.state import TrainState
 
 V5E_BF16_PEAK_FLOPS = 197e12
@@ -39,6 +43,11 @@ def main() -> None:
     p.add_argument("--remat", default="none",
                    choices=["none", "dots", "full"],
                    help="activation checkpointing (long sequences: dots)")
+    p.add_argument("--fused-ce", type=int, default=1,
+                   help="1 (default): fused head+CE via fused_lm_loss; "
+                        "0: materialized logits + sparse CE")
+    p.add_argument("--out", default="",
+                   help="also write the JSON record to this path")
     args = p.parse_args()
 
     model = GPT(vocab_size=args.vocab, max_len=args.seq,
@@ -59,6 +68,14 @@ def main() -> None:
 
     def step(state, tokens, targets):
         def loss_of(params):
+            if args.fused_ce:
+                # Fused head + CE (models/gpt.py fused_lm_loss): only
+                # logsumexp rows cross the fwd/bwd boundary — measured
+                # ~6 ms/step faster than the materialized path here (the
+                # one-chunk default trades a transient f32 logits chunk
+                # for speed; chunk_size < vocab is the memory valve).
+                return fused_lm_loss(model, {"params": params}, tokens,
+                                     targets, train=True)
             logits = model.apply({"params": params}, tokens, train=True)
             return optax.softmax_cross_entropy_with_integer_labels(
                 logits, targets).mean()
@@ -78,10 +95,32 @@ def main() -> None:
     toks = B * S / dt
     n_params = sum(x.size for x in jax.tree.leaves(state.params))
     mfu = 6 * n_params * toks / V5E_BF16_PEAK_FLOPS
-    print(f"{n_params / 1e6:.0f}M params, B{B} S{S} bf16 flash:")
+    print(f"{n_params / 1e6:.0f}M params, B{B} S{S} bf16 "
+          f"{args.remat} remat, fused_ce={bool(args.fused_ce)}:")
     print(f"  {dt * 1e3:.1f} ms/step = {toks:,.0f} tokens/sec/chip")
     print(f"  ~{mfu * 100:.0f}% MFU (6ND / {V5E_BF16_PEAK_FLOPS / 1e12:.0f}"
           " TFLOP/s v5e bf16 peak)")
+    record = {
+        "metric": "gpt_small_train_tokens_per_sec_per_chip",
+        "value": round(toks, 1),
+        "unit": "tokens/sec/chip",
+        "mfu_6nd": round(mfu, 4),
+        "ms_per_step": round(dt * 1e3, 2),
+        "config": {"batch": B, "seq": S, "depth": args.depth,
+                   "width": args.width, "heads": args.heads,
+                   "vocab": args.vocab, "params_m": round(n_params / 1e6, 1),
+                   "remat": args.remat, "fused_ce": bool(args.fused_ce),
+                   "attention": "flash", "dtype": "bfloat16",
+                   "steps": args.steps},
+        "device": jax.devices()[0].device_kind,
+    }
+    print(json.dumps(record))
+    if args.out:
+        out_dir = os.path.dirname(args.out)
+        if out_dir:
+            os.makedirs(out_dir, exist_ok=True)
+        with open(args.out, "w") as f:
+            json.dump(record, f, indent=1)
 
 
 if __name__ == "__main__":

@@ -360,3 +360,73 @@ def test_ring_flash_gpt_matches_reference(mesh8):
         variables, x)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                atol=2e-4, rtol=2e-4)
+
+
+def test_fused_lm_loss_matches_materialized():
+    """fused_lm_loss (features -> chunked CE, logits never materialized)
+    computes the same loss AND parameter gradients as the standard
+    logits + sparse-CE path, including on a padded-vocab head."""
+    import optax
+
+    from pddl_tpu.models.gpt import fused_lm_loss
+
+    for vm in (1, 32):  # plain and vocab_multiple-padded heads
+        model = GPT(vocab_size=97, max_len=32, embed_dim=32, depth=2,
+                    num_heads=4, attention="reference", vocab_multiple=vm)
+        tokens = jnp.asarray(
+            np.random.default_rng(0).integers(0, 97, (2, 24)), jnp.int32)
+        targets = jnp.asarray(
+            np.random.default_rng(1).integers(0, 97, (2, 24)), jnp.int32)
+        v = model.init(jax.random.key(0), tokens, train=False)
+
+        def materialized(v):
+            logits = model.apply(v, tokens, train=False)
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits, targets).mean()
+
+        def fused(v):
+            return fused_lm_loss(model, v, tokens, targets, train=False)
+
+        lm, gm = jax.value_and_grad(materialized)(v)
+        lf, gf = jax.value_and_grad(fused)(v)
+        np.testing.assert_allclose(float(lf), float(lm), rtol=1e-6)
+        for a, b in zip(jax.tree.leaves(gf), jax.tree.leaves(gm)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=1e-5, rtol=1e-5)
+
+        # Chunked (multi-slab) variant agrees too — the memory valve.
+        lc = fused_lm_loss(model, v, tokens, targets, train=False,
+                           chunk_size=32)
+        np.testing.assert_allclose(float(lc), float(lm), rtol=1e-6)
+
+    # init() with features_only=True must STILL create lm_head (the
+    # early return is apply-only), or the params tree silently loses the
+    # head and checkpoints go shape-incompatible.
+    v_feat = GPT(vocab_size=97, max_len=32, embed_dim=32, depth=1,
+                 num_heads=4, attention="reference").init(
+        jax.random.key(0), tokens, train=False, features_only=True)
+    assert "lm_head" in v_feat["params"]
+
+    # bf16 (the bench/TPU configuration): both paths do the head matmul
+    # from bf16 operands with f32 accumulation — bf16-level agreement.
+    model = GPT(vocab_size=97, max_len=32, embed_dim=32, depth=2,
+                num_heads=4, attention="reference", dtype=jnp.bfloat16)
+    tokens = jnp.asarray(
+        np.random.default_rng(0).integers(0, 97, (2, 24)), jnp.int32)
+    targets = jnp.asarray(
+        np.random.default_rng(1).integers(0, 97, (2, 24)), jnp.int32)
+    v = model.init(jax.random.key(0), tokens, train=False)
+
+    def materialized16(v):
+        logits = model.apply(v, tokens, train=False)
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, targets).mean()
+
+    lm, gm = jax.value_and_grad(materialized16)(v)
+    lf, gf = jax.value_and_grad(
+        lambda v: fused_lm_loss(model, v, tokens, targets, train=False))(v)
+    np.testing.assert_allclose(float(lf), float(lm), rtol=2e-3)
+    for a, b in zip(jax.tree.leaves(gf), jax.tree.leaves(gm)):
+        np.testing.assert_allclose(np.asarray(a, np.float32),
+                                   np.asarray(b, np.float32),
+                                   atol=2e-2, rtol=2e-2)
