@@ -1,4 +1,5 @@
-"""HuggingFace GPT-2 weight import — the LM-family ``weights='imagenet'``.
+"""HuggingFace LM weight import (GPT-2 + Llama) — the LM families'
+``weights='imagenet'``.
 
 The reference's pretrained mode loads published backbone weights into the
 vision model (``/root/reference/imagenet-pretrained-resnet50.py:56``);
@@ -44,6 +45,39 @@ PyTree = Any
 
 def _np(t) -> np.ndarray:
     return t.detach().cpu().numpy() if hasattr(t, "detach") else np.asarray(t)
+
+
+def _tree_put(params: PyTree, path: str, value: np.ndarray, *,
+              allow_vocab_pad: bool = False,
+              what: str = "hf import") -> None:
+    """Write ``value`` at ``a/b/c``-style ``path`` in a mutable numpy tree.
+
+    Shared by both importers. ``allow_vocab_pad``: a smaller HF vocab
+    fills the real slice of a ``vocab_multiple``-padded leaf (rows of a
+    ``[V, E]`` embedding, columns of an ``[E, V]`` head, a ``[V]`` bias);
+    padding entries keep their init — they are unreachable, the head
+    slices them away.
+    """
+    node = params
+    *parents, name = path.split("/")
+    for p in parents:
+        node = node[p]
+    old = node[name]
+    if allow_vocab_pad and value.shape != old.shape:
+        merged = np.array(old)
+        if value.ndim == 1:
+            merged[: value.shape[0]] = value
+        elif value.shape[0] != old.shape[0]:       # [V, E] rows
+            merged[: value.shape[0], ...] = value
+        else:                                      # [E, V] columns
+            merged[:, : value.shape[1]] = value
+        value = merged
+    if value.shape != old.shape:
+        raise ValueError(
+            f"{what} {path}: shape {value.shape} != model's "
+            f"{old.shape} (wrong depth/width/heads?)"
+        )
+    node[name] = value.astype(old.dtype)
 
 
 def load_hf_gpt2(model_or_dir, variables: PyTree, *,
@@ -92,32 +126,8 @@ def load_hf_gpt2(model_or_dir, variables: PyTree, *,
     # _as_mutable unfreezes FrozenDict levels like keras_import does).
     params = jax.tree.map(np.asarray, _as_mutable(variables["params"]))
 
-    def leaf(path: str):
-        node = params
-        *parents, name = path.split("/")
-        for p in parents:
-            node = node[p]
-        return node, name
-
     def put(path: str, value: np.ndarray, allow_vocab_pad: bool = False):
-        node, name = leaf(path)
-        old = node[name]
-        if allow_vocab_pad and value.shape != old.shape:
-            # vocab_multiple padding: fill the real slice, keep the rest.
-            merged = np.array(old)
-            if value.ndim == 1:
-                merged[: value.shape[0]] = value
-            elif value.shape[0] != old.shape[0]:   # [V, E] rows
-                merged[: value.shape[0], ...] = value
-            else:                                  # [E, V] columns
-                merged[:, : value.shape[1]] = value
-            value = merged
-        if value.shape != old.shape:
-            raise ValueError(
-                f"hf import {path}: shape {value.shape} != model's "
-                f"{old.shape} (wrong depth/width/heads?)"
-            )
-        node[name] = value.astype(old.dtype)
+        _tree_put(params, path, value, allow_vocab_pad=allow_vocab_pad)
 
     wte = sd[f"{prefix}wte.weight"]
     put("token_embed/embedding", wte, allow_vocab_pad=True)
@@ -175,6 +185,115 @@ def load_hf_gpt2(model_or_dir, variables: PyTree, *,
     lm_bias = np.array(lm_bias)
     lm_bias[: wte.shape[0]] = 0.0
     params["lm_head"]["bias"] = lm_bias
+
+    out = dict(variables)
+    out["params"] = params
+    return out
+
+
+def load_hf_llama(model_or_dir, variables: PyTree, *,
+                  model=None, expected_rms_eps: float | None = None,
+                  expected_rope_theta: float | None = None) -> PyTree:
+    """Load a HF Llama checkpoint into a :class:`~pddl_tpu.models.llama.
+    Llama` variables tree.
+
+    Name map (HF ``model.*`` → ours; torch ``nn.Linear`` stores
+    ``[out, in]``, so every kernel transposes)::
+
+        embed_tokens.weight                      embed.embedding   [V, E]
+        layers.<i>.input_layernorm.weight        block<i>.ln1.scale
+        layers.<i>.self_attn.{q,k,v}_proj.weight block<i>.attn.{query,key,value}
+                                                 ([E, H(or H_kv), D])
+        layers.<i>.self_attn.o_proj.weight       block<i>.attn.out [H*D, E]
+        layers.<i>.post_attention_layernorm.*    block<i>.ln2.scale
+        layers.<i>.mlp.{gate,up}_proj.weight     block<i>.mlp_{gate,up} [E, I]
+        layers.<i>.mlp.down_proj.weight          block<i>.mlp_down [I, E]
+        norm.weight                              ln_final.scale
+        lm_head.weight (or tied embed)           lm_head.kernel    [E, V]
+
+    Like the GPT-2 importer, module attributes invisible in the weights
+    are validated when the ``model`` (or the ``expected_*`` values) is
+    given: ``rms_eps`` against ``config.rms_norm_eps`` and ``rope_theta``
+    against ``config.rope_theta`` — either mismatch silently skews logits.
+    A ``vocab_multiple``-padded model accepts the smaller HF vocab.
+    """
+    if isinstance(model_or_dir, str):
+        from transformers import LlamaForCausalLM  # noqa: PLC0415
+
+        model_or_dir = LlamaForCausalLM.from_pretrained(model_or_dir)
+    cfg = getattr(model_or_dir, "config", None)
+    if expected_rms_eps is None and model is not None:
+        expected_rms_eps = getattr(model, "rms_eps", None)
+    if expected_rope_theta is None and model is not None:
+        expected_rope_theta = getattr(model, "rope_theta", None)
+    if cfg is not None:
+        # Only validate against a real config — a bare state_dict holder
+        # (supported, like the GPT-2 importer) has nothing to check
+        # against, and inventing defaults would spuriously reject e.g. a
+        # Llama-3-style rope_theta=500000 model.
+        for name, want, have in (
+            ("rms_eps", expected_rms_eps,
+             getattr(cfg, "rms_norm_eps", None)),
+            ("rope_theta", expected_rope_theta,
+             getattr(cfg, "rope_theta", None)),
+        ):
+            if want is not None and have is not None \
+                    and not np.isclose(want, have, rtol=1e-3):
+                raise ValueError(
+                    f"hf llama import: model was built with {name}={want} "
+                    f"but the checkpoint uses {have} — rebuild the Llama "
+                    f"with {name}={have} (the value is baked into the "
+                    "module, not the weights, so the import would "
+                    "silently skew logits)"
+                )
+    sd = {k: _np(v) for k, v in model_or_dir.state_dict().items()}
+    prefix = "model." if any(k.startswith("model.") for k in sd) else ""
+
+    params = jax.tree.map(np.asarray, _as_mutable(variables["params"]))
+
+    def put(path: str, value: np.ndarray, allow_vocab_pad: bool = False):
+        _tree_put(params, path, value, allow_vocab_pad=allow_vocab_pad,
+                  what="hf llama import")
+
+    wte = sd[f"{prefix}embed_tokens.weight"]
+    put("embed/embedding", wte, allow_vocab_pad=True)
+
+    n_blocks = sum(1 for k in params if k.startswith("block"))
+    n_hf = 1 + max(
+        (int(m.group(1)) for m in
+         (re.match(rf"{re.escape(prefix)}layers\.(\d+)\.", k) for k in sd)
+         if m),
+        default=-1,
+    )
+    if n_hf != n_blocks:
+        raise ValueError(
+            f"hf llama import: checkpoint has {n_hf} layers but the model "
+            f"has {n_blocks} — depths must match"
+        )
+    e = wte.shape[1]
+    for i in range(n_blocks):
+        hf = f"{prefix}layers.{i}."
+        put(f"block{i}/ln1/scale", sd[hf + "input_layernorm.weight"])
+        put(f"block{i}/ln2/scale", sd[hf + "post_attention_layernorm.weight"])
+
+        attn = params[f"block{i}"]["attn"]
+        h = attn["query"]["kernel"].shape[1]
+        d = e // h
+        for name, proj in (("query", "q_proj"), ("key", "k_proj"),
+                           ("value", "v_proj")):
+            w = sd[hf + f"self_attn.{proj}.weight"]  # [Hx*D, E]
+            hx = attn[name]["kernel"].shape[1]       # H or H_kv
+            put(f"block{i}/attn/{name}/kernel", w.T.reshape(e, hx, d))
+        put(f"block{i}/attn/out/kernel",
+            sd[hf + "self_attn.o_proj.weight"].T)    # [E, H*D] -> [H*D, E]
+
+        put(f"block{i}/mlp_gate/kernel", sd[hf + "mlp.gate_proj.weight"].T)
+        put(f"block{i}/mlp_up/kernel", sd[hf + "mlp.up_proj.weight"].T)
+        put(f"block{i}/mlp_down/kernel", sd[hf + "mlp.down_proj.weight"].T)
+
+    put("ln_final/scale", sd[f"{prefix}norm.weight"])
+    head = sd.get("lm_head.weight", wte)  # tied when absent
+    put("lm_head/kernel", head.T, allow_vocab_pad=True)
 
     out = dict(variables)
     out["params"] = params

@@ -442,7 +442,9 @@ def fused_lm_loss(model: GPT, variables, tokens, targets, *,
     # Compute dtype like the materialized Dense(dtype=model.dtype) would:
     # the chunked matmuls run on these operands with f32 accumulation.
     kernel = head["kernel"][:, :model.vocab_size].astype(model.dtype)
-    bias = head["bias"][:model.vocab_size].astype(jnp.float32)
+    # Bias-free heads (the Llama family) simply skip the bias term.
+    bias = head["bias"][:model.vocab_size].astype(jnp.float32) \
+        if "bias" in head else None
     return chunked_cross_entropy(
         feats, kernel, targets, bias,
         chunk_size=chunk_size if chunk_size is not None else model.vocab_size,

@@ -1,0 +1,278 @@
+"""Llama family: RoPE + RMSNorm + SwiGLU + grouped-query attention.
+
+The reference repo trains one CNN family end to end
+(`/root/reference/imagenet-resnet50.py:52`); its TPU rebuild carries a
+transformer LM line (:mod:`pddl_tpu.models.gpt`) as the long-context
+workload. This module adds the *modern* decoder architecture — the
+Llama/Mistral/Qwen lineage — on the same substrate:
+
+- **RoPE** (:mod:`pddl_tpu.ops.rope`) instead of GPT-2's learned
+  position table: no ``max_len``-sized parameter, positions enter
+  through q/k rotation, HF half-split convention so
+  :func:`pddl_tpu.ckpt.hf_import.load_hf_llama` checkpoints reproduce
+  transformers' logits to f32 tolerance.
+- **RMSNorm** (f32 compute, like the family's LayerNorms) pre-attention,
+  pre-MLP, and final.
+- **SwiGLU** MLP (``silu(gate)·up → down``), no biases anywhere.
+- **Grouped-query attention**: ``num_kv_heads <= num_heads`` K/V heads,
+  broadcast to the query heads for the kernel — the KV *cache* stays at
+  KV-head size, which is the whole point of GQA (decode memory/BW drops
+  by ``num_heads/num_kv_heads``).
+
+Everything else — flash/ring attention, Megatron TP (use
+``LLAMA_TP_RULES`` from :mod:`pddl_tpu.parallel.tensor_parallel`),
+fused-CE training loss, KV-cache generation — is shared with the GPT
+family: :func:`pddl_tpu.models.gpt.generate` and
+:func:`pddl_tpu.models.gpt.fused_lm_loss` are duck-typed over both.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from pddl_tpu.models.vit import remat_block
+from pddl_tpu.ops.attention import attention_reference, flash_attention
+from pddl_tpu.ops.rope import apply_rope_qk
+
+
+def _rms_norm(eps: float, param_dtype, name: str):
+    """Family-standard RMSNorm: f32 compute (stable under bf16), learned
+    scale in ``param_dtype``."""
+    return nn.RMSNorm(epsilon=eps, dtype=jnp.float32,
+                      param_dtype=param_dtype, name=name)
+
+
+class LlamaAttention(nn.Module):
+    """Causal GQA with RoPE over the repo's attention kernels.
+
+    Layout mirrors :class:`pddl_tpu.models.vit.MultiHeadAttention`
+    (``query``/``key``/``value`` DenseGeneral, flattened ``out``) so the
+    Megatron TP path rules apply unchanged; K/V carry ``num_kv_heads``
+    and are repeated head-wise to feed the kernels.
+    """
+
+    num_heads: int
+    num_kv_heads: int
+    rope_theta: float = 10000.0
+    attention: str = "flash"  # "flash" | "reference" | "ring" | "ring_flash"
+    mesh: Optional[Any] = None
+    decode: bool = False
+    max_decode_len: int = 1024
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        b, s, e = x.shape
+        if e % self.num_heads:
+            raise ValueError(f"embed dim {e} not divisible by {self.num_heads} heads")
+        if self.num_heads % self.num_kv_heads:
+            raise ValueError(
+                f"num_heads {self.num_heads} not divisible by "
+                f"num_kv_heads {self.num_kv_heads}")
+        head_dim = e // self.num_heads
+        dense = functools.partial(
+            nn.DenseGeneral, use_bias=False, dtype=self.dtype,
+            param_dtype=self.param_dtype,
+        )
+        q = dense(features=(self.num_heads, head_dim), name="query")(x)
+        k = dense(features=(self.num_kv_heads, head_dim), name="key")(x)
+        v = dense(features=(self.num_kv_heads, head_dim), name="value")(x)
+        q, k, v = (t.transpose(0, 2, 1, 3) for t in (q, k, v))  # [B, H, S, D]
+
+        if self.decode:
+            return self._decode_step(q, k, v, b, s, head_dim, dense)
+
+        q, k = apply_rope_qk(q, k, jnp.arange(s), theta=self.rope_theta)
+        k, v = (self._expand_kv(t) for t in (k, v))
+
+        if self.attention == "flash":
+            o = flash_attention(q, k, v, causal=True)
+        elif self.attention == "reference":
+            o = attention_reference(q, k, v, causal=True)
+        elif self.attention in ("ring", "ring_flash"):
+            from pddl_tpu.ops.ring_attention import sequence_parallel_attention
+
+            if self.mesh is None:
+                raise ValueError(f"attention={self.attention!r} needs the mesh")
+            o = sequence_parallel_attention(
+                q, k, v, self.mesh, causal=True,
+                use_flash=self.attention == "ring_flash")
+        else:
+            raise ValueError(f"unknown attention {self.attention!r}")
+
+        o = o.transpose(0, 2, 1, 3).reshape(b, s, e)
+        return dense(features=e, name="out")(o)
+
+    def _expand_kv(self, t: jnp.ndarray) -> jnp.ndarray:
+        """[B, H_kv, S, D] → [B, H, S, D] by repeating each KV head."""
+        rep = self.num_heads // self.num_kv_heads
+        if rep == 1:
+            return t
+        return jnp.repeat(t, rep, axis=1)
+
+    def _decode_step(self, q, k, v, b, s, head_dim, dense):
+        """KV-cache decoding; the cache holds POST-RoPE keys at KV-head
+        granularity (each key is rotated once, at its absolute position —
+        queries rotate at theirs, relative phase falls out)."""
+        hkv = self.num_kv_heads
+        initialized = self.has_variable("cache", "cached_key")
+        cached_k = self.variable(
+            "cache", "cached_key", jnp.zeros,
+            (b, hkv, self.max_decode_len, head_dim), self.dtype)
+        cached_v = self.variable(
+            "cache", "cached_value", jnp.zeros,
+            (b, hkv, self.max_decode_len, head_dim), self.dtype)
+        index = self.variable(
+            "cache", "cache_index", lambda: jnp.zeros((), jnp.int32))
+
+        i = index.value
+        q, k = apply_rope_qk(q, k, i + jnp.arange(s), theta=self.rope_theta)
+        if initialized:
+            cached_k.value = jax.lax.dynamic_update_slice(
+                cached_k.value, k.astype(self.dtype), (0, 0, i, 0))
+            cached_v.value = jax.lax.dynamic_update_slice(
+                cached_v.value, v.astype(self.dtype), (0, 0, i, 0))
+            index.value = i + s
+
+        kf = self._expand_kv(cached_k.value).astype(jnp.float32)
+        vf = self._expand_kv(cached_v.value).astype(jnp.float32)
+        qf = q.astype(jnp.float32) * (head_dim ** -0.5)
+        scores = jnp.einsum("bhqd,bhkd->bhqk", qf, kf)
+        k_pos = jnp.arange(self.max_decode_len)[None, :]
+        q_pos = i + jnp.arange(s)[:, None]
+        scores = jnp.where((k_pos <= q_pos)[None, None], scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1)
+        o = jnp.einsum("bhqk,bhkd->bhqd", probs, vf).astype(q.dtype)
+        o = o.transpose(0, 2, 1, 3).reshape(b, s, self.num_heads * head_dim)
+        return dense(features=self.num_heads * head_dim, name="out")(o)
+
+
+class LlamaBlock(nn.Module):
+    """Pre-RMSNorm residual block: attention then SwiGLU MLP."""
+
+    num_heads: int
+    num_kv_heads: int
+    intermediate_dim: int
+    rope_theta: float = 10000.0
+    attention: str = "flash"
+    mesh: Optional[Any] = None
+    decode: bool = False
+    max_decode_len: int = 1024
+    rms_eps: float = 1e-5
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True, /):
+        # train is positional-only for remat static_argnums — see
+        # vit.TransformerBlock. (SwiGLU has no dropout; the arg exists
+        # for block-interface parity.)
+        del train
+        e = x.shape[-1]
+        h = _rms_norm(self.rms_eps, self.param_dtype, "ln1")(x)
+        h = LlamaAttention(
+            num_heads=self.num_heads, num_kv_heads=self.num_kv_heads,
+            rope_theta=self.rope_theta, attention=self.attention,
+            mesh=self.mesh, decode=self.decode,
+            max_decode_len=self.max_decode_len, dtype=self.dtype,
+            param_dtype=self.param_dtype, name="attn",
+        )(h.astype(self.dtype))
+        x = x + h
+
+        h = _rms_norm(self.rms_eps, self.param_dtype, "ln2")(x)
+        h = h.astype(self.dtype)
+        dense = functools.partial(nn.Dense, use_bias=False, dtype=self.dtype,
+                                  param_dtype=self.param_dtype)
+        gate = dense(self.intermediate_dim, name="mlp_gate")(h)
+        up = dense(self.intermediate_dim, name="mlp_up")(h)
+        h = dense(e, name="mlp_down")(nn.silu(gate) * up)
+        return x + h
+
+
+class Llama(nn.Module):
+    """Decoder-only Llama-architecture LM: tokens ``[B, S]`` → logits.
+
+    Interface-compatible with :class:`pddl_tpu.models.gpt.GPT` where it
+    matters — ``max_len``/``decode``/``vocab_size``/``vocab_multiple``/
+    ``dtype`` attributes, ``features_only`` apply mode, ``lm_head``
+    param naming — so :func:`pddl_tpu.models.gpt.generate` and
+    :func:`pddl_tpu.models.gpt.fused_lm_loss` work on it unchanged.
+    """
+
+    vocab_size: int
+    max_len: int = 2048
+    embed_dim: int = 512
+    depth: int = 4
+    num_heads: int = 8
+    num_kv_heads: Optional[int] = None  # None → MHA (= num_heads)
+    intermediate_dim: Optional[int] = None  # None → SwiGLU-standard ~8E/3
+    rope_theta: float = 10000.0
+    attention: str = "flash"
+    mesh: Optional[Any] = None
+    remat: str = "none"
+    vocab_multiple: int = 1  # pad V for vocab-parallel TP (see gpt.GPT)
+    decode: bool = False
+    rms_eps: float = 1e-5
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, tokens, *, train: bool = True,
+                 features_only: bool = False):
+        kv = self.num_kv_heads or self.num_heads
+        inter = self.intermediate_dim
+        if inter is None:
+            # The SwiGLU convention: 2/3 of the 4E classic MLP width,
+            # rounded up to a multiple of 128 (lane-friendly).
+            inter = -(-(8 * self.embed_dim // 3) // 128) * 128
+        padded_v = -(-self.vocab_size // self.vocab_multiple) * self.vocab_multiple
+        x = nn.Embed(padded_v, self.embed_dim, dtype=self.dtype,
+                     param_dtype=self.param_dtype, name="embed")(tokens)
+
+        block_cls = (LlamaBlock if self.decode
+                     else remat_block(LlamaBlock, self.remat))
+        for i in range(self.depth):
+            x = block_cls(
+                num_heads=self.num_heads, num_kv_heads=kv,
+                intermediate_dim=inter, rope_theta=self.rope_theta,
+                attention=self.attention, mesh=self.mesh,
+                decode=self.decode, max_decode_len=self.max_len,
+                rms_eps=self.rms_eps, dtype=self.dtype,
+                param_dtype=self.param_dtype, name=f"block{i}",
+            )(x, train)
+
+        x = _rms_norm(self.rms_eps, self.param_dtype, "ln_final")(x)
+        if features_only and not self.is_initializing():
+            # Pre-head features for fused CE. init() falls through to the
+            # Dense regardless (like gpt._GPTHead), so lm_head params
+            # exist even when the first trace goes through fused_lm_loss.
+            return x.astype(self.dtype)
+        logits = nn.Dense(padded_v, use_bias=False, dtype=self.dtype,
+                          param_dtype=self.param_dtype, name="lm_head")(
+                              x.astype(self.dtype))
+        return logits[..., :self.vocab_size].astype(jnp.float32)
+
+
+def tiny_llama(vocab_size: int = 64, **kwargs) -> Llama:
+    """Miniature Llama for tests/dry-runs (GQA exercised: 4 q / 2 kv)."""
+    kwargs.setdefault("max_len", 128)
+    kwargs.setdefault("embed_dim", 32)
+    kwargs.setdefault("depth", 2)
+    kwargs.setdefault("num_heads", 4)
+    kwargs.setdefault("num_kv_heads", 2)
+    kwargs.setdefault("attention", "reference")
+    return Llama(vocab_size=vocab_size, **kwargs)
+
+
+# Llama-3.2-1B-shaped config (RoPE theta 500k, GQA 32/8). Fits one v5e
+# chip in bf16 for training at moderate batch; the multi-chip strategies
+# apply as with every family.
+Llama_1B = functools.partial(
+    Llama, embed_dim=2048, depth=16, num_heads=32, num_kv_heads=8,
+    intermediate_dim=8192, rope_theta=500000.0, max_len=4096)

@@ -70,5 +70,11 @@ def _populate() -> None:
     register_model("gpt_small", _gpt(gpt.GPT_Small))
     register_model("tiny_gpt", _gpt(gpt.tiny_gpt))
 
+    from pddl_tpu.models import llama
+
+    # Llama configs ride the same LM adapter (vocab from num_classes).
+    register_model("llama_1b", _gpt(llama.Llama_1B))
+    register_model("tiny_llama", _gpt(llama.tiny_llama))
+
 
 _populate()

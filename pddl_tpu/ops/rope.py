@@ -1,0 +1,68 @@
+"""Rotary position embeddings (RoPE) for the Llama family.
+
+The reference repo is a fixed-resolution CNN codebase
+(`/root/reference/imagenet-resnet50.py:52`) with no positional encoding of
+any kind — this op exists for the TPU build's long-context transformer
+families, where RoPE is what modern decoder LMs (Llama/Mistral/Qwen) use
+instead of GPT-2's learned position table.
+
+Convention: the half-split ("rotate_half") layout used by HF
+``transformers``' Llama implementation — the head dim is split into two
+halves ``[x1, x2]`` and rotated as ``[x1·cos − x2·sin, x2·cos + x1·sin]``
+with the frequency vector CONCATENATED twice (not interleaved). Matching
+HF exactly is what makes ``ckpt/hf_import.load_hf_llama`` checkpoints
+reproduce logits bit-for-bit-ish (f32 tolerance) — see
+``tests/test_llama.py``.
+
+Angles are computed in f32 regardless of the activation dtype (bf16
+angles visibly corrupt long-range positions), then the rotation is
+applied in the input's dtype.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def rope_cos_sin(positions: jnp.ndarray, head_dim: int,
+                 *, theta: float = 10000.0) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """``(cos, sin)`` tables for integer ``positions`` (any shape).
+
+    Returns f32 arrays of shape ``positions.shape + (head_dim,)`` with the
+    HF layout: frequencies for the first half, duplicated for the second.
+    """
+    if head_dim % 2:
+        raise ValueError(f"RoPE needs an even head_dim, got {head_dim}")
+    inv_freq = 1.0 / (theta ** (
+        jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+    angles = positions.astype(jnp.float32)[..., None] * inv_freq  # [..., D/2]
+    emb = jnp.concatenate([angles, angles], axis=-1)              # [..., D]
+    return jnp.cos(emb), jnp.sin(emb)
+
+
+def _rotate_half(x: jnp.ndarray) -> jnp.ndarray:
+    x1, x2 = jnp.split(x, 2, axis=-1)
+    return jnp.concatenate([-x2, x1], axis=-1)
+
+
+def apply_rope(x: jnp.ndarray, cos: jnp.ndarray, sin: jnp.ndarray) -> jnp.ndarray:
+    """Rotate ``x [..., S, D]`` by per-position ``(cos, sin) [S, D]`` tables.
+
+    ``cos``/``sin`` broadcast against ``x``'s leading dims (pass
+    ``[S, D]`` tables for ``[B, H, S, D]`` activations). Computation
+    happens in f32; the result is cast back to ``x.dtype``.
+    """
+    xf = x.astype(jnp.float32)
+    out = xf * cos + _rotate_half(xf) * sin
+    return out.astype(x.dtype)
+
+
+def apply_rope_qk(q: jnp.ndarray, k: jnp.ndarray, positions: jnp.ndarray,
+                  *, theta: float = 10000.0) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Apply RoPE to query/key ``[B, H, S, D]`` at integer ``positions [S]``.
+
+    q and k may carry different head counts (grouped-query attention);
+    the same tables broadcast over both.
+    """
+    cos, sin = rope_cos_sin(positions, q.shape[-1], theta=theta)
+    return apply_rope(q, cos, sin), apply_rope(k, cos, sin)

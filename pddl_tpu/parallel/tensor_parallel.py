@@ -92,6 +92,19 @@ VIT_TP_RULES: Sequence[Rule] = (
     (r"/lm_head/bias", _shard_dim(0)),
 )
 
+# Llama family (pddl_tpu/models/llama.py): same attention layout as the
+# ViT/GPT families (the /attn/ rules apply as-is; GQA just means the
+# key/value leaves carry H_kv — which must divide the model axis, or the
+# divisibility fallback replicates them), SwiGLU in place of mlp1/mlp2
+# (gate/up column-parallel, down row-parallel — silu(gate)·up is
+# elementwise in the sharded intermediate dim, so the pair needs no
+# collective between them), and Embed/lm_head under Llama's own names.
+LLAMA_TP_RULES: Sequence[Rule] = (
+    (r"/mlp_(gate|up)/kernel", _shard_dim(1)),            # column-parallel (E, I)
+    (r"/mlp_down/kernel", _shard_dim(0)),                 # row-parallel (I, E)
+    (r"/embed/embedding", _shard_dim(0)),                 # vocab-parallel
+) + tuple(VIT_TP_RULES)
+
 # Expert parallelism: Switch-MoE expert-major weights (pddl_tpu/ops/moe.py,
 # w1/w2/b1/b2 of shape [n_experts, ...]) shard dim 0 over `expert`; the
 # router stays replicated. Composes with the TP rules above.
