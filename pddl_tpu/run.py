@@ -31,7 +31,7 @@ def build_trainer(cfg: ExperimentConfig, strategy=None):
     from pddl_tpu.train import callbacks as cb
     from pddl_tpu.train.loop import Trainer
 
-    strategy = strategy or get_strategy(cfg.strategy, **cfg.strategy_options)
+    strategy = strategy or get_strategy(cfg.strategy, **_strategy_options(cfg))
     model_kwargs = dict(
         num_classes=cfg.num_classes,
         dtype=jnp.bfloat16 if cfg.compute_dtype == "bfloat16" else jnp.float32,
@@ -43,15 +43,15 @@ def build_trainer(cfg: ExperimentConfig, strategy=None):
     if cfg.vocab_multiple > 1:
         if not _is_lm(cfg.model):
             raise ValueError(
-                f"--vocab-multiple applies to language models (gpt*), not "
-                f"{cfg.model!r}"
+                f"--vocab-multiple applies to language models "
+                f"(gpt*/llama*), not {cfg.model!r}"
             )
         model_kwargs["vocab_multiple"] = cfg.vocab_multiple
     if cfg.remat and cfg.remat != "none":
-        if not any(t in cfg.model for t in ("vit", "gpt")):
+        if not any(t in cfg.model for t in ("vit", "gpt", "llama")):
             raise ValueError(
-                f"--remat applies to transformer models (vit*/gpt*), not "
-                f"{cfg.model!r}"
+                f"--remat applies to transformer models "
+                f"(vit*/gpt*/llama*), not {cfg.model!r}"
             )
         model_kwargs["remat"] = cfg.remat
     if cfg.stem != "keras":
@@ -158,7 +158,25 @@ def build_trainer(cfg: ExperimentConfig, strategy=None):
 
 def _is_lm(model_name: str) -> bool:
     """Language-model registry names (token batches, no augmentation)."""
-    return model_name.startswith("gpt") or model_name.endswith("gpt")
+    return "gpt" in model_name or "llama" in model_name
+
+
+def _strategy_options(cfg: ExperimentConfig) -> dict:
+    """``cfg.strategy_options``, with the family-correct TP rule table.
+
+    The Llama family's SwiGLU/embed leaves live under their own names
+    (``mlp_gate``/``mlp_up``/``mlp_down``, ``embed``), which the default
+    ``VIT_TP_RULES`` never match — a tensor-parallel Llama would silently
+    replicate the bulk of each block. Explicit ``rules`` in the config
+    still win.
+    """
+    opts = dict(cfg.strategy_options)
+    if (cfg.strategy == "tensor_parallel" and "llama" in cfg.model
+            and "rules" not in opts):
+        from pddl_tpu.parallel.tensor_parallel import LLAMA_TP_RULES
+
+        opts["rules"] = LLAMA_TP_RULES
+    return opts
 
 
 def build_data(cfg: ExperimentConfig, strategy):
@@ -261,7 +279,7 @@ def run_experiment(cfg: ExperimentConfig, steps_per_epoch: Optional[int] = None,
     # does. Caught by the multi-process kill/resume test.
     from pddl_tpu.parallel.base import get_strategy
 
-    strategy = get_strategy(cfg.strategy, **cfg.strategy_options)
+    strategy = get_strategy(cfg.strategy, **_strategy_options(cfg))
     strategy.setup()
     trainer, callbacks = build_trainer(cfg, strategy)
     train, val = build_data(cfg, strategy)

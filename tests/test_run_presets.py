@@ -122,6 +122,57 @@ def test_cli_parses_and_runs():
     assert rc == 0
 
 
+def test_cli_runs_llama_family():
+    """The Llama family rides the same LM plumbing as gpt* names
+    (token batches, synthetic-text data, no augmentation)."""
+    from pddl_tpu.run import main
+
+    rc = main([
+        "--preset", "single", "--model", "tiny_llama", "--batch", "8",
+        "--seq-len", "32", "--epochs", "1", "--steps-per-epoch", "2",
+        "--verbose", "0",
+    ])
+    assert rc == 0
+
+
+def test_strategy_options_pick_llama_tp_rules():
+    """A tensor-parallel Llama run must get LLAMA_TP_RULES (the default
+    VIT table matches none of the SwiGLU/embed leaf names and would
+    silently replicate most of each block); explicit rules still win."""
+    from pddl_tpu.config import get_preset
+    from pddl_tpu.parallel.tensor_parallel import LLAMA_TP_RULES
+    from pddl_tpu.run import _strategy_options
+
+    cfg = get_preset("single", model="tiny_llama",
+                     strategy="tensor_parallel",
+                     strategy_options={"model_parallel": 2})
+    assert _strategy_options(cfg)["rules"] is LLAMA_TP_RULES
+
+    cfg = get_preset("single", model="tiny_gpt",
+                     strategy="tensor_parallel",
+                     strategy_options={"model_parallel": 2})
+    assert "rules" not in _strategy_options(cfg)
+
+    sentinel = ()
+    cfg = get_preset("single", model="tiny_llama",
+                     strategy="tensor_parallel",
+                     strategy_options={"model_parallel": 2,
+                                       "rules": sentinel})
+    assert _strategy_options(cfg)["rules"] is sentinel
+
+
+def test_cli_tensor_parallel_llama_trains():
+    from pddl_tpu.run import main
+
+    rc = main([
+        "--preset", "single", "--model", "tiny_llama", "--batch", "8",
+        "--seq-len", "32", "--epochs", "1", "--steps-per-epoch", "2",
+        "--strategy", "tensor_parallel", "--model-parallel", "2",
+        "--verbose", "0",
+    ])
+    assert rc == 0
+
+
 def test_unknown_preset_raises():
     with pytest.raises(ValueError, match="unknown preset"):
         get_preset("nope")
