@@ -11,6 +11,13 @@ Long context on ONE chip (``--remat dots``): S=8192 at ~32k tokens/s,
 S=16384 at ~22k tokens/s (B1), where the materialized-scores attention
 could not even hold a single layer's S² matrix.
 
+``--family llama`` benches the modern-decoder family at the same shape
+(RoPE/SwiGLU/RMSNorm, GQA ``--kv-heads``, llama-tokenizer 32000 vocab):
+125M params at B8 S2048 bf16 train at ~98.6k tokens/s/chip — faster
+than the GPT shape end-to-end (166.1 vs 173.4 ms/step, pinned as
+``artifacts/gpt_bench/r03_llama_b8_s2048.json`` vs ``r03_b8_s2048.json``;
+the smaller vocab head outweighs the RoPE rotations).
+
     PYTHONPATH=. python benchmarks/gpt_train_bench.py [--seq 2048 --batch 8]
 """
 
@@ -34,12 +41,19 @@ V5E_BF16_PEAK_FLOPS = 197e12
 
 def main() -> None:
     p = argparse.ArgumentParser()
+    p.add_argument("--family", default="gpt", choices=["gpt", "llama"],
+                   help="gpt: learned-pos/GELU/LayerNorm GPT-2 shape; "
+                        "llama: RoPE/SwiGLU/RMSNorm with GQA "
+                        "(--kv-heads), llama-tokenizer vocab default")
     p.add_argument("--seq", type=int, default=2048)
     p.add_argument("--batch", type=int, default=8)
     p.add_argument("--depth", type=int, default=12)
     p.add_argument("--width", type=int, default=768)
     p.add_argument("--heads", type=int, default=12)
-    p.add_argument("--vocab", type=int, default=50257)
+    p.add_argument("--kv-heads", type=int, default=4,
+                   help="llama family only: grouped-query KV heads")
+    p.add_argument("--vocab", type=int, default=None,
+                   help="default: 50257 (gpt) / 32000 (llama)")
     p.add_argument("--steps", type=int, default=10)
     p.add_argument("--remat", default="none",
                    choices=["none", "dots", "full"],
@@ -51,10 +65,21 @@ def main() -> None:
                    help="also write the JSON record to this path")
     args = p.parse_args()
 
-    model = GPT(vocab_size=args.vocab, max_len=args.seq,
-                embed_dim=args.width, depth=args.depth,
-                num_heads=args.heads, attention="flash",
-                remat=args.remat, dtype=jnp.bfloat16)
+    if args.vocab is None:
+        args.vocab = 50257 if args.family == "gpt" else 32000
+    if args.family == "gpt":
+        model = GPT(vocab_size=args.vocab, max_len=args.seq,
+                    embed_dim=args.width, depth=args.depth,
+                    num_heads=args.heads, attention="flash",
+                    remat=args.remat, dtype=jnp.bfloat16)
+    else:
+        from pddl_tpu.models.llama import Llama
+
+        model = Llama(vocab_size=args.vocab, max_len=args.seq,
+                      embed_dim=args.width, depth=args.depth,
+                      num_heads=args.heads, num_kv_heads=args.kv_heads,
+                      attention="flash", remat=args.remat,
+                      dtype=jnp.bfloat16)
     B, S = args.batch, args.seq
     tokens = jax.random.randint(jax.random.key(0), (B, S), 0, args.vocab)
     targets = jax.random.randint(jax.random.key(1), (B, S), 0, args.vocab)
@@ -107,12 +132,13 @@ def main() -> None:
     print(f"  ~{mfu * 100:.0f}% MFU (6ND / {V5E_BF16_PEAK_FLOPS / 1e12:.0f}"
           " TFLOP/s v5e bf16 peak)", file=sys.stderr)
     record = {
-        "metric": "gpt_small_train_tokens_per_sec_per_chip",
+        "metric": f"{args.family}_small_train_tokens_per_sec_per_chip",
         "value": round(toks, 1),
         "unit": "tokens/sec/chip",
         "mfu_6nd": round(mfu, 4),
         "ms_per_step": round(dt * 1e3, 2),
-        "config": {"batch": B, "seq": S, "depth": args.depth,
+        "config": {"family": args.family, "batch": B, "seq": S,
+                   "depth": args.depth,
                    "width": args.width, "heads": args.heads,
                    "vocab": args.vocab, "params_m": round(n_params / 1e6, 1),
                    "remat": args.remat, "fused_ce": bool(args.fused_ce),
@@ -120,6 +146,8 @@ def main() -> None:
                    "steps": args.steps},
         "device": jax.devices()[0].device_kind,
     }
+    if args.family == "llama":
+        record["config"]["kv_heads"] = args.kv_heads
     print(json.dumps(record))
     if args.out:
         out_dir = os.path.dirname(args.out)
