@@ -42,6 +42,7 @@ class GPT(nn.Module):
     mesh: Optional[Any] = None  # required for "ring"/"ring_flash"
     dropout: float = 0.0
     moe_experts: int = 0
+    moe_top_k: int = 1  # experts per token (1=Switch, 2=GShard/Mixtral)
     moe_every: int = 2
     remat: str = "none"  # "none" | "dots" | "full" (vit.REMAT_POLICIES)
     # Pad the embedding/head vocab dim up to a multiple (Megatron's
@@ -78,7 +79,8 @@ class GPT(nn.Module):
                 num_heads=self.num_heads, mlp_ratio=self.mlp_ratio,
                 attention=self.attention, mesh=self.mesh, causal=True,
                 decode=self.decode, max_decode_len=self.max_len,
-                dropout=self.dropout, moe_experts=moe, ln_eps=self.ln_eps,
+                dropout=self.dropout, moe_experts=moe,
+                moe_top_k=self.moe_top_k, ln_eps=self.ln_eps,
                 dtype=self.dtype,
                 param_dtype=self.param_dtype, name=f"block{i}",
             )(x, train)  # positional: remat keeps arg 2 static

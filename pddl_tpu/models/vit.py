@@ -145,6 +145,7 @@ class TransformerBlock(nn.Module):
     max_decode_len: int = 1024
     dropout: float = 0.0
     moe_experts: int = 0  # >0: Switch-MoE FFN instead of the dense MLP
+    moe_top_k: int = 1  # experts per token (1=Switch, 2=GShard/Mixtral)
     ln_eps: float = 1e-6  # flax default; HF GPT-2 checkpoints use 1e-5
     dtype: Any = jnp.float32
     param_dtype: Any = jnp.float32
@@ -175,6 +176,7 @@ class TransformerBlock(nn.Module):
 
             h = SwitchFFN(
                 num_experts=self.moe_experts, mlp_ratio=self.mlp_ratio,
+                top_k=self.moe_top_k,
                 dtype=self.dtype, param_dtype=self.param_dtype, name="moe",
             )(h.astype(self.dtype))
         else:
@@ -243,6 +245,7 @@ class ViT(nn.Module):
     mesh: Optional[Any] = None
     dropout: float = 0.0
     moe_experts: int = 0  # >0: every `moe_every`-th block uses Switch-MoE
+    moe_top_k: int = 1
     moe_every: int = 2
     remat: str = "none"  # "none" | "dots" | "full" (REMAT_POLICIES)
     dtype: Any = jnp.float32
@@ -268,7 +271,8 @@ class ViT(nn.Module):
             x = block_cls(
                 num_heads=self.num_heads, mlp_ratio=self.mlp_ratio,
                 attention=self.attention, mesh=self.mesh,
-                dropout=self.dropout, moe_experts=moe, dtype=self.dtype,
+                dropout=self.dropout, moe_experts=moe,
+                moe_top_k=self.moe_top_k, dtype=self.dtype,
                 param_dtype=self.param_dtype, name=f"block{i}",
             )(x, train)  # positional: remat keeps arg 2 static
 

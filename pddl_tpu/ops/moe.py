@@ -1,4 +1,5 @@
-"""Mixture-of-Experts: Switch-style top-1 routed FFN, expert-parallel ready.
+"""Mixture-of-Experts: top-k routed FFN (Switch top-1 / GShard top-2),
+expert-parallel ready.
 
 Beyond-parity capability (the reference has no MoE — SURVEY.md §2c lists
 expert parallelism as absent; the mesh reserves an ``expert`` axis for it,
@@ -12,10 +13,11 @@ expert parallelism as absent; the mesh reserves an ``expert`` axis for it,
   sharding dim 0 over the ``expert`` mesh axis places one expert group per
   device; XLA lowers the dispatch/combine einsums to the all-to-alls.
 - **Capacity factor**: batch rows are the dispatch groups; each expert
-  processes at most ``capacity_factor * seq / n_experts`` tokens per group
-  (dispatch tensors are ``[B, S, N, C]`` — linear in batch). Overflow
-  tokens pass through the residual (standard Switch behavior), keeping
-  per-expert work static-shaped.
+  processes at most ``capacity_factor * top_k * seq / n_experts`` tokens
+  per group (dispatch tensors are ``[B, S, N, C]`` — linear in batch;
+  top-2 routes twice the token-slots, so capacity scales with ``top_k``).
+  Overflow tokens pass through the residual (standard Switch behavior),
+  keeping per-expert work static-shaped.
 - **Load-balancing aux loss** (Switch loss: ``n·Σ fᵢ·Pᵢ``) is exported via
   ``self.sow("losses", ...)``; the Trainer adds every sown loss to the
   task loss.
@@ -30,15 +32,21 @@ from flax import linen as nn
 
 
 class SwitchFFN(nn.Module):
-    """Top-1 routed expert FFN (drop-in for a transformer MLP block).
+    """Top-k routed expert FFN (drop-in for a transformer MLP block).
 
     Input/output ``[batch, seq, embed]``; experts are two-layer GELU FFNs
-    with hidden dim ``mlp_ratio * embed``.
+    with hidden dim ``mlp_ratio * embed``. ``top_k=1`` is the Switch
+    Transformer; ``top_k=2`` is GShard/Mixtral-style routing where every
+    token is processed by its two highest-probability experts with the
+    two gates renormalized to sum to one (``normalize_gates``), second
+    choices queueing behind the group's first choices for capacity.
     """
 
     num_experts: int
     mlp_ratio: int = 4
+    top_k: int = 1
     capacity_factor: float = 1.25
+    normalize_gates: bool = True  # top_k >= 2: g_j / sum_j g_j
     aux_loss_weight: float = 0.01
     dtype: Any = jnp.float32
     param_dtype: Any = jnp.float32
@@ -47,10 +55,14 @@ class SwitchFFN(nn.Module):
     def __call__(self, x):
         b, s, d = x.shape
         n = self.num_experts
+        if not 1 <= self.top_k <= n:
+            raise ValueError(
+                f"top_k={self.top_k} must be in [1, num_experts={n}]")
         # Batch rows are the dispatch groups (the Switch/Mesh-TF "group"
         # dim): capacity is per group, so dispatch/combine are
         # [B, S, N, C] — linear in batch, never quadratic in total tokens.
-        capacity = max(1, int(self.capacity_factor * s / n))
+        # top-2 doubles routed token-slots, so capacity scales with k.
+        capacity = max(1, int(self.capacity_factor * self.top_k * s / n))
         hidden = d * self.mlp_ratio
 
         # Router (f32 for a stable softmax regardless of compute dtype).
@@ -58,25 +70,47 @@ class SwitchFFN(nn.Module):
             n, dtype=jnp.float32, param_dtype=self.param_dtype, name="router"
         )(x.astype(jnp.float32))
         probs = nn.softmax(router_logits, axis=-1)            # (B, S, N)
-        expert_index = jnp.argmax(probs, axis=-1)             # (B, S)
-        expert_gate = jnp.max(probs, axis=-1)                 # (B, S)
 
-        # Capacity-limited one-hot dispatch: position of each token within
-        # its expert's queue (per group); tokens past capacity are dropped
-        # (residual passthrough happens at the call site via x + moe(x)).
-        raw_onehot = nn.one_hot(expert_index, n)              # (B, S, N)
-        position = jnp.cumsum(raw_onehot, axis=1) * raw_onehot  # 1-based
-        onehot = raw_onehot * (position <= capacity)
-        pos_in_expert = (position - 1.0) * onehot             # 0-based, 0 where dropped
-        # (B, S, N, C) one-hot over capacity slots.
-        dispatch = onehot[..., None] * nn.one_hot(
-            pos_in_expert.sum(axis=-1).astype(jnp.int32), capacity
-        )[..., None, :]
-        combine = dispatch * expert_gate[..., None, None]     # gate-weighted
+        # k sequential choices (k is tiny and static — an unrolled Python
+        # loop of MXU-friendly one-hot ops, no sorting network needed).
+        # Choice j's queue positions start after the KEPT tokens of
+        # choices < j (mesh-tf top-2 convention), so second choices never
+        # displace first choices from an expert's capacity.
+        remaining = probs
+        offset = jnp.zeros((b, n), probs.dtype)     # kept tokens per expert
+        gates, dispatches = [], []
+        first_choice_onehot = None
+        for _ in range(self.top_k):
+            gate = jnp.max(remaining, axis=-1)                # (B, S)
+            raw_onehot = nn.one_hot(
+                jnp.argmax(remaining, axis=-1), n)            # (B, S, N)
+            if first_choice_onehot is None:
+                first_choice_onehot = raw_onehot
+            remaining = remaining * (1.0 - raw_onehot)
+            position = (jnp.cumsum(raw_onehot, axis=1)
+                        + offset[:, None, :]) * raw_onehot    # 1-based
+            onehot = raw_onehot * (position <= capacity)
+            offset = offset + jnp.sum(onehot, axis=1)
+            pos_in_expert = (position - 1.0) * onehot         # 0-based
+            dispatches.append(onehot[..., None] * nn.one_hot(
+                pos_in_expert.sum(axis=-1).astype(jnp.int32), capacity
+            )[..., None, :])                                  # (B, S, N, C)
+            gates.append(gate)
 
-        # Load-balancing loss BEFORE capacity drop (Switch eq. 4-6):
+        if self.top_k > 1 and self.normalize_gates:
+            denom = sum(gates) + 1e-9
+            gates = [g / denom for g in gates]
+
+        dispatch = sum(dispatches)
+        # Dropped tokens have an all-zero dispatch row, so gating needs no
+        # explicit kept mask.
+        combine = sum(dsp * g[..., None, None]
+                      for dsp, g in zip(dispatches, gates))
+
+        # Load-balancing loss BEFORE capacity drop (Switch eq. 4-6; for
+        # top-k the token fraction counts FIRST choices, per GShard):
         # n * sum_i( fraction_of_tokens_i * mean_router_prob_i ).
-        frac = jnp.mean(raw_onehot, axis=(0, 1))
+        frac = jnp.mean(first_choice_onehot, axis=(0, 1))
         mean_prob = jnp.mean(probs, axis=(0, 1))
         aux = self.aux_loss_weight * n * jnp.sum(frac * mean_prob)
         self.sow("losses", "moe_aux_loss", aux)

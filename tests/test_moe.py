@@ -93,3 +93,146 @@ def test_expert_parallel_training_and_sharding():
     assert moe_params["b1"].sharding.spec == P(EXPERT_AXIS)
     assert moe_params["router"]["kernel"].sharding.spec == P()
     assert tr.state.params["block0"]["mlp1"]["kernel"].sharding.spec == P()
+
+
+def test_top2_routes_to_two_best_experts():
+    """With capacity >= all assignments, top-2 output equals the sum of the
+    two best experts' FFNs weighted by renormalized gates (GShard)."""
+    moe = SwitchFFN(num_experts=4, mlp_ratio=2, top_k=2, capacity_factor=8.0)
+    x = jax.random.normal(jax.random.key(0), (2, 8, 16))
+    variables = moe.init(jax.random.key(1), x)
+    out, _ = moe.apply(variables, x, mutable=["losses"])
+
+    p = variables["params"]
+    xt = np.asarray(x.reshape(16, 16))
+    logits = xt.astype(np.float32) @ np.asarray(p["router"]["kernel"]) \
+        + np.asarray(p["router"]["bias"])
+    probs = np.asarray(jax.nn.softmax(jnp.asarray(logits), axis=-1))
+
+    def gelu(a):
+        return np.asarray(jax.nn.gelu(jnp.asarray(a)))
+
+    def ffn(t, e):
+        return gelu(xt[t] @ np.asarray(p["w1"][e]) + np.asarray(p["b1"][e])) \
+            @ np.asarray(p["w2"][e]) + np.asarray(p["b2"][e])
+
+    expected = np.zeros_like(xt)
+    for t in range(16):
+        order = probs[t].argsort()[::-1]
+        e1, e2 = order[0], order[1]
+        g1, g2 = probs[t, e1], probs[t, e2]
+        denom = g1 + g2
+        expected[t] = (g1 / denom) * ffn(t, e1) + (g2 / denom) * ffn(t, e2)
+    np.testing.assert_allclose(np.asarray(out).reshape(16, 16), expected,
+                               atol=1e-5, rtol=1e-4)
+
+
+def test_top2_unnormalized_gates():
+    """normalize_gates=False keeps the raw router probabilities as gates."""
+    common = dict(num_experts=4, mlp_ratio=2, top_k=2, capacity_factor=8.0)
+    x = jax.random.normal(jax.random.key(0), (1, 8, 16))
+    moe_n = SwitchFFN(**common)
+    variables = moe_n.init(jax.random.key(1), x)
+    out_norm, _ = moe_n.apply(variables, x, mutable=["losses"])
+    out_raw, _ = SwitchFFN(**common, normalize_gates=False).apply(
+        variables, x, mutable=["losses"])
+    # Raw top-2 gates sum below 1, so the un-normalized output is strictly
+    # smaller in magnitude wherever the output is non-zero.
+    a = np.abs(np.asarray(out_raw)).sum()
+    b = np.abs(np.asarray(out_norm)).sum()
+    assert a < b
+
+
+def test_top2_capacity_ordering_matches_two_phase_oracle():
+    """Under capacity pressure the implementation's documented semantics —
+    ALL first choices claim slots (in token order), then second choices
+    queue behind the group's kept first-choice count — must match an
+    explicit two-phase oracle exactly, including which tokens drop."""
+    moe = SwitchFFN(num_experts=2, mlp_ratio=1, top_k=2, capacity_factor=0.25)
+    # capacity = int(0.25 * 2 * 8 / 2) = 2 slots per expert, 8 tokens:
+    # guaranteed contention on both experts.
+    x = jax.random.normal(jax.random.key(2), (1, 8, 8))
+    variables = moe.init(jax.random.key(1), x)
+    out, _ = moe.apply(variables, x, mutable=["losses"])
+
+    p = variables["params"]
+    xt = np.asarray(x[0])
+    probs = np.asarray(jax.nn.softmax(
+        x[0].astype(jnp.float32) @ jnp.asarray(p["router"]["kernel"])
+        + jnp.asarray(p["router"]["bias"]), axis=-1))
+    capacity = 2
+
+    def ffn(t, e):
+        h = np.asarray(jax.nn.gelu(jnp.asarray(
+            xt[t] @ np.asarray(p["w1"][e]) + np.asarray(p["b1"][e]))))
+        return h @ np.asarray(p["w2"][e]) + np.asarray(p["b2"][e])
+
+    expected = np.zeros_like(xt)
+    e1 = probs.argmax(-1)
+    # Phase 1: first choices in token order.
+    fill = {0: 0, 1: 0}
+    kept1 = []
+    for t in range(8):
+        if fill[e1[t]] < capacity:
+            fill[e1[t]] += 1
+            kept1.append(t)
+    # Phase 2: second choices queue behind the KEPT first-choice counts.
+    for t in range(8):
+        e2 = probs[t].argsort()[::-1][1]
+        g1, g2 = probs[t, e1[t]], probs[t, e2]
+        denom = g1 + g2
+        if t in kept1:
+            expected[t] += (g1 / denom) * ffn(t, e1[t])
+        if fill[e2] < capacity:
+            fill[e2] += 1
+            expected[t] += (g2 / denom) * ffn(t, e2)
+    np.testing.assert_allclose(np.asarray(out)[0], expected,
+                               atol=1e-5, rtol=1e-4)
+    # The scenario actually exercised drops (otherwise weaken nothing).
+    assert len(kept1) < 8 or any(
+        np.abs(expected[t]).sum() == 0 for t in range(8))
+
+
+def test_top1_behavior_unchanged_by_generalization():
+    """top_k=1 (the default) must reproduce the pre-top-k Switch output
+    byte-for-byte: same capacity formula, same gates, same dispatch."""
+    moe = SwitchFFN(num_experts=4, mlp_ratio=2, capacity_factor=1.25)
+    x = jax.random.normal(jax.random.key(0), (2, 16, 16))
+    variables = moe.init(jax.random.key(1), x)
+    out, _ = moe.apply(variables, x, mutable=["losses"])
+    # Re-derive with the documented top-1 semantics directly.
+    p = variables["params"]
+    probs = np.asarray(jax.nn.softmax(
+        x.astype(jnp.float32) @ jnp.asarray(p["router"]["kernel"])
+        + jnp.asarray(p["router"]["bias"]), axis=-1))
+    capacity = max(1, int(1.25 * 16 / 4))
+    expected = np.zeros((2, 16, 16), np.float32)
+    for b in range(2):
+        fill = {e: 0 for e in range(4)}
+        for t in range(16):
+            e = probs[b, t].argmax()
+            if fill[e] < capacity:
+                fill[e] += 1
+                xt = np.asarray(x[b, t])
+                h = np.asarray(jax.nn.gelu(jnp.asarray(
+                    xt @ np.asarray(p["w1"][e]) + np.asarray(p["b1"][e]))))
+                expected[b, t] = (h @ np.asarray(p["w2"][e])
+                                  + np.asarray(p["b2"][e])) * probs[b, t, e]
+    np.testing.assert_allclose(np.asarray(out), expected, atol=1e-5,
+                               rtol=1e-4)
+
+
+def test_top2_expert_parallel_training():
+    strategy = ExpertParallelStrategy(expert_parallel=4)
+    model = ViT(patch_size=8, embed_dim=32, depth=2, num_heads=4,
+                num_classes=8, attention="reference", moe_experts=4,
+                moe_top_k=2, moe_every=2)
+    tr = Trainer(model, optimizer="adamw", learning_rate=1e-3,
+                 strategy=strategy, seed=0)
+    ds = SyntheticImageClassification(
+        batch_size=strategy.scale_batch_size(8), image_size=32,
+        num_classes=8, seed=0, signal_strength=3.0)
+    hist = tr.fit(ds, epochs=2, steps_per_epoch=4, verbose=0)
+    assert hist.history["loss"][-1] < hist.history["loss"][0]
+    moe_params = tr.state.params["block1"]["moe"]
+    assert moe_params["w1"].sharding.spec == P(EXPERT_AXIS)
