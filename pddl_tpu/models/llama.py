@@ -60,6 +60,7 @@ class LlamaAttention(nn.Module):
     num_kv_heads: int
     rope_theta: float = 10000.0
     attention: str = "flash"  # "flash" | "reference" | "ring" | "ring_flash"
+    sliding_window: Optional[int] = None  # Mistral-style SWA width
     mesh: Optional[Any] = None
     decode: bool = False
     max_decode_len: int = 1024
@@ -75,6 +76,11 @@ class LlamaAttention(nn.Module):
             raise ValueError(
                 f"num_heads {self.num_heads} not divisible by "
                 f"num_kv_heads {self.num_kv_heads}")
+        if self.sliding_window is not None and self.sliding_window < 1:
+            # Validate here so the decode path (which builds its own mask)
+            # rejects it too, not just the flash/reference kernels.
+            raise ValueError(
+                f"sliding_window must be >= 1, got {self.sliding_window}")
         head_dim = e // self.num_heads
         dense = functools.partial(
             nn.DenseGeneral, use_bias=False, dtype=self.dtype,
@@ -92,14 +98,20 @@ class LlamaAttention(nn.Module):
         k, v = (self._expand_kv(t) for t in (k, v))
 
         if self.attention == "flash":
-            o = flash_attention(q, k, v, causal=True)
+            o = flash_attention(q, k, v, causal=True,
+                                window=self.sliding_window)
         elif self.attention == "reference":
-            o = attention_reference(q, k, v, causal=True)
+            o = attention_reference(q, k, v, causal=True,
+                                    window=self.sliding_window)
         elif self.attention in ("ring", "ring_flash"):
             from pddl_tpu.ops.ring_attention import sequence_parallel_attention
 
             if self.mesh is None:
                 raise ValueError(f"attention={self.attention!r} needs the mesh")
+            if self.sliding_window is not None:
+                raise ValueError(
+                    "sliding_window is not supported on the ring/"
+                    "sequence-parallel path (use flash or reference)")
             o = sequence_parallel_attention(
                 q, k, v, self.mesh, causal=True,
                 use_flash=self.attention == "ring_flash")
@@ -146,7 +158,10 @@ class LlamaAttention(nn.Module):
         scores = jnp.einsum("bhqd,bhkd->bhqk", qf, kf)
         k_pos = jnp.arange(self.max_decode_len)[None, :]
         q_pos = i + jnp.arange(s)[:, None]
-        scores = jnp.where((k_pos <= q_pos)[None, None], scores, -1e30)
+        mask = k_pos <= q_pos
+        if self.sliding_window is not None:
+            mask &= k_pos > q_pos - self.sliding_window
+        scores = jnp.where(mask[None, None], scores, -1e30)
         probs = jax.nn.softmax(scores, axis=-1)
         o = jnp.einsum("bhqk,bhkd->bhqd", probs, vf).astype(q.dtype)
         o = o.transpose(0, 2, 1, 3).reshape(b, s, self.num_heads * head_dim)
@@ -161,6 +176,7 @@ class LlamaBlock(nn.Module):
     intermediate_dim: int
     rope_theta: float = 10000.0
     attention: str = "flash"
+    sliding_window: Optional[int] = None
     mesh: Optional[Any] = None
     decode: bool = False
     max_decode_len: int = 1024
@@ -179,6 +195,7 @@ class LlamaBlock(nn.Module):
         h = LlamaAttention(
             num_heads=self.num_heads, num_kv_heads=self.num_kv_heads,
             rope_theta=self.rope_theta, attention=self.attention,
+            sliding_window=self.sliding_window,
             mesh=self.mesh, decode=self.decode,
             max_decode_len=self.max_decode_len, dtype=self.dtype,
             param_dtype=self.param_dtype, name="attn",
@@ -214,6 +231,7 @@ class Llama(nn.Module):
     intermediate_dim: Optional[int] = None  # None → SwiGLU-standard ~8E/3
     rope_theta: float = 10000.0
     attention: str = "flash"
+    sliding_window: Optional[int] = None  # Mistral-style SWA width
     mesh: Optional[Any] = None
     remat: str = "none"
     vocab_multiple: int = 1  # pad V for vocab-parallel TP (see gpt.GPT)
@@ -241,7 +259,8 @@ class Llama(nn.Module):
             x = block_cls(
                 num_heads=self.num_heads, num_kv_heads=kv,
                 intermediate_dim=inter, rope_theta=self.rope_theta,
-                attention=self.attention, mesh=self.mesh,
+                attention=self.attention,
+                sliding_window=self.sliding_window, mesh=self.mesh,
                 decode=self.decode, max_decode_len=self.max_len,
                 rms_eps=self.rms_eps, dtype=self.dtype,
                 param_dtype=self.param_dtype, name=f"block{i}",

@@ -42,22 +42,36 @@ NEG_INF = -1e30  # large-negative instead of -inf: keeps masked softmax NaN-free
 def attention_reference(
     q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     *, causal: bool = False, scale: Optional[float] = None,
-    k_offset: int = 0,
+    k_offset: int = 0, window: Optional[int] = None,
 ) -> jnp.ndarray:
     """Plain softmax attention (the numerics oracle).
 
     ``k_offset`` shifts key/value global positions for causal masking —
     used by ring attention where each shard sees a rotated K/V slice.
+    ``window`` (requires ``causal``): sliding-window attention — query t
+    sees keys ``[t-window+1, t]`` (Mistral's SWA; window=1 is self-only).
     """
     *_, sq, d = q.shape
     sk = k.shape[-2]
     scale = (1.0 / math.sqrt(d)) if scale is None else scale
+    if window is not None:
+        if not causal:
+            raise ValueError("window requires causal=True (sliding-window "
+                             "attention is a causal-LM construct)")
+        if window < 1:
+            # An empty band would make every row's scores equal (-1e30,
+            # not -inf) and softmax silently uniform — raise like the
+            # flash path instead.
+            raise ValueError(f"window must be >= 1, got {window}")
     s = jnp.einsum("...qd,...kd->...qk", q.astype(jnp.float32),
                    k.astype(jnp.float32)) * scale
     if causal:
         q_pos = jnp.arange(sq)[:, None]
         k_pos = jnp.arange(sk)[None, :] + k_offset
-        s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        mask = q_pos >= k_pos
+        if window is not None:
+            mask &= k_pos > q_pos - window
+        s = jnp.where(mask, s, NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
     return jnp.einsum("...qk,...kd->...qd", p, v.astype(jnp.float32)).astype(q.dtype)
 
@@ -68,7 +82,8 @@ def attention_reference(
 LANES = 128
 
 
-def _masked_scores(q_ref, k_ref, qi, ki, *, scale, causal, block_q, block_k):
+def _masked_scores(q_ref, k_ref, qi, ki, *, scale, causal, block_q, block_k,
+                   window=None):
     """Recompute one (bq, bk) score block: s = scale·q·kᵀ, causal-masked.
 
     Shared by the forward and both backward kernels so the mask/scale
@@ -91,14 +106,31 @@ def _masked_scores(q_ref, k_ref, qi, ki, *, scale, causal, block_q, block_k):
             jnp.int32, (block_q, block_k), 0)
         k_pos = ki * block_k + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 1)
-        s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        mask = q_pos >= k_pos
+        if window is not None:
+            mask &= k_pos > q_pos - window
+        s = jnp.where(mask, s, NEG_INF)
     return s
+
+
+def _block_in_band(qi, ki, *, causal, block_q, block_k, window):
+    """Static-shape predicate: does block (qi, ki) intersect the causal
+    (and, with ``window``, sliding-window) band? Shared by the forward
+    and both backward sweeps so skip logic can never drift from the mask
+    in :func:`_masked_scores`."""
+    run = True
+    if causal:
+        run = ki * block_k <= qi * block_q + block_q - 1
+        if window is not None:
+            # block's max k_pos >= block's min q_pos - window + 1
+            run &= ki * block_k + block_k - 1 >= qi * block_q - window + 1
+    return run
 
 
 # --------------------------------------------------------------- flash fwd
 def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
                   *, scale: float, causal: bool, block_q: int, block_k: int,
-                  num_k: int):
+                  num_k: int, window=None):
     """Forward kernel; ``lse_ref is None`` in the inference (no-vjp) variant,
     which then skips the LSE write entirely."""
     qi = pl.program_id(1)
@@ -110,15 +142,16 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
         l_ref[:] = jnp.zeros_like(l_ref)
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
-    # Causal: skip blocks strictly above the diagonal.
-    run = True
-    if causal:
-        run = ki * block_k <= qi * block_q + block_q - 1
+    # Causal: skip blocks strictly above the diagonal; with a sliding
+    # window, also blocks entirely below the band (compute drops from
+    # O(S^2) to O(S*window) as S grows).
+    run = _block_in_band(qi, ki, causal=causal, block_q=block_q,
+                         block_k=block_k, window=window)
 
     @pl.when(run)
     def _compute():
         s = _masked_scores(q_ref, k_ref, qi, ki, scale=scale, causal=causal,
-                           block_q=block_q, block_k=block_k)
+                           block_q=block_q, block_k=block_k, window=window)
         m_prev = m_ref[:, :1]                             # (bq, 1)
         l_prev = l_ref[:, :1]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
@@ -177,11 +210,19 @@ def _resolve_blocks(block_q: Optional[int],
 def flash_attention(
     q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     *, causal: bool = False, scale: Optional[float] = None,
+    window: Optional[int] = None,
     block_q: Optional[int] = None, block_k: Optional[int] = None,
     interpret: Optional[bool] = None,
     fused_backward: bool = True,
 ) -> jnp.ndarray:
     """Flash attention, fused Pallas forward AND backward (see module docs).
+
+    ``window`` (requires ``causal``) enables sliding-window attention
+    (Mistral's SWA): query t attends to keys ``[t-window+1, t]``. Blocks
+    entirely outside the band are skipped in the forward and both
+    backward sweeps, so compute scales O(S*window) instead of O(S^2);
+    ``window >= S`` degrades gracefully to plain causal. Not supported
+    through the ring/sequence-parallel path (``flash_attention_lse``).
 
     ``block_q``/``block_k`` default to the local device generation's tuned
     pair (:func:`tuned_blocks`; re-tune a new chip with
@@ -207,8 +248,18 @@ def flash_attention(
     *_, sq, d = q.shape
     sk = k.shape[-2]
     scale_v = (1.0 / math.sqrt(d)) if scale is None else scale
+    if window is not None:
+        if not causal:
+            raise ValueError("window requires causal=True (sliding-window "
+                             "attention is a causal-LM construct)")
+        if window < 1:
+            raise ValueError(f"window must be >= 1, got {window}")
+        window = int(window)
+        if window >= sk:
+            window = None  # the band covers everything: plain causal
     if not fused_backward:
-        return attention_reference(q, k, v, causal=causal, scale=scale_v)
+        return attention_reference(q, k, v, causal=causal, scale=scale_v,
+                                   window=window)
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     block_q, block_k = _resolve_blocks(block_q, block_k)
@@ -217,9 +268,10 @@ def flash_attention(
     if bq < 8 or bk < 8:
         # Degenerate tiling (e.g. prime-ish lengths): the kernel would run
         # sub-VPU-width blocks slower than one fused XLA softmax.
-        return attention_reference(q, k, v, causal=causal, scale=scale_v)
+        return attention_reference(q, k, v, causal=causal, scale=scale_v,
+                                   window=window)
     q, scale_v = _fold_scale(q, scale_v)
-    return _flash(q, k, v, causal, scale_v, bq, bk, bool(interpret))
+    return _flash(q, k, v, causal, scale_v, bq, bk, bool(interpret), window)
 
 
 def _fold_scale(q: jnp.ndarray, scale: float) -> tuple[jnp.ndarray, float]:
@@ -272,7 +324,7 @@ def _sds_like(ref_value):
 
 
 def _flash_forward_call(q, k, v, causal, scale, block_q, block_k, interpret,
-                        want_lse):
+                        want_lse, window=None):
     """Run the forward kernel; returns flat (out [bh,sq,d], lse or None).
 
     ``want_lse=False`` (inference / non-differentiated calls) uses a variant
@@ -291,7 +343,7 @@ def _flash_forward_call(q, k, v, causal, scale, block_q, block_k, interpret,
     kernel = functools.partial(
         _flash_kernel if want_lse else _flash_kernel_nolse,
         scale=scale, causal=causal,
-        block_q=block_q, block_k=block_k, num_k=num_k,
+        block_q=block_q, block_k=block_k, num_k=num_k, window=window,
     )
     sds = _sds_like(qf)
 
@@ -320,18 +372,19 @@ def _flash_forward_call(q, k, v, causal, scale, block_q, block_k, interpret,
     return result[0], None
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash(q, k, v, causal, scale, block_q, block_k, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash(q, k, v, causal, scale, block_q, block_k, interpret, window=None):
     b, h, sq, d = q.shape
     out, _ = _flash_forward_call(q, k, v, causal, scale, block_q, block_k,
-                                 interpret, want_lse=False)
+                                 interpret, want_lse=False, window=window)
     return out.reshape(b, h, sq, d)
 
 
-def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret,
+               window=None):
     b, h, sq, d = q.shape
     out, lse = _flash_forward_call(q, k, v, causal, scale, block_q, block_k,
-                                   interpret, want_lse=True)
+                                   interpret, want_lse=True, window=window)
     # Residuals live from forward to backward — across every later layer's
     # forward. Keep LSE packed [bh, sq] for that window; the transient
     # lane-replicated buffer the kernel wrote is freed here.
@@ -350,7 +403,7 @@ def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
 def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, di_ref,
                          dq_ref, acc_ref,
                          *, scale: float, causal: bool, block_q: int,
-                         block_k: int, num_k: int):
+                         block_k: int, num_k: int, window=None):
     qi = pl.program_id(1)
     ki = pl.program_id(2)
 
@@ -358,14 +411,13 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, di_ref,
     def _init():
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
-    run = True
-    if causal:
-        run = ki * block_k <= qi * block_q + block_q - 1
+    run = _block_in_band(qi, ki, causal=causal, block_q=block_q,
+                         block_k=block_k, window=window)
 
     @pl.when(run)
     def _compute():
         s = _masked_scores(q_ref, k_ref, qi, ki, scale=scale, causal=causal,
-                           block_q=block_q, block_k=block_k)
+                           block_q=block_q, block_k=block_k, window=window)
         p = jnp.exp(s - lse_ref[0][:, :1])                # masked -> exactly 0
         dp = jax.lax.dot_general(                         # (bq, bk)
             do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
@@ -386,7 +438,7 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, di_ref,
 def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, di_ref,
                           dk_ref, dv_ref, dk_acc_ref, dv_acc_ref,
                           *, scale: float, causal: bool, block_q: int,
-                          block_k: int, num_q: int):
+                          block_k: int, num_q: int, window=None):
     ki = pl.program_id(1)
     qi = pl.program_id(2)
 
@@ -395,14 +447,15 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, di_ref,
         dk_acc_ref[:] = jnp.zeros_like(dk_acc_ref)
         dv_acc_ref[:] = jnp.zeros_like(dv_acc_ref)
 
-    run = True
-    if causal:
-        run = qi * block_q + block_q - 1 >= ki * block_k
+    # Same band predicate as the forward, from the dkv grid's viewpoint:
+    # above-diagonal OR fully-below-window blocks contribute nothing.
+    run = _block_in_band(qi, ki, causal=causal, block_q=block_q,
+                         block_k=block_k, window=window)
 
     @pl.when(run)
     def _compute():
         s = _masked_scores(q_ref, k_ref, qi, ki, scale=scale, causal=causal,
-                           block_q=block_q, block_k=block_k)
+                           block_q=block_q, block_k=block_k, window=window)
         p = jnp.exp(s - lse_ref[0][:, :1])
         dv_acc_ref[:] += jax.lax.dot_general(             # pᵀ·do -> (bk, d)
             p.astype(do_ref.dtype), do_ref[0], (((0,), (0,)), ((), ())),
@@ -425,13 +478,13 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, di_ref,
         dv_ref[0] = dv_acc_ref[:].astype(dv_ref.dtype)
 
 
-def _flash_bwd(causal, scale, block_q, block_k, interpret, res, g):
+def _flash_bwd(causal, scale, block_q, block_k, interpret, window, res, g):
     return _flash_bwd_impl(causal, scale, block_q, block_k, interpret, res,
-                           g, dlse=None)
+                           g, dlse=None, window=window)
 
 
 def _flash_bwd_impl(causal, scale, block_q, block_k, interpret, res, g,
-                    dlse=None):
+                    dlse=None, window=None):
     """Shared fused backward. ``dlse`` (``[b, h, sq]`` or None) is the LSE
     output's cotangent for the (o, lse) variant: since
     d(lse)/d(s) = p, it enters every kernel as ``ds = p·(dp − di + dlse)``
@@ -466,7 +519,7 @@ def _flash_bwd_impl(causal, scale, block_q, block_k, interpret, res, g,
     dq = pl.pallas_call(
         functools.partial(
             _flash_bwd_dq_kernel, scale=scale, causal=causal,
-            block_q=block_q, block_k=block_k, num_k=num_k,
+            block_q=block_q, block_k=block_k, num_k=num_k, window=window,
         ),
         grid=(b * h, num_q, num_k),
         in_specs=[q_spec, k_spec, k_spec, q_spec, row_spec, row_spec],
@@ -485,7 +538,7 @@ def _flash_bwd_impl(causal, scale, block_q, block_k, interpret, res, g,
     dk, dv = pl.pallas_call(
         functools.partial(
             _flash_bwd_dkv_kernel, scale=scale, causal=causal,
-            block_q=block_q, block_k=block_k, num_q=num_q,
+            block_q=block_q, block_k=block_k, num_q=num_q, window=window,
         ),
         grid=(b * h, num_k, num_q),
         in_specs=[qT_spec, kT_spec, kT_spec, qT_spec, rowT_spec, rowT_spec],
@@ -528,7 +581,7 @@ def _flash_lse_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
 def _flash_lse_bwd(causal, scale, block_q, block_k, interpret, res, g):
     do, dlse = g
     return _flash_bwd_impl(causal, scale, block_q, block_k, interpret, res,
-                           do, dlse=dlse)
+                           do, dlse=dlse, window=None)
 
 
 _flash_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)

@@ -362,3 +362,56 @@ def test_tuned_blocks_resolution():
     auto = flash_attention(q, k, v, causal=True)
     explicit = flash_attention(q, k, v, causal=True, block_q=bq, block_k=bk)
     np.testing.assert_array_equal(np.asarray(auto), np.asarray(explicit))
+
+
+# ------------------------------------------------------- sliding window
+def test_flash_sliding_window_matches_reference():
+    """Flash SWA vs the windowed reference oracle, with blocks small
+    enough that whole k-blocks are skipped below the band (the O(S*W)
+    path), windows aligned and unaligned to the block size."""
+    q, k, v = (jax.random.normal(jax.random.key(i), (2, 2, 256, 32))
+               for i in range(3))
+    for w in (1, 37, 64, 200):
+        ref = attention_reference(q, k, v, causal=True, window=w)
+        got = flash_attention(q, k, v, causal=True, window=w,
+                              block_q=64, block_k=64)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5, err_msg=f"w={w}")
+
+
+def test_flash_sliding_window_grads_match_reference():
+    q, k, v = (jax.random.normal(jax.random.key(i), (1, 2, 256, 32))
+               for i in range(3))
+
+    for w in (37, 128):
+        def loss_flash(q, k, v, w=w):
+            return flash_attention(q, k, v, causal=True, window=w,
+                                   block_q=64, block_k=64).sum()
+
+        def loss_ref(q, k, v, w=w):
+            return attention_reference(q, k, v, causal=True, window=w).sum()
+
+        gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gf, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=2e-4, rtol=2e-4,
+                                       err_msg=f"w={w}")
+
+
+def test_window_geq_seq_degrades_to_plain_causal():
+    q, k, v = (jax.random.normal(jax.random.key(i), (1, 2, 64, 32))
+               for i in range(3))
+    plain = flash_attention(q, k, v, causal=True)
+    wide = flash_attention(q, k, v, causal=True, window=64)
+    np.testing.assert_array_equal(np.asarray(plain), np.asarray(wide))
+
+
+def test_window_requires_causal():
+    q = jnp.zeros((1, 1, 16, 8))
+    with pytest.raises(ValueError, match="causal"):
+        flash_attention(q, q, q, window=4)
+    with pytest.raises(ValueError, match="causal"):
+        attention_reference(q, q, q, window=4)
+    with pytest.raises(ValueError, match=">= 1"):
+        flash_attention(q, q, q, causal=True, window=0)
