@@ -216,6 +216,12 @@ def load_hf_llama(model_or_dir, variables: PyTree, *,
     given: ``rms_eps`` against ``config.rms_norm_eps`` and ``rope_theta``
     against ``config.rope_theta`` — either mismatch silently skews logits.
     A ``vocab_multiple``-padded model accepts the smaller HF vocab.
+
+    Mistral checkpoints are Llama-layout state dicts and import through
+    this same function: build the Llama with
+    ``sliding_window=config.sliding_window`` and the logits match
+    transformers' windowed attention
+    (``tests/test_llama.py::test_hf_mistral_checkpoint_loads_with_sliding_window``).
     """
     if isinstance(model_or_dir, str):
         from transformers import LlamaForCausalLM  # noqa: PLC0415
@@ -245,6 +251,19 @@ def load_hf_llama(model_or_dir, variables: PyTree, *,
                     f"with {name}={have} (the value is baked into the "
                     "module, not the weights, so the import would "
                     "silently skew logits)"
+                )
+        if model is not None:
+            # sliding_window distinguishes a Mistral checkpoint; unlike
+            # eps/theta, None-vs-set is the dangerous mismatch (a Llama
+            # left at the default silently ignores the checkpoint's SWA
+            # for every sequence longer than the window).
+            want_sw = getattr(model, "sliding_window", None)
+            have_sw = getattr(cfg, "sliding_window", None)
+            if want_sw != have_sw:
+                raise ValueError(
+                    f"hf llama import: model sliding_window={want_sw} but "
+                    f"the checkpoint config uses {have_sw} — rebuild with "
+                    f"sliding_window={have_sw}"
                 )
     sd = {k: _np(v) for k, v in model_or_dir.state_dict().items()}
     prefix = "model." if any(k.startswith("model.") for k in sd) else ""

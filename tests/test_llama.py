@@ -214,16 +214,20 @@ torch = pytest.importorskip("torch")
 transformers = pytest.importorskip("transformers")
 
 
-def _hf_llama(vocab=V, kv_heads=2):
-    cfg = transformers.LlamaConfig(
+def _hf_llama(vocab=V, kv_heads=2, cls=None, **extra):
+    """Tiny random HF model sharing one hyperparameter set across all the
+    golden tests; ``cls``/``extra`` cover the Mistral variant."""
+    if cls is None:
+        cls = transformers.LlamaForCausalLM
+    cfg = cls.config_class(
         vocab_size=vocab, hidden_size=E, intermediate_size=64,
         num_hidden_layers=L, num_attention_heads=H,
         num_key_value_heads=kv_heads, max_position_embeddings=64,
         rms_norm_eps=1e-6, rope_theta=10000.0, attention_dropout=0.0,
-        tie_word_embeddings=False,
+        tie_word_embeddings=False, **extra,
     )
     torch.manual_seed(0)
-    return transformers.LlamaForCausalLM(cfg).eval()
+    return cls(cfg).eval()
 
 
 def test_hf_llama_logits_match():
@@ -344,3 +348,32 @@ def test_sliding_window_below_one_rejected_everywhere():
     dec = _model(sliding_window=-1).clone(decode=True)
     with pytest.raises(ValueError, match=">= 1"):
         dec.init(jax.random.key(0), _tokens(seq=1), train=False)
+
+
+def test_hf_mistral_checkpoint_loads_with_sliding_window():
+    """A Mistral checkpoint is a Llama-layout state dict + SWA config:
+    load_hf_llama imports it, and with sliding_window set from the config
+    our logits match transformers' (S=24 > window=8, so the window
+    genuinely shapes the compared logits)."""
+    from pddl_tpu.ckpt.hf_import import load_hf_llama
+
+    hf = _hf_llama(cls=transformers.MistralForCausalLM, sliding_window=8)
+    ours = _model(intermediate_dim=64, rms_eps=1e-6, sliding_window=8)
+    tokens = _tokens()
+    v = ours.init(jax.random.key(0), tokens, train=False)
+    v = load_hf_llama(hf, v, model=ours)
+    with torch.no_grad():
+        ref = hf(torch.from_numpy(
+            np.asarray(tokens, np.int64))).logits.numpy()
+    got = np.asarray(ours.apply(v, tokens, train=False))
+    np.testing.assert_allclose(got, ref, atol=3e-4, rtol=3e-4)
+
+
+def test_hf_mistral_rejects_sliding_window_mismatch():
+    from pddl_tpu.ckpt.hf_import import load_hf_llama
+
+    hf = _hf_llama(cls=transformers.MistralForCausalLM, sliding_window=8)
+    ours = _model(intermediate_dim=64, rms_eps=1e-6)  # window left unset
+    v = ours.init(jax.random.key(0), _tokens(), train=False)
+    with pytest.raises(ValueError, match="sliding_window"):
+        load_hf_llama(hf, v, model=ours)
