@@ -222,6 +222,9 @@ def load_hf_llama(model_or_dir, variables: PyTree, *,
     ``sliding_window=config.sliding_window`` and the logits match
     transformers' windowed attention
     (``tests/test_llama.py::test_hf_mistral_checkpoint_loads_with_sliding_window``).
+    Qwen2 checkpoints likewise: build with ``qkv_bias=True`` (their one
+    structural delta — q/k/v projection biases, imported when present in
+    the state dict).
     """
     if isinstance(model_or_dir, str):
         from transformers import LlamaForCausalLM  # noqa: PLC0415
@@ -259,6 +262,25 @@ def load_hf_llama(model_or_dir, variables: PyTree, *,
             # for every sequence longer than the window).
             want_sw = getattr(model, "sliding_window", None)
             have_sw = getattr(cfg, "sliding_window", None)
+            layer_types = getattr(cfg, "layer_types", None)
+            if layer_types is not None:
+                # Modern transformers resolves the use_sliding_window /
+                # max_window_layers combination into per-layer types; our
+                # single global sliding_window can represent all-full or
+                # all-sliding, nothing mixed.
+                kinds = set(layer_types)
+                if kinds == {"full_attention"}:
+                    have_sw = None
+                elif kinds != {"sliding_attention"}:
+                    raise ValueError(
+                        "hf llama import: checkpoint mixes per-layer "
+                        f"attention types {sorted(kinds)} (e.g. Qwen2 "
+                        "max_window_layers) — not representable by the "
+                        "global sliding_window attribute"
+                    )
+            elif getattr(cfg, "use_sliding_window", True) is False:
+                # Qwen2-style gate without resolved layer_types.
+                have_sw = None
             if want_sw != have_sw:
                 raise ValueError(
                     f"hf llama import: model sliding_window={want_sw} but "
@@ -273,6 +295,15 @@ def load_hf_llama(model_or_dir, variables: PyTree, *,
     def put(path: str, value: np.ndarray, allow_vocab_pad: bool = False):
         _tree_put(params, path, value, allow_vocab_pad=allow_vocab_pad,
                   what="hf llama import")
+
+    if f"{prefix}layers.0.self_attn.q_proj.bias" in sd \
+            and "bias" not in params.get("block0", {}).get("attn", {}).get(
+                "query", {}):
+        raise ValueError(
+            "hf llama import: checkpoint carries q/k/v projection biases "
+            "(Qwen2-style) but the model has none — rebuild the Llama "
+            "with qkv_bias=True"
+        )
 
     wte = sd[f"{prefix}embed_tokens.weight"]
     put("embed/embedding", wte, allow_vocab_pad=True)
@@ -303,6 +334,9 @@ def load_hf_llama(model_or_dir, variables: PyTree, *,
             w = sd[hf + f"self_attn.{proj}.weight"]  # [Hx*D, E]
             hx = attn[name]["kernel"].shape[1]       # H or H_kv
             put(f"block{i}/attn/{name}/kernel", w.T.reshape(e, hx, d))
+            if hf + f"self_attn.{proj}.bias" in sd:  # Qwen2: qkv biases
+                put(f"block{i}/attn/{name}/bias",
+                    sd[hf + f"self_attn.{proj}.bias"].reshape(hx, d))
         put(f"block{i}/attn/out/kernel",
             sd[hf + "self_attn.o_proj.weight"].T)    # [E, H*D] -> [H*D, E]
 

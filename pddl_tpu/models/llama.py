@@ -13,7 +13,8 @@ Llama/Mistral/Qwen lineage — on the same substrate:
   transformers' logits to f32 tolerance.
 - **RMSNorm** (f32 compute, like the family's LayerNorms) pre-attention,
   pre-MLP, and final.
-- **SwiGLU** MLP (``silu(gate)·up → down``), no biases anywhere.
+- **SwiGLU** MLP (``silu(gate)·up → down``), no biases anywhere
+  (except Qwen2's q/k/v projection biases, ``qkv_bias=True``).
 - **Grouped-query attention**: ``num_kv_heads <= num_heads`` K/V heads,
   broadcast to the query heads for the kernel — the KV *cache* stays at
   KV-head size, which is the whole point of GQA (decode memory/BW drops
@@ -61,6 +62,7 @@ class LlamaAttention(nn.Module):
     rope_theta: float = 10000.0
     attention: str = "flash"  # "flash" | "reference" | "ring" | "ring_flash"
     sliding_window: Optional[int] = None  # Mistral-style SWA width
+    qkv_bias: bool = False  # Qwen2-style q/k/v projection biases
     mesh: Optional[Any] = None
     decode: bool = False
     max_decode_len: int = 1024
@@ -86,9 +88,12 @@ class LlamaAttention(nn.Module):
             nn.DenseGeneral, use_bias=False, dtype=self.dtype,
             param_dtype=self.param_dtype,
         )
-        q = dense(features=(self.num_heads, head_dim), name="query")(x)
-        k = dense(features=(self.num_kv_heads, head_dim), name="key")(x)
-        v = dense(features=(self.num_kv_heads, head_dim), name="value")(x)
+        # Qwen2 puts biases on q/k/v (only); the out projection is always
+        # bias-free across the lineage.
+        qkv = functools.partial(dense, use_bias=self.qkv_bias)
+        q = qkv(features=(self.num_heads, head_dim), name="query")(x)
+        k = qkv(features=(self.num_kv_heads, head_dim), name="key")(x)
+        v = qkv(features=(self.num_kv_heads, head_dim), name="value")(x)
         q, k, v = (t.transpose(0, 2, 1, 3) for t in (q, k, v))  # [B, H, S, D]
 
         if self.decode:
@@ -177,6 +182,7 @@ class LlamaBlock(nn.Module):
     rope_theta: float = 10000.0
     attention: str = "flash"
     sliding_window: Optional[int] = None
+    qkv_bias: bool = False
     mesh: Optional[Any] = None
     decode: bool = False
     max_decode_len: int = 1024
@@ -195,7 +201,7 @@ class LlamaBlock(nn.Module):
         h = LlamaAttention(
             num_heads=self.num_heads, num_kv_heads=self.num_kv_heads,
             rope_theta=self.rope_theta, attention=self.attention,
-            sliding_window=self.sliding_window,
+            sliding_window=self.sliding_window, qkv_bias=self.qkv_bias,
             mesh=self.mesh, decode=self.decode,
             max_decode_len=self.max_decode_len, dtype=self.dtype,
             param_dtype=self.param_dtype, name="attn",
@@ -232,6 +238,7 @@ class Llama(nn.Module):
     rope_theta: float = 10000.0
     attention: str = "flash"
     sliding_window: Optional[int] = None  # Mistral-style SWA width
+    qkv_bias: bool = False  # Qwen2-style q/k/v biases
     mesh: Optional[Any] = None
     remat: str = "none"
     vocab_multiple: int = 1  # pad V for vocab-parallel TP (see gpt.GPT)
@@ -260,7 +267,8 @@ class Llama(nn.Module):
                 num_heads=self.num_heads, num_kv_heads=kv,
                 intermediate_dim=inter, rope_theta=self.rope_theta,
                 attention=self.attention,
-                sliding_window=self.sliding_window, mesh=self.mesh,
+                sliding_window=self.sliding_window,
+                qkv_bias=self.qkv_bias, mesh=self.mesh,
                 decode=self.decode, max_decode_len=self.max_len,
                 rms_eps=self.rms_eps, dtype=self.dtype,
                 param_dtype=self.param_dtype, name=f"block{i}",

@@ -377,3 +377,46 @@ def test_hf_mistral_rejects_sliding_window_mismatch():
     v = ours.init(jax.random.key(0), _tokens(), train=False)
     with pytest.raises(ValueError, match="sliding_window"):
         load_hf_llama(hf, v, model=ours)
+
+
+def test_hf_qwen2_checkpoint_loads_with_qkv_bias():
+    """Qwen2's structural delta is q/k/v projection biases: build with
+    qkv_bias=True and the imported logits match transformers'."""
+    from pddl_tpu.ckpt.hf_import import load_hf_llama
+
+    hf = _hf_llama(cls=transformers.Qwen2ForCausalLM)
+    ours = _model(intermediate_dim=64, rms_eps=1e-6, qkv_bias=True)
+    tokens = _tokens()
+    v = ours.init(jax.random.key(0), tokens, train=False)
+    blk = v["params"]["block0"]["attn"]
+    assert "bias" in blk["query"] and "bias" not in blk["out"]
+    v = load_hf_llama(hf, v, model=ours)
+    with torch.no_grad():
+        ref = hf(torch.from_numpy(
+            np.asarray(tokens, np.int64))).logits.numpy()
+    got = np.asarray(ours.apply(v, tokens, train=False))
+    np.testing.assert_allclose(got, ref, atol=3e-4, rtol=3e-4)
+
+
+def test_hf_qwen2_bias_mismatch_raises_descriptively():
+    from pddl_tpu.ckpt.hf_import import load_hf_llama
+
+    hf = _hf_llama(cls=transformers.Qwen2ForCausalLM)
+    ours = _model(intermediate_dim=64, rms_eps=1e-6)  # qkv_bias left False
+    v = ours.init(jax.random.key(0), _tokens(), train=False)
+    with pytest.raises(ValueError, match="qkv_bias=True"):
+        load_hf_llama(hf, v, model=ours)
+
+
+def test_hf_mixed_layer_types_rejected():
+    """A checkpoint windowing only SOME layers (Qwen2 max_window_layers)
+    is unrepresentable by the global sliding_window attribute."""
+    from pddl_tpu.ckpt.hf_import import load_hf_llama
+
+    hf = _hf_llama(cls=transformers.Qwen2ForCausalLM,
+                   use_sliding_window=True, sliding_window=8,
+                   max_window_layers=1)  # layer 0 full, layer 1 sliding
+    ours = _model(intermediate_dim=64, rms_eps=1e-6, qkv_bias=True)
+    v = ours.init(jax.random.key(0), _tokens(), train=False)
+    with pytest.raises(ValueError, match="per-layer attention types"):
+        load_hf_llama(hf, v, model=ours)
