@@ -1,0 +1,81 @@
+"""Export Llama-family weights to a HuggingFace ``transformers`` state dict.
+
+The inverse of :func:`pddl_tpu.ckpt.hf_import.load_hf_llama` — train or
+fine-tune on TPU here, serve anywhere transformers runs. The export is
+exact for the whole Llama/Mistral/Qwen2 lineage because the
+architectures correspond one-to-one (untied embed/head, bias-free except
+Qwen2's q/k/v). The GPT-2 family is deliberately NOT exported: HF GPT-2
+ties ``lm_head`` to ``wte``, and a trained untied head has no faithful
+representation in that format.
+
+Keys follow ``LlamaForCausalLM`` (``model.*`` + ``lm_head.weight``);
+every kernel transposes back to torch ``nn.Linear``'s ``[out, in]``.
+Values are numpy arrays — wrap in ``torch.from_numpy`` for
+``load_state_dict`` (see ``tests/test_llama.py`` roundtrips).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+PyTree = Any
+
+
+def export_hf_llama(variables: PyTree, *, model=None) -> Dict[str, np.ndarray]:
+    """Map a :class:`~pddl_tpu.models.llama.Llama` variables tree onto HF
+    Llama state-dict keys.
+
+    Args:
+      variables: ``{"params": ...}`` (trained or fresh).
+      model: the Llama the variables belong to, if available — used to
+        slice ``vocab_multiple`` padding back off the embedding and head
+        (padding rows/columns never influenced training: the head slices
+        them away, so dropping them is exact).
+
+    Returns a ``{key: np.ndarray}`` state dict (f32).
+    """
+    params = variables["params"]
+    vocab = getattr(model, "vocab_size", None)
+    sd: Dict[str, np.ndarray] = {}
+
+    def put(key: str, value) -> None:
+        sd[key] = np.asarray(value, np.float32)
+
+    emb = np.asarray(params["embed"]["embedding"])
+    head = np.asarray(params["lm_head"]["kernel"])       # [E, V(+pad)]
+    if vocab is not None:
+        emb = emb[:vocab]
+        head = head[:, :vocab]
+    put("model.embed_tokens.weight", emb)                # [V, E]
+    put("lm_head.weight", head.T)                        # [V, E]
+    put("model.norm.weight", params["ln_final"]["scale"])
+
+    n_blocks = sum(1 for k in params if k.startswith("block"))
+    for i in range(n_blocks):
+        blk = params[f"block{i}"]
+        hf = f"model.layers.{i}."
+        put(hf + "input_layernorm.weight", blk["ln1"]["scale"])
+        put(hf + "post_attention_layernorm.weight", blk["ln2"]["scale"])
+
+        attn = blk["attn"]
+        e = attn["query"]["kernel"].shape[0]  # shape read, no host copy
+        for name, proj in (("query", "q_proj"), ("key", "k_proj"),
+                           ("value", "v_proj")):
+            kern = np.asarray(attn[name]["kernel"])      # [E, Hx, D]
+            put(hf + f"self_attn.{proj}.weight",
+                kern.reshape(e, -1).T)                   # [Hx*D, E]
+            if "bias" in attn[name]:                     # Qwen2 lineage
+                put(hf + f"self_attn.{proj}.bias",
+                    np.asarray(attn[name]["bias"]).reshape(-1))
+        put(hf + "self_attn.o_proj.weight",
+            np.asarray(attn["out"]["kernel"]).T)         # [E, H*D]
+
+        put(hf + "mlp.gate_proj.weight",
+            np.asarray(blk["mlp_gate"]["kernel"]).T)     # [I, E]
+        put(hf + "mlp.up_proj.weight",
+            np.asarray(blk["mlp_up"]["kernel"]).T)
+        put(hf + "mlp.down_proj.weight",
+            np.asarray(blk["mlp_down"]["kernel"]).T)     # [E, I]
+    return sd

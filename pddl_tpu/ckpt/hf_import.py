@@ -281,6 +281,22 @@ def load_hf_llama(model_or_dir, variables: PyTree, *,
             elif getattr(cfg, "use_sliding_window", True) is False:
                 # Qwen2-style gate without resolved layer_types.
                 have_sw = None
+            elif have_sw is not None:
+                # No layer_types (older transformers): apply Qwen2's
+                # max_window_layers semantics by hand — layers below it
+                # run full attention, so a mixed split is unrepresentable.
+                mwl = getattr(cfg, "max_window_layers", None)
+                nhl = getattr(cfg, "num_hidden_layers", None)
+                if mwl is not None and nhl is not None:
+                    if mwl >= nhl:
+                        have_sw = None         # every layer full
+                    elif mwl > 0:
+                        raise ValueError(
+                            "hf llama import: checkpoint windows only "
+                            f"layers >= max_window_layers={mwl} of {nhl} "
+                            "— not representable by the global "
+                            "sliding_window attribute"
+                        )
             if want_sw != have_sw:
                 raise ValueError(
                     f"hf llama import: model sliding_window={want_sw} but "
@@ -296,13 +312,22 @@ def load_hf_llama(model_or_dir, variables: PyTree, *,
         _tree_put(params, path, value, allow_vocab_pad=allow_vocab_pad,
                   what="hf llama import")
 
-    if f"{prefix}layers.0.self_attn.q_proj.bias" in sd \
-            and "bias" not in params.get("block0", {}).get("attn", {}).get(
-                "query", {}):
+    ckpt_has_bias = f"{prefix}layers.0.self_attn.q_proj.bias" in sd
+    model_has_bias = "bias" in params.get("block0", {}).get(
+        "attn", {}).get("query", {})
+    if ckpt_has_bias and not model_has_bias:
         raise ValueError(
             "hf llama import: checkpoint carries q/k/v projection biases "
             "(Qwen2-style) but the model has none — rebuild the Llama "
             "with qkv_bias=True"
+        )
+    if model_has_bias and not ckpt_has_bias:
+        # The loop below would overwrite every weight but silently keep
+        # the target tree's existing bias values — raise instead.
+        raise ValueError(
+            "hf llama import: model was built with qkv_bias=True but the "
+            "checkpoint has no q/k/v projection biases — rebuild with "
+            "qkv_bias=False"
         )
 
     wte = sd[f"{prefix}embed_tokens.weight"]

@@ -420,3 +420,56 @@ def test_hf_mixed_layer_types_rejected():
     v = ours.init(jax.random.key(0), _tokens(), train=False)
     with pytest.raises(ValueError, match="per-layer attention types"):
         load_hf_llama(hf, v, model=ours)
+
+
+def test_hf_export_roundtrips_into_transformers():
+    """export_hf_llama produces a state dict transformers loads strictly,
+    and the served logits match ours — TPU-train, serve-anywhere."""
+    from pddl_tpu.ckpt.hf_export import export_hf_llama
+
+    ours = _model(intermediate_dim=64, rms_eps=1e-6, qkv_bias=True)
+    tokens = _tokens()
+    v = ours.init(jax.random.key(7), tokens, train=False)
+    sd = {k: torch.from_numpy(x) for k, x in export_hf_llama(
+        v, model=ours).items()}
+
+    hf = _hf_llama(cls=transformers.Qwen2ForCausalLM)
+    missing, unexpected = hf.load_state_dict(sd, strict=True)
+    assert not missing and not unexpected
+    hf = hf.eval()
+    with torch.no_grad():
+        ref = hf(torch.from_numpy(
+            np.asarray(tokens, np.int64))).logits.numpy()
+    got = np.asarray(ours.apply(v, tokens, train=False))
+    np.testing.assert_allclose(got, ref, atol=3e-4, rtol=3e-4)
+
+
+def test_hf_export_import_is_identity_with_padded_vocab():
+    """export -> import lands bit-exactly back on the original params,
+    including slicing vocab_multiple padding off and refilling it."""
+    from pddl_tpu.ckpt.hf_export import export_hf_llama
+    from pddl_tpu.ckpt.hf_import import load_hf_llama
+
+    ours = _model(intermediate_dim=64, rms_eps=1e-6, vocab_multiple=32)
+    tokens = _tokens()
+    v = ours.init(jax.random.key(7), tokens, train=False)
+    sd = export_hf_llama(v, model=ours)
+
+    class _Holder:
+        def state_dict(self):
+            return sd
+
+    v2 = load_hf_llama(_Holder(), v, model=ours)
+    before = ours.apply(v, tokens, train=False)
+    after = ours.apply(v2, tokens, train=False)
+    np.testing.assert_array_equal(np.asarray(before), np.asarray(after))
+
+
+def test_hf_biasless_checkpoint_into_biased_model_raises():
+    from pddl_tpu.ckpt.hf_import import load_hf_llama
+
+    hf = _hf_llama()  # plain Llama: no qkv biases
+    ours = _model(intermediate_dim=64, rms_eps=1e-6, qkv_bias=True)
+    v = ours.init(jax.random.key(0), _tokens(), train=False)
+    with pytest.raises(ValueError, match="qkv_bias=False"):
+        load_hf_llama(hf, v, model=ours)
