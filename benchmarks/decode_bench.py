@@ -1,0 +1,94 @@
+"""Autoregressive decode throughput on one chip (generation serving path).
+
+Measures :func:`pddl_tpu.models.gpt.generate` — batched prefill + the
+ENTIRE decode as one on-device ``lax.scan`` dispatch (sampling included)
+— for the GPT and Llama families at small-model shapes. The scan design
+is what makes this number meaningful under tunneled/remote transports: a
+host-side token loop would measure dispatch latency, not the model.
+
+Reports new-tokens/sec (prompt excluded) for greedy decoding, single
+stream (B1) and batched (B8). Representative v5e numbers are pinned in
+``artifacts/gpt_bench/r03_decode.json``.
+
+    PYTHONPATH=. python benchmarks/decode_bench.py [--out out.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+
+from pddl_tpu.models.gpt import GPT_Small, generate
+from pddl_tpu.models.llama import Llama_Small
+
+
+def _bench_generate(model, variables, batch: int, prompt_len: int,
+                    new_tokens: int, iters: int = 3) -> float:
+    prompt = jax.random.randint(jax.random.key(0), (batch, prompt_len),
+                                0, model.vocab_size)
+    out = generate(model, variables, prompt, max_new_tokens=new_tokens)
+    int(out[0, -1])  # scalar fetch = sync under tunneled transports
+    best = float("inf")
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        out = generate(model, variables, prompt, max_new_tokens=new_tokens)
+        int(out[0, -1])
+        best = min(best, time.perf_counter() - t0)
+    return batch * new_tokens / best
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--prompt-len", type=int, default=64)
+    p.add_argument("--new-tokens", type=int, default=256)
+    p.add_argument("--out", default="")
+    args = p.parse_args()
+
+    # param_dtype=bf16: the serving configuration — decode is weight-
+    # bandwidth-bound, so f32 storage would halve throughput for nothing.
+    models = {
+        "gpt_small": GPT_Small(vocab_size=50257, max_len=1024,
+                               dtype=jnp.bfloat16,
+                               param_dtype=jnp.bfloat16),
+        "llama_small": Llama_Small(vocab_size=32000, max_len=1024,
+                                   dtype=jnp.bfloat16,
+                                   param_dtype=jnp.bfloat16),
+    }
+    record = {
+        "metric": "greedy_decode_new_tokens_per_sec",
+        "unit": "tokens/sec/chip",
+        "config": {"prompt_len": args.prompt_len,
+                   "new_tokens": args.new_tokens, "dtype": "bfloat16"},
+        "results": {},
+        "device": jax.devices()[0].device_kind,
+    }
+    for name, model in models.items():
+        variables = jax.jit(model.init)(
+            jax.random.key(0),
+            jnp.zeros((1, args.prompt_len), jnp.int32), train=False)
+        variables = {"params": variables["params"]}
+        for batch in (1, 8):
+            tps = _bench_generate(model, variables, batch,
+                                  args.prompt_len, args.new_tokens)
+            record["results"][f"{name}_b{batch}"] = round(tps, 1)
+            print(f"{name} B{batch}: {tps:,.0f} new tokens/s",
+                  file=sys.stderr, flush=True)
+
+    line = json.dumps(record)
+    print(line)
+    if args.out:
+        out_dir = os.path.dirname(args.out)
+        if out_dir:
+            os.makedirs(out_dir, exist_ok=True)
+        with open(args.out, "w") as f:
+            f.write(line + "\n")
+
+
+if __name__ == "__main__":
+    main()

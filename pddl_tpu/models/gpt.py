@@ -300,49 +300,30 @@ def generate(model: GPT, variables, prompt, max_new_tokens: int, *,
         )
     dec = model.clone(decode=True)
     params = variables["params"]
-    param_sh = None
-    if strategy is not None:
-        # One batched transfer for the whole tree; the same sharding tree
-        # feeds the jits' in_shardings below.
-        param_sh = strategy.tree_sharding(params)
-        params = jax.device_put(params, param_sh)
-    # The fresh cache is all zeros by construction; eval_shape over init
-    # gets its structure without materializing (and discarding) a full
-    # random parameter set.
-    cache_shapes = jax.eval_shape(
-        lambda: dec.init(jax.random.key(0), prompt[:, :1], train=False)
-    )["cache"]
+    cache_shapes = _decode_cache_shapes(dec, b)
 
     def fresh_cache():
         return jax.tree.map(lambda sd: jnp.zeros(sd.shape, sd.dtype),
                             cache_shapes)
 
-    # params is an ARGUMENT of every jitted function below, never a
-    # closure: closed-over arrays become program CONSTANTS, which bakes
-    # the full parameter set into the executable — gigabyte compile
-    # payloads (remote-compile transports reject them outright) and a
-    # recompile for every new checkpoint.
-    def step_fn(params, cache, tok):
-        logits, mutated = dec.apply(
-            {"params": params, "cache": cache}, tok,
-            train=False, mutable=["cache"],
-        )
-        return mutated["cache"], logits[:, -1]
-
     # The prefill step runs ONCE (decode then scans on device) — no
     # donation: donating the just-created zero cache is never usable.
     if strategy is None:
         cache = fresh_cache()
-        step = jax.jit(step_fn)
+        step, run = _decode_programs(dec, temperature, top_k, top_p,
+                                     max_new_tokens)
     else:
-        from jax.sharding import NamedSharding, PartitionSpec
-
+        # One batched transfer for the whole tree; the same sharding tree
+        # feeds the jits' in_shardings.
+        param_sh = strategy.tree_sharding(params)
+        params = jax.device_put(params, param_sh)
         cache_sh = strategy.decode_cache_sharding(cache_shapes)
-        repl = NamedSharding(strategy.mesh, PartitionSpec())
+        p_leaves, p_def = jax.tree_util.tree_flatten(param_sh)
+        c_leaves, c_def = jax.tree_util.tree_flatten(cache_sh)
+        step, run = _sharded_decode_programs(
+            dec, temperature, top_k, top_p, max_new_tokens,
+            p_def, tuple(p_leaves), c_def, tuple(c_leaves))
         cache = jax.jit(fresh_cache, out_shardings=cache_sh)()
-        step = jax.jit(step_fn,
-                       in_shardings=(param_sh, cache_sh, repl),
-                       out_shardings=(cache_sh, repl))
 
     # Batched prefill: the whole prompt in ONE call (causal within the
     # block); then the ENTIRE decode runs as one compiled lax.scan — a
@@ -352,6 +333,26 @@ def generate(model: GPT, variables, prompt, max_new_tokens: int, *,
     # latency (remote/tunneled transports, busy hosts); on-device scan
     # makes generation latency the compute itself.
     cache, logits = step(params, cache, prompt)
+    if rng is None:
+        rng = jax.random.key(0)  # unused under greedy; scan needs a value
+    return jnp.concatenate([prompt, run(params, cache, logits, rng)], axis=1)
+
+
+def _decode_fns(dec, temperature, top_k, top_p, max_new_tokens):
+    """(step_fn, decode_all) python callables for a decode-mode model.
+
+    params is an ARGUMENT of both functions, never a closure: closed-over
+    arrays become program CONSTANTS, which bakes the full parameter set
+    into the executable — gigabyte compile payloads (remote-compile
+    transports reject them outright) and a recompile for every new
+    checkpoint.
+    """
+    def step_fn(params, cache, tok):
+        logits, mutated = dec.apply(
+            {"params": params, "cache": cache}, tok,
+            train=False, mutable=["cache"],
+        )
+        return mutated["cache"], logits[:, -1]
 
     def sample_next(logits, rng):
         if temperature > 0:
@@ -376,15 +377,69 @@ def generate(model: GPT, variables, prompt, max_new_tokens: int, *,
             body, (cache, logits, rng), None, length=max_new_tokens)
         return jnp.moveaxis(toks[..., 0], 0, 1)  # [T, B, 1] -> [B, T]
 
-    if rng is None:
-        rng = jax.random.key(0)  # unused under greedy; scan needs a value
-    if strategy is None:
-        run = jax.jit(decode_all, donate_argnums=(1,))
-    else:
-        run = jax.jit(decode_all, donate_argnums=(1,),
-                      in_shardings=(param_sh, cache_sh, repl, repl),
-                      out_shardings=repl)
-    return jnp.concatenate([prompt, run(params, cache, logits, rng)], axis=1)
+    return step_fn, decode_all
+
+
+@functools.lru_cache(maxsize=16)
+def _decode_cache_shapes(dec, batch: int):
+    """KV-cache ShapeDtypeStructs for a decode module at a batch size.
+
+    The fresh cache is all zeros by construction; eval_shape over init
+    gets its structure without materializing (and discarding) a full
+    random parameter set. Cached: the abstract trace of init walks every
+    block and is pure per-(dec, batch) overhead on the serving hot path.
+    """
+    dummy = jnp.zeros((batch, 1), jnp.int32)
+    return jax.eval_shape(
+        lambda: dec.init(jax.random.key(0), dummy, train=False)
+    )["cache"]
+
+
+@functools.lru_cache(maxsize=16)
+def _decode_programs(dec, temperature, top_k, top_p, max_new_tokens):
+    """Jitted (prefill_step, decode_scan) for the unsharded path, CACHED
+    on the (hashable, frozen) decode module + sampling statics.
+
+    Without this cache every generate() call would build fresh closures
+    and re-trace/re-compile the whole decode scan — tens of seconds per
+    request in a serving loop. With it, repeated calls (and new
+    checkpoints of the same shape, which are just new jit arguments) hit
+    the compiled programs.
+    """
+    step_fn, decode_all = _decode_fns(dec, temperature, top_k, top_p,
+                                      max_new_tokens)
+    return jax.jit(step_fn), jax.jit(decode_all, donate_argnums=(1,))
+
+
+@functools.lru_cache(maxsize=16)
+def _sharded_decode_programs(dec, temperature, top_k, top_p, max_new_tokens,
+                             param_sh_def, param_sh_leaves,
+                             cache_sh_def, cache_sh_leaves):
+    """(step, run) for tensor-parallel decoding, cached like
+    :func:`_decode_programs` so sharded serving doesn't re-compile per
+    request.
+
+    Keys are VALUES, not identities: the flattened parameter and cache
+    sharding trees (NamedShardings and treedefs hash by value, and the
+    mesh is embedded in every leaf), so a strategy object rebuilt per
+    request still hits; a different mesh, checkpoint structure, or
+    sampling config misses. One lru_cache mechanism shared with the
+    unsharded path — same true-LRU eviction.
+    """
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    param_sh = jax.tree_util.tree_unflatten(param_sh_def, param_sh_leaves)
+    cache_sh = jax.tree_util.tree_unflatten(cache_sh_def, cache_sh_leaves)
+    repl = NamedSharding(param_sh_leaves[0].mesh, PartitionSpec())
+    step_fn, decode_all = _decode_fns(dec, temperature, top_k, top_p,
+                                      max_new_tokens)
+    step = jax.jit(step_fn,
+                   in_shardings=(param_sh, cache_sh, repl),
+                   out_shardings=(cache_sh, repl))
+    run = jax.jit(decode_all, donate_argnums=(1,),
+                  in_shardings=(param_sh, cache_sh, repl, repl),
+                  out_shardings=repl)
+    return step, run
 
 
 GPT_Small = functools.partial(GPT, embed_dim=768, depth=12, num_heads=12)

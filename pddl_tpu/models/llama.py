@@ -297,6 +297,12 @@ def tiny_llama(vocab_size: int = 64, **kwargs) -> Llama:
     return Llama(vocab_size=vocab_size, **kwargs)
 
 
+# GPT-2-small-comparable shape (12x768, GQA 12/4) — the benchmark
+# configuration (`benchmarks/gpt_train_bench.py --family llama`,
+# `benchmarks/decode_bench.py`).
+Llama_Small = functools.partial(
+    Llama, embed_dim=768, depth=12, num_heads=12, num_kv_heads=4)
+
 # Llama-3.2-1B-shaped config (RoPE theta 500k, GQA 32/8). Fits one v5e
 # chip in bf16 for training at moderate batch; the multi-chip strategies
 # apply as with every family.
