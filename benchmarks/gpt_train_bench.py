@@ -52,12 +52,22 @@ def main() -> None:
     p.add_argument("--heads", type=int, default=12)
     p.add_argument("--kv-heads", type=int, default=4,
                    help="llama family only: grouped-query KV heads")
+    p.add_argument("--intermediate", type=int, default=None,
+                   help="llama family only: SwiGLU hidden dim "
+                        "(default: the ~8E/3 convention)")
     p.add_argument("--vocab", type=int, default=None,
                    help="default: 50257 (gpt) / 32000 (llama)")
     p.add_argument("--steps", type=int, default=10)
     p.add_argument("--remat", default="none",
                    choices=["none", "dots", "full"],
                    help="activation checkpointing (long sequences: dots)")
+    p.add_argument("--param-dtype", default="float32",
+                   choices=["float32", "bfloat16"],
+                   help="parameter storage dtype; bfloat16 halves "
+                        "weight+optimizer HBM (how the 1B shape fits "
+                        "one chip)")
+    p.add_argument("--chunk-size", type=int, default=None,
+                   help="fused-CE vocab chunk (memory valve)")
     p.add_argument("--fused-ce", type=int, default=1,
                    help="1 (default): fused head+CE via fused_lm_loss; "
                         "0: materialized logits + sparse CE")
@@ -67,19 +77,23 @@ def main() -> None:
 
     if args.vocab is None:
         args.vocab = 50257 if args.family == "gpt" else 32000
+    param_dtype = jnp.bfloat16 if args.param_dtype == "bfloat16" \
+        else jnp.float32
     if args.family == "gpt":
         model = GPT(vocab_size=args.vocab, max_len=args.seq,
                     embed_dim=args.width, depth=args.depth,
                     num_heads=args.heads, attention="flash",
-                    remat=args.remat, dtype=jnp.bfloat16)
+                    remat=args.remat, dtype=jnp.bfloat16,
+                    param_dtype=param_dtype)
     else:
         from pddl_tpu.models.llama import Llama
 
         model = Llama(vocab_size=args.vocab, max_len=args.seq,
                       embed_dim=args.width, depth=args.depth,
                       num_heads=args.heads, num_kv_heads=args.kv_heads,
+                      intermediate_dim=args.intermediate,
                       attention="flash", remat=args.remat,
-                      dtype=jnp.bfloat16)
+                      dtype=jnp.bfloat16, param_dtype=param_dtype)
     B, S = args.batch, args.seq
     tokens = jax.random.randint(jax.random.key(0), (B, S), 0, args.vocab)
     targets = jax.random.randint(jax.random.key(1), (B, S), 0, args.vocab)
@@ -102,7 +116,8 @@ def main() -> None:
                 # f32 logits chunk for speed; chunk_size < vocab is the
                 # memory valve).
                 return fused_lm_loss(model, {"params": params}, tokens,
-                                     targets, train=True)
+                                     targets, train=True,
+                                     chunk_size=args.chunk_size)
             logits = model.apply({"params": params}, tokens, train=True)
             return optax.softmax_cross_entropy_with_integer_labels(
                 logits, targets).mean()
@@ -131,8 +146,10 @@ def main() -> None:
           file=sys.stderr)
     print(f"  ~{mfu * 100:.0f}% MFU (6ND / {V5E_BF16_PEAK_FLOPS / 1e12:.0f}"
           " TFLOP/s v5e bf16 peak)", file=sys.stderr)
+    size_tag = ("small" if n_params < 5e8
+                else f"{max(1, int(n_params / 1e9 + 0.5))}b")
     record = {
-        "metric": f"{args.family}_small_train_tokens_per_sec_per_chip",
+        "metric": f"{args.family}_{size_tag}_train_tokens_per_sec_per_chip",
         "value": round(toks, 1),
         "unit": "tokens/sec/chip",
         "mfu_6nd": round(mfu, 4),
@@ -143,11 +160,14 @@ def main() -> None:
                    "vocab": args.vocab, "params_m": round(n_params / 1e6, 1),
                    "remat": args.remat, "fused_ce": bool(args.fused_ce),
                    "attention": "flash", "dtype": "bfloat16",
+                   "param_dtype": args.param_dtype,
+                   "chunk_size": args.chunk_size if args.fused_ce else None,
                    "steps": args.steps},
         "device": jax.devices()[0].device_kind,
     }
     if args.family == "llama":
         record["config"]["kv_heads"] = args.kv_heads
+        record["config"]["intermediate"] = args.intermediate
     print(json.dumps(record))
     if args.out:
         out_dir = os.path.dirname(args.out)
