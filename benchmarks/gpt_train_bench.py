@@ -146,8 +146,12 @@ def main() -> None:
           file=sys.stderr)
     print(f"  ~{mfu * 100:.0f}% MFU (6ND / {V5E_BF16_PEAK_FLOPS / 1e12:.0f}"
           " TFLOP/s v5e bf16 peak)", file=sys.stderr)
+    gb = n_params / 1e9
+    rounded = max(1, round(gb))
+    # Integer tag only when honest (within 15%); 600M is "0.6b", not "1b".
     size_tag = ("small" if n_params < 5e8
-                else f"{max(1, int(n_params / 1e9 + 0.5))}b")
+                else f"{rounded}b" if abs(gb - rounded) / rounded <= 0.15
+                else f"{gb:.1f}b")
     record = {
         "metric": f"{args.family}_{size_tag}_train_tokens_per_sec_per_chip",
         "value": round(toks, 1),
@@ -167,7 +171,12 @@ def main() -> None:
     }
     if args.family == "llama":
         record["config"]["kv_heads"] = args.kv_heads
-        record["config"]["intermediate"] = args.intermediate
+        # Record the RESOLVED SwiGLU width (the model's ~8E/3 convention
+        # when the flag is unset) so the artifact is self-describing.
+        record["config"]["intermediate"] = (
+            args.intermediate
+            if args.intermediate is not None
+            else -(-(8 * args.width // 3) // 128) * 128)
     print(json.dumps(record))
     if args.out:
         out_dir = os.path.dirname(args.out)

@@ -202,7 +202,9 @@ def run_digits(work_dir: str, out_path: str) -> dict:
     }
 
 
-def run_pycorpus(work_dir: str, out_path: str) -> dict:
+def run_pycorpus(work_dir: str, out_path: str, *,
+                 model_name: str = "gpt_small",
+                 track_name: str = "pycorpus") -> dict:
     from pddl_tpu.config import get_preset
     from pddl_tpu.run import run_experiment
 
@@ -214,15 +216,16 @@ def run_pycorpus(work_dir: str, out_path: str) -> dict:
     }
     cfg = get_preset(
         "single",
-        model="gpt_small", num_classes=256, seq_len=256,
+        model=model_name, num_classes=256, seq_len=256,
         data_dir=data_dir, per_replica_batch=32,
         learning_rate=3e-4, lr_schedule="cosine",
         lr_schedule_options={"decay_steps": 3000, "warmup_steps": 100},
         epochs=10, steps_per_epoch=300, seed=0, verbose=0,
     )
     if SMOKE:
+        tiny = "tiny_llama" if "llama" in model_name else "tiny_gpt"
         cfg = cfg.replace(
-            model="tiny_gpt", seq_len=128, per_replica_batch=8, epochs=2,
+            model=tiny, seq_len=128, per_replica_batch=8, epochs=2,
             steps_per_epoch=10,
             lr_schedule_options={"decay_steps": 20, "warmup_steps": 2},
         )
@@ -230,7 +233,7 @@ def run_pycorpus(work_dir: str, out_path: str) -> dict:
     history = run_experiment(cfg, validation_steps=20 if not SMOKE else 2)
     elapsed = time.time() - start
     header = {
-        "track": "pycorpus",
+        "track": track_name,
         "dataset": "CPython 3.12 stdlib source, byte-level (real text)",
         "sizes": sizes, "model": cfg.model, "seed": cfg.seed,
         "seq_len": cfg.seq_len, "batch": cfg.per_replica_batch,
@@ -255,7 +258,8 @@ def run_pycorpus(work_dir: str, out_path: str) -> dict:
 
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    p.add_argument("--track", choices=("digits", "pycorpus", "all"),
+    p.add_argument("--track",
+                   choices=("digits", "pycorpus", "pycorpus-llama", "all"),
                    default="all")
     p.add_argument("--work-dir", default="/tmp/pddl_tpu_real_data",
                    help="where datasets are materialized (not committed)")
@@ -276,6 +280,13 @@ def main(argv=None) -> int:
     if args.track in ("pycorpus", "all"):
         results["pycorpus"] = run_pycorpus(
             args.work_dir, os.path.join(args.artifacts_dir, "pycorpus.jsonl"))
+    if args.track in ("pycorpus-llama", "all"):
+        # Same corpus, same token budget/schedule, modern-decoder family:
+        # an apples-to-apples architecture comparison against pycorpus.
+        results["pycorpus-llama"] = run_pycorpus(
+            args.work_dir,
+            os.path.join(args.artifacts_dir, "pycorpus_llama.jsonl"),
+            model_name="llama_small", track_name="pycorpus-llama")
     print(json.dumps(results, indent=2))
     return 0
 

@@ -73,6 +73,7 @@ def _populate() -> None:
     from pddl_tpu.models import llama
 
     # Llama configs ride the same LM adapter (vocab from num_classes).
+    register_model("llama_small", _gpt(llama.Llama_Small))
     register_model("llama_1b", _gpt(llama.Llama_1B))
     register_model("tiny_llama", _gpt(llama.tiny_llama))
 
