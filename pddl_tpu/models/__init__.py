@@ -16,12 +16,15 @@ from pddl_tpu.models.resnet import (
     ResNet152,
 )
 from pddl_tpu.models.vit import ViT, ViT_S16, ViT_B16, ViT_L16
-from pddl_tpu.models.llama import Llama, Llama_1B, tiny_llama
+from pddl_tpu.models.llama import (Llama, Llama_1B, Llama_Small,
+                                    GPipeLlama, tiny_llama)
 from pddl_tpu.models.registry import get_model, register_model, list_models
 
 __all__ = [
+    "GPipeLlama",
     "Llama",
     "Llama_1B",
+    "Llama_Small",
     "tiny_llama",
     "ResNet",
     "ResNet18",

@@ -36,9 +36,17 @@ import jax
 import jax.numpy as jnp
 from flax import linen as nn
 
+from pddl_tpu.models.gpipe import GPipeModel
 from pddl_tpu.models.vit import remat_block
 from pddl_tpu.ops.attention import attention_reference, flash_attention
 from pddl_tpu.ops.rope import apply_rope_qk
+
+
+def _default_intermediate_dim(embed_dim: int) -> int:
+    """The SwiGLU convention: 2/3 of the 4E classic MLP width, rounded up
+    to a multiple of 128 (lane-friendly). One definition shared by
+    :class:`Llama` and :class:`GPipeLlama`."""
+    return -(-(8 * embed_dim // 3) // 128) * 128
 
 
 def _rms_norm(eps: float, param_dtype, name: str):
@@ -253,12 +261,15 @@ class Llama(nn.Module):
         kv = self.num_kv_heads or self.num_heads
         inter = self.intermediate_dim
         if inter is None:
-            # The SwiGLU convention: 2/3 of the 4E classic MLP width,
-            # rounded up to a multiple of 128 (lane-friendly).
-            inter = -(-(8 * self.embed_dim // 3) // 128) * 128
-        padded_v = -(-self.vocab_size // self.vocab_multiple) * self.vocab_multiple
-        x = nn.Embed(padded_v, self.embed_dim, dtype=self.dtype,
-                     param_dtype=self.param_dtype, name="embed")(tokens)
+            inter = _default_intermediate_dim(self.embed_dim)
+        # Stem/head shared with GPipeLlama; share_scope keeps the param
+        # names (embed/ln_final/lm_head) at this module's top level.
+        embed = _LlamaEmbed(vocab_size=self.vocab_size,
+                            embed_dim=self.embed_dim,
+                            vocab_multiple=self.vocab_multiple,
+                            dtype=self.dtype, param_dtype=self.param_dtype)
+        nn.share_scope(self, embed)
+        x = embed(tokens)
 
         block_cls = (LlamaBlock if self.decode
                      else remat_block(LlamaBlock, self.remat))
@@ -274,16 +285,13 @@ class Llama(nn.Module):
                 param_dtype=self.param_dtype, name=f"block{i}",
             )(x, train)
 
-        x = _rms_norm(self.rms_eps, self.param_dtype, "ln_final")(x)
-        if features_only and not self.is_initializing():
-            # Pre-head features for fused CE. init() falls through to the
-            # Dense regardless (like gpt._GPTHead), so lm_head params
-            # exist even when the first trace goes through fused_lm_loss.
-            return x.astype(self.dtype)
-        logits = nn.Dense(padded_v, use_bias=False, dtype=self.dtype,
-                          param_dtype=self.param_dtype, name="lm_head")(
-                              x.astype(self.dtype))
-        return logits[..., :self.vocab_size].astype(jnp.float32)
+        head = _LlamaHead(vocab_size=self.vocab_size,
+                          vocab_multiple=self.vocab_multiple,
+                          rms_eps=self.rms_eps, dtype=self.dtype,
+                          param_dtype=self.param_dtype,
+                          features_only=features_only)
+        nn.share_scope(self, head)
+        return head(x)
 
 
 def tiny_llama(vocab_size: int = 64, **kwargs) -> Llama:
@@ -309,3 +317,111 @@ Llama_Small = functools.partial(
 Llama_1B = functools.partial(
     Llama, embed_dim=2048, depth=16, num_heads=32, num_kv_heads=8,
     intermediate_dim=8192, rope_theta=500000.0, max_len=4096)
+
+
+class _LlamaEmbed(nn.Module):
+    """Token embedding (the pre-pipeline Llama stem; RoPE needs no
+    positional parameters — positions enter inside each block)."""
+
+    vocab_size: int
+    embed_dim: int
+    vocab_multiple: int = 1
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, tokens):
+        padded_v = -(-self.vocab_size // self.vocab_multiple) * self.vocab_multiple
+        return nn.Embed(padded_v, self.embed_dim, dtype=self.dtype,
+                        param_dtype=self.param_dtype, name="embed")(tokens)
+
+
+class _LlamaStage(nn.Module):
+    """One pipeline stage: a run of Llama blocks.
+
+    PP splits LAYERS, never the sequence, so each block's internal
+    ``arange(S)`` RoPE positions stay correct on every stage."""
+
+    num_heads: int
+    num_kv_heads: int
+    intermediate_dim: int
+    blocks: int
+    rope_theta: float = 10000.0
+    attention: str = "reference"
+    rms_eps: float = 1e-5
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        for i in range(self.blocks):
+            x = LlamaBlock(
+                num_heads=self.num_heads, num_kv_heads=self.num_kv_heads,
+                intermediate_dim=self.intermediate_dim,
+                rope_theta=self.rope_theta, attention=self.attention,
+                rms_eps=self.rms_eps, dtype=self.dtype,
+                param_dtype=self.param_dtype, name=f"block{i}",
+            )(x, False)
+        return x
+
+
+class _LlamaHead(nn.Module):
+    """Final RMSNorm + bias-free LM head (shared by :class:`Llama` via
+    ``share_scope`` and by :class:`GPipeLlama` as the post-pipeline
+    projection)."""
+
+    vocab_size: int
+    vocab_multiple: int = 1
+    rms_eps: float = 1e-5
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+
+    features_only: bool = False  # stop after ln_final (fused-CE path)
+
+    @nn.compact
+    def __call__(self, x):
+        x = _rms_norm(self.rms_eps, self.param_dtype, "ln_final")(x)
+        if self.features_only and not self.is_initializing():
+            # Pre-head features for fused CE. init() falls through to the
+            # Dense regardless (like gpt._GPTHead), so lm_head params
+            # exist even when the first trace goes through fused_lm_loss.
+            return x.astype(self.dtype)
+        padded_v = -(-self.vocab_size // self.vocab_multiple) * self.vocab_multiple
+        logits = nn.Dense(padded_v, use_bias=False, dtype=self.dtype,
+                          param_dtype=self.param_dtype, name="lm_head")(
+                              x.astype(self.dtype))
+        return logits[..., :self.vocab_size].astype(jnp.float32)
+
+
+class GPipeLlama(GPipeModel):
+    """Pipeline-parallel modern-decoder LM: PP x the Llama architecture —
+    token embed (replicated) → ``n_stages`` stacked RoPE/RMSNorm/SwiGLU
+    stages through the GPipe schedule → bias-free head (replicated).
+    Completes the PP row of the parallelism x family matrix alongside
+    :class:`pddl_tpu.models.vit.GPipeViT` and
+    :class:`pddl_tpu.models.gpt.GPipeGPT`."""
+
+    def __init__(self, *, vocab_size: int, n_stages: int,
+                 blocks_per_stage: int, n_microbatches: int, mesh,
+                 embed_dim: int = 256, num_heads: int = 4,
+                 num_kv_heads: Optional[int] = None,
+                 intermediate_dim: Optional[int] = None,
+                 rope_theta: float = 10000.0,
+                 attention: str = "reference", rms_eps: float = 1e-5,
+                 dtype: Any = jnp.float32, param_dtype: Any = jnp.float32):
+        kv = num_kv_heads or num_heads
+        if intermediate_dim is None:
+            intermediate_dim = _default_intermediate_dim(embed_dim)
+        super().__init__(
+            embed=_LlamaEmbed(vocab_size=vocab_size, embed_dim=embed_dim,
+                              dtype=dtype, param_dtype=param_dtype),
+            stage=_LlamaStage(num_heads=num_heads, num_kv_heads=kv,
+                              intermediate_dim=intermediate_dim,
+                              blocks=blocks_per_stage,
+                              rope_theta=rope_theta, attention=attention,
+                              rms_eps=rms_eps, dtype=dtype,
+                              param_dtype=param_dtype),
+            head=_LlamaHead(vocab_size=vocab_size, rms_eps=rms_eps,
+                            dtype=dtype, param_dtype=param_dtype),
+            n_stages=n_stages, n_microbatches=n_microbatches, mesh=mesh,
+        )
