@@ -28,6 +28,33 @@ from pddl_tpu.models.gpt import GPT_Small, generate
 from pddl_tpu.models.llama import Llama_Small
 
 
+# Peak HBM bandwidth per chip, GB/s — the denominator of the decode
+# roofline (single-stream decode is weight+KV-read bound).
+HBM_GBPS = {"TPU v5 lite": 819.0, "TPU v5e": 819.0}
+
+
+def _roofline_tokens_per_sec(model, variables, prompt_len: int,
+                             new_tokens: int) -> float | None:
+    """Weight+KV bandwidth roofline for single-stream greedy decode.
+
+    Every decoded token must read all parameters once plus the live KV
+    prefix (k and v, kv-head granularity, storage dtype) in each layer;
+    the prefix is averaged over the decode. Anything above the returned
+    rate would exceed the chip's HBM bandwidth.
+    """
+    bw = HBM_GBPS.get(jax.devices()[0].device_kind)
+    if bw is None:
+        return None
+    param_bytes = sum(leaf.size * leaf.dtype.itemsize
+                      for leaf in jax.tree.leaves(variables["params"]))
+    hkv = getattr(model, "num_kv_heads", None) or model.num_heads
+    head_dim = model.embed_dim // model.num_heads
+    avg_prefix = prompt_len + new_tokens / 2
+    itemsize = jnp.dtype(model.dtype).itemsize
+    kv_bytes = 2 * model.depth * hkv * head_dim * itemsize * avg_prefix
+    return bw * 1e9 / (param_bytes + kv_bytes)
+
+
 def _bench_generate(model, variables, batch: int, prompt_len: int,
                     new_tokens: int, iters: int = 3) -> float:
     prompt = jax.random.randint(jax.random.key(0), (batch, prompt_len),
@@ -73,11 +100,19 @@ def main() -> None:
             jax.random.key(0),
             jnp.zeros((1, args.prompt_len), jnp.int32), train=False)
         variables = {"params": variables["params"]}
+        roof = _roofline_tokens_per_sec(model, variables,
+                                        args.prompt_len, args.new_tokens)
         for batch in (1, 8):
             tps = _bench_generate(model, variables, batch,
                                   args.prompt_len, args.new_tokens)
             record["results"][f"{name}_b{batch}"] = round(tps, 1)
-            print(f"{name} B{batch}: {tps:,.0f} new tokens/s",
+            if batch == 1 and roof is not None:
+                record["results"][f"{name}_roofline_b1"] = round(roof, 1)
+                record["results"][f"{name}_roofline_ratio_b1"] = round(
+                    tps / roof, 3)
+            print(f"{name} B{batch}: {tps:,.0f} new tokens/s"
+                  + (f" ({tps / roof:.0%} of {roof:,.0f} roofline)"
+                     if batch == 1 and roof else ""),
                   file=sys.stderr, flush=True)
 
     line = json.dumps(record)

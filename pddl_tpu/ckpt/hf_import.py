@@ -64,14 +64,24 @@ def _tree_put(params: PyTree, path: str, value: np.ndarray, *,
         node = node[p]
     old = node[name]
     if allow_vocab_pad and value.shape != old.shape:
-        merged = np.array(old)
-        if value.ndim == 1:
-            merged[: value.shape[0]] = value
-        elif value.shape[0] != old.shape[0]:       # [V, E] rows
-            merged[: value.shape[0], ...] = value
-        else:                                      # [E, V] columns
-            merged[:, : value.shape[1]] = value
-        value = merged
+        # Merge only when the checkpoint FITS inside the padded leaf; a
+        # checkpoint vocab LARGER than the model's (wrong vocab_size)
+        # falls through to the descriptive shape error below instead of
+        # an opaque numpy broadcast failure (ADVICE r3).
+        fits = (value.ndim == old.ndim
+                and all(vs <= os
+                        for vs, os in zip(value.shape, old.shape))
+                and sum(vs != os
+                        for vs, os in zip(value.shape, old.shape)) == 1)
+        if fits:
+            merged = np.array(old)
+            if value.ndim == 1:
+                merged[: value.shape[0]] = value
+            elif value.shape[0] != old.shape[0]:       # [V, E] rows
+                merged[: value.shape[0], ...] = value
+            else:                                      # [E, V] columns
+                merged[:, : value.shape[1]] = value
+            value = merged
     if value.shape != old.shape:
         raise ValueError(
             f"{what} {path}: shape {value.shape} != model's "

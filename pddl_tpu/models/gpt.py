@@ -404,7 +404,9 @@ def _decode_programs(dec, temperature, top_k, top_p, max_new_tokens):
     and re-trace/re-compile the whole decode scan — tens of seconds per
     request in a serving loop. With it, repeated calls (and new
     checkpoints of the same shape, which are just new jit arguments) hit
-    the compiled programs.
+    the compiled programs. Entries keep the module and executables alive
+    until LRU eviction (maxsize=16) or process exit — deliberate serving
+    behavior, not a leak.
     """
     step_fn, decode_all = _decode_fns(dec, temperature, top_k, top_p,
                                       max_new_tokens)
@@ -425,9 +427,19 @@ def _sharded_decode_programs(dec, temperature, top_k, top_p, max_new_tokens,
     request still hits; a different mesh, checkpoint structure, or
     sampling config misses. One lru_cache mechanism shared with the
     unsharded path — same true-LRU eviction.
+
+    Retention: like :func:`_decode_programs`, cached entries hold strong
+    references to the module, the NamedShardings (hence meshes and
+    device handles) and the compiled executables until LRU-evicted or
+    the process exits — the deliberate cost of not re-compiling per
+    serving request (same caveat as ``core/sharding.py``'s lru_cache).
     """
     from jax.sharding import NamedSharding, PartitionSpec
 
+    if not param_sh_leaves:
+        raise ValueError(
+            "sharded decode needs a non-empty params tree (got zero "
+            "parameter leaves — was the model initialized?)")
     param_sh = jax.tree_util.tree_unflatten(param_sh_def, param_sh_leaves)
     cache_sh = jax.tree_util.tree_unflatten(cache_sh_def, cache_sh_leaves)
     repl = NamedSharding(param_sh_leaves[0].mesh, PartitionSpec())

@@ -38,7 +38,11 @@ from flax import linen as nn
 
 from pddl_tpu.models.gpipe import GPipeModel
 from pddl_tpu.models.vit import remat_block
-from pddl_tpu.ops.attention import attention_reference, flash_attention
+from pddl_tpu.ops.attention import (
+    attention_reference,
+    decode_attention,
+    flash_attention,
+)
 from pddl_tpu.ops.rope import apply_rope_qk
 
 
@@ -61,8 +65,10 @@ class LlamaAttention(nn.Module):
 
     Layout mirrors :class:`pddl_tpu.models.vit.MultiHeadAttention`
     (``query``/``key``/``value`` DenseGeneral, flattened ``out``) so the
-    Megatron TP path rules apply unchanged; K/V carry ``num_kv_heads``
-    and are repeated head-wise to feed the kernels.
+    Megatron TP path rules apply unchanged. K/V carry ``num_kv_heads``
+    and are consumed at that size by every kernel (flash, reference,
+    ring): the q-head → kv-head mapping lives inside the kernels, so no
+    expanded copy is materialized anywhere in training or prefill.
     """
 
     num_heads: int
@@ -108,8 +114,12 @@ class LlamaAttention(nn.Module):
             return self._decode_step(q, k, v, b, s, head_dim, dense)
 
         q, k = apply_rope_qk(q, k, jnp.arange(s), theta=self.rope_theta)
-        k, v = (self._expand_kv(t) for t in (k, v))
 
+        # K/V stay at kv-head shape [B, H_kv, S, D] through every kernel:
+        # the attention ops consume grouped K/V natively (q-head → kv-head
+        # mapping in kernel index maps), so training/prefill get GQA's
+        # full HBM-bandwidth and activation-memory saving — no
+        # H/H_kv-times expansion is ever materialized.
         if self.attention == "flash":
             o = flash_attention(q, k, v, causal=True,
                                 window=self.sliding_window)
@@ -134,49 +144,110 @@ class LlamaAttention(nn.Module):
         o = o.transpose(0, 2, 1, 3).reshape(b, s, e)
         return dense(features=e, name="out")(o)
 
-    def _expand_kv(self, t: jnp.ndarray) -> jnp.ndarray:
-        """[B, H_kv, S, D] → [B, H, S, D] by repeating each KV head."""
-        rep = self.num_heads // self.num_kv_heads
-        if rep == 1:
-            return t
-        return jnp.repeat(t, rep, axis=1)
+    def _ring_len(self) -> Optional[int]:
+        """Rolling-cache length for SWA decode: the window rounded up to
+        a lane-friendly multiple of 128 (``>= window`` so the slot being
+        overwritten each step is always already outside the band), or
+        None when a full-length cache is smaller anyway."""
+        if self.sliding_window is None:
+            return None
+        ring = -(-self.sliding_window // 128) * 128
+        return ring if ring < self.max_decode_len else None
 
     def _decode_step(self, q, k, v, b, s, head_dim, dense):
-        """KV-cache decoding; the cache holds POST-RoPE keys at KV-head
-        granularity (each key is rotated once, at its absolute position —
-        queries rotate at theirs, relative phase falls out)."""
+        """KV-cache decoding at the bandwidth roofline.
+
+        The cache holds POST-RoPE keys at KV-head granularity in the
+        model's compute dtype (bf16 in serving — never cast up), and:
+
+        - single-token steps sweep it with
+          :func:`~pddl_tpu.ops.attention.decode_attention` — grouped
+          (unexpanded) K/V, online softmax over chunks, HBM traffic
+          bounded by the valid prefix;
+        - with ``sliding_window`` the cache is a ``window``-sized RING
+          buffer (:meth:`_ring_len`) instead of ``max_decode_len`` —
+          Mistral's rolling cache — so decode memory and traffic are
+          O(window), not O(max_len);
+        - multi-token PREFILL (including chunked prefill at any starting
+          index) runs the flash kernel on the block itself merged with a
+          pre-write history sweep in logsumexp space — O(block) score
+          memory, never ``[B,H,S,max_len]`` f32.
+        """
         hkv = self.num_kv_heads
+        ring = self._ring_len()
+        cache_len = ring or self.max_decode_len
         initialized = self.has_variable("cache", "cached_key")
         cached_k = self.variable(
             "cache", "cached_key", jnp.zeros,
-            (b, hkv, self.max_decode_len, head_dim), self.dtype)
+            (b, hkv, cache_len, head_dim), self.dtype)
         cached_v = self.variable(
             "cache", "cached_value", jnp.zeros,
-            (b, hkv, self.max_decode_len, head_dim), self.dtype)
+            (b, hkv, cache_len, head_dim), self.dtype)
         index = self.variable(
             "cache", "cache_index", lambda: jnp.zeros((), jnp.int32))
 
         i = index.value
         q, k = apply_rope_qk(q, k, i + jnp.arange(s), theta=self.rope_theta)
+        k = k.astype(self.dtype)
+        v = v.astype(self.dtype)
+        # Pre-write ring state: the multi-token ring path attends history
+        # from here (the block's own writes below may overwrite in-window
+        # history slots that this block's EARLY queries still need).
+        hist_k, hist_v = cached_k.value, cached_v.value
         if initialized:
-            cached_k.value = jax.lax.dynamic_update_slice(
-                cached_k.value, k.astype(self.dtype), (0, 0, i, 0))
-            cached_v.value = jax.lax.dynamic_update_slice(
-                cached_v.value, v.astype(self.dtype), (0, 0, i, 0))
+            if ring is None:
+                cached_k.value = jax.lax.dynamic_update_slice(
+                    cached_k.value, k, (0, 0, i, 0))
+                cached_v.value = jax.lax.dynamic_update_slice(
+                    cached_v.value, v, (0, 0, i, 0))
+            elif s == 1:
+                slot = i % ring
+                cached_k.value = jax.lax.dynamic_update_slice(
+                    cached_k.value, k, (0, 0, slot, 0))
+                cached_v.value = jax.lax.dynamic_update_slice(
+                    cached_v.value, v, (0, 0, slot, 0))
+            else:
+                # Prefill into the ring: only the last `ring` tokens can
+                # survive; scatter them at their slots (consecutive
+                # positions → distinct slots).
+                keep = min(s, ring)
+                slots = (i + jnp.arange(s)[s - keep:]) % ring
+                cached_k.value = cached_k.value.at[:, :, slots].set(
+                    k[:, :, s - keep:])
+                cached_v.value = cached_v.value.at[:, :, slots].set(
+                    v[:, :, s - keep:])
             index.value = i + s
 
-        kf = self._expand_kv(cached_k.value).astype(jnp.float32)
-        vf = self._expand_kv(cached_v.value).astype(jnp.float32)
-        qf = q.astype(jnp.float32) * (head_dim ** -0.5)
-        scores = jnp.einsum("bhqd,bhkd->bhqk", qf, kf)
-        k_pos = jnp.arange(self.max_decode_len)[None, :]
-        q_pos = i + jnp.arange(s)[:, None]
-        mask = k_pos <= q_pos
-        if self.sliding_window is not None:
-            mask &= k_pos > q_pos - self.sliding_window
-        scores = jnp.where(mask[None, None], scores, -1e30)
-        probs = jax.nn.softmax(scores, axis=-1)
-        o = jnp.einsum("bhqk,bhkd->bhqd", probs, vf).astype(q.dtype)
+        if s > 1:
+            # Prefill / chunked prefill, exact for ANY starting index i.
+            if ring is not None:
+                # Ring path: the block attends within itself through the
+                # flash kernel (O(block) memory) and strictly-pre-block
+                # history through a sweep of the PRE-WRITE ring; the two
+                # normalized partials merge in logsumexp space. At i == 0
+                # the history term has -inf lse and zero weight.
+                from pddl_tpu.ops.attention import flash_attention_lse
+
+                o_blk, lse_blk = flash_attention_lse(
+                    q, k, v, causal=True, window=self.sliding_window)
+                o_hist, lse_hist = decode_attention(
+                    q, hist_k, hist_v, i, window=self.sliding_window,
+                    rolling=True, history_only=True, return_lse=True,
+                    chunk=128)
+                m = jnp.maximum(lse_blk, lse_hist)
+                w_blk = jnp.exp(lse_blk - m)[..., None]
+                w_hist = jnp.exp(lse_hist - m)[..., None]
+                o = ((o_blk.astype(jnp.float32) * w_blk
+                      + o_hist.astype(jnp.float32) * w_hist)
+                     / (w_blk + w_hist)).astype(q.dtype)
+            else:
+                o = decode_attention(
+                    q, cached_k.value, cached_v.value, i,
+                    window=self.sliding_window, chunk=128)
+        else:
+            o = decode_attention(
+                q, cached_k.value, cached_v.value, i,
+                window=self.sliding_window, rolling=ring is not None)
         o = o.transpose(0, 2, 1, 3).reshape(b, s, self.num_heads * head_dim)
         return dense(features=self.num_heads * head_dim, name="out")(o)
 

@@ -6,9 +6,25 @@ from typing import Callable, Dict
 
 _REGISTRY: Dict[str, Callable] = {}
 
+# Registry names whose models consume TOKEN batches (language models):
+# run.py keys data/augmentation decisions off membership here — an exact
+# per-name property, never a substring heuristic (a vision model whose
+# name merely contains 'gpt' must not be fed token batches).
+LM_MODELS: set = set()
 
-def register_model(name: str, factory: Callable | None = None):
+# Registry names whose factories accept the ``remat`` kwarg (transformer
+# families with rematerializable blocks) — same exact-membership rule.
+REMAT_MODELS: set = set()
+
+
+def register_model(name: str, factory: Callable | None = None, *,
+                   is_lm: bool = False, supports_remat: bool = False):
     """Register a model factory; usable as a decorator or a call."""
+    if is_lm:
+        LM_MODELS.add(name)
+        REMAT_MODELS.add(name)  # every LM family here is remat-capable
+    if supports_remat:
+        REMAT_MODELS.add(name)
     if factory is not None:
         _REGISTRY[name] = factory
         return factory
@@ -48,10 +64,10 @@ def _populate() -> None:
 
         return make
 
-    register_model("vit_s16", _vit(vit.ViT_S16))
-    register_model("vit_b16", _vit(vit.ViT_B16))
-    register_model("vit_l16", _vit(vit.ViT_L16))
-    register_model("tiny_vit", _vit(vit.tiny_vit))
+    register_model("vit_s16", _vit(vit.ViT_S16), supports_remat=True)
+    register_model("vit_b16", _vit(vit.ViT_B16), supports_remat=True)
+    register_model("vit_l16", _vit(vit.ViT_L16), supports_remat=True)
+    register_model("tiny_vit", _vit(vit.tiny_vit), supports_remat=True)
 
     from pddl_tpu.models import gpt
 
@@ -67,15 +83,15 @@ def _populate() -> None:
 
         return make
 
-    register_model("gpt_small", _gpt(gpt.GPT_Small))
-    register_model("tiny_gpt", _gpt(gpt.tiny_gpt))
+    register_model("gpt_small", _gpt(gpt.GPT_Small), is_lm=True)
+    register_model("tiny_gpt", _gpt(gpt.tiny_gpt), is_lm=True)
 
     from pddl_tpu.models import llama
 
     # Llama configs ride the same LM adapter (vocab from num_classes).
-    register_model("llama_small", _gpt(llama.Llama_Small))
-    register_model("llama_1b", _gpt(llama.Llama_1B))
-    register_model("tiny_llama", _gpt(llama.tiny_llama))
+    register_model("llama_small", _gpt(llama.Llama_Small), is_lm=True)
+    register_model("llama_1b", _gpt(llama.Llama_1B), is_lm=True)
+    register_model("tiny_llama", _gpt(llama.tiny_llama), is_lm=True)
 
 
 _populate()

@@ -26,14 +26,17 @@ from __future__ import annotations
 import functools
 from typing import Any, Optional
 
-import math
 
 import jax
 import jax.numpy as jnp
 from flax import linen as nn
 
 from pddl_tpu.models.gpipe import GPipeModel
-from pddl_tpu.ops.attention import attention_reference, flash_attention
+from pddl_tpu.ops.attention import (
+    attention_reference,
+    decode_attention,
+    flash_attention,
+)
 
 
 class MultiHeadAttention(nn.Module):
@@ -95,8 +98,11 @@ class MultiHeadAttention(nn.Module):
 
         Handles both the batched prefill (``s`` prompt tokens in one call,
         causal within the block) and single-token steps (``s == 1``): the
-        block's K/V land at the running index, queries attend over
-        ``k_pos <= index + q_local_pos`` of the full (masked) cache.
+        block's K/V land at the running index, then
+        :func:`~pddl_tpu.ops.attention.decode_attention` sweeps the cache
+        in its STORAGE dtype with online softmax, traffic and compute
+        bounded by the valid prefix — never an f32 copy of the cache nor
+        an ``[s, max_decode_len]`` f32 score materialization.
         """
         h = self.num_heads
         # During init() the cache variables don't exist yet: create them
@@ -119,16 +125,8 @@ class MultiHeadAttention(nn.Module):
                 cached_v.value, v.astype(self.dtype), (0, 0, i, 0))
             index.value = i + s
 
-        kf = cached_k.value.astype(jnp.float32)
-        vf = cached_v.value.astype(jnp.float32)
-        qf = q.astype(jnp.float32) * (1.0 / math.sqrt(head_dim))
-        scores = jnp.einsum("bhqd,bhkd->bhqk", qf, kf)     # (b, h, s, L)
-        k_pos = jnp.arange(self.max_decode_len)[None, :]
-        q_pos = i + jnp.arange(s)[:, None]
-        mask = k_pos <= q_pos                              # (s, L)
-        scores = jnp.where(mask[None, None], scores, -1e30)
-        probs = jax.nn.softmax(scores, axis=-1)
-        o = jnp.einsum("bhqk,bhkd->bhqd", probs, vf).astype(q.dtype)
+        o = decode_attention(q, cached_k.value, cached_v.value, i,
+                             chunk=512 if s == 1 else 128)
         o = o.transpose(0, 2, 1, 3).reshape(b, s, h * head_dim)
         # Same `dense` partial as the training path: one definition of the
         # 'out' projection, so the two can never diverge.

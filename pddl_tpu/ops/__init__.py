@@ -11,6 +11,7 @@ live in :mod:`pddl_tpu.ops.ring_attention`.
 from pddl_tpu.ops import augment
 from pddl_tpu.ops.attention import (
     attention_reference,
+    decode_attention,
     flash_attention,
     flash_attention_lse,
 )
@@ -25,6 +26,7 @@ __all__ = [
     "augment",
     "attention_reference",
     "chunked_cross_entropy",
+    "decode_attention",
     "flash_attention",
     "flash_attention_lse",
     "ring_attention",

@@ -39,6 +39,23 @@ from jax.experimental import pallas as pl
 NEG_INF = -1e30  # large-negative instead of -inf: keeps masked softmax NaN-free
 
 
+def _gqa_rep(q: jnp.ndarray, k: jnp.ndarray) -> int:
+    """Query-heads-per-kv-head ratio; 1 for plain MHA.
+
+    Grouped-query attention passes K/V with ``H_kv <= H`` heads; every
+    kernel in this module consumes them UNEXPANDED (the q-head → kv-head
+    mapping happens in index maps / reshapes), so GQA's bandwidth saving
+    holds in training, not just in the decode cache.
+    """
+    hq, hkv = q.shape[-3], k.shape[-3]
+    if hq == hkv:
+        return 1
+    if hq % hkv:
+        raise ValueError(
+            f"query heads {hq} not divisible by kv heads {hkv}")
+    return hq // hkv
+
+
 def attention_reference(
     q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     *, causal: bool = False, scale: Optional[float] = None,
@@ -50,6 +67,8 @@ def attention_reference(
     used by ring attention where each shard sees a rotated K/V slice.
     ``window`` (requires ``causal``): sliding-window attention — query t
     sees keys ``[t-window+1, t]`` (Mistral's SWA; window=1 is self-only).
+    K/V may carry fewer heads than q (grouped-query attention): each kv
+    head serves ``H/H_kv`` consecutive query heads, unexpanded.
     """
     *_, sq, d = q.shape
     sk = k.shape[-2]
@@ -63,8 +82,15 @@ def attention_reference(
             # not -inf) and softmax silently uniform — raise like the
             # flash path instead.
             raise ValueError(f"window must be >= 1, got {window}")
-    s = jnp.einsum("...qd,...kd->...qk", q.astype(jnp.float32),
-                   k.astype(jnp.float32)) * scale
+    rep = _gqa_rep(q, k)
+    if rep > 1:
+        hkv = k.shape[-3]
+        qg = q.reshape(*q.shape[:-3], hkv, rep, sq, d)
+        s = jnp.einsum("...grqd,...gkd->...grqk", qg.astype(jnp.float32),
+                       k.astype(jnp.float32)) * scale
+    else:
+        s = jnp.einsum("...qd,...kd->...qk", q.astype(jnp.float32),
+                       k.astype(jnp.float32)) * scale
     if causal:
         q_pos = jnp.arange(sq)[:, None]
         k_pos = jnp.arange(sk)[None, :] + k_offset
@@ -73,6 +99,9 @@ def attention_reference(
             mask &= k_pos > q_pos - window
         s = jnp.where(mask, s, NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
+    if rep > 1:
+        o = jnp.einsum("...grqk,...gkd->...grqd", p, v.astype(jnp.float32))
+        return o.reshape(q.shape).astype(q.dtype)
     return jnp.einsum("...qk,...kd->...qd", p, v.astype(jnp.float32)).astype(q.dtype)
 
 
@@ -221,8 +250,9 @@ def flash_attention(
     (Mistral's SWA): query t attends to keys ``[t-window+1, t]``. Blocks
     entirely outside the band are skipped in the forward and both
     backward sweeps, so compute scales O(S*window) instead of O(S^2);
-    ``window >= S`` degrades gracefully to plain causal. Not supported
-    through the ring/sequence-parallel path (``flash_attention_lse``).
+    ``window >= S`` degrades gracefully to plain causal.
+    :func:`flash_attention_lse` accepts ``window`` too; only the
+    ring/sequence-parallel wrapper rejects it.
 
     ``block_q``/``block_k`` default to the local device generation's tuned
     pair (:func:`tuned_blocks`; re-tune a new chip with
@@ -244,19 +274,18 @@ def flash_attention(
     rule): for higher-order differentiation — Hessian-vector products,
     gradient penalties — pass ``fused_backward=False`` to use the exact
     O(S²)-memory reference path, differentiable at any order.
+
+    K/V may carry fewer heads than q (grouped-query attention). They are
+    consumed UNEXPANDED: the kernels map each query head to its kv head
+    in the block index maps, so no ``H/H_kv``-times K/V copy is ever
+    materialized in HBM, forward or backward — dk/dv come back at kv-head
+    shape, accumulated over the query group inside the kernel.
     """
     *_, sq, d = q.shape
     sk = k.shape[-2]
+    _gqa_rep(q, k)  # validate head grouping before any dispatch
     scale_v = (1.0 / math.sqrt(d)) if scale is None else scale
-    if window is not None:
-        if not causal:
-            raise ValueError("window requires causal=True (sliding-window "
-                             "attention is a causal-LM construct)")
-        if window < 1:
-            raise ValueError(f"window must be >= 1, got {window}")
-        window = int(window)
-        if window >= sk:
-            window = None  # the band covers everything: plain causal
+    window = _normalize_window(window, causal, sk)
     if not fused_backward:
         return attention_reference(q, k, v, causal=causal, scale=scale_v,
                                    window=window)
@@ -272,6 +301,25 @@ def flash_attention(
                                    window=window)
     q, scale_v = _fold_scale(q, scale_v)
     return _flash(q, k, v, causal, scale_v, bq, bk, bool(interpret), window)
+
+
+def _normalize_window(window: Optional[int], causal: bool,
+                      sk: int) -> Optional[int]:
+    """Validate a sliding-window width and clamp the trivial case.
+
+    One definition shared by :func:`flash_attention` and
+    :func:`flash_attention_lse` so the two entry points can never drift:
+    window needs ``causal``, must be ``>= 1``, and ``window >= sk``
+    degrades to plain causal (returned as None)."""
+    if window is None:
+        return None
+    if not causal:
+        raise ValueError("window requires causal=True (sliding-window "
+                         "attention is a causal-LM construct)")
+    if window < 1:
+        raise ValueError(f"window must be >= 1, got {window}")
+    window = int(window)
+    return None if window >= sk else window
 
 
 def _fold_scale(q: jnp.ndarray, scale: float) -> tuple[jnp.ndarray, float]:
@@ -323,6 +371,19 @@ def _sds_like(ref_value):
     return jax.ShapeDtypeStruct
 
 
+def _kv_index_map(h: int, hkv: int):
+    """K/V BlockSpec index map over the flat ``b*h``-major grid axis.
+
+    For GQA the K/V operands stay at ``[b*hkv, S, D]``; each q head's
+    grid slot reads its group's kv head: flat kv index
+    ``(batch)*hkv + (q_head)//rep``. MHA keeps the identity map (no
+    scalar-core arithmetic on the hot path)."""
+    if h == hkv:
+        return lambda bh, qi, ki: (bh, ki, 0)
+    rep = h // hkv
+    return lambda bh, qi, ki: ((bh // h) * hkv + (bh % h) // rep, ki, 0)
+
+
 def _flash_forward_call(q, k, v, causal, scale, block_q, block_k, interpret,
                         want_lse, window=None):
     """Run the forward kernel; returns flat (out [bh,sq,d], lse or None).
@@ -330,12 +391,15 @@ def _flash_forward_call(q, k, v, causal, scale, block_q, block_k, interpret,
     ``want_lse=False`` (inference / non-differentiated calls) uses a variant
     with no LSE output at all — a pallas_call output can't be DCE'd by XLA,
     so the [bh, sq, LANES] write must not exist rather than be unused.
+    K/V may be grouped (``hkv < h``); they are consumed unexpanded via
+    :func:`_kv_index_map`.
     """
     b, h, sq, d = q.shape
+    hkv = k.shape[1]
     sk = k.shape[-2]
     qf = q.reshape(b * h, sq, d)
-    kf = k.reshape(b * h, sk, d)
-    vf = v.reshape(b * h, sk, d)
+    kf = k.reshape(b * hkv, sk, d)
+    vf = v.reshape(b * hkv, sk, d)
     num_q = pl.cdiv(sq, block_q)
     num_k = pl.cdiv(sk, block_k)
     from jax.experimental.pallas import tpu as pltpu  # noqa: PLC0415
@@ -346,6 +410,7 @@ def _flash_forward_call(q, k, v, causal, scale, block_q, block_k, interpret,
         block_q=block_q, block_k=block_k, num_k=num_k, window=window,
     )
     sds = _sds_like(qf)
+    kv_map = _kv_index_map(h, hkv)
 
     o_spec = pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0))
     lse_spec = pl.BlockSpec((1, block_q, LANES), lambda bh, qi, ki: (bh, qi, 0))
@@ -354,8 +419,8 @@ def _flash_forward_call(q, k, v, causal, scale, block_q, block_k, interpret,
         grid=(b * h, num_q, num_k),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda bh, qi, ki: (bh, qi, 0)),
-            pl.BlockSpec((1, block_k, d), lambda bh, qi, ki: (bh, ki, 0)),
-            pl.BlockSpec((1, block_k, d), lambda bh, qi, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, block_k, d), kv_map),
+            pl.BlockSpec((1, block_k, d), kv_map),
         ],
         out_specs=[o_spec] + ([lse_spec] if want_lse else []),
         out_shape=[sds((b * h, sq, d), q.dtype)]
@@ -438,11 +503,18 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, di_ref,
 def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, di_ref,
                           dk_ref, dv_ref, dk_acc_ref, dv_acc_ref,
                           *, scale: float, causal: bool, block_q: int,
-                          block_k: int, num_q: int, window=None):
+                          block_k: int, num_q: int, inner_steps: int,
+                          window=None):
+    """dk/dv sweep. The inner grid axis covers ``rep * num_q`` steps under
+    GQA — all query heads of the kv head's group, q blocks innermost — so
+    dk/dv accumulate the WHOLE group in scratch and each K/V block is
+    fetched once per group instead of once per query head. ``qi`` is the
+    per-head q-block index decoded from the flat inner step."""
     ki = pl.program_id(1)
-    qi = pl.program_id(2)
+    t = pl.program_id(2)
+    qi = t % num_q  # per-q-head block index (t == qi for MHA)
 
-    @pl.when(qi == 0)
+    @pl.when(t == 0)
     def _init():
         dk_acc_ref[:] = jnp.zeros_like(dk_acc_ref)
         dv_acc_ref[:] = jnp.zeros_like(dv_acc_ref)
@@ -472,7 +544,7 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, di_ref,
         )
         dk_acc_ref[:] += (scale * dsq) if scale != 1.0 else dsq
 
-    @pl.when(qi == num_q - 1)
+    @pl.when(t == inner_steps - 1)
     def _finalize():
         dk_ref[0] = dk_acc_ref[:].astype(dk_ref.dtype)
         dv_ref[0] = dv_acc_ref[:].astype(dv_ref.dtype)
@@ -492,10 +564,12 @@ def _flash_bwd_impl(causal, scale, block_q, block_k, interpret, res, g,
     no lse term (lse is a function of q/k only)."""
     q, k, v, out, lse_packed = res
     b, h, sq, d = q.shape
+    hkv = k.shape[1]
+    rep = h // hkv
     sk = k.shape[-2]
     qf = q.reshape(b * h, sq, d)
-    kf = k.reshape(b * h, sk, d)
-    vf = v.reshape(b * h, sk, d)
+    kf = k.reshape(b * hkv, sk, d)
+    vf = v.reshape(b * hkv, sk, d)
     dof = g.reshape(b * h, sq, d)
     num_q = pl.cdiv(sq, block_q)
     num_k = pl.cdiv(sk, block_k)
@@ -514,7 +588,8 @@ def _flash_bwd_impl(causal, scale, block_q, block_k, interpret, res, g,
     sds = _sds_like(qf)
     q_spec = pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0))
     row_spec = pl.BlockSpec((1, block_q, LANES), lambda bh, i, j: (bh, i, 0))
-    k_spec = pl.BlockSpec((1, block_k, d), lambda bh, i, j: (bh, j, 0))
+    kv_map = _kv_index_map(h, hkv)
+    k_spec = pl.BlockSpec((1, block_k, d), lambda bh, i, j: kv_map(bh, i, j))
 
     dq = pl.pallas_call(
         functools.partial(
@@ -529,23 +604,34 @@ def _flash_bwd_impl(causal, scale, block_q, block_k, interpret, res, g,
         interpret=interpret,
     )(qf, kf, vf, dof, lse, di)
 
-    # dk/dv sweep: grid (bh, k_blocks, q_blocks) — q innermost so the k/v
-    # accumulators persist in scratch across the q sweep.
-    qT_spec = pl.BlockSpec((1, block_q, d), lambda bh, j, i: (bh, i, 0))
-    rowT_spec = pl.BlockSpec((1, block_q, LANES), lambda bh, j, i: (bh, i, 0))
-    kT_spec = pl.BlockSpec((1, block_k, d), lambda bh, j, i: (bh, j, 0))
+    # dk/dv sweep: grid (b*hkv, k_blocks, rep*q_blocks) — the inner axis
+    # runs q blocks innermost within each query head of the kv head's
+    # group, so the k/v accumulators persist in scratch across the whole
+    # group (dk/dv are SUMS over the group's query heads) and each K/V
+    # block is read once per group, not once per query head.
+    def _q_flat(bkv, t):
+        if rep == 1:
+            return bkv
+        return (bkv // hkv) * h + (bkv % hkv) * rep + t // num_q
+
+    qT_spec = pl.BlockSpec(
+        (1, block_q, d), lambda bkv, j, t: (_q_flat(bkv, t), t % num_q, 0))
+    rowT_spec = pl.BlockSpec(
+        (1, block_q, LANES), lambda bkv, j, t: (_q_flat(bkv, t), t % num_q, 0))
+    kT_spec = pl.BlockSpec((1, block_k, d), lambda bkv, j, t: (bkv, j, 0))
 
     dk, dv = pl.pallas_call(
         functools.partial(
             _flash_bwd_dkv_kernel, scale=scale, causal=causal,
-            block_q=block_q, block_k=block_k, num_q=num_q, window=window,
+            block_q=block_q, block_k=block_k, num_q=num_q,
+            inner_steps=rep * num_q, window=window,
         ),
-        grid=(b * h, num_k, num_q),
+        grid=(b * hkv, num_k, rep * num_q),
         in_specs=[qT_spec, kT_spec, kT_spec, qT_spec, rowT_spec, rowT_spec],
         out_specs=[kT_spec, kT_spec],
         out_shape=[
-            sds((b * h, sk, d), k.dtype),
-            sds((b * h, sk, d), v.dtype),
+            sds((b * hkv, sk, d), k.dtype),
+            sds((b * hkv, sk, d), v.dtype),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_k, d), jnp.float32),
@@ -554,49 +640,69 @@ def _flash_bwd_impl(causal, scale, block_q, block_k, interpret, res, g,
         interpret=interpret,
     )(qf, kf, vf, dof, lse, di)
 
-    return (dq.reshape(b, h, sq, d), dk.reshape(b, h, sk, d),
-            dv.reshape(b, h, sk, d))
+    return (dq.reshape(b, h, sq, d), dk.reshape(b, hkv, sk, d),
+            dv.reshape(b, hkv, sk, d))
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
 # ------------------------------------------------------- (o, lse) variant
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash_lse(q, k, v, causal, scale, block_q, block_k, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash_lse(q, k, v, causal, scale, block_q, block_k, interpret,
+               window=None):
     (o, lse), _ = _flash_lse_fwd(q, k, v, causal, scale, block_q, block_k,
-                                 interpret)
+                                 interpret, window)
     return o, lse
 
 
-def _flash_lse_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+def _flash_lse_fwd(q, k, v, causal, scale, block_q, block_k, interpret,
+                   window=None):
     b, h, sq, d = q.shape
     out, lse = _flash_forward_call(q, k, v, causal, scale, block_q, block_k,
-                                   interpret, want_lse=True)
+                                   interpret, want_lse=True, window=window)
     lse_rows = lse[..., 0]
     return ((out.reshape(b, h, sq, d), lse_rows.reshape(b, h, sq)),
             (q, k, v, out, lse_rows))
 
 
-def _flash_lse_bwd(causal, scale, block_q, block_k, interpret, res, g):
+def _flash_lse_bwd(causal, scale, block_q, block_k, interpret, window, res,
+                   g):
     do, dlse = g
     return _flash_bwd_impl(causal, scale, block_q, block_k, interpret, res,
-                           do, dlse=dlse, window=None)
+                           do, dlse=dlse, window=window)
 
 
 _flash_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
 
 
-def _attention_reference_lse(q, k, v, causal, scale):
-    """O(S²) (o, lse) fallback with the reference's exact masking."""
-    s = scale * jnp.einsum(
-        "...qd,...kd->...qk", q.astype(jnp.float32), k.astype(jnp.float32))
+def _attention_reference_lse(q, k, v, causal, scale, window=None):
+    """O(S²) (o, lse) fallback with the reference's exact masking.
+    Supports grouped K/V like every other kernel in this module."""
+    rep = _gqa_rep(q, k)
+    if rep > 1:
+        hkv = k.shape[-3]
+        sq, d = q.shape[-2:]
+        qg = q.reshape(*q.shape[:-3], hkv, rep, sq, d)
+        s = scale * jnp.einsum("...grqd,...gkd->...grqk",
+                               qg.astype(jnp.float32), k.astype(jnp.float32))
+    else:
+        s = scale * jnp.einsum("...qd,...kd->...qk", q.astype(jnp.float32),
+                               k.astype(jnp.float32))
     if causal:
         sq, sk = s.shape[-2], s.shape[-1]
-        mask = jnp.arange(sq)[:, None] >= jnp.arange(sk)[None, :]
+        q_pos = jnp.arange(sq)[:, None]
+        k_pos = jnp.arange(sk)[None, :]
+        mask = q_pos >= k_pos
+        if window is not None:
+            mask &= k_pos > q_pos - window
         s = jnp.where(mask, s, NEG_INF)
     lse = jax.scipy.special.logsumexp(s, axis=-1)
     p = jnp.exp(s - lse[..., None])
+    if rep > 1:
+        o = jnp.einsum("...grqk,...gkd->...grqd", p, v.astype(jnp.float32))
+        return (o.reshape(q.shape).astype(q.dtype),
+                lse.reshape(*q.shape[:-1]))
     o = jnp.einsum("...qk,...kd->...qd", p, v.astype(jnp.float32))
     return o.astype(q.dtype), lse
 
@@ -604,6 +710,7 @@ def _attention_reference_lse(q, k, v, causal, scale):
 def flash_attention_lse(
     q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     *, causal: bool = False, scale: Optional[float] = None,
+    window: Optional[int] = None,
     block_q: Optional[int] = None, block_k: Optional[int] = None,
     interpret: Optional[bool] = None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
@@ -615,17 +722,150 @@ def flash_attention_lse(
     ``o = Σᵢ oᵢ·exp(lseᵢ − m) / Σᵢ exp(lseᵢ − m)``. Fully differentiable
     including through ``lse`` (the cotangent folds into the fused
     backward's row term). Falls back to an O(S²) reference when shapes
-    don't tile, exactly like :func:`flash_attention`.
+    don't tile, exactly like :func:`flash_attention`. Grouped K/V
+    (``H_kv < H``) is supported unexpanded like everywhere else — this
+    is what lets ring attention rotate kv-head-sized shards.
     """
     *_, sq, d = q.shape
     sk = k.shape[-2]
+    _gqa_rep(q, k)  # validate head grouping before any dispatch
     scale_v = (1.0 / math.sqrt(d)) if scale is None else scale
+    window = _normalize_window(window, causal, sk)
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     block_q, block_k = _resolve_blocks(block_q, block_k)
     bq = _largest_dividing_block(sq, block_q)
     bk = _largest_dividing_block(sk, block_k)
     if bq < 8 or bk < 8:
-        return _attention_reference_lse(q, k, v, causal, scale_v)
+        return _attention_reference_lse(q, k, v, causal, scale_v, window)
     q, scale_v = _fold_scale(q, scale_v)
-    return _flash_lse(q, k, v, causal, scale_v, bq, bk, bool(interpret))
+    return _flash_lse(q, k, v, causal, scale_v, bq, bk, bool(interpret),
+                      window)
+
+
+# ----------------------------------------------------------- decode sweep
+def decode_attention(
+    q: jnp.ndarray, k_cache: jnp.ndarray, v_cache: jnp.ndarray,
+    index, *, window: Optional[int] = None, rolling: bool = False,
+    chunk: int = 512, scale: Optional[float] = None,
+    history_only: bool = False, return_lse: bool = False,
+):
+    """Serving-path attention over a KV cache, at the bandwidth roofline.
+
+    The naive decode step (what this replaced) expanded the cache to
+    query-head count, cast it to f32, and scored every padded position —
+    ~6x the necessary HBM traffic for a GQA model plus dead-position
+    work. Here instead:
+
+    - the cache is read in its STORAGE dtype (bf16 in serving); the
+      score matmul accumulates in f32 on the MXU
+      (``preferred_element_type``), like the training kernel;
+    - K/V stay at kv-head granularity — q is grouped ``[B, H_kv, rep,
+      S, D]`` against the unexpanded cache;
+    - the sweep visits only ``ceil((index+S)/chunk)`` cache chunks via a
+      dynamic-trip-count ``fori_loop`` with online softmax, so HBM
+      traffic and compute are bounded by the VALID PREFIX, not the
+      padded cache length.
+
+    Args:
+      q: ``[B, H, S, D]`` post-RoPE queries (``S`` tokens being decoded).
+      k_cache/v_cache: ``[B, H_kv, L, D]`` cache, current tokens already
+        written at their slots.
+      index: scalar int32 — tokens in the cache BEFORE this call (query
+        global positions are ``index .. index+S-1``).
+      window: sliding-window width (Mistral SWA); masks keys below
+        ``q_pos - window + 1``.
+      rolling: the cache is a RING buffer of size ``L`` (requires
+        ``L >= window``): slot ``j`` holds the newest global position
+        ``p ≡ j (mod L)`` with ``p <= index+S-1``. Slot→position is
+        reconstructed arithmetically for masking; never-written slots
+        (``p < 0``) are masked out.
+      chunk: cache positions per loop iteration (clamped to divide L).
+      history_only: the cache holds ONLY the ``index`` tokens BEFORE this
+        call (the current block is NOT written): queries attend strictly
+        to ``pos < index``. The chunked-prefill building block — merge
+        the result with the block's own (windowed, causal) attention in
+        logsumexp space.
+      return_lse: also return per-row logsumexp ``[B, H, S]`` (for
+        merging partials, as in ring attention).
+
+    Returns ``[B, H, S, D]`` in q's dtype (plus lse under ``return_lse``).
+    """
+    b, h, s, d = q.shape
+    hkv, cache_len = k_cache.shape[1], k_cache.shape[2]
+    rep = _gqa_rep(q, k_cache)
+    if rolling:
+        # Both invariants are static; violating either silently loses
+        # in-window history, so fail loudly here instead.
+        if window is None:
+            raise ValueError("rolling=True needs a sliding window (the "
+                             "ring holds only the newest position per "
+                             "slot — unwindowed attention would silently "
+                             "miss overwritten history)")
+        if cache_len < window:
+            raise ValueError(
+                f"rolling cache length {cache_len} < window {window}: "
+                "in-window keys would be overwritten before leaving the "
+                "band")
+    scale_v = (1.0 / math.sqrt(d)) if scale is None else scale
+    chunk = _largest_dividing_block(cache_len, min(chunk, cache_len))
+    n_chunks = cache_len // chunk
+
+    qg = q.reshape(b, hkv, rep, s, d)
+    # Tokens the cache holds: through this block (written before the
+    # call) unless history_only, where the block is attended separately.
+    total = index if history_only else index + s
+    q_pos = index + jnp.arange(s)  # global positions of the queries
+
+    def body(c, carry):
+        m, l, acc = carry
+        kc = jax.lax.dynamic_slice(
+            k_cache, (0, 0, c * chunk, 0), (b, hkv, chunk, d))
+        vc = jax.lax.dynamic_slice(
+            v_cache, (0, 0, c * chunk, 0), (b, hkv, chunk, d))
+        sb = jnp.einsum("bgrqd,bgkd->bgrqk", qg.astype(k_cache.dtype), kc,
+                        preferred_element_type=jnp.float32) * scale_v
+        slot = c * chunk + jnp.arange(chunk)
+        if rolling:
+            # Newest global position congruent to the slot index; jnp's
+            # mod is non-negative, so unwritten slots land at p < 0.
+            pos = (total - 1) - (total - 1 - slot) % cache_len
+            valid = pos >= 0
+        else:
+            pos = slot
+            valid = None
+        if history_only:
+            # strictly pre-block keys; broadcasts against the per-query
+            # window term below
+            mask = jnp.broadcast_to(pos[None, :] < index, (s, chunk))
+        else:
+            mask = pos[None, :] <= q_pos[:, None]
+        if window is not None:
+            mask &= pos[None, :] > q_pos[:, None] - window
+        if valid is not None:
+            mask &= valid[None, :]
+        sb = jnp.where(mask, sb, NEG_INF)  # broadcasts over (b, g, r)
+        m_new = jnp.maximum(m, jnp.max(sb, axis=-1, keepdims=True))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(sb - m_new)
+        l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc = acc * alpha + jnp.einsum(
+            "bgrqk,bgkd->bgrqd", p.astype(v_cache.dtype), vc,
+            preferred_element_type=jnp.float32)
+        return m_new, l, acc
+
+    # Bound the sweep to chunks overlapping the valid prefix. A rolling
+    # cache is dense once wrapped, so every chunk is live after that; the
+    # min() still trims the pre-wrap phase.
+    live = jnp.minimum((total + chunk - 1) // chunk, n_chunks)
+    m0 = jnp.full((b, hkv, rep, s, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, hkv, rep, s, 1), jnp.float32)
+    acc0 = jnp.zeros((b, hkv, rep, s, d), jnp.float32)
+    m, l, acc = jax.lax.fori_loop(0, live, body, (m0, l0, acc0))
+    out = (acc / jnp.maximum(l, 1e-30)).reshape(b, h, s, d).astype(q.dtype)
+    if return_lse:
+        # Rows with nothing attended (empty history) keep lse ~ -inf so
+        # a logsumexp-space merge gives them zero weight.
+        lse = (m + jnp.log(jnp.maximum(l, 1e-30))).reshape(b, h, s)
+        return out, lse
+    return out

@@ -45,31 +45,39 @@ def ring_attention(
     """Per-shard ring attention; call inside ``shard_map``.
 
     Args are local shards ``[batch, heads, seq_local, head_dim]``; returns
-    the local output shard of exact global attention.
+    the local output shard of exact global attention. K/V may be grouped
+    (``H_kv < H``, GQA): the *unexpanded* kv-head-sized shards rotate
+    around the ring, so per-hop ``ppermute`` ICI traffic is
+    ``H/H_kv``-times smaller than rotating expanded K/V would be.
     """
     b, h, s_local, d = q.shape
+    hkv = k.shape[1]
+    rep = h // hkv  # validated by the array-level wrapper / model layer
     n = lax.axis_size(axis_name)
     my = lax.axis_index(axis_name)
     scale_v = (1.0 / math.sqrt(d)) if scale is None else scale
 
-    qf = q.astype(jnp.float32) * scale_v
+    # Grouped layout [B, H_kv, rep, S, D] for q and the accumulators; the
+    # per-rotation einsums contract each kv head against its whole query
+    # group in one pass. rep == 1 (MHA) makes the group axis size-1.
+    qf = (q.astype(jnp.float32) * scale_v).reshape(b, hkv, rep, s_local, d)
     q_pos = my * s_local + jnp.arange(s_local)  # global positions of local Q
 
     def step(i, carry):
         m, l, acc, kc, vc = carry
         # kc/vc originated on shard (my - i) mod n after i rotations.
         src = (my - i) % n
-        s = jnp.einsum("bhqd,bhkd->bhqk", qf, kc.astype(jnp.float32))
+        s = jnp.einsum("bgrqd,bgkd->bgrqk", qf, kc.astype(jnp.float32))
         if causal:
             k_pos = src * s_local + jnp.arange(s_local)
             mask = q_pos[:, None] >= k_pos[None, :]
-            s = jnp.where(mask[None, None], s, NEG_INF)
+            s = jnp.where(mask, s, NEG_INF)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
         alpha = jnp.exp(m - m_new)
         p = jnp.exp(s - m_new)
         l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
         acc = acc * alpha + jnp.einsum(
-            "bhqk,bhkd->bhqd", p, vc.astype(jnp.float32))
+            "bgrqk,bgkd->bgrqd", p, vc.astype(jnp.float32))
         # Rotate K/V one hop around the ring (neighbor exchange on ICI).
         perm = [(j, (j + 1) % n) for j in range(n)]
         kc = lax.ppermute(kc, axis_name, perm)
@@ -82,11 +90,12 @@ def ring_attention(
     def _vary(x):
         return lax.pcast(x, axis_name, to="varying")
 
-    m0 = _vary(jnp.full((b, h, s_local, 1), NEG_INF, jnp.float32))
-    l0 = _vary(jnp.zeros((b, h, s_local, 1), jnp.float32))
-    acc0 = _vary(jnp.zeros((b, h, s_local, d), jnp.float32))
+    m0 = _vary(jnp.full((b, hkv, rep, s_local, 1), NEG_INF, jnp.float32))
+    l0 = _vary(jnp.zeros((b, hkv, rep, s_local, 1), jnp.float32))
+    acc0 = _vary(jnp.zeros((b, hkv, rep, s_local, d), jnp.float32))
     m, l, acc, _, _ = lax.fori_loop(0, n, step, (m0, l0, acc0, k, v))
-    return (acc / jnp.maximum(l, 1e-30)).astype(q.dtype)
+    out = acc / jnp.maximum(l, 1e-30)
+    return out.reshape(b, h, s_local, d).astype(q.dtype)
 
 
 def ring_attention_flash(
@@ -177,6 +186,9 @@ def sequence_parallel_attention(
     keeps a single f32 accumulator), with O(block) instead of
     O(s_local²) score memory per rotation.
     """
+    from pddl_tpu.ops.attention import _gqa_rep
+
+    _gqa_rep(q, k)  # validate head grouping before entering the shard_map
     spec = P(None, None, axis_name, None)
     inner = ring_attention_flash if use_flash else ring_attention
     fn = functools.partial(inner, axis_name=axis_name,

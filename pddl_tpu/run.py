@@ -48,10 +48,12 @@ def build_trainer(cfg: ExperimentConfig, strategy=None):
             )
         model_kwargs["vocab_multiple"] = cfg.vocab_multiple
     if cfg.remat and cfg.remat != "none":
-        if not any(t in cfg.model for t in ("vit", "gpt", "llama")):
+        from pddl_tpu.models.registry import REMAT_MODELS
+
+        if cfg.model not in REMAT_MODELS:
             raise ValueError(
                 f"--remat applies to transformer models "
-                f"(vit*/gpt*/llama*), not {cfg.model!r}"
+                f"({sorted(REMAT_MODELS)}), not {cfg.model!r}"
             )
         model_kwargs["remat"] = cfg.remat
     if cfg.stem != "keras":
@@ -157,8 +159,14 @@ def build_trainer(cfg: ExperimentConfig, strategy=None):
 
 
 def _is_lm(model_name: str) -> bool:
-    """Language-model registry names (token batches, no augmentation)."""
-    return "gpt" in model_name or "llama" in model_name
+    """Language-model registry names (token batches, no augmentation).
+
+    Exact membership in the registry's ``is_lm`` set — never substring
+    matching, so a future vision entry whose name merely contains 'gpt'
+    can't silently be fed token batches (ADVICE r3)."""
+    from pddl_tpu.models.registry import LM_MODELS
+
+    return model_name in LM_MODELS
 
 
 def _strategy_options(cfg: ExperimentConfig) -> dict:

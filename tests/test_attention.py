@@ -407,6 +407,204 @@ def test_window_geq_seq_degrades_to_plain_causal():
     np.testing.assert_array_equal(np.asarray(plain), np.asarray(wide))
 
 
+# -------------------------------------------------- grouped-query (GQA)
+def _tiled(t, rep):
+    """Oracle-side expansion: repeat each kv head rep times (what the
+    kernels must now match WITHOUT materializing)."""
+    return jnp.repeat(t, rep, axis=1)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("rep", [2, 4])
+def test_flash_gqa_matches_expanded_reference(causal, rep):
+    """Flash with unexpanded [B, H_kv, S, D] K/V == MHA flash on the
+    jnp.repeat-expanded K/V — the no-copy GQA path's core guarantee."""
+    kq, kk, kv = jax.random.split(jax.random.key(3), 3)
+    b, h, s, d = 2, 4, 256, 64
+    q = jax.random.normal(kq, (b, h, s, d))
+    k = jax.random.normal(kk, (b, h // rep, s, d))
+    v = jax.random.normal(kv, (b, h // rep, s, d))
+    ref = attention_reference(q, _tiled(k, rep), _tiled(v, rep),
+                              causal=causal)
+    grouped_ref = attention_reference(q, k, v, causal=causal)
+    out = flash_attention(q, k, v, causal=causal, block_q=64, block_k=64)
+    np.testing.assert_allclose(np.asarray(grouped_ref), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_gqa_gradients_match_expanded_reference(causal):
+    """dq at query-head shape; dk/dv at KV-head shape must equal the
+    group-sum of the expanded oracle's per-head gradients (the kernel
+    accumulates the query group in its dkv sweep)."""
+    rep = 2
+    kq, kk, kv = jax.random.split(jax.random.key(5), 3)
+    b, h, s, d = 1, 4, 128, 32
+    q = jax.random.normal(kq, (b, h, s, d))
+    k = jax.random.normal(kk, (b, h // rep, s, d))
+    v = jax.random.normal(kv, (b, h // rep, s, d))
+    cot = jnp.cos(jnp.arange(b * h * s * d, dtype=jnp.float32)
+                  ).reshape(b, h, s, d)
+
+    def loss_flash(q, k, v):
+        o = flash_attention(q, k, v, causal=causal, block_q=32, block_k=32)
+        return (o * cot).sum()
+
+    def loss_ref(q, k, v):
+        o = attention_reference(q, _tiled(k, rep), _tiled(v, rep),
+                                causal=causal)
+        return (o * cot).sum()
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    # Differentiating through jnp.repeat group-sums dk/dv automatically
+    # (repeat's transpose), so oracle grads land at kv-head shape too.
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for got, want, name in zip(gf, gr, "qkv"):
+        assert got.shape == want.shape
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=1e-4, rtol=1e-4,
+                                   err_msg=f"d{name}")
+
+
+def test_flash_gqa_sliding_window():
+    """GQA × SWA through the flash kernel (band skip composes with the
+    kv-head index maps)."""
+    kq, kk, kv = jax.random.split(jax.random.key(8), 3)
+    q = jax.random.normal(kq, (1, 6, 256, 32))
+    k = jax.random.normal(kk, (1, 2, 256, 32))
+    v = jax.random.normal(kv, (1, 2, 256, 32))
+    for w in (37, 128):
+        ref = attention_reference(q, _tiled(k, 3), _tiled(v, 3),
+                                  causal=True, window=w)
+        got = flash_attention(q, k, v, causal=True, window=w,
+                              block_q=64, block_k=64)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5, err_msg=f"w={w}")
+
+
+def test_flash_lse_gqa_matches_reference():
+    from pddl_tpu.ops.attention import (
+        _attention_reference_lse,
+        flash_attention_lse,
+    )
+
+    kq, kk, kv = jax.random.split(jax.random.key(9), 3)
+    q = jax.random.normal(kq, (1, 4, 64, 16))
+    k = jax.random.normal(kk, (1, 2, 64, 16))
+    v = jax.random.normal(kv, (1, 2, 64, 16))
+    for causal in (False, True):
+        o1, l1 = flash_attention_lse(q, k, v, causal=causal,
+                                     block_q=32, block_k=32)
+        o2, l2 = _attention_reference_lse(q, _tiled(k, 2), _tiled(v, 2),
+                                          causal, 16 ** -0.5)
+        np.testing.assert_allclose(np.asarray(o1), np.asarray(o2),
+                                   atol=2e-5, rtol=2e-5)
+        np.testing.assert_allclose(np.asarray(l1), np.asarray(l2),
+                                   atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.parametrize("use_flash", [False, True])
+def test_ring_gqa_rotates_unexpanded_kv(mesh8, use_flash):
+    """Ring attention with kv-head-sized shards (the ppermute payload is
+    H/H_kv-times smaller) == full expanded attention, fwd and grads."""
+    from pddl_tpu.core.mesh import MeshConfig, build_mesh
+
+    mesh = build_mesh(MeshConfig(data=1, seq=8))
+    kq, kk, kv = jax.random.split(jax.random.key(12), 3)
+    q = jax.random.normal(kq, (1, 4, 128, 16))
+    k = jax.random.normal(kk, (1, 2, 128, 16))
+    v = jax.random.normal(kv, (1, 2, 128, 16))
+    for causal in (False, True):
+        ref = attention_reference(q, _tiled(k, 2), _tiled(v, 2),
+                                  causal=causal)
+        out = jax.jit(lambda a, b, c: sequence_parallel_attention(
+            a, b, c, mesh, causal=causal, use_flash=use_flash))(q, k, v)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-4, rtol=2e-4)
+
+    # Oracle grads: differentiating THROUGH jnp.repeat already reduces
+    # dk/dv over each query group (repeat's transpose is a group-sum), so
+    # shapes match the ring's kv-head-sized grads directly.
+    g_ref = jax.grad(lambda a, b, c: attention_reference(
+        a, _tiled(b, 2), _tiled(c, 2), causal=True).sum(),
+        argnums=(0, 1, 2))(q, k, v)
+    g_ring = jax.grad(lambda a, b, c: sequence_parallel_attention(
+        a, b, c, mesh, causal=True, use_flash=use_flash).sum(),
+        argnums=(0, 1, 2))(q, k, v)
+    for got, want in zip(g_ring, g_ref):
+        assert got.shape == want.shape
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=3e-4, rtol=3e-4)
+
+
+def test_decode_attention_linear_and_rolling_match_oracle():
+    """The serving sweep (bf16-style storage reads, grouped heads,
+    prefix-bounded fori_loop, ring-buffer slot mapping) vs plain windowed
+    attention over the true key history."""
+    from pddl_tpu.ops.attention import decode_attention
+
+    B, Hkv, rep, D = 1, 2, 3, 16
+    H = Hkv * rep
+    ring, window, T = 128, 100, 300  # cache wrapped twice
+    kk, kv, kq = jax.random.split(jax.random.key(21), 3)
+    keys = jax.random.normal(kk, (B, Hkv, T, D))
+    vals = jax.random.normal(kv, (B, Hkv, T, D))
+    q = jax.random.normal(kq, (B, H, 1, D))
+
+    # Oracle: the current token (position T-1) attends over the real
+    # history under the window.
+    ref = attention_reference(q, keys, vals, causal=True, window=window,
+                              k_offset=-(T - 1))
+
+    # Linear cache: history at slots 0..T-1, padded tail beyond.
+    k_lin = jnp.zeros((B, Hkv, 512, D)).at[:, :, :T].set(keys)
+    v_lin = jnp.zeros((B, Hkv, 512, D)).at[:, :, :T].set(vals)
+    out_lin = decode_attention(q, k_lin, v_lin, jnp.int32(T - 1),
+                               window=window, chunk=128)
+    np.testing.assert_allclose(np.asarray(out_lin), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+    # Ring cache: slot j holds the newest position ≡ j (mod ring).
+    slots = jnp.arange(T) % ring
+    k_ring = jnp.zeros((B, Hkv, ring, D)).at[:, :, slots].set(keys)
+    v_ring = jnp.zeros((B, Hkv, ring, D)).at[:, :, slots].set(vals)
+    out_ring = decode_attention(q, k_ring, v_ring, jnp.int32(T - 1),
+                                window=window, rolling=True, chunk=64)
+    np.testing.assert_allclose(np.asarray(out_ring), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_decode_attention_prefix_bound_ignores_cache_garbage():
+    """Slots beyond the valid prefix must never influence the output —
+    the fori_loop stops at the last live chunk and masking covers the
+    partial one (huge garbage planted past the prefix stays inert)."""
+    from pddl_tpu.ops.attention import decode_attention
+
+    B, H, D, L, T = 1, 2, 8, 256, 70
+    kk, kv, kq = jax.random.split(jax.random.key(4), 3)
+    keys = jax.random.normal(kk, (B, H, T, D))
+    vals = jax.random.normal(kv, (B, H, T, D))
+    q = jax.random.normal(kq, (B, H, 1, D))
+    k_cache = jnp.full((B, H, L, D), 1e30).at[:, :, :T].set(keys)
+    v_cache = jnp.full((B, H, L, D), 1e30).at[:, :, :T].set(vals)
+    out = decode_attention(q, k_cache, v_cache, jnp.int32(T - 1), chunk=64)
+    ref = attention_reference(q, keys, vals, causal=True,
+                              k_offset=-(T - 1))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_gqa_head_divisibility_validated():
+    q = jnp.zeros((1, 4, 16, 8))
+    k = jnp.zeros((1, 3, 16, 8))
+    with pytest.raises(ValueError, match="divisible"):
+        flash_attention(q, k, k)
+    with pytest.raises(ValueError, match="divisible"):
+        attention_reference(q, k, k)
+
+
 def test_window_requires_causal():
     q = jnp.zeros((1, 1, 16, 8))
     with pytest.raises(ValueError, match="causal"):

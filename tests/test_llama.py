@@ -335,6 +335,113 @@ def test_generate_respects_sliding_window():
         np.asarray(jnp.argmax(full[:, 4:], axis=-1)))
 
 
+def test_rolling_ring_cache_wraps_and_matches_full_forward():
+    """Mistral's rolling KV cache: with window < max_len the decode cache
+    is a ring of ~window slots (not max_len), and logits stay exact at
+    every position even after the ring has WRAPPED (oldest keys
+    overwritten are precisely the out-of-window ones)."""
+    model = _model(sliding_window=100, max_len=192)
+    seq = 160  # > ring length 128: wraps
+    tokens = _tokens(batch=1, seq=seq, seed=9)
+    v = model.init(jax.random.key(0), tokens, train=False)
+    full = model.apply(v, tokens, train=False)
+
+    dec = model.clone(decode=True)
+    cache = jax.eval_shape(
+        lambda: dec.init(jax.random.key(0), tokens[:, :1], train=False)
+    )["cache"]
+    # The ring is window-sized (rounded to 128), NOT max_len-sized.
+    k_shape = cache["block0"]["attn"]["cached_key"].shape
+    assert k_shape[2] == 128, k_shape
+    cache = jax.tree.map(lambda sd: jnp.zeros(sd.shape, sd.dtype), cache)
+
+    prefill = 8
+    logits, mut = dec.apply({"params": v["params"], "cache": cache},
+                            tokens[:, :prefill], train=False,
+                            mutable=["cache"])
+    np.testing.assert_allclose(np.asarray(logits),
+                               np.asarray(full[:, :prefill]),
+                               atol=1e-5, rtol=1e-5)
+    cache = mut["cache"]
+    step = jax.jit(lambda cache, tok: dec.apply(
+        {"params": v["params"], "cache": cache}, tok,
+        train=False, mutable=["cache"]))
+    for t in range(prefill, seq):
+        logits, mut = step(cache, tokens[:, t:t + 1])
+        cache = mut["cache"]
+        np.testing.assert_allclose(
+            np.asarray(logits[:, 0]), np.asarray(full[:, t]),
+            atol=1e-5, rtol=1e-5, err_msg=f"position {t}")
+
+
+def test_ring_prefill_longer_than_ring():
+    """A prompt longer than the ring: prefill writes only the last
+    `ring` keys; subsequent single-token steps stay exact."""
+    model = _model(sliding_window=100, max_len=256)
+    seq, prefill = 150, 140  # prefill 140 > ring 128
+    tokens = _tokens(batch=1, seq=seq, seed=13)
+    v = model.init(jax.random.key(0), tokens, train=False)
+    full = model.apply(v, tokens, train=False)
+
+    dec = model.clone(decode=True)
+    cache = jax.eval_shape(
+        lambda: dec.init(jax.random.key(0), tokens[:, :1], train=False)
+    )["cache"]
+    cache = jax.tree.map(lambda sd: jnp.zeros(sd.shape, sd.dtype), cache)
+    logits, mut = dec.apply({"params": v["params"], "cache": cache},
+                            tokens[:, :prefill], train=False,
+                            mutable=["cache"])
+    np.testing.assert_allclose(np.asarray(logits),
+                               np.asarray(full[:, :prefill]),
+                               atol=1e-5, rtol=1e-5)
+    cache = mut["cache"]
+    for t in range(prefill, seq):
+        logits, mut = dec.apply({"params": v["params"], "cache": cache},
+                                tokens[:, t:t + 1], train=False,
+                                mutable=["cache"])
+        cache = mut["cache"]
+        np.testing.assert_allclose(
+            np.asarray(logits[:, 0]), np.asarray(full[:, t]),
+            atol=1e-5, rtol=1e-5, err_msg=f"position {t}")
+
+
+def test_ring_chunked_prefill_at_nonzero_index():
+    """Chunked prefill on the SWA ring path: a SECOND multi-token call at
+    i > 0 (after the ring has content, including post-wrap) must merge
+    in-window HISTORY keys with the block's own — exact vs full forward."""
+    model = _model(sliding_window=100, max_len=256)
+    tokens = _tokens(batch=1, seq=200, seed=17)
+    v = model.init(jax.random.key(0), tokens, train=False)
+    full = model.apply(v, tokens, train=False)
+
+    dec = model.clone(decode=True)
+    cache = jax.eval_shape(
+        lambda: dec.init(jax.random.key(0), tokens[:, :1], train=False)
+    )["cache"]
+    cache = jax.tree.map(lambda sd: jnp.zeros(sd.shape, sd.dtype), cache)
+    # Three multi-token chunks: 0..80 (no wrap), 80..150 (wraps the
+    # 128-ring), 150..200 (fully wrapped history).
+    for lo, hi in ((0, 80), (80, 150), (150, 200)):
+        logits, mut = dec.apply({"params": v["params"], "cache": cache},
+                                tokens[:, lo:hi], train=False,
+                                mutable=["cache"])
+        cache = mut["cache"]
+        np.testing.assert_allclose(
+            np.asarray(logits), np.asarray(full[:, lo:hi]),
+            atol=1e-5, rtol=1e-5, err_msg=f"chunk {lo}:{hi}")
+
+
+def test_decode_attention_rolling_validates_statics():
+    from pddl_tpu.ops.attention import decode_attention
+
+    q = jnp.zeros((1, 2, 1, 8))
+    c = jnp.zeros((1, 2, 64, 8))
+    with pytest.raises(ValueError, match="sliding window"):
+        decode_attention(q, c, c, jnp.int32(0), rolling=True)
+    with pytest.raises(ValueError, match="overwritten"):
+        decode_attention(q, c, c, jnp.int32(0), rolling=True, window=100)
+
+
 def test_sliding_window_below_one_rejected_everywhere():
     from pddl_tpu.ops.attention import attention_reference, flash_attention
 
