@@ -37,22 +37,33 @@ def _roofline_tokens_per_sec(model, variables, prompt_len: int,
                              new_tokens: int) -> float | None:
     """Weight+KV bandwidth roofline for single-stream greedy decode.
 
-    Every decoded token must read all parameters once plus the live KV
-    prefix (k and v, kv-head granularity, storage dtype) in each layer;
-    the prefix is averaged over the decode. Anything above the returned
-    rate would exceed the chip's HBM bandwidth.
+    Every decoded token must read all MATMUL parameters once plus the
+    live KV prefix (k and v, kv-head granularity, storage dtype) in each
+    layer; the prefix is averaged over the decode. Input-embedding (and
+    position) tables are excluded from the per-token weight read — decode
+    GATHERS one row per token, it does not stream the table — with the
+    gathered rows added back. Anything above the returned rate would
+    exceed the chip's HBM bandwidth.
     """
     bw = HBM_GBPS.get(jax.devices()[0].device_kind)
     if bw is None:
         return None
+    params = dict(variables["params"])
+    gathered_rows = 0
+    for name in ("token_embed", "embed", "pos_embed"):  # gather, not stream
+        node = params.pop(name, None)
+        if node is not None:
+            leaves = jax.tree.leaves(node)
+            gathered_rows += sum(  # one row per decoded token
+                leaf.shape[-1] * leaf.dtype.itemsize for leaf in leaves)
     param_bytes = sum(leaf.size * leaf.dtype.itemsize
-                      for leaf in jax.tree.leaves(variables["params"]))
+                      for leaf in jax.tree.leaves(params))
     hkv = getattr(model, "num_kv_heads", None) or model.num_heads
     head_dim = model.embed_dim // model.num_heads
     avg_prefix = prompt_len + new_tokens / 2
     itemsize = jnp.dtype(model.dtype).itemsize
     kv_bytes = 2 * model.depth * hkv * head_dim * itemsize * avg_prefix
-    return bw * 1e9 / (param_bytes + kv_bytes)
+    return bw * 1e9 / (param_bytes + gathered_rows + kv_bytes)
 
 
 def _bench_generate(model, variables, batch: int, prompt_len: int,

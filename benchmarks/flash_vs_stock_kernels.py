@@ -40,7 +40,8 @@ import jax.numpy as jnp
 from pddl_tpu.ops.attention import flash_attention
 
 
-def _bench(op, q, k, v, iters: int = 30, grad: bool = False) -> float:
+def _bench(op, q, k, v, iters: int = 30, grad: bool = False,
+           reps: int = 3) -> float:
     if grad:
         # The fetched scalar must depend on dq AND dk AND dv: pallas calls
         # are pure at the jaxpr level, so an unused dk/dv would let JAX DCE
@@ -52,11 +53,17 @@ def _bench(op, q, k, v, iters: int = 30, grad: bool = False) -> float:
     else:
         f = jax.jit(lambda q, k, v: op(q, k, v)[0, 0, 0, 0].astype(jnp.float32))
     float(f(q, k, v))  # compile + sync
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        out = f(q, k, v)
-    float(out)  # scalar fetch drains the dispatch queue
-    return (time.perf_counter() - t0) / iters * 1e3
+    # Best of `reps` batches: single-batch timing on the tunneled chip is
+    # exposed to multi-ms transient slowdowns (observed ~30% run-to-run);
+    # min-of-batches recovers the stable rate all impls are compared at.
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = f(q, k, v)
+        float(out)  # scalar fetch drains the dispatch queue
+        best = min(best, (time.perf_counter() - t0) / iters * 1e3)
+    return best
 
 
 def main() -> None:

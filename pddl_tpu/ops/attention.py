@@ -18,9 +18,12 @@ Design:
 - Backward: fully fused Pallas kernels as well. The forward additionally
   emits the log-sum-exp rows (lane-replicated, the standard TPU layout);
   the backward recomputes each score block from q/k + LSE in VMEM — never
-  materializing the [S, S] probability matrix — in two sweeps: a dq kernel
-  (k innermost, dq accumulates in scratch) and a dk/dv kernel (q innermost,
-  dk/dv accumulate in scratch).
+  materializing the [S, S] probability matrix. Default: a SINGLE fused
+  sweep producing dq/dk/dv together (5 MXU passes per block pair, dq
+  accumulated across the k sweep in a sequence-sized VMEM scratch); when
+  that scratch would not fit (very long sequences), two sweeps — a dq
+  kernel (k innermost) and a dk/dv kernel (q innermost) — at 7 passes
+  and a second operand read.
 
 All shapes are ``[batch, heads, seq, head_dim]``; dtypes bf16/f32 in, f32
 accumulation inside (MXU-native mixed precision).
@@ -465,6 +468,49 @@ def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret,
 # Each kernel recomputes its p block in VMEM from q/k + saved LSE; the [S,S]
 # matrices never touch HBM.
 
+def _block_p_ds(q_ref, k_ref, v_ref, do_ref, lse_ref, di_ref, qi, ki,
+                *, scale, causal, block_q, block_k, window):
+    """Recompute one block's (p, ds) — the shared first half of every
+    backward kernel (masked scores → p from saved LSE → dp → ds). One
+    definition so the fused single-sweep kernel and both two-sweep
+    fallback kernels can never drift."""
+    s = _masked_scores(q_ref, k_ref, qi, ki, scale=scale, causal=causal,
+                       block_q=block_q, block_k=block_k, window=window)
+    p = jnp.exp(s - lse_ref[0][:, :1])                    # masked -> exactly 0
+    dp = jax.lax.dot_general(                             # (bq, bk)
+        do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    ds = p * (dp - di_ref[0][:, :1])
+    return p, ds
+
+
+def _scaled(x, scale):
+    """Apply the softmax scale unless it was folded into q (== 1.0)."""
+    return (scale * x) if scale != 1.0 else x
+
+
+def _dq_contrib(ds, k_ref, scale):
+    """ds·k → this block's dq rows (bq, d)."""
+    return _scaled(jax.lax.dot_general(
+        ds.astype(k_ref.dtype), k_ref[0], (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32), scale)
+
+
+def _dk_contrib(ds, q_ref, scale):
+    """dsᵀ·q → this block's dk rows (bk, d)."""
+    return _scaled(jax.lax.dot_general(
+        ds.astype(q_ref.dtype), q_ref[0], (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32), scale)
+
+
+def _dv_contrib(p, do_ref):
+    """pᵀ·do → this block's dv rows (bk, d)."""
+    return jax.lax.dot_general(
+        p.astype(do_ref.dtype), do_ref[0], (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+
 def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, di_ref,
                          dq_ref, acc_ref,
                          *, scale: float, causal: bool, block_q: int,
@@ -481,19 +527,10 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, di_ref,
 
     @pl.when(run)
     def _compute():
-        s = _masked_scores(q_ref, k_ref, qi, ki, scale=scale, causal=causal,
-                           block_q=block_q, block_k=block_k, window=window)
-        p = jnp.exp(s - lse_ref[0][:, :1])                # masked -> exactly 0
-        dp = jax.lax.dot_general(                         # (bq, bk)
-            do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        ds = p * (dp - di_ref[0][:, :1])
-        dsk = jax.lax.dot_general(                        # (bq, d)
-            ds.astype(k_ref.dtype), k_ref[0], (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        acc_ref[:] += (scale * dsk) if scale != 1.0 else dsk
+        _, ds = _block_p_ds(q_ref, k_ref, v_ref, do_ref, lse_ref, di_ref,
+                            qi, ki, scale=scale, causal=causal,
+                            block_q=block_q, block_k=block_k, window=window)
+        acc_ref[:] += _dq_contrib(ds, k_ref, scale)
 
     @pl.when(ki == num_k - 1)
     def _finalize():
@@ -526,28 +563,82 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, di_ref,
 
     @pl.when(run)
     def _compute():
-        s = _masked_scores(q_ref, k_ref, qi, ki, scale=scale, causal=causal,
-                           block_q=block_q, block_k=block_k, window=window)
-        p = jnp.exp(s - lse_ref[0][:, :1])
-        dv_acc_ref[:] += jax.lax.dot_general(             # pᵀ·do -> (bk, d)
-            p.astype(do_ref.dtype), do_ref[0], (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        dp = jax.lax.dot_general(                         # (bq, bk)
-            do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        ds = p * (dp - di_ref[0][:, :1])
-        dsq = jax.lax.dot_general(                        # dsᵀ·q -> (bk, d)
-            ds.astype(q_ref.dtype), q_ref[0], (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        dk_acc_ref[:] += (scale * dsq) if scale != 1.0 else dsq
+        p, ds = _block_p_ds(q_ref, k_ref, v_ref, do_ref, lse_ref, di_ref,
+                            qi, ki, scale=scale, causal=causal,
+                            block_q=block_q, block_k=block_k, window=window)
+        dv_acc_ref[:] += _dv_contrib(p, do_ref)
+        dk_acc_ref[:] += _dk_contrib(ds, q_ref, scale)
 
     @pl.when(t == inner_steps - 1)
     def _finalize():
         dk_ref[0] = dk_acc_ref[:].astype(dk_ref.dtype)
         dv_ref[0] = dv_acc_ref[:].astype(dv_ref.dtype)
+
+
+def _flash_bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, di_ref,
+                            dq_ref, dk_ref, dv_ref,
+                            dq_acc_ref, dk_acc_ref, dv_acc_ref,
+                            *, scale: float, causal: bool, block_q: int,
+                            block_k: int, num_q: int, num_k: int,
+                            inner_steps: int, window=None):
+    """Single-sweep fused backward: dq, dk, dv from ONE pass over the
+    (k_block, q_block) grid.
+
+    The two-sweep backward reads q/k/v/do twice and recomputes the score
+    and dp matmuls in both kernels (7 MXU passes per block pair); here
+    each block pair is visited once (5 passes) and the operands are read
+    once per sweep. The price is a dq accumulator covering the WHOLE
+    local sequence (``rep·S_q × D`` f32) living in VMEM scratch across
+    the k sweep — the caller falls back to the two-sweep kernels when
+    that does not fit (very long sequences).
+
+    Grid: ``(b·hkv, num_k, rep·num_q)`` — same shape as the dkv sweep;
+    dk/dv accumulate per (kv-head, k-block) across the inner axis, dq
+    rows accumulate at ``t·block_q`` offsets across the OUTER k sweep
+    and are emitted on its last iteration. dq output blocks mapped at
+    earlier k iterations receive transient garbage writebacks that the
+    final iteration's writes (later in sequential grid order)
+    overwrite."""
+    ki = pl.program_id(1)
+    t = pl.program_id(2)
+    qi = t % num_q
+
+    @pl.when(jnp.logical_and(ki == 0, t == 0))
+    def _init_dq():
+        dq_acc_ref[:] = jnp.zeros_like(dq_acc_ref)
+
+    @pl.when(t == 0)
+    def _init_dkv():
+        dk_acc_ref[:] = jnp.zeros_like(dk_acc_ref)
+        dv_acc_ref[:] = jnp.zeros_like(dv_acc_ref)
+
+    run = _block_in_band(qi, ki, causal=causal, block_q=block_q,
+                         block_k=block_k, window=window)
+
+    @pl.when(run)
+    def _compute():
+        p, ds = _block_p_ds(q_ref, k_ref, v_ref, do_ref, lse_ref, di_ref,
+                            qi, ki, scale=scale, causal=causal,
+                            block_q=block_q, block_k=block_k, window=window)
+        dv_acc_ref[:] += _dv_contrib(p, do_ref)
+        dk_acc_ref[:] += _dk_contrib(ds, q_ref, scale)
+        rows = pl.ds(t * block_q, block_q)
+        dq_acc_ref[rows, :] += _dq_contrib(ds, k_ref, scale)
+
+    @pl.when(t == inner_steps - 1)
+    def _finalize_dkv():
+        dk_ref[0] = dk_acc_ref[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc_ref[:].astype(dv_ref.dtype)
+
+    @pl.when(ki == num_k - 1)
+    def _finalize_dq():
+        dq_ref[0] = dq_acc_ref[pl.ds(t * block_q, block_q), :].astype(
+            dq_ref.dtype)
+
+
+# dq accumulator budget for the fused single-sweep backward: rep·S_q·D
+# f32 must sit in VMEM alongside the operand blocks (~16 MB/core total).
+_FUSED_BWD_DQ_BYTES = 6 * 1024 * 1024
 
 
 def _flash_bwd(causal, scale, block_q, block_k, interpret, window, res, g):
@@ -586,6 +677,48 @@ def _flash_bwd_impl(causal, scale, block_q, block_k, interpret, res, g,
     di = jnp.broadcast_to(di_rows, (b * h, sq, LANES))
 
     sds = _sds_like(qf)
+
+    # Specs shared by the fused single-sweep backward and the dkv sweep
+    # of the two-sweep fallback (grid (b·hkv, k_blocks, rep·q_blocks)).
+    def _q_flat(bkv, t):
+        if rep == 1:
+            return bkv
+        return (bkv // hkv) * h + (bkv % hkv) * rep + t // num_q
+
+    qT_spec = pl.BlockSpec(
+        (1, block_q, d), lambda bkv, j, t: (_q_flat(bkv, t), t % num_q, 0))
+    rowT_spec = pl.BlockSpec(
+        (1, block_q, LANES), lambda bkv, j, t: (_q_flat(bkv, t), t % num_q, 0))
+    kT_spec = pl.BlockSpec((1, block_k, d), lambda bkv, j, t: (bkv, j, 0))
+
+    if rep * sq * d * 4 <= _FUSED_BWD_DQ_BYTES:
+        # Single fused sweep: 5 MXU passes per block pair instead of 7,
+        # operands read once. See _flash_bwd_fused_kernel.
+        dq, dk, dv = pl.pallas_call(
+            functools.partial(
+                _flash_bwd_fused_kernel, scale=scale, causal=causal,
+                block_q=block_q, block_k=block_k, num_q=num_q,
+                num_k=num_k, inner_steps=rep * num_q, window=window,
+            ),
+            grid=(b * hkv, num_k, rep * num_q),
+            in_specs=[qT_spec, kT_spec, kT_spec, qT_spec, rowT_spec,
+                      rowT_spec],
+            out_specs=[qT_spec, kT_spec, kT_spec],
+            out_shape=[
+                sds((b * h, sq, d), q.dtype),
+                sds((b * hkv, sk, d), k.dtype),
+                sds((b * hkv, sk, d), v.dtype),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((rep * num_q * block_q, d), jnp.float32),
+                pltpu.VMEM((block_k, d), jnp.float32),
+                pltpu.VMEM((block_k, d), jnp.float32),
+            ],
+            interpret=interpret,
+        )(qf, kf, vf, dof, lse, di)
+        return (dq.reshape(b, h, sq, d), dk.reshape(b, hkv, sk, d),
+                dv.reshape(b, hkv, sk, d))
+
     q_spec = pl.BlockSpec((1, block_q, d), lambda bh, i, j: (bh, i, 0))
     row_spec = pl.BlockSpec((1, block_q, LANES), lambda bh, i, j: (bh, i, 0))
     kv_map = _kv_index_map(h, hkv)
@@ -609,17 +742,6 @@ def _flash_bwd_impl(causal, scale, block_q, block_k, interpret, res, g,
     # group, so the k/v accumulators persist in scratch across the whole
     # group (dk/dv are SUMS over the group's query heads) and each K/V
     # block is read once per group, not once per query head.
-    def _q_flat(bkv, t):
-        if rep == 1:
-            return bkv
-        return (bkv // hkv) * h + (bkv % hkv) * rep + t // num_q
-
-    qT_spec = pl.BlockSpec(
-        (1, block_q, d), lambda bkv, j, t: (_q_flat(bkv, t), t % num_q, 0))
-    rowT_spec = pl.BlockSpec(
-        (1, block_q, LANES), lambda bkv, j, t: (_q_flat(bkv, t), t % num_q, 0))
-    kT_spec = pl.BlockSpec((1, block_k, d), lambda bkv, j, t: (bkv, j, 0))
-
     dk, dv = pl.pallas_call(
         functools.partial(
             _flash_bwd_dkv_kernel, scale=scale, causal=causal,
@@ -780,7 +902,9 @@ def decode_attention(
         ``p ≡ j (mod L)`` with ``p <= index+S-1``. Slot→position is
         reconstructed arithmetically for masking; never-written slots
         (``p < 0``) are masked out.
-      chunk: cache positions per loop iteration (clamped to divide L).
+      chunk: cache positions per loop iteration (clamped to ``L``; need
+        not divide it — the tail chunk clamps its start and masks the
+        overlap).
       history_only: the cache holds ONLY the ``index`` tokens BEFORE this
         call (the current block is NOT written): queries attend strictly
         to ``pos < index``. The chunked-prefill building block — merge
@@ -808,8 +932,12 @@ def decode_attention(
                 "in-window keys would be overwritten before leaving the "
                 "band")
     scale_v = (1.0 / math.sqrt(d)) if scale is None else scale
-    chunk = _largest_dividing_block(cache_len, min(chunk, cache_len))
-    n_chunks = cache_len // chunk
+    # Chunks need NOT divide the cache: the final chunk's slice start is
+    # clamped and the overlap with the previous chunk masked out (the
+    # dedup term below), so a non-round cache length costs one partially
+    # re-read chunk — never a degenerate chunk=1 sweep.
+    chunk = min(chunk, cache_len)
+    n_chunks = -(-cache_len // chunk)
 
     qg = q.reshape(b, hkv, rep, s, d)
     # Tokens the cache holds: through this block (written before the
@@ -819,13 +947,15 @@ def decode_attention(
 
     def body(c, carry):
         m, l, acc = carry
+        start = jnp.minimum(c * chunk, cache_len - chunk)  # clamped tail
         kc = jax.lax.dynamic_slice(
-            k_cache, (0, 0, c * chunk, 0), (b, hkv, chunk, d))
+            k_cache, (0, 0, start, 0), (b, hkv, chunk, d))
         vc = jax.lax.dynamic_slice(
-            v_cache, (0, 0, c * chunk, 0), (b, hkv, chunk, d))
+            v_cache, (0, 0, start, 0), (b, hkv, chunk, d))
         sb = jnp.einsum("bgrqd,bgkd->bgrqk", qg.astype(k_cache.dtype), kc,
                         preferred_element_type=jnp.float32) * scale_v
-        slot = c * chunk + jnp.arange(chunk)
+        slot = start + jnp.arange(chunk)
+        dedup = slot >= c * chunk  # drop the clamped tail's re-read overlap
         if rolling:
             # Newest global position congruent to the slot index; jnp's
             # mod is non-negative, so unwritten slots land at p < 0.
@@ -844,6 +974,7 @@ def decode_attention(
             mask &= pos[None, :] > q_pos[:, None] - window
         if valid is not None:
             mask &= valid[None, :]
+        mask &= dedup[None, :]
         sb = jnp.where(mask, sb, NEG_INF)  # broadcasts over (b, g, r)
         m_new = jnp.maximum(m, jnp.max(sb, axis=-1, keepdims=True))
         alpha = jnp.exp(m - m_new)

@@ -539,6 +539,34 @@ def test_ring_gqa_rotates_unexpanded_kv(mesh8, use_flash):
                                    atol=3e-4, rtol=3e-4)
 
 
+@pytest.mark.parametrize("gqa", [False, True])
+def test_fused_and_twosweep_backwards_agree(monkeypatch, gqa):
+    """The single-sweep fused backward (default) and the two-sweep
+    fallback (forced via a zero dq-scratch budget) must produce the same
+    gradients — the fallback exists only for sequences whose dq
+    accumulator exceeds VMEM."""
+    import pddl_tpu.ops.attention as A
+
+    kq, kk, kv = jax.random.split(jax.random.key(31), 3)
+    hkv = 2 if gqa else 4
+    q = jax.random.normal(kq, (1, 4, 128, 32))
+    k = jax.random.normal(kk, (1, hkv, 128, 32))
+    v = jax.random.normal(kv, (1, hkv, 128, 32))
+
+    def grads():
+        return jax.grad(lambda *a: flash_attention(
+            *a, causal=True, window=50, block_q=32, block_k=32).sum(),
+            argnums=(0, 1, 2))(q, k, v)
+
+    fused = grads()
+    monkeypatch.setattr(A, "_FUSED_BWD_DQ_BYTES", 0)
+    twosweep = grads()
+    for a, b, name in zip(fused, twosweep, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-5, rtol=1e-5,
+                                   err_msg=f"d{name}")
+
+
 def test_decode_attention_linear_and_rolling_match_oracle():
     """The serving sweep (bf16-style storage reads, grouped heads,
     prefix-bounded fori_loop, ring-buffer slot mapping) vs plain windowed
@@ -573,6 +601,24 @@ def test_decode_attention_linear_and_rolling_match_oracle():
     out_ring = decode_attention(q, k_ring, v_ring, jnp.int32(T - 1),
                                 window=window, rolling=True, chunk=64)
     np.testing.assert_allclose(np.asarray(out_ring), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_decode_attention_chunk_not_dividing_cache():
+    """A cache length the chunk doesn't divide (prime-ish max_decode_len)
+    must stay exact: the tail chunk clamps its slice start and masks the
+    re-read overlap — never degrading to a chunk=1 sweep."""
+    from pddl_tpu.ops.attention import decode_attention
+
+    B, H, D, L, T = 1, 2, 16, 331, 331  # prime cache length, fully live
+    kk, kv, kq = jax.random.split(jax.random.key(6), 3)
+    keys = jax.random.normal(kk, (B, H, T, D))
+    vals = jax.random.normal(kv, (B, H, T, D))
+    q = jax.random.normal(kq, (B, H, 1, D))
+    ref = attention_reference(q, keys, vals, causal=True,
+                              k_offset=-(T - 1))
+    out = decode_attention(q, keys, vals, jnp.int32(T - 1), chunk=128)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                atol=2e-5, rtol=2e-5)
 
 
