@@ -44,35 +44,25 @@ from __future__ import annotations
 import argparse
 import json
 import sys
-import time
 
 import jax
 import jax.numpy as jnp
 
-from pddl_tpu.ops.attention import flash_attention
+# One timing methodology for all kernel benches (best-of-reps chained
+# dispatch, scalar-fetch sync) — shared with the head-to-head bench so
+# the two can never measure differently. Script-dir import: both live in
+# benchmarks/ and run as scripts.
+sys.path.insert(0, __file__.rsplit("/", 1)[0])
+from flash_vs_stock_kernels import _bench as _bench_op  # noqa: E402
+
+from pddl_tpu.ops.attention import flash_attention  # noqa: E402
 
 
 def _bench(B, H, S, D, grad=False, iters=50, reps=3) -> float:
     q, k, v = (jax.random.normal(jax.random.key(i), (B, H, S, D),
                                  jnp.bfloat16) for i in range(3))
-    if grad:
-        # Scalar must depend on dq AND dk AND dv or JAX DCEs kernels.
-        f = jax.jit(lambda q, k, v: sum(
-            g[0, 0, 0, 0].astype(jnp.float32) for g in jax.grad(
-                lambda a, b, c: flash_attention(a, b, c, causal=True)
-                .astype(jnp.float32).sum(), argnums=(0, 1, 2))(q, k, v)))
-    else:
-        f = jax.jit(lambda q, k, v: flash_attention(
-            q, k, v, causal=True)[0, 0, 0, 0].astype(jnp.float32))
-    float(f(q, k, v))  # compile + sync
-    best = float("inf")
-    for _ in range(reps):
-        t0 = time.perf_counter()
-        for _ in range(iters):
-            out = f(q, k, v)
-        float(out)  # scalar fetch drains the dispatch queue
-        best = min(best, (time.perf_counter() - t0) / iters * 1e3)
-    return best
+    return _bench_op(lambda q, k, v: flash_attention(q, k, v, causal=True),
+                     q, k, v, iters=iters, grad=grad, reps=reps)
 
 
 def main() -> None:

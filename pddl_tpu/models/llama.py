@@ -131,12 +131,12 @@ class LlamaAttention(nn.Module):
 
             if self.mesh is None:
                 raise ValueError(f"attention={self.attention!r} needs the mesh")
-            if self.sliding_window is not None:
-                raise ValueError(
-                    "sliding_window is not supported on the ring/"
-                    "sequence-parallel path (use flash or reference)")
+            # SWA composes with the ring: out-of-band rotations (and
+            # their ppermute hops) are skipped, so long-context Mistral
+            # under sequence parallelism pays O(window) per device.
             o = sequence_parallel_attention(
                 q, k, v, self.mesh, causal=True,
+                window=self.sliding_window,
                 use_flash=self.attention == "ring_flash")
         else:
             raise ValueError(f"unknown attention {self.attention!r}")

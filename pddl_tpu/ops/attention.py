@@ -115,12 +115,14 @@ LANES = 128
 
 
 def _masked_scores(q_ref, k_ref, qi, ki, *, scale, causal, block_q, block_k,
-                   window=None):
+                   window=None, k_offset=0):
     """Recompute one (bq, bk) score block: s = scale·q·kᵀ, causal-masked.
 
     Shared by the forward and both backward kernels so the mask/scale
     semantics can never drift between the p used forward and the p
-    recomputed backward.
+    recomputed backward. ``k_offset`` (static) shifts every key's global
+    position — ring attention's off-diagonal rotations see keys that are
+    ``i·s_local`` positions earlier than their local index.
     """
     # Operands stay in their storage dtype (bf16 in training) with f32
     # accumulation: bf16xbf16 products are exact in f32, so this matches
@@ -136,7 +138,7 @@ def _masked_scores(q_ref, k_ref, qi, ki, *, scale, causal, block_q, block_k,
     if causal:
         q_pos = qi * block_q + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 0)
-        k_pos = ki * block_k + jax.lax.broadcasted_iota(
+        k_pos = ki * block_k + k_offset + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 1)
         mask = q_pos >= k_pos
         if window is not None:
@@ -145,24 +147,25 @@ def _masked_scores(q_ref, k_ref, qi, ki, *, scale, causal, block_q, block_k,
     return s
 
 
-def _block_in_band(qi, ki, *, causal, block_q, block_k, window):
+def _block_in_band(qi, ki, *, causal, block_q, block_k, window, k_offset=0):
     """Static-shape predicate: does block (qi, ki) intersect the causal
     (and, with ``window``, sliding-window) band? Shared by the forward
     and both backward sweeps so skip logic can never drift from the mask
-    in :func:`_masked_scores`."""
+    in :func:`_masked_scores` (same ``k_offset`` shift)."""
     run = True
     if causal:
-        run = ki * block_k <= qi * block_q + block_q - 1
+        run = ki * block_k + k_offset <= qi * block_q + block_q - 1
         if window is not None:
             # block's max k_pos >= block's min q_pos - window + 1
-            run &= ki * block_k + block_k - 1 >= qi * block_q - window + 1
+            run &= (ki * block_k + block_k - 1 + k_offset
+                    >= qi * block_q - window + 1)
     return run
 
 
 # --------------------------------------------------------------- flash fwd
 def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
                   *, scale: float, causal: bool, block_q: int, block_k: int,
-                  num_k: int, window=None):
+                  num_k: int, window=None, k_offset=0):
     """Forward kernel; ``lse_ref is None`` in the inference (no-vjp) variant,
     which then skips the LSE write entirely."""
     qi = pl.program_id(1)
@@ -178,12 +181,13 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
     # window, also blocks entirely below the band (compute drops from
     # O(S^2) to O(S*window) as S grows).
     run = _block_in_band(qi, ki, causal=causal, block_q=block_q,
-                         block_k=block_k, window=window)
+                         block_k=block_k, window=window, k_offset=k_offset)
 
     @pl.when(run)
     def _compute():
         s = _masked_scores(q_ref, k_ref, qi, ki, scale=scale, causal=causal,
-                           block_q=block_q, block_k=block_k, window=window)
+                           block_q=block_q, block_k=block_k, window=window,
+                           k_offset=k_offset)
         m_prev = m_ref[:, :1]                             # (bq, 1)
         l_prev = l_ref[:, :1]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
@@ -306,14 +310,16 @@ def flash_attention(
     return _flash(q, k, v, causal, scale_v, bq, bk, bool(interpret), window)
 
 
-def _normalize_window(window: Optional[int], causal: bool,
-                      sk: int) -> Optional[int]:
+def _normalize_window(window: Optional[int], causal: bool, sk: int,
+                      k_offset: int = 0) -> Optional[int]:
     """Validate a sliding-window width and clamp the trivial case.
 
     One definition shared by :func:`flash_attention` and
     :func:`flash_attention_lse` so the two entry points can never drift:
     window needs ``causal``, must be ``>= 1``, and ``window >= sk``
-    degrades to plain causal (returned as None)."""
+    degrades to plain causal (returned as None) — but only for aligned
+    keys (``k_offset == 0``); offset keys sit further below the
+    diagonal, where the band can still cut."""
     if window is None:
         return None
     if not causal:
@@ -322,7 +328,7 @@ def _normalize_window(window: Optional[int], causal: bool,
     if window < 1:
         raise ValueError(f"window must be >= 1, got {window}")
     window = int(window)
-    return None if window >= sk else window
+    return None if (window >= sk and k_offset == 0) else window
 
 
 def _fold_scale(q: jnp.ndarray, scale: float) -> tuple[jnp.ndarray, float]:
@@ -388,7 +394,7 @@ def _kv_index_map(h: int, hkv: int):
 
 
 def _flash_forward_call(q, k, v, causal, scale, block_q, block_k, interpret,
-                        want_lse, window=None):
+                        want_lse, window=None, k_offset=0):
     """Run the forward kernel; returns flat (out [bh,sq,d], lse or None).
 
     ``want_lse=False`` (inference / non-differentiated calls) uses a variant
@@ -411,6 +417,7 @@ def _flash_forward_call(q, k, v, causal, scale, block_q, block_k, interpret,
         _flash_kernel if want_lse else _flash_kernel_nolse,
         scale=scale, causal=causal,
         block_q=block_q, block_k=block_k, num_k=num_k, window=window,
+        k_offset=k_offset,
     )
     sds = _sds_like(qf)
     kv_map = _kv_index_map(h, hkv)
@@ -440,19 +447,22 @@ def _flash_forward_call(q, k, v, causal, scale, block_q, block_k, interpret,
     return result[0], None
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
-def _flash(q, k, v, causal, scale, block_q, block_k, interpret, window=None):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
+def _flash(q, k, v, causal, scale, block_q, block_k, interpret, window=None,
+           k_offset=0):
     b, h, sq, d = q.shape
     out, _ = _flash_forward_call(q, k, v, causal, scale, block_q, block_k,
-                                 interpret, want_lse=False, window=window)
+                                 interpret, want_lse=False, window=window,
+                                 k_offset=k_offset)
     return out.reshape(b, h, sq, d)
 
 
 def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret,
-               window=None):
+               window=None, k_offset=0):
     b, h, sq, d = q.shape
     out, lse = _flash_forward_call(q, k, v, causal, scale, block_q, block_k,
-                                   interpret, want_lse=True, window=window)
+                                   interpret, want_lse=True, window=window,
+                                   k_offset=k_offset)
     # Residuals live from forward to backward — across every later layer's
     # forward. Keep LSE packed [bh, sq] for that window; the transient
     # lane-replicated buffer the kernel wrote is freed here.
@@ -469,13 +479,14 @@ def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret,
 # matrices never touch HBM.
 
 def _block_p_ds(q_ref, k_ref, v_ref, do_ref, lse_ref, di_ref, qi, ki,
-                *, scale, causal, block_q, block_k, window):
+                *, scale, causal, block_q, block_k, window, k_offset=0):
     """Recompute one block's (p, ds) — the shared first half of every
     backward kernel (masked scores → p from saved LSE → dp → ds). One
     definition so the fused single-sweep kernel and both two-sweep
     fallback kernels can never drift."""
     s = _masked_scores(q_ref, k_ref, qi, ki, scale=scale, causal=causal,
-                       block_q=block_q, block_k=block_k, window=window)
+                       block_q=block_q, block_k=block_k, window=window,
+                       k_offset=k_offset)
     p = jnp.exp(s - lse_ref[0][:, :1])                    # masked -> exactly 0
     dp = jax.lax.dot_general(                             # (bq, bk)
         do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
@@ -514,7 +525,7 @@ def _dv_contrib(p, do_ref):
 def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, di_ref,
                          dq_ref, acc_ref,
                          *, scale: float, causal: bool, block_q: int,
-                         block_k: int, num_k: int, window=None):
+                         block_k: int, num_k: int, window=None, k_offset=0):
     qi = pl.program_id(1)
     ki = pl.program_id(2)
 
@@ -523,13 +534,14 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, di_ref,
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
     run = _block_in_band(qi, ki, causal=causal, block_q=block_q,
-                         block_k=block_k, window=window)
+                         block_k=block_k, window=window, k_offset=k_offset)
 
     @pl.when(run)
     def _compute():
         _, ds = _block_p_ds(q_ref, k_ref, v_ref, do_ref, lse_ref, di_ref,
                             qi, ki, scale=scale, causal=causal,
-                            block_q=block_q, block_k=block_k, window=window)
+                            block_q=block_q, block_k=block_k, window=window,
+                            k_offset=k_offset)
         acc_ref[:] += _dq_contrib(ds, k_ref, scale)
 
     @pl.when(ki == num_k - 1)
@@ -541,7 +553,7 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, di_ref,
                           dk_ref, dv_ref, dk_acc_ref, dv_acc_ref,
                           *, scale: float, causal: bool, block_q: int,
                           block_k: int, num_q: int, inner_steps: int,
-                          window=None):
+                          window=None, k_offset=0):
     """dk/dv sweep. The inner grid axis covers ``rep * num_q`` steps under
     GQA — all query heads of the kv head's group, q blocks innermost — so
     dk/dv accumulate the WHOLE group in scratch and each K/V block is
@@ -559,13 +571,14 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, di_ref,
     # Same band predicate as the forward, from the dkv grid's viewpoint:
     # above-diagonal OR fully-below-window blocks contribute nothing.
     run = _block_in_band(qi, ki, causal=causal, block_q=block_q,
-                         block_k=block_k, window=window)
+                         block_k=block_k, window=window, k_offset=k_offset)
 
     @pl.when(run)
     def _compute():
         p, ds = _block_p_ds(q_ref, k_ref, v_ref, do_ref, lse_ref, di_ref,
                             qi, ki, scale=scale, causal=causal,
-                            block_q=block_q, block_k=block_k, window=window)
+                            block_q=block_q, block_k=block_k, window=window,
+                            k_offset=k_offset)
         dv_acc_ref[:] += _dv_contrib(p, do_ref)
         dk_acc_ref[:] += _dk_contrib(ds, q_ref, scale)
 
@@ -580,7 +593,7 @@ def _flash_bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, di_ref,
                             dq_acc_ref, dk_acc_ref, dv_acc_ref,
                             *, scale: float, causal: bool, block_q: int,
                             block_k: int, num_q: int, num_k: int,
-                            inner_steps: int, window=None):
+                            inner_steps: int, window=None, k_offset=0):
     """Single-sweep fused backward: dq, dk, dv from ONE pass over the
     (k_block, q_block) grid.
 
@@ -613,13 +626,14 @@ def _flash_bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, di_ref,
         dv_acc_ref[:] = jnp.zeros_like(dv_acc_ref)
 
     run = _block_in_band(qi, ki, causal=causal, block_q=block_q,
-                         block_k=block_k, window=window)
+                         block_k=block_k, window=window, k_offset=k_offset)
 
     @pl.when(run)
     def _compute():
         p, ds = _block_p_ds(q_ref, k_ref, v_ref, do_ref, lse_ref, di_ref,
                             qi, ki, scale=scale, causal=causal,
-                            block_q=block_q, block_k=block_k, window=window)
+                            block_q=block_q, block_k=block_k, window=window,
+                            k_offset=k_offset)
         dv_acc_ref[:] += _dv_contrib(p, do_ref)
         dk_acc_ref[:] += _dk_contrib(ds, q_ref, scale)
         rows = pl.ds(t * block_q, block_q)
@@ -641,13 +655,14 @@ def _flash_bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, di_ref,
 _FUSED_BWD_DQ_BYTES = 6 * 1024 * 1024
 
 
-def _flash_bwd(causal, scale, block_q, block_k, interpret, window, res, g):
+def _flash_bwd(causal, scale, block_q, block_k, interpret, window, k_offset,
+               res, g):
     return _flash_bwd_impl(causal, scale, block_q, block_k, interpret, res,
-                           g, dlse=None, window=window)
+                           g, dlse=None, window=window, k_offset=k_offset)
 
 
 def _flash_bwd_impl(causal, scale, block_q, block_k, interpret, res, g,
-                    dlse=None, window=None):
+                    dlse=None, window=None, k_offset=0):
     """Shared fused backward. ``dlse`` (``[b, h, sq]`` or None) is the LSE
     output's cotangent for the (o, lse) variant: since
     d(lse)/d(s) = p, it enters every kernel as ``ds = p·(dp − di + dlse)``
@@ -699,6 +714,7 @@ def _flash_bwd_impl(causal, scale, block_q, block_k, interpret, res, g,
                 _flash_bwd_fused_kernel, scale=scale, causal=causal,
                 block_q=block_q, block_k=block_k, num_q=num_q,
                 num_k=num_k, inner_steps=rep * num_q, window=window,
+                k_offset=k_offset,
             ),
             grid=(b * hkv, num_k, rep * num_q),
             in_specs=[qT_spec, kT_spec, kT_spec, qT_spec, rowT_spec,
@@ -728,6 +744,7 @@ def _flash_bwd_impl(causal, scale, block_q, block_k, interpret, res, g,
         functools.partial(
             _flash_bwd_dq_kernel, scale=scale, causal=causal,
             block_q=block_q, block_k=block_k, num_k=num_k, window=window,
+            k_offset=k_offset,
         ),
         grid=(b * h, num_q, num_k),
         in_specs=[q_spec, k_spec, k_spec, q_spec, row_spec, row_spec],
@@ -746,7 +763,7 @@ def _flash_bwd_impl(causal, scale, block_q, block_k, interpret, res, g,
         functools.partial(
             _flash_bwd_dkv_kernel, scale=scale, causal=causal,
             block_q=block_q, block_k=block_k, num_q=num_q,
-            inner_steps=rep * num_q, window=window,
+            inner_steps=rep * num_q, window=window, k_offset=k_offset,
         ),
         grid=(b * hkv, num_k, rep * num_q),
         in_specs=[qT_spec, kT_spec, kT_spec, qT_spec, rowT_spec, rowT_spec],
@@ -770,35 +787,37 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
 # ------------------------------------------------------- (o, lse) variant
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
 def _flash_lse(q, k, v, causal, scale, block_q, block_k, interpret,
-               window=None):
+               window=None, k_offset=0):
     (o, lse), _ = _flash_lse_fwd(q, k, v, causal, scale, block_q, block_k,
-                                 interpret, window)
+                                 interpret, window, k_offset)
     return o, lse
 
 
 def _flash_lse_fwd(q, k, v, causal, scale, block_q, block_k, interpret,
-                   window=None):
+                   window=None, k_offset=0):
     b, h, sq, d = q.shape
     out, lse = _flash_forward_call(q, k, v, causal, scale, block_q, block_k,
-                                   interpret, want_lse=True, window=window)
+                                   interpret, want_lse=True, window=window,
+                                   k_offset=k_offset)
     lse_rows = lse[..., 0]
     return ((out.reshape(b, h, sq, d), lse_rows.reshape(b, h, sq)),
             (q, k, v, out, lse_rows))
 
 
-def _flash_lse_bwd(causal, scale, block_q, block_k, interpret, window, res,
-                   g):
+def _flash_lse_bwd(causal, scale, block_q, block_k, interpret, window,
+                   k_offset, res, g):
     do, dlse = g
     return _flash_bwd_impl(causal, scale, block_q, block_k, interpret, res,
-                           do, dlse=dlse, window=window)
+                           do, dlse=dlse, window=window, k_offset=k_offset)
 
 
 _flash_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
 
 
-def _attention_reference_lse(q, k, v, causal, scale, window=None):
+def _attention_reference_lse(q, k, v, causal, scale, window=None,
+                             k_offset=0):
     """O(S²) (o, lse) fallback with the reference's exact masking.
     Supports grouped K/V like every other kernel in this module."""
     rep = _gqa_rep(q, k)
@@ -814,7 +833,7 @@ def _attention_reference_lse(q, k, v, causal, scale, window=None):
     if causal:
         sq, sk = s.shape[-2], s.shape[-1]
         q_pos = jnp.arange(sq)[:, None]
-        k_pos = jnp.arange(sk)[None, :]
+        k_pos = jnp.arange(sk)[None, :] + k_offset
         mask = q_pos >= k_pos
         if window is not None:
             mask &= k_pos > q_pos - window
@@ -832,7 +851,7 @@ def _attention_reference_lse(q, k, v, causal, scale, window=None):
 def flash_attention_lse(
     q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     *, causal: bool = False, scale: Optional[float] = None,
-    window: Optional[int] = None,
+    window: Optional[int] = None, k_offset: int = 0,
     block_q: Optional[int] = None, block_k: Optional[int] = None,
     interpret: Optional[bool] = None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
@@ -847,22 +866,27 @@ def flash_attention_lse(
     don't tile, exactly like :func:`flash_attention`. Grouped K/V
     (``H_kv < H``) is supported unexpanded like everywhere else — this
     is what lets ring attention rotate kv-head-sized shards.
+
+    ``k_offset`` (static) shifts the keys' global positions for the
+    causal/window mask — ring attention's rotation ``i`` passes
+    ``-i·s_local`` so each visiting shard masks at its true positions.
     """
     *_, sq, d = q.shape
     sk = k.shape[-2]
     _gqa_rep(q, k)  # validate head grouping before any dispatch
     scale_v = (1.0 / math.sqrt(d)) if scale is None else scale
-    window = _normalize_window(window, causal, sk)
+    window = _normalize_window(window, causal, sk, k_offset)
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     block_q, block_k = _resolve_blocks(block_q, block_k)
     bq = _largest_dividing_block(sq, block_q)
     bk = _largest_dividing_block(sk, block_k)
     if bq < 8 or bk < 8:
-        return _attention_reference_lse(q, k, v, causal, scale_v, window)
+        return _attention_reference_lse(q, k, v, causal, scale_v, window,
+                                        k_offset)
     q, scale_v = _fold_scale(q, scale_v)
     return _flash_lse(q, k, v, causal, scale_v, bq, bk, bool(interpret),
-                      window)
+                      window, k_offset)
 
 
 # ----------------------------------------------------------- decode sweep

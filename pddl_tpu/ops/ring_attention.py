@@ -37,10 +37,24 @@ from jax.sharding import Mesh, PartitionSpec as P
 from pddl_tpu.ops.attention import NEG_INF
 
 
+def _band_hops(n: int, s_local: int, window: Optional[int]) -> int:
+    """Ring rotations that can carry in-band keys (incl. the diagonal).
+
+    The sliding-window band is translation-invariant along the ring, so
+    rotation ``i`` contributes iff the shard ``i`` hops back overlaps
+    some query's ``(q-window, q]`` — a STATIC property of ``i``:
+    ``i·s_local <= window + s_local - 2``. Rotations (and their
+    ``ppermute`` hops) beyond that are skipped entirely: compute and ICI
+    traffic scale O(window), not O(S)."""
+    if window is None:
+        return n
+    return min(n, (window + s_local - 2) // s_local + 1)
+
+
 def ring_attention(
     q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     axis_name: str = "seq", *, causal: bool = False,
-    scale: Optional[float] = None,
+    scale: Optional[float] = None, window: Optional[int] = None,
 ) -> jnp.ndarray:
     """Per-shard ring attention; call inside ``shard_map``.
 
@@ -49,6 +63,9 @@ def ring_attention(
     (``H_kv < H``, GQA): the *unexpanded* kv-head-sized shards rotate
     around the ring, so per-hop ``ppermute`` ICI traffic is
     ``H/H_kv``-times smaller than rotating expanded K/V would be.
+    ``window`` (requires ``causal``): Mistral-style sliding-window
+    attention — the loop stops after :func:`_band_hops` rotations, so a
+    long-context SWA model pays O(window) ring compute and comms.
     """
     b, h, s_local, d = q.shape
     hkv = k.shape[1]
@@ -56,6 +73,9 @@ def ring_attention(
     n = lax.axis_size(axis_name)
     my = lax.axis_index(axis_name)
     scale_v = (1.0 / math.sqrt(d)) if scale is None else scale
+    if window is not None and not causal:
+        raise ValueError("window requires causal=True")
+    hops = _band_hops(n, s_local, window)
 
     # Grouped layout [B, H_kv, rep, S, D] for q and the accumulators; the
     # per-rotation einsums contract each kv head against its whole query
@@ -71,6 +91,8 @@ def ring_attention(
         if causal:
             k_pos = src * s_local + jnp.arange(s_local)
             mask = q_pos[:, None] >= k_pos[None, :]
+            if window is not None:
+                mask &= k_pos[None, :] > q_pos[:, None] - window
             s = jnp.where(mask, s, NEG_INF)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
         alpha = jnp.exp(m - m_new)
@@ -93,7 +115,7 @@ def ring_attention(
     m0 = _vary(jnp.full((b, hkv, rep, s_local, 1), NEG_INF, jnp.float32))
     l0 = _vary(jnp.zeros((b, hkv, rep, s_local, 1), jnp.float32))
     acc0 = _vary(jnp.zeros((b, hkv, rep, s_local, d), jnp.float32))
-    m, l, acc, _, _ = lax.fori_loop(0, n, step, (m0, l0, acc0, k, v))
+    m, l, acc, _, _ = lax.fori_loop(0, hops, step, (m0, l0, acc0, k, v))
     out = acc / jnp.maximum(l, 1e-30)
     return out.reshape(b, h, s_local, d).astype(q.dtype)
 
@@ -101,7 +123,7 @@ def ring_attention(
 def ring_attention_flash(
     q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     axis_name: str = "seq", *, causal: bool = False,
-    scale: Optional[float] = None,
+    scale: Optional[float] = None, window: Optional[int] = None,
 ) -> jnp.ndarray:
     """Ring attention whose per-rotation compute is the FLASH kernel.
 
@@ -115,6 +137,12 @@ def ring_attention_flash(
     earlier shards (``src < my``) run unmasked, later shards contribute
     nothing (lse = −inf) — block-level causality over the ring, exact
     row-level causality inside the kernel.
+
+    ``window`` (requires ``causal``): the rotation loop UNROLLS to the
+    :func:`_band_hops` in-band rotations, each running the kernel with a
+    static ``k_offset = -i·s_local`` so its causal+window mask sits at
+    the visiting shard's true positions; out-of-band rotations (and
+    their ppermute hops) never execute.
     """
     from pddl_tpu.ops.attention import flash_attention_lse
 
@@ -122,6 +150,8 @@ def ring_attention_flash(
     n = lax.axis_size(axis_name)
     my = lax.axis_index(axis_name)
     scale_v = (1.0 / math.sqrt(d)) if scale is None else scale
+    if window is not None and not causal:
+        raise ValueError("window requires causal=True")
     perm = [(j, (j + 1) % n) for j in range(n)]
 
     def merge(m, s, acc, o_i, lse_i):
@@ -160,11 +190,29 @@ def ring_attention_flash(
     # causal that is the diagonal block, which needs row-level masking
     # INSIDE the kernel — selecting the causal kernel statically here
     # removes the data-dependent branch entirely.
-    o0, lse0 = flash_attention_lse(q, k, v, causal=causal, scale=scale_v)
+    o0, lse0 = flash_attention_lse(q, k, v, causal=causal, scale=scale_v,
+                                   window=window)
     m0 = _vary(jnp.full((b, h, s_local), NEG_INF, jnp.float32))
     s0 = _vary(jnp.zeros((b, h, s_local), jnp.float32))
     acc0 = _vary(jnp.zeros((b, h, s_local, d), jnp.float32))
     m, s, acc = merge(m0, s0, acc0, o0, lse0)
+
+    if window is not None:
+        # Unrolled in-band rotations: i is a Python int, so the kernel's
+        # k_offset (and the band-skip predicates inside it) are static.
+        hops = _band_hops(n, s_local, window)
+        kc, vc = k, v
+        for i in range(1, hops):
+            kc = lax.ppermute(kc, axis_name, perm)
+            vc = lax.ppermute(vc, axis_name, perm)
+            o_i, lse_i = flash_attention_lse(
+                q, kc, vc, causal=True, window=window,
+                k_offset=-i * s_local, scale=scale_v)
+            # Wrapped sources are future shards: zero their weight.
+            lse_i = jnp.where((my - i) % n < my, lse_i, NEG_INF)
+            m, s, acc = merge(m, s, acc, o_i, lse_i)
+        return (acc / jnp.maximum(s, 1e-30)[..., None]).astype(q.dtype)
+
     kc = lax.ppermute(k, axis_name, perm)
     vc = lax.ppermute(v, axis_name, perm)
     m, s, acc, _, _ = lax.fori_loop(1, n, step, (m, s, acc, kc, vc))
@@ -175,6 +223,7 @@ def sequence_parallel_attention(
     q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     mesh: Mesh, *, axis_name: str = "seq", causal: bool = False,
     scale: Optional[float] = None, use_flash: bool = False,
+    window: Optional[int] = None,
 ) -> jnp.ndarray:
     """Array-level wrapper: global ``[B, H, S, D]`` inputs sharded on S.
 
@@ -185,14 +234,20 @@ def sequence_parallel_attention(
     bf16 inputs see one extra per-rotation rounding where the XLA path
     keeps a single f32 accumulator), with O(block) instead of
     O(s_local²) score memory per rotation.
+
+    ``window`` (requires ``causal``): sliding-window attention composed
+    with the ring — rotations whose shard lies wholly outside the band
+    are skipped (no kernel launch, no ppermute hop), so long-context SWA
+    costs O(window) per device instead of O(S).
     """
-    from pddl_tpu.ops.attention import _gqa_rep
+    from pddl_tpu.ops.attention import _gqa_rep, _normalize_window
 
     _gqa_rep(q, k)  # validate head grouping before entering the shard_map
+    window = _normalize_window(window, causal, k.shape[-2])
     spec = P(None, None, axis_name, None)
     inner = ring_attention_flash if use_flash else ring_attention
     fn = functools.partial(inner, axis_name=axis_name,
-                           causal=causal, scale=scale)
+                           causal=causal, scale=scale, window=window)
     # check_vma: the flash ring is branch-free (the former lax.cond around
     # the pallas call is gone), but the varying-axes checker still cannot
     # see through the pallas kernel itself: its internal dynamic_slices mix

@@ -313,13 +313,23 @@ def test_sliding_window_decode_matches_full_forward():
             atol=1e-5, rtol=1e-5)
 
 
-def test_sliding_window_rejected_on_ring_path():
+@pytest.mark.parametrize("attention", ["ring", "ring_flash"])
+def test_sliding_window_supported_on_ring_path(attention):
+    """SWA × sequence parallelism (VERDICT r3 task 4): the ring paths
+    accept sliding_window and reproduce the windowed reference logits —
+    long-context Mistral's two levers compose."""
     from pddl_tpu.core.mesh import MeshConfig, build_mesh
 
-    model = _model(attention="ring_flash", sliding_window=4,
-                   mesh=build_mesh(MeshConfig(seq=2)))
-    with pytest.raises(ValueError, match="sliding_window"):
-        model.init(jax.random.key(0), _tokens(), train=False)
+    mesh = build_mesh(MeshConfig(data=1, seq=8))
+    tokens = _tokens(batch=1, seq=64)
+    ref_model = _model(sliding_window=10, max_len=64)
+    ring_model = _model(sliding_window=10, max_len=64,
+                        attention=attention, mesh=mesh)
+    v = ref_model.init(jax.random.key(0), tokens, train=False)
+    ref = ref_model.apply(v, tokens, train=False)
+    got = jax.jit(lambda t: ring_model.apply(v, t, train=False))(tokens)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               atol=2e-4, rtol=2e-4)
 
 
 def test_generate_respects_sliding_window():
