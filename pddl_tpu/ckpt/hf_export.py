@@ -2,9 +2,11 @@
 
 The inverse of :func:`pddl_tpu.ckpt.hf_import.load_hf_llama` — train or
 fine-tune on TPU here, serve anywhere transformers runs. The export is
-exact for the whole Llama/Mistral/Qwen2 lineage because the
+exact for the whole Llama/Mistral/Qwen2/Mixtral lineage because the
 architectures correspond one-to-one (untied embed/head, bias-free except
-Qwen2's q/k/v). The GPT-2 family is deliberately NOT exported: HF GPT-2
+Qwen2's q/k/v; Mixtral layers round-trip through
+``block_sparse_moe.{gate,experts.*}``). The GPT-2 family is deliberately
+NOT exported: HF GPT-2
 ties ``lm_head`` to ``wte``, and a trained untied head has no faithful
 representation in that format.
 
@@ -72,10 +74,22 @@ def export_hf_llama(variables: PyTree, *, model=None) -> Dict[str, np.ndarray]:
         put(hf + "self_attn.o_proj.weight",
             np.asarray(attn["out"]["kernel"]).T)         # [E, H*D]
 
-        put(hf + "mlp.gate_proj.weight",
-            np.asarray(blk["mlp_gate"]["kernel"]).T)     # [I, E]
-        put(hf + "mlp.up_proj.weight",
-            np.asarray(blk["mlp_up"]["kernel"]).T)
-        put(hf + "mlp.down_proj.weight",
-            np.asarray(blk["mlp_down"]["kernel"]).T)     # [E, I]
+        if "moe" in blk:
+            # Mixtral layer: router + expert-major SwiGLU stacks back to
+            # per-expert Linear weights (keys follow MixtralForCausalLM).
+            moe = blk["moe"]
+            put(hf + "block_sparse_moe.gate.weight",
+                np.asarray(moe["router"]["kernel"]).T)   # [N, E]
+            for ours in ("w1", "w3", "w2"):
+                stack = np.asarray(moe[ours])            # [N, in, out]
+                for x in range(stack.shape[0]):
+                    put(hf + f"block_sparse_moe.experts.{x}.{ours}.weight",
+                        stack[x].T)
+        else:
+            put(hf + "mlp.gate_proj.weight",
+                np.asarray(blk["mlp_gate"]["kernel"]).T)  # [I, E]
+            put(hf + "mlp.up_proj.weight",
+                np.asarray(blk["mlp_up"]["kernel"]).T)
+            put(hf + "mlp.down_proj.weight",
+                np.asarray(blk["mlp_down"]["kernel"]).T)  # [E, I]
     return sd

@@ -313,6 +313,34 @@ def load_hf_llama(model_or_dir, variables: PyTree, *,
                     f"the checkpoint config uses {have_sw} — rebuild with "
                     f"sliding_window={have_sw}"
                 )
+            # Mixtral: routing width is config, not weights — a top-k
+            # mismatch imports cleanly and silently skews every logit.
+            have_tk = getattr(cfg, "num_experts_per_tok", None)
+            n_local = getattr(cfg, "num_local_experts", None)
+            if n_local and have_tk:
+                want_tk = getattr(model, "moe_top_k", None)
+                if want_tk is not None and want_tk != have_tk:
+                    raise ValueError(
+                        f"hf llama import: model moe_top_k={want_tk} but "
+                        f"the checkpoint uses num_experts_per_tok="
+                        f"{have_tk} — rebuild with moe_top_k={have_tk}"
+                    )
+                # Mixtral routing is DROPLESS; our dense dispatch drops
+                # overflow beyond capacity_factor*k*S/n tokens per
+                # expert. Worst case every token picks the same expert,
+                # so droplessness needs capacity_factor >= n/k — below
+                # that an imbalanced prompt silently diverges from
+                # transformers' logits with no error.
+                want_cf = getattr(model, "moe_capacity_factor", None)
+                if want_cf is not None and want_cf < n_local / have_tk:
+                    raise ValueError(
+                        f"hf llama import: moe_capacity_factor={want_cf} "
+                        f"can drop routed tokens (dropless Mixtral needs "
+                        f">= num_local_experts/num_experts_per_tok = "
+                        f"{n_local / have_tk:g}) — rebuild with "
+                        f"moe_capacity_factor={n_local / have_tk:g} or "
+                        "higher for serving parity"
+                    )
     sd = {k: _np(v) for k, v in model_or_dir.state_dict().items()}
     prefix = "model." if any(k.startswith("model.") for k in sd) else ""
 
@@ -375,9 +403,41 @@ def load_hf_llama(model_or_dir, variables: PyTree, *,
         put(f"block{i}/attn/out/kernel",
             sd[hf + "self_attn.o_proj.weight"].T)    # [E, H*D] -> [H*D, E]
 
-        put(f"block{i}/mlp_gate/kernel", sd[hf + "mlp.gate_proj.weight"].T)
-        put(f"block{i}/mlp_up/kernel", sd[hf + "mlp.up_proj.weight"].T)
-        put(f"block{i}/mlp_down/kernel", sd[hf + "mlp.down_proj.weight"].T)
+        if hf + "block_sparse_moe.gate.weight" in sd:
+            # Mixtral layer: router + expert-major SwiGLU experts. Ours
+            # keeps the HF per-expert names (w1 gate / w3 up / w2 down)
+            # stacked on a leading expert dim; torch Linear stores
+            # [out, in] so every matrix transposes.
+            if "moe" not in params[f"block{i}"]:
+                raise ValueError(
+                    "hf llama import: checkpoint is a Mixtral (routed "
+                    f"experts in layer {i}) but the model block has no "
+                    "MoE — rebuild the Llama with moe_experts="
+                    "config.num_local_experts"
+                )
+            n_exp = params[f"block{i}"]["moe"]["w1"].shape[0]
+            ck_exp = sum(
+                1 for k in sd
+                if k.startswith(hf + "block_sparse_moe.experts.")
+                and k.endswith(".w1.weight"))
+            if n_exp != ck_exp:
+                raise ValueError(
+                    f"hf llama import: layer {i} has {ck_exp} experts in "
+                    f"the checkpoint but the model was built with "
+                    f"moe_experts={n_exp}"
+                )
+            put(f"block{i}/moe/router/kernel",
+                sd[hf + "block_sparse_moe.gate.weight"].T)
+            for ours, theirs in (("w1", "w1"), ("w3", "w3"), ("w2", "w2")):
+                put(f"block{i}/moe/{ours}", np.stack([
+                    sd[hf + f"block_sparse_moe.experts.{x}.{theirs}.weight"].T
+                    for x in range(n_exp)]))
+        else:
+            put(f"block{i}/mlp_gate/kernel",
+                sd[hf + "mlp.gate_proj.weight"].T)
+            put(f"block{i}/mlp_up/kernel", sd[hf + "mlp.up_proj.weight"].T)
+            put(f"block{i}/mlp_down/kernel",
+                sd[hf + "mlp.down_proj.weight"].T)
 
     put("ln_final/scale", sd[f"{prefix}norm.weight"])
     head = sd.get("lm_head.weight", wte)  # tied when absent
@@ -386,3 +446,24 @@ def load_hf_llama(model_or_dir, variables: PyTree, *,
     out = dict(variables)
     out["params"] = params
     return out
+
+
+def load_hf_mixtral(model_or_dir, variables: PyTree, *, model=None,
+                    **kwargs) -> PyTree:
+    """Load a HF Mixtral checkpoint into a Llama variables tree.
+
+    A Mixtral checkpoint is the Llama layout with each layer's MLP
+    replaced by ``block_sparse_moe`` (router ``gate`` + per-expert
+    SwiGLU ``w1``/``w3``/``w2``); :func:`load_hf_llama` detects and maps
+    those layers, so this wrapper only resolves string inputs through
+    ``MixtralForCausalLM``. Build the target model with
+    ``moe_experts=config.num_local_experts`` and
+    ``moe_top_k=config.num_experts_per_tok`` (validated when ``model``
+    is passed; use a generous ``moe_capacity_factor`` for parity —
+    Mixtral routing is dropless).
+    """
+    if isinstance(model_or_dir, str):
+        from transformers import MixtralForCausalLM  # noqa: PLC0415
+
+        model_or_dir = MixtralForCausalLM.from_pretrained(model_or_dir)
+    return load_hf_llama(model_or_dir, variables, model=model, **kwargs)

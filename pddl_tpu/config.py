@@ -45,6 +45,12 @@ class ExperimentConfig:
     # transform either way).
     stem: str = "keras"
     compute_dtype: str = "bfloat16"
+    # Parameter (and thus optimizer-moment) STORAGE dtype. "bfloat16"
+    # halves weight+optimizer HBM — how the 1B llama fits one chip —
+    # but bf16 Adam moments are a convergence hazard; see
+    # docs/CONVERGENCE.md's f32-vs-bf16 comparison before using it for
+    # quality-critical training.
+    param_dtype: str = "float32"
     # transformer families only: activation rematerialization policy
     # ("none" | "dots" | "full" — models/vit.py REMAT_POLICIES)
     remat: Optional[str] = None

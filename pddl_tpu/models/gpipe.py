@@ -27,7 +27,7 @@ class GPipeModel:
     """Pipeline-parallel model = embed + ``n_stages`` x stage + head."""
 
     def __init__(self, *, embed, stage, head, n_stages: int,
-                 n_microbatches: int, mesh):
+                 n_microbatches: int, mesh, remat_stages: bool = False):
         from pddl_tpu.core.mesh import STAGE_AXIS
 
         if mesh.shape[STAGE_AXIS] != n_stages:
@@ -42,6 +42,7 @@ class GPipeModel:
         self.n_stages = n_stages
         self.n_microbatches = n_microbatches
         self.mesh = mesh
+        self.remat_stages = remat_stages
 
     # -- flax-like surface --------------------------------------------------
     def init(self, rng, x, train: bool = False):
@@ -74,6 +75,7 @@ class GPipeModel:
         h = gpipe_apply(
             p["stages"], h, mesh=self.mesh, stage_fn=self._stage_fn,
             n_microbatches=self.n_microbatches, check_vma=check_vma,
+            remat_stages=self.remat_stages,
         )
         out = self.head.apply({"params": p["head"]}, h)
         if mutable:

@@ -253,7 +253,9 @@ class LlamaAttention(nn.Module):
 
 
 class LlamaBlock(nn.Module):
-    """Pre-RMSNorm residual block: attention then SwiGLU MLP."""
+    """Pre-RMSNorm residual block: attention then a SwiGLU MLP — dense,
+    or routed over ``moe_experts`` SwiGLU experts (the Mixtral block:
+    ``block_sparse_moe`` with top-``moe_top_k`` routing)."""
 
     num_heads: int
     num_kv_heads: int
@@ -265,6 +267,9 @@ class LlamaBlock(nn.Module):
     mesh: Optional[Any] = None
     decode: bool = False
     max_decode_len: int = 1024
+    moe_experts: int = 0  # >0: Mixtral-style routed SwiGLU experts
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 2.0
     rms_eps: float = 1e-5
     dtype: Any = jnp.float32
     param_dtype: Any = jnp.float32
@@ -289,6 +294,17 @@ class LlamaBlock(nn.Module):
 
         h = _rms_norm(self.rms_eps, self.param_dtype, "ln2")(x)
         h = h.astype(self.dtype)
+        if self.moe_experts:
+            from pddl_tpu.ops.moe import SwitchFFN
+
+            h = SwitchFFN(
+                num_experts=self.moe_experts,
+                hidden_dim=self.intermediate_dim, top_k=self.moe_top_k,
+                capacity_factor=self.moe_capacity_factor,
+                expert_act="swiglu", dtype=self.dtype,
+                param_dtype=self.param_dtype, name="moe",
+            )(h)
+            return x + h
         dense = functools.partial(nn.Dense, use_bias=False, dtype=self.dtype,
                                   param_dtype=self.param_dtype)
         gate = dense(self.intermediate_dim, name="mlp_gate")(h)
@@ -322,6 +338,10 @@ class Llama(nn.Module):
     remat: str = "none"
     vocab_multiple: int = 1  # pad V for vocab-parallel TP (see gpt.GPT)
     decode: bool = False
+    moe_experts: int = 0  # >0: Mixtral — routed SwiGLU experts
+    moe_top_k: int = 2  # Mixtral's num_experts_per_tok
+    moe_every: int = 1  # Mixtral puts MoE in EVERY layer
+    moe_capacity_factor: float = 2.0
     rms_eps: float = 1e-5
     dtype: Any = jnp.float32
     param_dtype: Any = jnp.float32
@@ -345,6 +365,11 @@ class Llama(nn.Module):
         block_cls = (LlamaBlock if self.decode
                      else remat_block(LlamaBlock, self.remat))
         for i in range(self.depth):
+            # Interleave MoE blocks (every moe_every-th, counted from the
+            # back like ViT — Mixtral's moe_every=1 makes every block
+            # routed).
+            moe = (self.moe_experts
+                   if (self.depth - 1 - i) % self.moe_every == 0 else 0)
             x = block_cls(
                 num_heads=self.num_heads, num_kv_heads=kv,
                 intermediate_dim=inter, rope_theta=self.rope_theta,
@@ -352,6 +377,8 @@ class Llama(nn.Module):
                 sliding_window=self.sliding_window,
                 qkv_bias=self.qkv_bias, mesh=self.mesh,
                 decode=self.decode, max_decode_len=self.max_len,
+                moe_experts=moe, moe_top_k=self.moe_top_k,
+                moe_capacity_factor=self.moe_capacity_factor,
                 rms_eps=self.rms_eps, dtype=self.dtype,
                 param_dtype=self.param_dtype, name=f"block{i}",
             )(x, train)
@@ -381,6 +408,14 @@ def tiny_llama(vocab_size: int = 64, **kwargs) -> Llama:
 # `benchmarks/decode_bench.py`).
 Llama_Small = functools.partial(
     Llama, embed_dim=768, depth=12, num_heads=12, num_kv_heads=4)
+
+# ~300M-parameter mid-size shape (GQA 16/4): big enough that bf16
+# parameter/optimizer storage meaningfully matters, small enough to train
+# f32 on one chip with no remat — the f32-vs-bf16 convergence comparison
+# shape (docs/CONVERGENCE.md).
+Llama_300M = functools.partial(
+    Llama, embed_dim=1280, depth=16, num_heads=20, num_kv_heads=4,
+    intermediate_dim=3456)
 
 # Llama-3.2-1B-shaped config (RoPE theta 500k, GQA 32/8). Fits one v5e
 # chip in bf16 for training at moderate batch; the multi-chip strategies
@@ -479,6 +514,7 @@ class GPipeLlama(GPipeModel):
                  intermediate_dim: Optional[int] = None,
                  rope_theta: float = 10000.0,
                  attention: str = "reference", rms_eps: float = 1e-5,
+                 remat_stages: bool = False,
                  dtype: Any = jnp.float32, param_dtype: Any = jnp.float32):
         kv = num_kv_heads or num_heads
         if intermediate_dim is None:
@@ -495,4 +531,5 @@ class GPipeLlama(GPipeModel):
             head=_LlamaHead(vocab_size=vocab_size, rms_eps=rms_eps,
                             dtype=dtype, param_dtype=param_dtype),
             n_stages=n_stages, n_microbatches=n_microbatches, mesh=mesh,
+            remat_stages=remat_stages,
         )

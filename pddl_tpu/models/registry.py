@@ -90,6 +90,7 @@ def _populate() -> None:
 
     # Llama configs ride the same LM adapter (vocab from num_classes).
     register_model("llama_small", _gpt(llama.Llama_Small), is_lm=True)
+    register_model("llama_300m", _gpt(llama.Llama_300M), is_lm=True)
     register_model("llama_1b", _gpt(llama.Llama_1B), is_lm=True)
     register_model("tiny_llama", _gpt(llama.tiny_llama), is_lm=True)
 

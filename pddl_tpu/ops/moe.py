@@ -34,18 +34,30 @@ from flax import linen as nn
 class SwitchFFN(nn.Module):
     """Top-k routed expert FFN (drop-in for a transformer MLP block).
 
-    Input/output ``[batch, seq, embed]``; experts are two-layer GELU FFNs
-    with hidden dim ``mlp_ratio * embed``. ``top_k=1`` is the Switch
+    Input/output ``[batch, seq, embed]``. ``top_k=1`` is the Switch
     Transformer; ``top_k=2`` is GShard/Mixtral-style routing where every
     token is processed by its two highest-probability experts with the
-    two gates renormalized to sum to one (``normalize_gates``), second
-    choices queueing behind the group's first choices for capacity.
+    two gates renormalized to sum to one (``normalize_gates`` — exactly
+    transformers' Mixtral routing: softmax over all experts, top-k,
+    renormalize by the kept sum), second choices queueing behind the
+    group's first choices for capacity.
+
+    Expert architecture (``expert_act``):
+
+    - ``"gelu"`` — two-layer GELU FFN with biases, hidden
+      ``mlp_ratio·embed`` (the Switch classic; the ViT family's MoE).
+    - ``"swiglu"`` — ``w2·(silu(x·w1) ⊙ (x·w3))``, bias-free, hidden
+      ``hidden_dim`` (the Mixtral expert; parameter names w1/w3/w2
+      follow the HF checkpoint layout so
+      :func:`pddl_tpu.ckpt.hf_import.load_hf_llama` maps them 1:1).
     """
 
     num_experts: int
     mlp_ratio: int = 4
+    hidden_dim: int | None = None  # overrides mlp_ratio * embed when set
     top_k: int = 1
     capacity_factor: float = 1.25
+    expert_act: str = "gelu"  # "gelu" | "swiglu" (Mixtral)
     normalize_gates: bool = True  # top_k >= 2: g_j / sum_j g_j
     aux_loss_weight: float = 0.01
     dtype: Any = jnp.float32
@@ -58,12 +70,15 @@ class SwitchFFN(nn.Module):
         if not 1 <= self.top_k <= n:
             raise ValueError(
                 f"top_k={self.top_k} must be in [1, num_experts={n}]")
+        if self.expert_act not in ("gelu", "swiglu"):
+            raise ValueError(f"unknown expert_act {self.expert_act!r}")
         # Batch rows are the dispatch groups (the Switch/Mesh-TF "group"
         # dim): capacity is per group, so dispatch/combine are
         # [B, S, N, C] — linear in batch, never quadratic in total tokens.
         # top-2 doubles routed token-slots, so capacity scales with k.
         capacity = max(1, int(self.capacity_factor * self.top_k * s / n))
-        hidden = d * self.mlp_ratio
+        hidden = self.hidden_dim if self.hidden_dim is not None \
+            else d * self.mlp_ratio
 
         # Router (f32 for a stable softmax regardless of compute dtype).
         router_logits = nn.Dense(
@@ -123,17 +138,30 @@ class SwitchFFN(nn.Module):
         # batch_axis=(0,): the expert dim must not count toward fan-in, or
         # every expert initializes sqrt(n) too small.
         he = nn.initializers.he_normal(batch_axis=(0,))
-        w1 = self.param("w1", he, (n, d, hidden),
-                        self.param_dtype).astype(self.dtype)
-        b1 = self.param("b1", nn.initializers.zeros, (n, hidden),
-                        self.param_dtype).astype(self.dtype)
-        w2 = self.param("w2", he, (n, hidden, d),
-                        self.param_dtype).astype(self.dtype)
-        b2 = self.param("b2", nn.initializers.zeros, (n, d),
-                        self.param_dtype).astype(self.dtype)
 
         # Dispatch -> expert FFN -> combine: all MXU einsums, static shapes.
         expert_in = jnp.einsum("bsnc,bsd->bncd", dispatch, xc)
-        h = nn.gelu(jnp.einsum("bncd,ndh->bnch", expert_in, w1) + b1[:, None, :])
-        expert_out = jnp.einsum("bnch,nhd->bncd", h, w2) + b2[:, None, :]
+        if self.expert_act == "swiglu":
+            w1 = self.param("w1", he, (n, d, hidden),
+                            self.param_dtype).astype(self.dtype)  # gate
+            w3 = self.param("w3", he, (n, d, hidden),
+                            self.param_dtype).astype(self.dtype)  # up
+            w2 = self.param("w2", he, (n, hidden, d),
+                            self.param_dtype).astype(self.dtype)  # down
+            gate_h = jnp.einsum("bncd,ndh->bnch", expert_in, w1)
+            up_h = jnp.einsum("bncd,ndh->bnch", expert_in, w3)
+            expert_out = jnp.einsum("bnch,nhd->bncd",
+                                    nn.silu(gate_h) * up_h, w2)
+        else:
+            w1 = self.param("w1", he, (n, d, hidden),
+                            self.param_dtype).astype(self.dtype)
+            b1 = self.param("b1", nn.initializers.zeros, (n, hidden),
+                            self.param_dtype).astype(self.dtype)
+            w2 = self.param("w2", he, (n, hidden, d),
+                            self.param_dtype).astype(self.dtype)
+            b2 = self.param("b2", nn.initializers.zeros, (n, d),
+                            self.param_dtype).astype(self.dtype)
+            h = nn.gelu(jnp.einsum("bncd,ndh->bnch", expert_in, w1)
+                        + b1[:, None, :])
+            expert_out = jnp.einsum("bnch,nhd->bncd", h, w2) + b2[:, None, :]
         return jnp.einsum("bsnc,bncd->bsd", combine, expert_out)

@@ -47,6 +47,7 @@ def gpipe_apply(
     stage_axis: str = STAGE_AXIS,
     data_axis: str = DATA_AXIS,
     check_vma: bool = True,
+    remat_stages: bool = False,
 ) -> jnp.ndarray:
     """Run ``x`` through the stage pipeline; returns same-shape activations.
 
@@ -60,7 +61,20 @@ def gpipe_apply(
       n_microbatches: microbatch count M; ``batch % M == 0``. Larger M
         shrinks the pipeline bubble (``(S-1)/(M+S-1)``) but each microbatch
         must stay big enough to keep the MXU busy.
+      remat_stages: rematerialize each stage call in the backward. The
+        AD-derived backward saves one stage-internal activation set per
+        tick: ``M + S - 1`` ticks of ``B/M``-row microbatches, i.e.
+        ``temp ≈ c·B·(M+S-1)/M`` at fixed global batch (measured law —
+        larger M SHRINKS the envelope toward the ``c·B`` floor while
+        also shrinking the bubble). What caps model size is the floor's
+        constant ``c`` — every block-internal activation of the global
+        batch — and remat cuts it ~5-10x by keeping only tick-boundary
+        microbatches and recomputing stage internals in the backward
+        (measured: benchmarks/gpipe_memory_bench.py,
+        docs/ARCHITECTURE.md §7d; exactness: tests/test_pipeline.py).
     """
+    if remat_stages:
+        stage_fn = jax.checkpoint(stage_fn)
     n_stages = mesh.shape[stage_axis]
     batch = x.shape[0]
     dp = mesh.shape[data_axis]

@@ -105,13 +105,21 @@ LLAMA_TP_RULES: Sequence[Rule] = (
     (r"/embed/embedding", _shard_dim(0)),                 # vocab-parallel
 ) + tuple(VIT_TP_RULES)
 
-# Expert parallelism: Switch-MoE expert-major weights (pddl_tpu/ops/moe.py,
-# w1/w2/b1/b2 of shape [n_experts, ...]) shard dim 0 over `expert`; the
-# router stays replicated. Composes with the TP rules above.
+# Expert parallelism: expert-major MoE weights (pddl_tpu/ops/moe.py —
+# GELU w1/w2/b1/b2 and Mixtral-SwiGLU w1/w3/w2, all [n_experts, ...])
+# shard dim 0 over `expert`; the router stays replicated. Composes with
+# the TP rules above.
 VIT_EP_RULES: Sequence[Rule] = (
-    (r"/moe/(w1|w2|b1|b2)", _shard_dim(0, EXPERT_AXIS)),
+    (r"/moe/(w1|w2|w3|b1|b2)", _shard_dim(0, EXPERT_AXIS)),
     (r"/moe/router/", lambda s: PartitionSpec()),
 ) + tuple(VIT_TP_RULES)
+
+# The same expert rules over the Llama family's leaf names (Mixtral:
+# routed SwiGLU experts inside LlamaBlock).
+LLAMA_EP_RULES: Sequence[Rule] = (
+    (r"/moe/(w1|w2|w3|b1|b2)", _shard_dim(0, EXPERT_AXIS)),
+    (r"/moe/router/", lambda s: PartitionSpec()),
+) + tuple(LLAMA_TP_RULES)
 
 
 @register_strategy("tensor_parallel")

@@ -35,6 +35,8 @@ def build_trainer(cfg: ExperimentConfig, strategy=None):
     model_kwargs = dict(
         num_classes=cfg.num_classes,
         dtype=jnp.bfloat16 if cfg.compute_dtype == "bfloat16" else jnp.float32,
+        param_dtype=(jnp.bfloat16 if cfg.param_dtype == "bfloat16"
+                     else jnp.float32),
         bn_mode=cfg.bn_mode,
     )
     # Transformer families only; an explicit "none" is the default and is

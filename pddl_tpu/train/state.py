@@ -286,9 +286,16 @@ def make_optimizer(
     if schedule is not None:
         lr = make_schedule(schedule, learning_rate, **(schedule_options or {}))
     # `mask` must be declared static: inject_hyperparams otherwise treats
-    # any callable kwarg as a step->value schedule.
-    inject = (optax.inject_hyperparams(factory, static_args=("mask",))
-              if "mask" in kwargs else optax.inject_hyperparams(factory))
+    # any callable kwarg as a step->value schedule. hyperparam_dtype MUST
+    # be pinned to f32: inject otherwise casts hyperparams to the params'
+    # dtype, and under bf16 parameter storage b2=0.999 rounds to exactly
+    # 1.0 — bias correction 1-b2^t becomes 0 and the first Adam update
+    # divides by zero (params go NaN in one step).
+    inject = (optax.inject_hyperparams(factory, static_args=("mask",),
+                                       hyperparam_dtype=jnp.float32)
+              if "mask" in kwargs
+              else optax.inject_hyperparams(factory,
+                                            hyperparam_dtype=jnp.float32))
     tx = inject(learning_rate=lr, **kwargs)
     if grad_clip_norm is not None:
         tx = optax.chain(optax.clip_by_global_norm(grad_clip_norm), tx)

@@ -1,0 +1,87 @@
+"""Child process for the LM DP x TP multi-process test (not a pytest file).
+
+Trains a tiny GQA Llama for two steps under TensorParallelStrategy
+(LLAMA_TP_RULES) over a 4-device ``data=2 x model=2`` mesh and prints the
+final loss. Run two ways by tests/test_multiprocess.py:
+
+- TWO real OS processes x 2 fake CPU devices each (PDDL_* bootstrap set):
+  DP crosses the process boundary, the Megatron all-reduces compile into
+  the step, gradients ride gloo — the transformer-family analogue of the
+  ResNet path in _multiworker_child.py.
+- ONE process x 4 fake devices (no coordinator): the single-process
+  oracle the multi-process loss must match.
+
+Exits non-zero on any assertion failure.
+"""
+
+import os
+import sys
+
+_LOCAL = int(os.environ.get("PDDL_TEST_LOCAL_DEVICES", "2"))
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "")
+    + f" --xla_force_host_platform_device_count={_LOCAL}"
+)
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+
+def main() -> None:
+    from pddl_tpu.core import dist
+
+    multiprocess = "PDDL_COORDINATOR" in os.environ
+    if multiprocess:
+        spec = dist.initialize()
+        assert spec.is_multiprocess, spec
+
+    from pddl_tpu.parallel.tensor_parallel import (
+        LLAMA_TP_RULES,
+        TensorParallelStrategy,
+    )
+
+    strategy = TensorParallelStrategy(model_parallel=2,
+                                      rules=LLAMA_TP_RULES)
+    mesh = strategy.setup()
+    assert mesh.devices.size == 4, mesh
+
+    from pddl_tpu.data.synthetic import SyntheticLanguageModeling
+    from pddl_tpu.models.llama import Llama
+    from pddl_tpu.train.loop import Trainer
+
+    model = Llama(vocab_size=16, max_len=32, embed_dim=32, depth=2,
+                  num_heads=4, num_kv_heads=2, attention="reference")
+    data = SyntheticLanguageModeling(
+        batch_size=strategy.scale_batch_size(4), seq_len=32, vocab_size=16,
+        seed=3, process_index=strategy.process_index,
+        process_count=strategy.data_process_count,
+    )
+    trainer = Trainer(model, optimizer="sgd", learning_rate=0.01,
+                      strategy=strategy, seed=0, input_key="tokens",
+                      target_key="targets")
+    hist = trainer.fit(data, epochs=1, steps_per_epoch=2, verbose=0)
+    loss = float(hist.history["loss"][-1])
+    assert np.isfinite(loss), loss
+
+    # The Megatron sharding must actually be installed: q/k/v
+    # column-parallel on `model`, embed vocab-parallel.
+    from jax.sharding import PartitionSpec as P
+    from pddl_tpu.core.mesh import MODEL_AXIS
+
+    attn = trainer.state.params["block0"]["attn"]
+    assert attn["query"]["kernel"].sharding.spec == P(None, MODEL_AXIS), \
+        attn["query"]["kernel"].sharding.spec
+    emb = trainer.state.params["embed"]["embedding"]
+    assert emb.sharding.spec == P(MODEL_AXIS), emb.sharding.spec
+
+    print(f"child {jax.process_index()} LMTP OK loss={loss:.10f}",
+          flush=True)
+
+
+if __name__ == "__main__":
+    main()
+    sys.exit(0)

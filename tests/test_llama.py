@@ -561,6 +561,88 @@ def test_hf_export_roundtrips_into_transformers():
     np.testing.assert_allclose(got, ref, atol=3e-4, rtol=3e-4)
 
 
+def _mixtral_pair():
+    """Matching (HF MixtralForCausalLM, our MoE Llama) at tiny shape.
+
+    moe_capacity_factor is generous because Mixtral routing is DROPLESS;
+    with capacity >= routed tokens the dense-dispatch formulation is
+    exactly transformers' gather/scatter one."""
+    hf = _hf_llama(cls=transformers.MixtralForCausalLM,
+                   num_local_experts=4, num_experts_per_tok=2,
+                   sliding_window=None, router_aux_loss_coef=0.0)
+    ours = _model(intermediate_dim=64, rms_eps=1e-6, moe_experts=4,
+                  moe_top_k=2, moe_capacity_factor=16.0)
+    return hf, ours
+
+
+def test_hf_mixtral_logits_match():
+    """Mixtral = Llama layout + routed SwiGLU experts: import through
+    load_hf_mixtral and the logits match transformers' (VERDICT r3
+    task 5 done-criterion)."""
+    from pddl_tpu.ckpt.hf_import import load_hf_mixtral
+
+    hf, ours = _mixtral_pair()
+    tokens = _tokens()
+    v = ours.init(jax.random.key(0), tokens, train=False)
+    v = load_hf_mixtral(hf, v, model=ours)
+    with torch.no_grad():
+        ref = hf(torch.from_numpy(
+            np.asarray(tokens, np.int64))).logits.numpy()
+    got = np.asarray(ours.apply(v, tokens, train=False))
+    np.testing.assert_allclose(got, ref, atol=3e-4, rtol=3e-4)
+
+
+def test_hf_mixtral_rejects_wrong_expert_config():
+    from pddl_tpu.ckpt.hf_import import load_hf_mixtral
+
+    hf, _ = _mixtral_pair()
+    tokens = _tokens()
+    dense = _model(intermediate_dim=64, rms_eps=1e-6)  # no MoE
+    v = dense.init(jax.random.key(0), tokens, train=False)
+    with pytest.raises(ValueError, match="moe_experts"):
+        load_hf_mixtral(hf, v, model=dense)
+
+    wrong_k = _model(intermediate_dim=64, rms_eps=1e-6, moe_experts=4,
+                     moe_top_k=1)
+    v = wrong_k.init(jax.random.key(0), tokens, train=False)
+    with pytest.raises(ValueError, match="num_experts_per_tok"):
+        load_hf_mixtral(hf, v, model=wrong_k)
+
+    wrong_n = _model(intermediate_dim=64, rms_eps=1e-6, moe_experts=8,
+                     moe_top_k=2)
+    v = wrong_n.init(jax.random.key(0), tokens, train=False)
+    with pytest.raises(ValueError, match="experts"):
+        load_hf_mixtral(hf, v, model=wrong_n)
+
+    # Undersized capacity would silently drop routed tokens that
+    # transformers' dropless Mixtral keeps — must be rejected up front.
+    droppy = _model(intermediate_dim=64, rms_eps=1e-6, moe_experts=4,
+                    moe_top_k=2, moe_capacity_factor=1.0)
+    v = droppy.init(jax.random.key(0), tokens, train=False)
+    with pytest.raises(ValueError, match="capacity"):
+        load_hf_mixtral(hf, v, model=droppy)
+
+
+def test_hf_mixtral_export_roundtrips_into_transformers():
+    """export_hf_llama emits block_sparse_moe keys for MoE blocks;
+    transformers loads them strictly and serves our logits."""
+    from pddl_tpu.ckpt.hf_export import export_hf_llama
+
+    hf, ours = _mixtral_pair()
+    tokens = _tokens()
+    v = ours.init(jax.random.key(7), tokens, train=False)
+    sd = {k: torch.from_numpy(x) for k, x in export_hf_llama(
+        v, model=ours).items()}
+    missing, unexpected = hf.load_state_dict(sd, strict=True)
+    assert not missing and not unexpected
+    hf = hf.eval()
+    with torch.no_grad():
+        ref = hf(torch.from_numpy(
+            np.asarray(tokens, np.int64))).logits.numpy()
+    got = np.asarray(ours.apply(v, tokens, train=False))
+    np.testing.assert_allclose(got, ref, atol=3e-4, rtol=3e-4)
+
+
 def test_hf_export_import_is_identity_with_padded_vocab():
     """export -> import lands bit-exactly back on the original params,
     including slicing vocab_multiple padding off and refilling it."""

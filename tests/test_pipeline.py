@@ -144,3 +144,37 @@ def test_pipeline_bubble_arithmetic():
         outs.append(np.asarray(model.apply(variables, x)))
     for o in outs[1:]:
         np.testing.assert_allclose(o, outs[0], atol=1e-4, rtol=1e-4)
+
+
+def test_remat_stages_changes_memory_never_numbers():
+    """remat_stages (per-tick jax.checkpoint of the stage call — the
+    GPipe activation-memory mitigation, benchmarks/gpipe_memory_bench.py)
+    must reproduce the plain pipeline's loss AND gradients exactly."""
+    import optax
+
+    from pddl_tpu.models.llama import GPipeLlama
+
+    mesh = build_mesh(MeshConfig(data=2, stage=4))
+    tokens = jax.random.randint(jax.random.key(3), (8, 33), 0, 64)
+
+    def loss_and_grads(remat):
+        model = GPipeLlama(vocab_size=64, n_stages=4, blocks_per_stage=1,
+                           n_microbatches=2, mesh=mesh, embed_dim=32,
+                           num_heads=4, num_kv_heads=2,
+                           remat_stages=remat)
+        variables = model.init(jax.random.key(1), tokens[:, :-1])
+
+        def loss_fn(params):
+            logits = model.apply({"params": params}, tokens[:, :-1])
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits, tokens[:, 1:]).mean()
+
+        return jax.value_and_grad(loss_fn)(variables["params"])
+
+    loss_plain, g_plain = loss_and_grads(False)
+    loss_remat, g_remat = loss_and_grads(True)
+    np.testing.assert_allclose(float(loss_remat), float(loss_plain),
+                               rtol=1e-6)
+    for a, b in zip(jax.tree.leaves(g_remat), jax.tree.leaves(g_plain)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-5, rtol=1e-5)

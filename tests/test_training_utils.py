@@ -370,3 +370,28 @@ def test_decay_mask_misuse_raises():
         make_optimizer("adam", 1e-3, decay_mask=lambda p: p)
     with pytest.raises(ValueError, match="decay_mask"):
         make_optimizer(optax.sgd(0.1), decay_mask=lambda p: p)
+
+
+def test_bf16_params_keep_f32_hyperparams():
+    """inject_hyperparams must NOT cast optimizer hyperparams to the
+    params' storage dtype: in bf16, b2=0.999 rounds to exactly 1.0, the
+    bias correction 1-b2^t becomes 0, and the first Adam update divides
+    by zero — the whole tree NaNs in one step (found by the bf16-recipe
+    convergence track)."""
+    import jax.numpy as jnp
+    import optax
+
+    from pddl_tpu.train.state import _find_hyperparams, make_optimizer
+
+    p = {"w": jnp.ones((4, 4), jnp.bfloat16) * 0.5,
+         "b": jnp.zeros((4,), jnp.bfloat16)}
+    g = jax.tree.map(lambda x: jnp.full_like(x, 1e-3), p)
+    tx = make_optimizer("adamw", 3e-4)
+    s = tx.init(p)
+    hp = _find_hyperparams(s)
+    assert hp is not None and hp["b2"].dtype == jnp.float32
+    assert abs(float(hp["b2"]) - 0.999) < 1e-6  # NOT rounded to bf16's 1.0
+    for _ in range(3):
+        u, s = tx.update(g, s, p)
+        p = optax.apply_updates(p, u)
+    assert all(bool(jnp.isfinite(x).all()) for x in jax.tree.leaves(p))
