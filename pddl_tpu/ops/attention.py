@@ -890,6 +890,16 @@ def flash_attention_lse(
 
 
 # ----------------------------------------------------------- decode sweep
+# K-cache size (bytes, PER ARRAY — v doubles it) up to which a
+# single-token decode step reads the WHOLE cache in one fused pass
+# instead of the chunked loop. The loop's while/dynamic-slice machinery
+# is a FIXED ~30 µs/layer; the extra read scales with batch x cache, so
+# the gate is bytes-based: ~2 MB of K cache (+2 of V) costs ~5 µs extra
+# read — below the loop cost — while a large-batch or long cache falls
+# back to the prefix-bounded sweep.
+_SINGLE_SHOT_MAX_KC_BYTES = 2 * 1024 * 1024
+
+
 def decode_attention(
     q: jnp.ndarray, k_cache: jnp.ndarray, v_cache: jnp.ndarray,
     index, *, window: Optional[int] = None, rolling: bool = False,
@@ -938,6 +948,13 @@ def decode_attention(
         merging partials, as in ring attention).
 
     Returns ``[B, H, S, D]`` in q's dtype (plus lse under ``return_lse``).
+
+    Single-token steps over SMALL caches (``_SINGLE_SHOT_MAX_KC_BYTES``,
+    batch included) skip the loop entirely and run ONE fused masked pass
+    over the whole cache: the loop's while/dynamic-slice machinery is a
+    fixed per-layer cost that dwarfs the few extra megabytes of read at
+    single-stream sizes, while large-batch/long-cache steps keep the
+    prefix-bounded sweep (their extra read would scale with B·L).
     """
     b, h, s, d = q.shape
     hkv, cache_len = k_cache.shape[1], k_cache.shape[2]
@@ -956,6 +973,13 @@ def decode_attention(
                 "in-window keys would be overwritten before leaving the "
                 "band")
     scale_v = (1.0 / math.sqrt(d)) if scale is None else scale
+    # Short-cache single-token steps: one fused pass, no loop (see
+    # docstring). The chunked loop remains for big-batch/long caches
+    # (bounded HBM traffic) and multi-token prefill (bounded score
+    # memory).
+    kc_bytes = b * hkv * cache_len * d * jnp.dtype(k_cache.dtype).itemsize
+    if s == 1 and kc_bytes <= _SINGLE_SHOT_MAX_KC_BYTES:
+        chunk = cache_len
     # Chunks need NOT divide the cache: the final chunk's slice start is
     # clamped and the overlap with the previous chunk masked out (the
     # dedup term below), so a non-round cache length costs one partially
@@ -1016,7 +1040,19 @@ def decode_attention(
     m0 = jnp.full((b, hkv, rep, s, 1), NEG_INF, jnp.float32)
     l0 = jnp.zeros((b, hkv, rep, s, 1), jnp.float32)
     acc0 = jnp.zeros((b, hkv, rep, s, d), jnp.float32)
-    m, l, acc = jax.lax.fori_loop(0, live, body, (m0, l0, acc0))
+    if n_chunks == 1:
+        # Whole cache in one pass — no while loop in the program at all.
+        # `live == 0` (empty history under history_only) must still
+        # produce the loop's zero-iteration result: a fully-masked pass
+        # makes every row's p uniform (exp(NEG_INF - NEG_INF) == 1), so
+        # mask the result back to the inits instead of running on trust.
+        m1, l1, acc1 = body(0, (m0, l0, acc0))
+        keep = live > 0
+        m = jnp.where(keep, m1, m0)
+        l = jnp.where(keep, l1, l0)
+        acc = jnp.where(keep, acc1, acc0)
+    else:
+        m, l, acc = jax.lax.fori_loop(0, live, body, (m0, l0, acc0))
     out = (acc / jnp.maximum(l, 1e-30)).reshape(b, h, s, d).astype(q.dtype)
     if return_lse:
         # Rows with nothing attended (empty history) keep lse ~ -inf so

@@ -622,6 +622,24 @@ def test_decode_attention_chunk_not_dividing_cache():
                                atol=2e-5, rtol=2e-5)
 
 
+def test_decode_attention_empty_history_returns_zero_weight():
+    """history_only at index 0 (nothing attended yet) must yield zero
+    output and ~-inf lse on BOTH the single-shot and chunked paths — a
+    fully-masked fused pass would otherwise average the raw cache (the
+    masked-softmax exp(0) pitfall)."""
+    from pddl_tpu.ops.attention import decode_attention
+
+    kq = jax.random.key(2)
+    q = jax.random.normal(kq, (1, 2, 1, 8))
+    cache = jnp.full((1, 2, 64, 8), 7.0)  # garbage that must not leak
+    for chunk in (64, 16):  # single-shot and chunked
+        out, lse = decode_attention(q, cache, cache, jnp.int32(0),
+                                    history_only=True, return_lse=True,
+                                    chunk=chunk)
+        np.testing.assert_array_equal(np.asarray(out), 0.0)
+        assert float(lse.max()) < -1e29
+
+
 def test_decode_attention_prefix_bound_ignores_cache_garbage():
     """Slots beyond the valid prefix must never influence the output —
     the fori_loop stops at the last live chunk and masking covers the
