@@ -1,22 +1,22 @@
 """End-to-end GPT training throughput on one chip (tokens/sec, MFU).
 
 The harness behind the architecture doc's long-context numbers
-(v5e, GPT-2-small shape, B8 S2048 bf16 flash + fused-CE head:
-~95k tokens/s, ≈47.5% MFU by the 6ND estimate against the 197 TFLOP/s
-bf16 peak — chip-state variance of a few percent per run is normal;
-decomposition of the remainder: docs/ARCHITECTURE.md §7b and
-artifacts/gpt_bench/r03_ablation.json).
+(v5e, GPT-2-small shape, B8 S2048 bf16 flash + fused-CE head; round 4
+with the fused single-sweep attention backward: ~101k tokens/s, 50.6%
+6ND MFU against the 197 TFLOP/s bf16 peak — chip-state variance of a
+few percent per run is normal; decomposition of the remainder:
+docs/ARCHITECTURE.md §7b, artifacts/gpt_bench/r04_b8_s2048.json).
 
-Long context on ONE chip (``--remat dots``): S=8192 at ~32k tokens/s,
-S=16384 at ~22k tokens/s (B1), where the materialized-scores attention
-could not even hold a single layer's S² matrix.
+Long context on ONE chip (``--remat dots``, round 4): S=8192 at ~48k
+tokens/s, S=16384 at ~30k tokens/s (B1) — where the
+materialized-scores attention could not even hold a single layer's S²
+matrix (``r04_b1_s8192.json``, ``r04_b1_s16384.json``).
 
 ``--family llama`` benches the modern-decoder family at the same shape
 (RoPE/SwiGLU/RMSNorm, GQA ``--kv-heads``, llama-tokenizer 32000 vocab):
-125M params at B8 S2048 bf16 train at ~98.6k tokens/s/chip — faster
-than the GPT shape end-to-end (166.1 vs 173.4 ms/step, pinned as
-``artifacts/gpt_bench/r03_llama_b8_s2048.json`` vs ``r03_b8_s2048.json``;
-the smaller vocab head outweighs the RoPE rotations).
+125M params at B8 S2048 bf16 train at ~112.7k tokens/s/chip with the
+GQA-native kernels — 145.4 vs GPT's 161.8 ms/step, pinned as
+``artifacts/gpt_bench/r04_llama_b8_s2048.json`` vs ``r04_b8_s2048.json``.
 
     PYTHONPATH=. python benchmarks/gpt_train_bench.py [--seq 2048 --batch 8]
 """
