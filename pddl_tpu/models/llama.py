@@ -16,9 +16,19 @@ Llama/Mistral/Qwen lineage — on the same substrate:
 - **SwiGLU** MLP (``silu(gate)·up → down``), no biases anywhere
   (except Qwen2's q/k/v projection biases, ``qkv_bias=True``).
 - **Grouped-query attention**: ``num_kv_heads <= num_heads`` K/V heads,
-  broadcast to the query heads for the kernel — the KV *cache* stays at
-  KV-head size, which is the whole point of GQA (decode memory/BW drops
-  by ``num_heads/num_kv_heads``).
+  consumed UNEXPANDED by every kernel (flash, ring, decode — the
+  q-head → kv-head mapping lives inside them), so GQA's
+  ``num_heads/num_kv_heads`` memory/bandwidth saving holds in
+  training, prefill, sequence-parallel rotation, AND the decode cache.
+- **Sliding-window attention** (Mistral): band-skipped in the flash
+  kernel, composed with the ring/sequence-parallel path (out-of-band
+  rotations skipped — O(window) compute and ICI), and a
+  ``window``-sized rolling ring-buffer decode cache.
+- **Routed experts** (Mixtral): ``moe_experts`` switches each
+  ``moe_every``-th block's MLP to top-``moe_top_k`` SwiGLU experts
+  (:class:`pddl_tpu.ops.moe.SwitchFFN`, ``expert_act="swiglu"``);
+  import/export via :func:`pddl_tpu.ckpt.hf_import.load_hf_mixtral` /
+  ``export_hf_llama``; shard with ``LLAMA_EP_RULES``.
 
 Everything else — flash/ring attention, Megatron TP (use
 ``LLAMA_TP_RULES`` from :mod:`pddl_tpu.parallel.tensor_parallel`),
