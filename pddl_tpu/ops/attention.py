@@ -114,6 +114,21 @@ def attention_reference(
 LANES = 128
 
 
+def _sequential_grid():
+    """CompilerParams pinning sequential ('arbitrary') semantics on every
+    grid dim. All four flash pallas_calls depend on sequential grid order
+    for correctness: output blocks revisited along the innermost axis
+    receive transient garbage writebacks that only the final visit's
+    writes (later in grid order) overwrite, and the VMEM accumulators
+    init on the first inner step / finalize on the last. Pinned
+    explicitly so the assumption survives any change to the backend's
+    default dimension semantics."""
+    from jax.experimental.pallas import tpu as pltpu  # noqa: PLC0415
+
+    return pltpu.CompilerParams(
+        dimension_semantics=("arbitrary", "arbitrary", "arbitrary"))
+
+
 def _masked_scores(q_ref, k_ref, qi, ki, *, scale, causal, block_q, block_k,
                    window=None, k_offset=0):
     """Recompute one (bq, bk) score block: s = scale·q·kᵀ, causal-masked.
@@ -440,6 +455,7 @@ def _flash_forward_call(q, k, v, causal, scale, block_q, block_k, interpret,
             pltpu.VMEM((block_q, LANES), jnp.float32),  # running denom l
             pltpu.VMEM((block_q, d), jnp.float32),      # output accumulator
         ],
+        compiler_params=_sequential_grid(),
         interpret=interpret,
     )(qf, kf, vf)
     if want_lse:
@@ -730,6 +746,7 @@ def _flash_bwd_impl(causal, scale, block_q, block_k, interpret, res, g,
                 pltpu.VMEM((block_k, d), jnp.float32),
                 pltpu.VMEM((block_k, d), jnp.float32),
             ],
+            compiler_params=_sequential_grid(),
             interpret=interpret,
         )(qf, kf, vf, dof, lse, di)
         return (dq.reshape(b, h, sq, d), dk.reshape(b, hkv, sk, d),
@@ -751,6 +768,7 @@ def _flash_bwd_impl(causal, scale, block_q, block_k, interpret, res, g,
         out_specs=q_spec,
         out_shape=sds((b * h, sq, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        compiler_params=_sequential_grid(),
         interpret=interpret,
     )(qf, kf, vf, dof, lse, di)
 
@@ -776,6 +794,7 @@ def _flash_bwd_impl(causal, scale, block_q, block_k, interpret, res, g,
             pltpu.VMEM((block_k, d), jnp.float32),
             pltpu.VMEM((block_k, d), jnp.float32),
         ],
+        compiler_params=_sequential_grid(),
         interpret=interpret,
     )(qf, kf, vf, dof, lse, di)
 

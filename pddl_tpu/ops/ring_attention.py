@@ -67,9 +67,13 @@ def ring_attention(
     attention — the loop stops after :func:`_band_hops` rotations, so a
     long-context SWA model pays O(window) ring compute and comms.
     """
+    from pddl_tpu.ops.attention import _gqa_rep
+
     b, h, s_local, d = q.shape
     hkv = k.shape[1]
-    rep = h // hkv  # validated by the array-level wrapper / model layer
+    # Shape-static, so the check is free — direct shard_map callers get
+    # the descriptive error instead of an opaque reshape failure.
+    rep = _gqa_rep(q, k)
     n = lax.axis_size(axis_name)
     my = lax.axis_index(axis_name)
     scale_v = (1.0 / math.sqrt(d)) if scale is None else scale

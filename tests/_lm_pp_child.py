@@ -1,0 +1,94 @@
+"""Child process for the LM pipeline-parallel multi-process test (not a
+pytest file).
+
+Trains a tiny GQA GPipeLlama for two steps under PipelineStrategy over a
+``data=1 x stage=2`` mesh and prints the final loss. Run two ways by
+tests/test_multiprocess.py:
+
+- TWO real OS processes x 1 fake CPU device each (PDDL_* bootstrap set):
+  one pipeline stage per process, so EVERY ``ppermute`` activation hop of
+  the GPipe schedule (forward and the AD-derived backward) crosses the
+  process boundary on gloo — the one collective family no other
+  process-boundary test exercises.
+- ONE process x 2 fake devices (no coordinator): the single-process
+  fake-mesh oracle the multi-process loss must match.
+
+The batch is replicated over the ``stage`` axis (data axis has size 1),
+so both workers generate and feed the IDENTICAL full batch
+(process_count=1 for the dataset regardless of world size).
+
+Exits non-zero on any assertion failure.
+"""
+
+import os
+import sys
+
+_LOCAL = int(os.environ.get("PDDL_TEST_LOCAL_DEVICES", "1"))
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "")
+    + f" --xla_force_host_platform_device_count={_LOCAL}"
+)
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+
+def main() -> None:
+    from pddl_tpu.core import dist
+
+    multiprocess = "PDDL_COORDINATOR" in os.environ
+    if multiprocess:
+        spec = dist.initialize()
+        assert spec.is_multiprocess, spec
+
+    from pddl_tpu.parallel.pipeline import PipelineStrategy
+
+    strategy = PipelineStrategy(n_stages=2)
+    mesh = strategy.setup()
+    assert mesh.devices.size == 2, mesh
+    if multiprocess:
+        # The point of this test: the stage axis must SPAN the processes.
+        stage_procs = {d.process_index for d in mesh.devices.flat}
+        assert stage_procs == {0, 1}, stage_procs
+
+    from pddl_tpu.data.synthetic import SyntheticLanguageModeling
+    from pddl_tpu.models.llama import GPipeLlama
+    from pddl_tpu.train.loop import Trainer
+
+    model = GPipeLlama(vocab_size=16, n_stages=2, blocks_per_stage=1,
+                       n_microbatches=2, mesh=mesh, embed_dim=32,
+                       num_heads=4, num_kv_heads=2, attention="reference")
+    # data axis is size 1 -> the batch replicates over `stage`; every
+    # process must feed the identical FULL batch (not a shard of it).
+    data = SyntheticLanguageModeling(
+        batch_size=4, seq_len=32, vocab_size=16, seed=3,
+        process_index=0, process_count=1,
+    )
+    trainer = Trainer(model, optimizer="sgd", learning_rate=0.01,
+                      strategy=strategy, seed=0, input_key="tokens",
+                      target_key="targets")
+    hist = trainer.fit(data, epochs=1, steps_per_epoch=2, verbose=0)
+    loss = float(hist.history["loss"][-1])
+    assert np.isfinite(loss), loss
+
+    # The stage layout must actually be installed: stacked block weights
+    # shard their leading (stage) dim; embed/head replicate.
+    from jax.sharding import PartitionSpec as P
+    from pddl_tpu.core.mesh import STAGE_AXIS
+
+    wq = trainer.state.params["stages"]["block0"]["attn"]["query"]["kernel"]
+    assert wq.sharding.spec[0] == STAGE_AXIS, wq.sharding.spec
+    emb = trainer.state.params["embed"]["embed"]["embedding"]
+    assert emb.sharding.spec == P(), emb.sharding.spec
+
+    print(f"child {jax.process_index()} LMPP OK loss={loss:.10f}",
+          flush=True)
+
+
+if __name__ == "__main__":
+    main()
+    sys.exit(0)
