@@ -27,7 +27,8 @@ import jax.numpy as jnp
 
 from pddl_tpu.ops.attention import attention_reference, flash_attention
 
-BLOCKS = ((128, 128), (256, 512), (512, 512), (512, 1024))
+BLOCKS = ((128, 128), (256, 512), (512, 512), (512, 1024), (1024, 1024),
+          (256, 1024), (1024, 512))
 
 
 def bench(make_fn, *arrs, iters: int = 10) -> float:

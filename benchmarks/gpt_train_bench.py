@@ -57,6 +57,13 @@ def main() -> None:
                         "(default: the ~8E/3 convention)")
     p.add_argument("--vocab", type=int, default=None,
                    help="default: 50257 (gpt) / 32000 (llama)")
+    p.add_argument("--experts", type=int, default=0,
+                   help="llama family only: >0 routes every block's MLP "
+                        "over this many SwiGLU experts (Mixtral-style)")
+    p.add_argument("--moe-top-k", type=int, default=2,
+                   help="experts per token (with --experts)")
+    p.add_argument("--moe-capacity", type=float, default=2.0,
+                   help="train capacity factor (with --experts)")
     p.add_argument("--steps", type=int, default=10)
     p.add_argument("--remat", default="none",
                    choices=["none", "dots", "full"],
@@ -66,6 +73,12 @@ def main() -> None:
                    help="parameter storage dtype; bfloat16 halves "
                         "weight+optimizer HBM (how the 1B shape fits "
                         "one chip)")
+    p.add_argument("--param-update", default="plain",
+                   choices=["plain", "stochastic_round", "f32_master"],
+                   help="bf16-storage update rule "
+                        "(train/mixed_precision.py); the 1B headline "
+                        "uses stochastic_round — same memory as plain, "
+                        "f32-equivalent convergence (docs/CONVERGENCE.md)")
     p.add_argument("--chunk-size", type=int, default=None,
                    help="fused-CE vocab chunk (memory valve)")
     p.add_argument("--fused-ce", type=int, default=1,
@@ -93,11 +106,17 @@ def main() -> None:
                       num_heads=args.heads, num_kv_heads=args.kv_heads,
                       intermediate_dim=args.intermediate,
                       attention="flash", remat=args.remat,
+                      moe_experts=args.experts, moe_top_k=args.moe_top_k,
+                      moe_capacity_factor=args.moe_capacity,
                       dtype=jnp.bfloat16, param_dtype=param_dtype)
     B, S = args.batch, args.seq
     tokens = jax.random.randint(jax.random.key(0), (B, S), 0, args.vocab)
     targets = jax.random.randint(jax.random.key(1), (B, S), 0, args.vocab)
     tx = optax.adamw(1e-4)
+    if args.param_update != "plain":
+        from pddl_tpu.train.mixed_precision import wrap_param_update
+
+        tx = wrap_param_update(tx, args.param_update)
 
     def init(rng):
         params = model.init(rng, tokens[:1], train=False)["params"]
@@ -136,7 +155,20 @@ def main() -> None:
 
     toks = B * S / dt
     n_params = sum(x.size for x in jax.tree.leaves(state.params))
-    mfu = 6 * n_params * toks / V5E_BF16_PEAK_FLOPS
+    # MoE: 6ND must count ACTIVE params per token — each token runs
+    # top_k of the n experts, so expert weights contribute top_k/n of
+    # their size (router + dense weights count fully). For dense models
+    # n_active == n_params.
+    expert_params = sum(
+        leaf.size
+        for path, leaf in jax.tree_util.tree_flatten_with_path(
+            state.params)[0]
+        if "moe" in jax.tree_util.keystr(path)
+        and "router" not in jax.tree_util.keystr(path))
+    n_active = n_params - expert_params
+    if args.experts:
+        n_active += expert_params * args.moe_top_k // args.experts
+    mfu = 6 * n_active * toks / V5E_BF16_PEAK_FLOPS
     # Human-readable lines on stderr, ONE JSON line on stdout (the
     # bench.py contract: callers may json.loads captured stdout).
     print(f"{n_params / 1e6:.0f}M params, B{B} S{S} bf16 "
@@ -152,8 +184,10 @@ def main() -> None:
     size_tag = ("small" if n_params < 5e8
                 else f"{rounded}b" if abs(gb - rounded) / rounded <= 0.15
                 else f"{gb:.1f}b")
+    family_tag = (f"{args.family}_moe{args.experts}top{args.moe_top_k}"
+                  if args.experts else args.family)
     record = {
-        "metric": f"{args.family}_{size_tag}_train_tokens_per_sec_per_chip",
+        "metric": f"{family_tag}_{size_tag}_train_tokens_per_sec_per_chip",
         "value": round(toks, 1),
         "unit": "tokens/sec/chip",
         "mfu_6nd": round(mfu, 4),
@@ -165,10 +199,16 @@ def main() -> None:
                    "remat": args.remat, "fused_ce": bool(args.fused_ce),
                    "attention": "flash", "dtype": "bfloat16",
                    "param_dtype": args.param_dtype,
+                   "param_update": args.param_update,
                    "chunk_size": args.chunk_size if args.fused_ce else None,
                    "steps": args.steps},
         "device": jax.devices()[0].device_kind,
     }
+    if args.experts:
+        record["config"]["experts"] = args.experts
+        record["config"]["moe_top_k"] = args.moe_top_k
+        record["config"]["moe_capacity_factor"] = args.moe_capacity
+        record["config"]["params_active_m"] = round(n_active / 1e6, 1)
     if args.family == "llama":
         record["config"]["kv_heads"] = args.kv_heads
         # Record the RESOLVED SwiGLU width (the model's ~8E/3 convention

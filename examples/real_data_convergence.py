@@ -205,7 +205,8 @@ def run_digits(work_dir: str, out_path: str) -> dict:
 def run_pycorpus(work_dir: str, out_path: str, *,
                  model_name: str = "gpt_small",
                  track_name: str = "pycorpus",
-                 param_dtype: str = "float32") -> dict:
+                 param_dtype: str = "float32",
+                 param_update: str = "plain") -> dict:
     from pddl_tpu.config import get_preset
     from pddl_tpu.run import run_experiment
 
@@ -222,7 +223,7 @@ def run_pycorpus(work_dir: str, out_path: str, *,
         learning_rate=3e-4, lr_schedule="cosine",
         lr_schedule_options={"decay_steps": 3000, "warmup_steps": 100},
         epochs=10, steps_per_epoch=300, seed=0, verbose=0,
-        param_dtype=param_dtype,
+        param_dtype=param_dtype, param_update=param_update,
     )
     if SMOKE:
         tiny = "tiny_llama" if "llama" in model_name else "tiny_gpt"
@@ -242,7 +243,7 @@ def run_pycorpus(work_dir: str, out_path: str, *,
         "steps": cfg.epochs * cfg.steps_per_epoch,
         "optimizer": cfg.optimizer, "learning_rate": cfg.learning_rate,
         "lr_schedule": cfg.lr_schedule, **cfg.lr_schedule_options,
-        "param_dtype": cfg.param_dtype,
+        "param_dtype": cfg.param_dtype, "param_update": cfg.param_update,
         "wall_seconds": round(elapsed, 1),
     }
     _write_history(out_path, header, history)
@@ -263,7 +264,7 @@ def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     p.add_argument("--track",
                    choices=("digits", "pycorpus", "pycorpus-llama",
-                            "bf16-recipe", "all"),
+                            "bf16-recipe", "bf16-recipe-safe", "all"),
                    default="all")
     p.add_argument("--work-dir", default="/tmp/pddl_tpu_real_data",
                    help="where datasets are materialized (not committed)")
@@ -309,6 +310,20 @@ def main(argv=None) -> int:
         delta = (results["bf16_recipe_bf16"]["final_val_loss_nats"]
                  - results["bf16_recipe_f32"]["final_val_loss_nats"])
         results["bf16_minus_f32_final_val_nats"] = round(delta, 5)
+    if args.track == "bf16-recipe-safe":
+        # The round-5 fix for the +2.4%: same 304M shape, same budget,
+        # bf16 storage under the two safe update rules
+        # (train/mixed_precision.py). Compared against the committed
+        # round-4 f32/bf16-plain curves (same corpus/seed/schedule).
+        model = "llama_300m" if not SMOKE else "tiny_llama"
+        for mode in ("stochastic_round", "f32_master"):
+            tag = "sr" if mode == "stochastic_round" else "master"
+            results[f"bf16_safe_{tag}"] = run_pycorpus(
+                args.work_dir,
+                os.path.join(args.artifacts_dir,
+                             f"pycorpus_300m_bf16_{tag}.jsonl"),
+                model_name=model, track_name=f"bf16-recipe-{tag}",
+                param_dtype="bfloat16", param_update=mode)
     print(json.dumps(results, indent=2))
     return 0
 

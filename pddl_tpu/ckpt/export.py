@@ -79,3 +79,121 @@ def load_inference_artifact(path_or_bytes) -> Tuple[Any, Any]:
             data = f.read()
     exported = jax_export.deserialize(data)
     return exported.call, exported
+
+
+# --------------------------------------------------------------- decode
+def export_decode_programs(
+    model,
+    params: PyTree,
+    *,
+    batch: int,
+    prompt_len: int,
+    max_new_tokens: int,
+    temperature: float = 0.0,
+    top_k: Optional[int] = None,
+    top_p: Optional[float] = None,
+    platforms: Optional[Sequence[str]] = None,
+) -> dict:
+    """Serialize the full GENERATION pipeline as two StableHLO programs.
+
+    ``model.save``-then-serve is the endpoint of every reference script
+    (``/root/reference/imagenet-resnet50.py:72``); for the LM families
+    the serving artifact is generation, not a single forward. This
+    exports the same two programs :func:`pddl_tpu.models.gpt.generate`
+    dispatches (models/gpt.py `_decode_programs`) — nothing here is a
+    re-implementation of decoding:
+
+    - ``prefill``: ``(params, prompt i32[B,P]) -> (cache, logits)`` —
+      builds the zero cache internally and runs the batched prompt pass;
+    - ``decode``: ``(params, cache, logits, key_data u32[2]) ->
+      tokens i32[B,T]`` — the ENTIRE ``max_new_tokens`` loop as the one
+      on-device ``lax.scan`` dispatch, sampling included.
+
+    Parameters are call ARGUMENTS (new checkpoints of the same shape
+    reuse the artifact; weights never bloat the program). The RNG enters
+    as raw ``uint32[2]`` key data (``jax.random.key_data``) so the
+    serving boundary carries no JAX-extended dtypes. The KV-cache tree
+    flows between the two calls opaquely — a server treats it as a
+    buffer list. The whole decode path is pure jnp/lax
+    (``ops/attention.py decode_attention`` — chunked sweep, no custom
+    calls), so the artifact round-trips through any XLA runtime on the
+    recorded platforms.
+
+    Returns ``{"prefill": bytes, "decode": bytes, "manifest": dict}``.
+    """
+    import numpy as np
+
+    from pddl_tpu.models.gpt import _decode_cache_shapes, _decode_fns
+
+    dec = model.clone(decode=True)
+    step_fn, decode_all = _decode_fns(dec, temperature, top_k, top_p,
+                                      max_new_tokens)
+    cache_shapes = _decode_cache_shapes(dec, batch)
+
+    def prefill(p, prompt):
+        cache = jax.tree.map(lambda sd: jnp.zeros(sd.shape, sd.dtype),
+                             cache_shapes)
+        return step_fn(p, cache, prompt)
+
+    def decode(p, cache, logits, key_data):
+        return decode_all(p, cache, logits,
+                          jax.random.wrap_key_data(key_data))
+
+    p_spec = jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(np.shape(x), x.dtype), params)
+    prompt_spec = jax.ShapeDtypeStruct((batch, prompt_len), jnp.int32)
+    cache_spec = jax.tree.map(
+        lambda sd: jax.ShapeDtypeStruct(sd.shape, sd.dtype), cache_shapes)
+    # The decode program's logits input is EXACTLY the prefill program's
+    # logits output (shape and dtype) — jax_export enforces dtypes
+    # strictly at call time, so derive both from the same trace.
+    logits_spec = jax.eval_shape(prefill, p_spec, prompt_spec)[1]
+    key_spec = jax.ShapeDtypeStruct((2,), jnp.uint32)
+
+    kw = {"platforms": tuple(platforms)} if platforms else {}
+    pre = jax_export.export(jax.jit(prefill), **kw)(p_spec, prompt_spec)
+    run = jax_export.export(jax.jit(decode), **kw)(
+        p_spec, cache_spec, logits_spec, key_spec)
+    manifest = {
+        "batch": batch, "prompt_len": prompt_len,
+        "max_new_tokens": max_new_tokens, "temperature": temperature,
+        "top_k": top_k, "top_p": top_p,
+        "platforms": list(pre.platforms),
+    }
+    return {"prefill": pre.serialize(), "decode": run.serialize(),
+            "manifest": manifest}
+
+
+def save_decode_artifact(path: str, *args, **kwargs) -> str:
+    """:func:`export_decode_programs` into ONE file (a zip with
+    ``prefill.stablehlo``, ``decode.stablehlo``, ``manifest.json``);
+    returns ``path``."""
+    import json
+    import zipfile
+
+    arts = export_decode_programs(*args, **kwargs)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with zipfile.ZipFile(tmp, "w") as z:
+        z.writestr("prefill.stablehlo", arts["prefill"])
+        z.writestr("decode.stablehlo", arts["decode"])
+        z.writestr("manifest.json", json.dumps(arts["manifest"]))
+    os.replace(tmp, path)
+    return path
+
+
+def load_decode_artifact(path: str):
+    """Deserialize a :func:`save_decode_artifact` file.
+
+    Returns ``(prefill, decode, manifest)`` where
+    ``prefill(params, prompt) -> (cache, logits)`` and
+    ``decode(params, cache, logits, key_data) -> tokens`` run the
+    compiled programs on this process's devices.
+    """
+    import json
+    import zipfile
+
+    with zipfile.ZipFile(path) as z:
+        pre = jax_export.deserialize(z.read("prefill.stablehlo"))
+        run = jax_export.deserialize(z.read("decode.stablehlo"))
+        manifest = json.loads(z.read("manifest.json"))
+    return pre.call, run.call, manifest

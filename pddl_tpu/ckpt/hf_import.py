@@ -325,22 +325,28 @@ def load_hf_llama(model_or_dir, variables: PyTree, *,
                         f"the checkpoint uses num_experts_per_tok="
                         f"{have_tk} — rebuild with moe_top_k={have_tk}"
                     )
-                # Mixtral routing is DROPLESS; our dense dispatch drops
-                # overflow beyond capacity_factor*k*S/n tokens per
-                # expert. Worst case every token picks the same expert,
-                # so droplessness needs capacity_factor >= n/k — below
-                # that an imbalanced prompt silently diverges from
-                # transformers' logits with no error.
-                want_cf = getattr(model, "moe_capacity_factor", None)
-                if want_cf is not None and want_cf < n_local / have_tk:
-                    raise ValueError(
-                        f"hf llama import: moe_capacity_factor={want_cf} "
-                        f"can drop routed tokens (dropless Mixtral needs "
-                        f">= num_local_experts/num_experts_per_tok = "
-                        f"{n_local / have_tk:g}) — rebuild with "
-                        f"moe_capacity_factor={n_local / have_tk:g} or "
-                        "higher for serving parity"
-                    )
+                # Mixtral routing is DROPLESS; the dense dispatch drops
+                # overflow beyond capacity_factor*k*S/n tokens per expert
+                # in TRAINING. Eval/serving (train=False) is dropless by
+                # construction when moe_eval_dropless is on (capacity ==
+                # top_k*S covers the all-tokens-to-one-expert worst
+                # case, ops/moe.py) — so inference parity needs no
+                # capacity_factor condition. Only a model that turned
+                # dropless eval OFF must carry a worst-case
+                # capacity_factor >= n/k, or an imbalanced prompt
+                # silently diverges from transformers' logits.
+                if not getattr(model, "moe_eval_dropless", False):
+                    want_cf = getattr(model, "moe_capacity_factor", None)
+                    if want_cf is not None and want_cf < n_local / have_tk:
+                        raise ValueError(
+                            f"hf llama import: moe_eval_dropless=False "
+                            f"with moe_capacity_factor={want_cf} can drop "
+                            f"routed tokens at inference (dropless "
+                            f"Mixtral needs >= num_local_experts/"
+                            f"num_experts_per_tok = {n_local / have_tk:g})"
+                            " — re-enable moe_eval_dropless or raise "
+                            "moe_capacity_factor for serving parity"
+                        )
     sd = {k: _np(v) for k, v in model_or_dir.state_dict().items()}
     prefix = "model." if any(k.startswith("model.") for k in sd) else ""
 

@@ -51,6 +51,12 @@ class ExperimentConfig:
     # docs/CONVERGENCE.md's f32-vs-bf16 comparison before using it for
     # quality-critical training.
     param_dtype: str = "float32"
+    # Update rule for bf16 param storage: "plain" (round-to-nearest,
+    # measured +2.4% val loss at 304M), "stochastic_round" (unbiased,
+    # same memory — the default recipe fix), or "f32_master" (exact
+    # master copy). Ignored for float32 params.
+    # See train/mixed_precision.py and docs/CONVERGENCE.md.
+    param_update: str = "plain"
     # transformer families only: activation rematerialization policy
     # ("none" | "dots" | "full" — models/vit.py REMAT_POLICIES)
     remat: Optional[str] = None

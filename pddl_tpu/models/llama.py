@@ -280,6 +280,7 @@ class LlamaBlock(nn.Module):
     moe_experts: int = 0  # >0: Mixtral-style routed SwiGLU experts
     moe_top_k: int = 2
     moe_capacity_factor: float = 2.0
+    moe_eval_dropless: bool = True  # eval/serving capacity = top_k*S
     rms_eps: float = 1e-5
     dtype: Any = jnp.float32
     param_dtype: Any = jnp.float32
@@ -287,9 +288,9 @@ class LlamaBlock(nn.Module):
     @nn.compact
     def __call__(self, x, train: bool = True, /):
         # train is positional-only for remat static_argnums — see
-        # vit.TransformerBlock. (SwiGLU has no dropout; the arg exists
-        # for block-interface parity.)
-        del train
+        # vit.TransformerBlock. (SwiGLU has no dropout; train gates the
+        # MoE capacity rule: routed blocks drop over-capacity tokens in
+        # training but run DROPLESS at eval/serving.)
         e = x.shape[-1]
         h = _rms_norm(self.rms_eps, self.param_dtype, "ln1")(x)
         h = LlamaAttention(
@@ -311,9 +312,10 @@ class LlamaBlock(nn.Module):
                 num_experts=self.moe_experts,
                 hidden_dim=self.intermediate_dim, top_k=self.moe_top_k,
                 capacity_factor=self.moe_capacity_factor,
+                eval_dropless=self.moe_eval_dropless,
                 expert_act="swiglu", dtype=self.dtype,
                 param_dtype=self.param_dtype, name="moe",
-            )(h)
+            )(h, train)
             return x + h
         dense = functools.partial(nn.Dense, use_bias=False, dtype=self.dtype,
                                   param_dtype=self.param_dtype)
@@ -352,6 +354,7 @@ class Llama(nn.Module):
     moe_top_k: int = 2  # Mixtral's num_experts_per_tok
     moe_every: int = 1  # Mixtral puts MoE in EVERY layer
     moe_capacity_factor: float = 2.0
+    moe_eval_dropless: bool = True  # eval/serving capacity = top_k*S
     rms_eps: float = 1e-5
     dtype: Any = jnp.float32
     param_dtype: Any = jnp.float32
@@ -389,6 +392,7 @@ class Llama(nn.Module):
                 decode=self.decode, max_decode_len=self.max_len,
                 moe_experts=moe, moe_top_k=self.moe_top_k,
                 moe_capacity_factor=self.moe_capacity_factor,
+                moe_eval_dropless=self.moe_eval_dropless,
                 rms_eps=self.rms_eps, dtype=self.dtype,
                 param_dtype=self.param_dtype, name=f"block{i}",
             )(x, train)

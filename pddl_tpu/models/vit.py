@@ -176,7 +176,7 @@ class TransformerBlock(nn.Module):
                 num_experts=self.moe_experts, mlp_ratio=self.mlp_ratio,
                 top_k=self.moe_top_k,
                 dtype=self.dtype, param_dtype=self.param_dtype, name="moe",
-            )(h.astype(self.dtype))
+            )(h.astype(self.dtype), train)
         else:
             h = nn.Dense(e * self.mlp_ratio, dtype=self.dtype,
                          param_dtype=self.param_dtype, name="mlp1")(h.astype(self.dtype))

@@ -912,11 +912,15 @@ def flash_attention_lse(
 # K-cache size (bytes, PER ARRAY — v doubles it) up to which a
 # single-token decode step reads the WHOLE cache in one fused pass
 # instead of the chunked loop. The loop's while/dynamic-slice machinery
-# is a FIXED ~30 µs/layer; the extra read scales with batch x cache, so
-# the gate is bytes-based: ~2 MB of K cache (+2 of V) costs ~5 µs extra
-# read — below the loop cost — while a large-batch or long cache falls
-# back to the prefix-bounded sweep.
-_SINGLE_SHOT_MAX_KC_BYTES = 2 * 1024 * 1024
+# is a fixed per-layer cost; the extra read scales with batch x cache,
+# so the gate is bytes-based. Re-measured in round 5 under value-fetch
+# syncs (block_until_ready is not a reliable barrier on the tunneled
+# transport, so the round-4 placement at 2 MB was tuned on bad timing):
+# at 0.5 MB/layer (llama-small GQA) single-shot wins ~8%; at 1.5 MB
+# (GPT-small MHA) the prefix-bounded sweep wins ~7% at B1 and ~9% at B8
+# (benchmarks/decode_attribution.py). The crossover sits between, so
+# the gate is 1 MB.
+_SINGLE_SHOT_MAX_KC_BYTES = 1024 * 1024
 
 
 def decode_attention(

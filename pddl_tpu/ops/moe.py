@@ -60,11 +60,20 @@ class SwitchFFN(nn.Module):
     expert_act: str = "gelu"  # "gelu" | "swiglu" (Mixtral)
     normalize_gates: bool = True  # top_k >= 2: g_j / sum_j g_j
     aux_loss_weight: float = 0.01
+    # Eval/serving (train=False) uses capacity == top_k * seq — enough
+    # for the worst case (every token routed to ONE expert), so
+    # inference is DROPLESS regardless of capacity_factor. Real Mixtral
+    # checkpoints assume dropless routing; without this, an imbalanced
+    # prompt silently diverges from the reference logits. The price is
+    # dispatch/combine tensors growing to [B, S, N, top_k*S] at eval.
+    eval_dropless: bool = True
     dtype: Any = jnp.float32
     param_dtype: Any = jnp.float32
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, train: bool = True, /):
+        # train is positional-only to match the transformer blocks'
+        # remat static_argnums convention (vit.TransformerBlock).
         b, s, d = x.shape
         n = self.num_experts
         if not 1 <= self.top_k <= n:
@@ -76,7 +85,10 @@ class SwitchFFN(nn.Module):
         # dim): capacity is per group, so dispatch/combine are
         # [B, S, N, C] — linear in batch, never quadratic in total tokens.
         # top-2 doubles routed token-slots, so capacity scales with k.
-        capacity = max(1, int(self.capacity_factor * self.top_k * s / n))
+        if not train and self.eval_dropless:
+            capacity = self.top_k * s
+        else:
+            capacity = max(1, int(self.capacity_factor * self.top_k * s / n))
         hidden = self.hidden_dim if self.hidden_dim is not None \
             else d * self.mlp_ratio
 
@@ -129,6 +141,15 @@ class SwitchFFN(nn.Module):
         mean_prob = jnp.mean(probs, axis=(0, 1))
         aux = self.aux_loss_weight * n * jnp.sum(frac * mean_prob)
         self.sow("losses", "moe_aux_loss", aux)
+        # Measured capacity-drop observable: the fraction of routed
+        # token-slots (top_k per token) whose expert queue was already
+        # full, i.e. tokens this layer silently skipped. `offset` is the
+        # kept count per (batch row, expert) after all k choices. Sown
+        # into "metrics" (surfaced into the training logs by the
+        # Trainer); exactly 0.0 on the dropless eval path.
+        kept = jnp.sum(offset)
+        drop_rate = 1.0 - kept / (b * s * self.top_k)
+        self.sow("metrics", "moe_drop_rate", drop_rate)
 
         dispatch = dispatch.astype(self.dtype)
         combine = combine.astype(self.dtype)

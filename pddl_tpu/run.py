@@ -101,6 +101,7 @@ def build_trainer(cfg: ExperimentConfig, strategy=None):
             lr_schedule_options=schedule_options,
             ema_decay=cfg.ema_decay,
             gradient_accumulation_steps=cfg.gradient_accumulation_steps,
+            param_update=cfg.param_update,
         )
     else:
         # Crop never exceeds the input (the reference's RandomCrop(244) on
@@ -120,6 +121,7 @@ def build_trainer(cfg: ExperimentConfig, strategy=None):
             lr_schedule_options=schedule_options,
             ema_decay=cfg.ema_decay,
             gradient_accumulation_steps=cfg.gradient_accumulation_steps,
+            param_update=cfg.param_update,
         )
 
     callbacks = []
@@ -474,6 +476,10 @@ def main(argv=None) -> int:
     p.add_argument("--vocab-multiple", type=int, default=None,
                    help="pad the LM vocab dim to a multiple (enables "
                         "vocab-parallel TP on real vocab sizes)")
+    p.add_argument("--param-update", default=None,
+                   choices=["plain", "stochastic_round", "f32_master"],
+                   help="update rule for bf16 param storage "
+                        "(train/mixed_precision.py); ignored for f32")
     p.add_argument("--remat", default=None, choices=["none", "dots", "full"],
                    help="activation rematerialization for transformer "
                         "models (trade recompute for HBM)")
@@ -519,6 +525,7 @@ def main(argv=None) -> int:
         "num_classes": args.num_classes, "seq_len": args.seq_len,
         "vocab_multiple": args.vocab_multiple,
         "remat": args.remat, "stem": args.stem,
+        "param_update": args.param_update,
         "model": args.model, "strategy": args.strategy,
         "pretrained_h5": args.pretrained_h5,
         "checkpoint_dir": args.checkpoint_dir,

@@ -82,6 +82,10 @@ class Trainer:
         # reduction noise (~1e-3 relative) from semantic errors like a
         # psum-where-pmean-belongs (device_count x).
         log_grad_norm: bool = False,
+        # Low-precision parameter-update rule for bf16 param storage:
+        # "plain" | "stochastic_round" | "f32_master"
+        # (train/mixed_precision.py). No-op for f32 params.
+        param_update: str = "plain",
     ):
         self.model = model
         self.input_key = input_key
@@ -91,6 +95,7 @@ class Trainer:
             optimizer, learning_rate,
             schedule=lr_schedule, schedule_options=lr_schedule_options,
             accumulate_steps=gradient_accumulation_steps,
+            param_update=param_update, update_seed=seed,
         )
         self.ema_decay = ema_decay
         self.eval_with_ema = eval_with_ema
@@ -156,11 +161,33 @@ class Trainer:
             # (pddl_tpu/ops/moe.py); train AND eval steps add them to the
             # task loss (Keras add_loss semantics: evaluate() includes
             # add_loss terms, so train loss and val_loss stay comparable).
-            collections = ["batch_stats", "losses"] if train else ["losses"]
+            # "metrics" collects model-internal observables (e.g. the MoE
+            # capacity drop rate) — logged, never added to the loss.
+            collections = (["batch_stats", "losses", "metrics"] if train
+                           else ["losses", "metrics"])
             return self.model.apply(
                 variables, images, mutable=collections, **kwargs
             )
         return self.model.apply(variables, images, **kwargs), {}
+
+    @staticmethod
+    def _sown_metrics(updates) -> Dict[str, jnp.ndarray]:
+        """Aggregate model-internal observables sown into "metrics".
+
+        Leaves sharing a name (one per MoE block, say) are averaged into
+        one log entry — e.g. ``moe_drop_rate`` = mean fraction of routed
+        token-slots dropped at capacity, across routed blocks.
+        """
+        groups: Dict[str, list] = {}
+        flat = jax.tree_util.tree_flatten_with_path(
+            updates.get("metrics", {}))[0]
+        for path, leaf in flat:
+            names = [p.key for p in path
+                     if isinstance(p, jax.tree_util.DictKey)]
+            if names:
+                groups.setdefault(str(names[-1]), []).append(leaf)
+        return {name: sum(vals) / len(vals)
+                for name, vals in groups.items()}
 
     def _build_steps(self) -> None:
         batch_sh = self.strategy.batch_sharding()
@@ -199,6 +226,7 @@ class Trainer:
                 logs["grad_norm"] = optax.global_norm(grads)
             for name, fn in self.metric_fns.items():
                 logs[name] = fn(logits, labels)
+            logs.update(self._sown_metrics(updates))
             return new_state, logs
 
         def eval_step(state: TrainState, batch):
@@ -225,6 +253,7 @@ class Trainer:
             logs = {"loss": loss}
             for name, fn in self.metric_fns.items():
                 logs[name] = fn(logits, labels)
+            logs.update(self._sown_metrics(updates))
             return logs
 
         batch_shardings = {self.input_key: batch_sh, self.target_key: batch_sh}

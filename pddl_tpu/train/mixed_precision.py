@@ -1,0 +1,218 @@
+"""Safe parameter-update rules for low-precision parameter storage.
+
+The bf16 storage recipe (``config.py param_dtype`` — how the 1B llama
+fits one v5e chip) carries a measured quality cost: +0.0244 nats (+2.4%
+val loss) at the 304M/3k-step pycorpus budget (docs/CONVERGENCE.md,
+round 4). The physical cause is round-to-nearest on the parameter
+update: with LR ~3e-4 the per-step update is ~1e-4 of the parameter
+scale while a bf16 ulp is ~0.4% relative (8 mantissa bits), so most
+updates round to ZERO and their information is lost — a systematic
+bias, not noise.
+
+(It is the *update* that is at fault, not the moments: Adam's moments
+under bf16 params silently settle in f32 anyway — the f32 hyperparams
+pinned in ``make_optimizer`` promote ``b1*mu + (1-b1)*g`` to f32 on the
+first step. ``make_optimizer`` now pins them f32 from ``init`` so the
+state dtype is stable (no hidden step-2 retrace) and the memory
+arithmetic below is honest.)
+
+Two optax wrappers erase the bias, trading memory differently
+(bytes per parameter, Adam):
+
+==========================  =======  ==================================
+recipe                      bytes/p  quality mechanism
+==========================  =======  ==================================
+f32 everything                   12  baseline
+bf16 plain                       10  none — loses sub-ulp updates
+bf16 + stochastic_round          10  unbiased rounding: E[round(x)]=x,
+                                     a sub-ulp update lands with
+                                     probability update/ulp, so updates
+                                     accumulate correctly in expectation
+bf16 + f32_master                14  exact: the f32 master accumulates
+                                     every update; bf16 params are a
+                                     cast of it
+==========================  =======  ==================================
+
+``stochastic_round`` is the headline fix: SAME memory as the plain bf16
+recipe (the RNG key is 8 bytes total), strictly better convergence.
+``f32_master`` is the exactness gold standard — more total HBM than
+pure f32; its bf16 params buy *bandwidth* (matmul reads) and activation
+dtype, not capacity. Under the PS/ZeRO strategy both wrappers' extra
+state (master copy) shards over the data axis like any other optimizer
+leaf, so per-chip cost divides by the axis size.
+
+Both wrap the INJECTED optimizer chain (inside grad-clip and
+``optax.MultiSteps``) and keep their state as NamedTuples so
+``get_learning_rate``/``set_learning_rate``'s tuple recursion reaches
+the inner ``inject_hyperparams`` state unchanged.
+
+Reference stake: the reference's deliverable is a *trained* model
+(``/root/reference/imagenet-resnet50.py:67``) — a memory recipe that
+trains worse is not parity. Measured end-to-end by
+``examples/real_data_convergence.py --track bf16-recipe-safe``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax
+
+PyTree = Any
+
+
+def _f32(tree: PyTree) -> PyTree:
+    return jax.tree.map(lambda x: x.astype(jnp.float32), tree)
+
+
+def _sr_to_bf16(x32: jnp.ndarray, key: jnp.ndarray) -> jnp.ndarray:
+    """Stochastically round an f32 array to bf16.
+
+    bf16 is f32 with the low 16 mantissa bits dropped, so adding a
+    uniform random 16-bit integer to the f32 bit pattern and truncating
+    implements exact stochastic rounding: the probability of rounding up
+    equals the truncated fraction, and the truncated-bits-zero f32 is
+    value-identical to its bf16 cast.
+    """
+    bits = lax.bitcast_convert_type(x32, jnp.uint32)
+    noise = jax.random.bits(key, x32.shape, jnp.uint32) & jnp.uint32(0xFFFF)
+    rounded = (bits + noise) & jnp.uint32(0xFFFF0000)
+    return lax.bitcast_convert_type(rounded, jnp.float32).astype(jnp.bfloat16)
+
+
+class StochasticRoundState(NamedTuple):
+    key: jnp.ndarray  # raw uint32 PRNG key (orbax-serializable)
+    inner: optax.OptState
+
+
+def stochastic_round_update(
+    inner: optax.GradientTransformation, *, seed: int = 0,
+) -> optax.GradientTransformation:
+    """Apply ``inner``'s updates to bf16 params with stochastic rounding.
+
+    The inner optimizer runs in f32 (f32 grads in, f32-initialized
+    moments). Emitted updates ``u`` are built so ``optax.apply_updates``
+    reproduces the stochastically-rounded new parameters bit-for-bit:
+    the rounded value and the old parameter are both exactly
+    representable in f32, so ``f32(new) - f32(old)``, added back in f32
+    and cast, is lossless. Non-bf16 leaves pass the inner update through
+    untouched.
+    """
+
+    def init(params: PyTree) -> StochasticRoundState:
+        return StochasticRoundState(
+            key=jax.random.PRNGKey(seed), inner=inner.init(_f32(params)))
+
+    def update(grads, state, params=None):
+        if params is None:
+            raise ValueError("stochastic_round_update requires params")
+        u, inner_state = inner.update(_f32(grads), state.inner, _f32(params))
+        key, sub = jax.random.split(state.key)
+        leaves, treedef = jax.tree.flatten(params)
+        u_leaves = treedef.flatten_up_to(u)
+        out = []
+        for i, (p, du) in enumerate(zip(leaves, u_leaves)):
+            if p.dtype != jnp.bfloat16:
+                out.append(du)
+                continue
+            new32 = p.astype(jnp.float32) + du.astype(jnp.float32)
+            new16 = _sr_to_bf16(new32, jax.random.fold_in(sub, i))
+            out.append(new16.astype(jnp.float32) - p.astype(jnp.float32))
+        return (jax.tree.unflatten(treedef, out),
+                StochasticRoundState(key=key, inner=inner_state))
+
+    return optax.GradientTransformation(init, update)
+
+
+class F32MasterState(NamedTuple):
+    master: PyTree
+    inner: optax.OptState
+
+
+def f32_master_update(
+    inner: optax.GradientTransformation,
+) -> optax.GradientTransformation:
+    """Keep an f32 master copy; bf16 stored params are a cast of it.
+
+    The inner optimizer runs entirely against the f32 master (so its
+    moments are f32 too), every update accumulates exactly, and the
+    emitted update rebases the stored params onto ``cast(master)`` —
+    ``f32(cast(new_master)) - f32(params)`` is exact in f32, so
+    ``optax.apply_updates`` reproduces the cast bit-for-bit. Leaves
+    already in f32 (or any non-bf16 dtype) receive the inner update
+    directly and their master stays equal to them by construction.
+    """
+
+    def init(params: PyTree) -> F32MasterState:
+        if not any(leaf.dtype == jnp.bfloat16
+                   for leaf in jax.tree.leaves(params)):
+            # No bf16 leaves: a master copy would duplicate every
+            # parameter (+4 bytes/param of optimizer state) for zero
+            # behavioral change — make the documented "no-op for f32
+            # params" literal. master=None marks the pass-through.
+            return F32MasterState(master=None, inner=inner.init(params))
+        master = _f32(params)
+        return F32MasterState(master=master, inner=inner.init(master))
+
+    def update(grads, state, params=None):
+        if params is None:
+            raise ValueError("f32_master_update requires params")
+        if state.master is None:
+            u, inner_state = inner.update(grads, state.inner, params)
+            return u, F32MasterState(master=None, inner=inner_state)
+        u, inner_state = inner.update(_f32(grads), state.inner, state.master)
+        new_master = optax.apply_updates(state.master, u)
+
+        def emit(m_new, p, du):
+            if p.dtype == jnp.bfloat16:
+                return (m_new.astype(jnp.bfloat16).astype(jnp.float32)
+                        - p.astype(jnp.float32))
+            return du
+
+        out = jax.tree.map(emit, new_master, params, u)
+        return out, F32MasterState(master=new_master, inner=inner_state)
+
+    return optax.GradientTransformation(init, update)
+
+
+def stabilize_moment_dtype(
+    tx: optax.GradientTransformation,
+) -> optax.GradientTransformation:
+    """Pin bf16 optimizer-state leaves (Adam moments, SGD traces) to f32
+    at ``init``.
+
+    They settle there after one update regardless — the f32 hyperparams
+    pinned in ``make_optimizer`` promote ``b1*mu + (1-b1)*g`` to f32 —
+    so initializing them bf16 only buys a hidden retrace of the jitted
+    train step at step 2 when the state signature changes. A no-op for
+    f32 params.
+    """
+
+    def init(params: PyTree) -> optax.OptState:
+        return jax.tree.map(
+            lambda l: l.astype(jnp.float32)
+            if getattr(l, "dtype", None) == jnp.bfloat16 else l,
+            tx.init(params))
+
+    return optax.GradientTransformation(init, tx.update)
+
+
+#: config-string → wrapper registry (``config.param_update``).
+PARAM_UPDATE_MODES = ("plain", "stochastic_round", "f32_master")
+
+
+def wrap_param_update(
+    tx: optax.GradientTransformation, mode: str, *, seed: int = 0,
+) -> optax.GradientTransformation:
+    """Apply a :data:`PARAM_UPDATE_MODES` wrapper to a built chain."""
+    if mode == "plain":
+        return tx
+    if mode == "stochastic_round":
+        return stochastic_round_update(tx, seed=seed)
+    if mode == "f32_master":
+        return f32_master_update(tx)
+    raise ValueError(
+        f"unknown param_update {mode!r}; known: {PARAM_UPDATE_MODES}")

@@ -221,6 +221,8 @@ def make_optimizer(
     weight_decay: Optional[float] = None,
     grad_clip_norm: Optional[float] = None,
     accumulate_steps: Optional[int] = None,
+    param_update: str = "plain",
+    update_seed: int = 0,
     **kwargs,
 ) -> optax.GradientTransformation:
     """Build an optimizer with a state-injected (callback-adjustable) LR.
@@ -237,6 +239,13 @@ def make_optimizer(
     at 32/replica (``imagenet-resnet50-mirror.py:54``) still trains with
     identical optimizer math. Schedules then count *optimizer* updates,
     not micro-steps.
+
+    ``param_update`` selects the low-precision update rule for bf16
+    parameter storage (:mod:`pddl_tpu.train.mixed_precision`):
+    ``"plain"`` (round-to-nearest — loses sub-ulp updates, the measured
+    +2.4% recipe), ``"stochastic_round"`` (unbiased rounding, same
+    memory), or ``"f32_master"`` (exact f32 master copy). A no-op for
+    f32 params.
     """
     if isinstance(name, optax.GradientTransformation):
         # A prebuilt transformation: chain-level options still compose;
@@ -249,11 +258,21 @@ def make_optimizer(
                 "them, or pass the optimizer by name"
             )
         tx = name
+        from pddl_tpu.train.mixed_precision import (
+            stabilize_moment_dtype,
+            wrap_param_update,
+        )
+
+        # param_update composes with a prebuilt chain the same way the
+        # factory path does — silently ignoring it would train with the
+        # biased plain rule while config/logs claim otherwise.
+        if param_update != "plain":
+            tx = wrap_param_update(tx, param_update, seed=update_seed)
         if grad_clip_norm is not None:
             tx = optax.chain(optax.clip_by_global_norm(grad_clip_norm), tx)
         if accumulate_steps is not None and accumulate_steps > 1:
             tx = optax.MultiSteps(tx, every_k_schedule=accumulate_steps)
-        return tx
+        return stabilize_moment_dtype(tx)
     try:
         factory = _OPTIMIZERS[name.lower()]
     except KeyError:
@@ -297,11 +316,23 @@ def make_optimizer(
               else optax.inject_hyperparams(factory,
                                             hyperparam_dtype=jnp.float32))
     tx = inject(learning_rate=lr, **kwargs)
+    from pddl_tpu.train.mixed_precision import (
+        stabilize_moment_dtype,
+        wrap_param_update,
+    )
+
+    if param_update != "plain":
+        tx = wrap_param_update(tx, param_update, seed=update_seed)
     if grad_clip_norm is not None:
         tx = optax.chain(optax.clip_by_global_norm(grad_clip_norm), tx)
     if accumulate_steps is not None and accumulate_steps > 1:
         tx = optax.MultiSteps(tx, every_k_schedule=accumulate_steps)
-    return tx
+    # Under bf16 params, f32 hyperparams promote every floating state
+    # leaf (Adam moments, MultiSteps' grad accumulator) to f32 on the
+    # FIRST update anyway; pinning them f32 from init keeps the jitted
+    # step's state signature stable (no hidden step-2 retrace) and makes
+    # the recipe's memory honest: bf16 params, f32 optimizer state.
+    return stabilize_moment_dtype(tx)
 
 
 def _find_hyperparams(opt_state) -> Optional[dict]:

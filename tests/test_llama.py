@@ -614,13 +614,58 @@ def test_hf_mixtral_rejects_wrong_expert_config():
     with pytest.raises(ValueError, match="experts"):
         load_hf_mixtral(hf, v, model=wrong_n)
 
-    # Undersized capacity would silently drop routed tokens that
-    # transformers' dropless Mixtral keeps — must be rejected up front.
+    # Undersized TRAIN capacity is fine for serving since round 5: eval
+    # runs dropless by construction (ops/moe.py eval_dropless), so the
+    # import succeeds...
     droppy = _model(intermediate_dim=64, rms_eps=1e-6, moe_experts=4,
                     moe_top_k=2, moe_capacity_factor=1.0)
     v = droppy.init(jax.random.key(0), tokens, train=False)
+    load_hf_mixtral(hf, v, model=droppy)
+    # ...but a model that turned dropless eval OFF would silently drop
+    # routed tokens transformers' dropless Mixtral keeps — still
+    # rejected up front.
+    droppy_off = _model(intermediate_dim=64, rms_eps=1e-6, moe_experts=4,
+                        moe_top_k=2, moe_capacity_factor=1.0,
+                        moe_eval_dropless=False)
+    v = droppy_off.init(jax.random.key(0), tokens, train=False)
     with pytest.raises(ValueError, match="capacity"):
-        load_hf_mixtral(hf, v, model=droppy)
+        load_hf_mixtral(hf, v, model=droppy_off)
+
+
+def test_hf_mixtral_dropless_eval_parity_under_imbalance():
+    """The round-5 dropless-eval guarantee, proven against transformers:
+    force PATHOLOGICAL routing (router biased so every token's top-2 is
+    experts 0 and 1 — 4x over a capacity_factor=1 budget) and the
+    imported model's eval logits must STILL match HF's dropless Mixtral.
+    Before eval_dropless this configuration silently zeroed most routed
+    tokens' expert outputs."""
+    import torch as _torch
+
+    from pddl_tpu.ckpt.hf_import import load_hf_mixtral
+
+    hf, _ = _mixtral_pair()
+    # Bias every layer's router hard toward experts 0 and 1.
+    with _torch.no_grad():
+        for layer in hf.model.layers:
+            gate = layer.block_sparse_moe.gate
+            gate.weight.zero_()
+            gate.weight[0, :] = 5.0
+            gate.weight[1, :] = 4.0
+    ours = _model(intermediate_dim=64, rms_eps=1e-6, moe_experts=4,
+                  moe_top_k=2, moe_capacity_factor=1.0)
+    tokens = _tokens()
+    v = ours.init(jax.random.key(0), tokens, train=False)
+    v = load_hf_mixtral(hf, v, model=ours)
+    with _torch.no_grad():
+        ref = hf(_torch.from_numpy(
+            np.asarray(tokens, np.int64))).logits.numpy()
+    got = np.asarray(ours.apply(v, tokens, train=False))
+    np.testing.assert_allclose(got, ref, atol=3e-4, rtol=3e-4)
+    # The TRAIN path at this capacity genuinely drops (the scenario is
+    # real): its output must differ from the dropless eval one.
+    got_train, _ = ours.apply(v, tokens, train=True,
+                              mutable=["losses", "metrics"])
+    assert not np.allclose(np.asarray(got_train), got, atol=1e-3)
 
 
 def test_hf_mixtral_export_roundtrips_into_transformers():

@@ -1,0 +1,238 @@
+"""Low-precision parameter-update rules (train/mixed_precision.py).
+
+The plain bf16 recipe rounds most sub-ulp updates to zero (the measured
++2.4% val-loss cost, docs/CONVERGENCE.md); these tests pin the two
+fixes' defining properties: stochastic rounding is *unbiased* and lets
+sub-ulp updates accumulate, the f32 master is *exact*, and both compose
+with the injected-hyperparam chain (LR callbacks), MultiSteps, and the
+Trainer.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from pddl_tpu.train.mixed_precision import (
+    _sr_to_bf16,
+    f32_master_update,
+    stabilize_moment_dtype,
+    stochastic_round_update,
+)
+from pddl_tpu.train.state import (
+    get_learning_rate,
+    make_optimizer,
+    set_learning_rate,
+)
+
+
+def _state_dtypes(state):
+    return [l.dtype for l in jax.tree.leaves(state) if hasattr(l, "dtype")]
+
+
+def test_sr_is_unbiased_and_lands_on_neighbors():
+    """SR of x must yield only the two bracketing bf16 values, with mean
+    converging to x (unbiasedness is the whole point)."""
+    lo = jnp.float32(1.0)
+    ulp = jnp.float32(np.spacing(np.float32(1.0)) * 2**16)  # bf16 ulp at 1.0
+    frac = 0.3
+    x = jnp.full((4096,), lo + frac * ulp, jnp.float32)
+    out = _sr_to_bf16(x, jax.random.PRNGKey(0)).astype(jnp.float32)
+    vals = np.unique(np.asarray(out))
+    np.testing.assert_array_equal(vals, [1.0, 1.0 + float(ulp)])
+    p_up = float((out > lo).mean())
+    assert abs(p_up - frac) < 0.03, p_up  # 4096 samples: ~0.007 stderr
+
+
+def test_sr_exact_values_round_trip():
+    """Values already representable in bf16 must never move."""
+    x = jnp.array([0.0, 1.0, -2.5, 0.00390625], jnp.float32)
+    out = _sr_to_bf16(x, jax.random.PRNGKey(1))
+    np.testing.assert_array_equal(np.asarray(out, np.float32),
+                                  np.asarray(x, np.float32))
+
+
+def test_sub_ulp_updates_accumulate_under_sr_not_plain():
+    """1000 SGD steps of -1e-4 on a bf16 param at 1.0 (ulp 0.0078): plain
+    rounding drops every step (param frozen); SR accumulates them to
+    ~0.9 in expectation."""
+    p = {"w": jnp.ones((256,), jnp.bfloat16)}
+    g = {"w": jnp.full((256,), 1e-4, jnp.float32)}
+    sgd = optax.sgd(1.0)
+
+    def run(tx):
+        state = tx.init(p)
+
+        def step(carry, _):
+            params, s = carry
+            u, s = tx.update({"w": g["w"].astype(params["w"].dtype)}, s,
+                             params)
+            return (optax.apply_updates(params, u), s), None
+
+        (pf, _), _ = jax.lax.scan(step, (p, state), None, length=1000)
+        return float(pf["w"].astype(jnp.float32).mean())
+
+    frozen = run(sgd)
+    assert frozen == 1.0, frozen  # every update lost to round-to-nearest
+    moved = run(stochastic_round_update(sgd, seed=0))
+    assert abs(moved - 0.9) < 0.01, moved
+    exact = run(f32_master_update(sgd))
+    # master accumulates exactly; stored bf16 is the cast of 0.9
+    assert abs(exact - 0.9) < 0.004, exact
+
+
+def test_f32_master_matches_f32_reference_exactly():
+    """With identical external grads, the master trajectory must be
+    bit-identical to running the same optimizer on f32 params."""
+    tx = optax.adam(1e-2)
+    wrapped = f32_master_update(tx)
+    p16 = {"w": jnp.linspace(-1, 1, 64).astype(jnp.bfloat16)}
+    p32 = jax.tree.map(lambda x: x.astype(jnp.float32), p16)
+    s16, s32 = wrapped.init(p16), tx.init(p32)
+    key = jax.random.PRNGKey(7)
+    for i in range(20):
+        g = {"w": jax.random.normal(jax.random.fold_in(key, i), (64,))}
+        u16, s16 = wrapped.update(g, s16, p16)
+        p16 = optax.apply_updates(p16, u16)
+        u32, s32 = tx.update(g, s32, p32)
+        p32 = optax.apply_updates(p32, u32)
+    np.testing.assert_array_equal(np.asarray(s16.master["w"]),
+                                  np.asarray(p32["w"]))
+    # and the stored bf16 params are exactly the cast of the master
+    np.testing.assert_array_equal(
+        np.asarray(p16["w"], np.float32),
+        np.asarray(p32["w"].astype(jnp.bfloat16), np.float32))
+
+
+def test_f32_leaves_pass_through_unchanged():
+    """Mixed trees: f32 leaves get the inner update exactly; only bf16
+    leaves are rounded."""
+    tx = stochastic_round_update(optax.sgd(0.5), seed=3)
+    p = {"a": jnp.ones((8,), jnp.float32), "b": jnp.ones((8,), jnp.bfloat16)}
+    g = {"a": jnp.full((8,), 0.25, jnp.float32),
+         "b": jnp.full((8,), 0.25, jnp.bfloat16)}
+    s = tx.init(p)
+    u, _ = tx.update(g, s, p)
+    np.testing.assert_array_equal(np.asarray(u["a"]), -0.125)
+    new_b = optax.apply_updates(p, u)["b"]
+    assert new_b.dtype == jnp.bfloat16
+    np.testing.assert_allclose(np.asarray(new_b, np.float32), 0.875)
+
+
+def test_stabilized_moments_are_f32_from_init():
+    """make_optimizer must pin bf16-param moments to f32 at init so the
+    state signature never changes across updates (the hidden step-2
+    retrace found in round 5)."""
+    tx = make_optimizer("adam", 1e-3)
+    p = {"w": jnp.ones((4,), jnp.bfloat16)}
+    s0 = tx.init(p)
+    assert jnp.bfloat16 not in _state_dtypes(s0)
+    u, s1 = tx.update({"w": jnp.ones((4,), jnp.bfloat16)}, s0, p)
+    assert _state_dtypes(s1) == _state_dtypes(s0)
+
+
+@pytest.mark.parametrize("mode", ["stochastic_round", "f32_master"])
+def test_state_signature_stable_and_lr_callbacks_work(mode):
+    """The wrappers' NamedTuple states must keep the whole chain's
+    signature stable across updates AND stay transparent to the
+    get/set_learning_rate recursion (ReduceLROnPlateau's path)."""
+    from pddl_tpu.train.state import TrainState
+
+    tx = make_optimizer("adam", 1e-3, grad_clip_norm=1.0,
+                        accumulate_steps=2, param_update=mode)
+    p = {"w": jnp.ones((4,), jnp.bfloat16)}
+    s0 = tx.init(p)
+    u, s1 = tx.update({"w": jnp.ones((4,), jnp.bfloat16)}, s0, p)
+    assert _state_dtypes(s1) == _state_dtypes(s0)
+    state = TrainState(step=jnp.zeros((), jnp.int32), params=p,
+                       batch_stats={}, opt_state=s0)
+    assert get_learning_rate(state) == pytest.approx(1e-3)
+    state = set_learning_rate(state, 5e-4)
+    assert get_learning_rate(state) == pytest.approx(5e-4)
+
+
+@pytest.mark.parametrize("mode", ["plain", "stochastic_round", "f32_master"])
+def test_trainer_trains_bf16_model_under_each_mode(mode):
+    """End to end: a tiny bf16-param GPT fits under each update rule —
+    loss finite and decreasing, params still bf16."""
+    from pddl_tpu.data.synthetic import SyntheticLanguageModeling
+    from pddl_tpu.models.gpt import tiny_gpt
+    from pddl_tpu.train.loop import Trainer
+
+    model = tiny_gpt(vocab_size=32, param_dtype=jnp.bfloat16)
+    data = SyntheticLanguageModeling(batch_size=8, seq_len=32,
+                                     vocab_size=32, seed=0)
+    tr = Trainer(model, optimizer="adam", learning_rate=1e-2, seed=0,
+                 input_key="tokens", target_key="targets",
+                 param_update=mode)
+    hist = tr.fit(data, epochs=2, steps_per_epoch=8, verbose=0)
+    losses = hist.history["loss"]
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]
+    leaf = jax.tree.leaves(tr.state.params)[0]
+    assert leaf.dtype == jnp.bfloat16
+
+
+def test_checkpoint_roundtrip_with_wrapper_state(tmp_path):
+    """The wrapper state (master copy / PRNG key) must survive an orbax
+    save/restore — it is optimizer state like any other."""
+    from pddl_tpu.ckpt.checkpoint import Checkpointer
+    from pddl_tpu.data.synthetic import SyntheticLanguageModeling
+    from pddl_tpu.models.gpt import tiny_gpt
+    from pddl_tpu.train.loop import Trainer
+
+    def build():
+        model = tiny_gpt(vocab_size=32, param_dtype=jnp.bfloat16)
+        return Trainer(model, optimizer="adam", learning_rate=1e-2, seed=0,
+                       input_key="tokens", target_key="targets",
+                       param_update="f32_master")
+
+    data = SyntheticLanguageModeling(batch_size=8, seq_len=32,
+                                     vocab_size=32, seed=0)
+    tr = build()
+    tr.fit(data, epochs=1, steps_per_epoch=3, verbose=0)
+    mgr = Checkpointer(str(tmp_path))
+    mgr.save(tr.state)
+    mgr.wait()
+
+    tr2 = build()
+    tr2.init_state(next(iter(data)))
+    restored = Checkpointer(str(tmp_path), read_only=True).restore(tr2.state)
+    for a, b in zip(jax.tree.leaves(tr.state.opt_state),
+                    jax.tree.leaves(restored.opt_state)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_prebuilt_transformation_composes_with_param_update():
+    """A prebuilt optax chain passed to make_optimizer must still get the
+    requested update rule — silently training with the biased plain rule
+    while config claims stochastic_round would be a lie."""
+    tx = make_optimizer(optax.sgd(1.0), param_update="stochastic_round")
+    p = {"w": jnp.ones((256,), jnp.bfloat16)}
+    s = tx.init(p)
+    # sub-ulp update: plain rounding would freeze the param at 1.0
+    g = {"w": jnp.full((256,), 1e-4, jnp.bfloat16)}
+    for _ in range(200):
+        u, s = tx.update(g, s, p)
+        p = optax.apply_updates(p, u)
+    moved = float(p["w"].astype(jnp.float32).mean())
+    assert moved < 0.995, moved  # updates accumulated => SR was applied
+
+
+def test_f32_master_is_literal_noop_for_f32_params():
+    """No bf16 leaves: no master copy may be stored (it would duplicate
+    every parameter in optimizer state for zero behavioral change)."""
+    from pddl_tpu.train.mixed_precision import F32MasterState
+
+    tx = f32_master_update(optax.adam(1e-3))
+    p = {"w": jnp.ones((8,), jnp.float32)}
+    s = tx.init(p)
+    assert s.master is None
+    ref = optax.adam(1e-3)
+    sr = ref.init(p)
+    g = {"w": jnp.full((8,), 0.5, jnp.float32)}
+    u, s = tx.update(g, s, p)
+    ur, sr = ref.update(g, sr, p)
+    np.testing.assert_array_equal(np.asarray(u["w"]), np.asarray(ur["w"]))
+    assert s.master is None

@@ -236,3 +236,71 @@ def test_top2_expert_parallel_training():
     assert hist.history["loss"][-1] < hist.history["loss"][0]
     moe_params = tr.state.params["block1"]["moe"]
     assert moe_params["w1"].sharding.spec == P(EXPERT_AXIS)
+
+
+def test_drop_rate_observable_matches_capacity_math():
+    """The sown moe_drop_rate must equal the exact dropped-slot fraction:
+    force every token to expert 0 (router bias) and check against the
+    closed form 1 - capacity/(S*top_k) per batch row."""
+    moe = SwitchFFN(num_experts=4, mlp_ratio=2, capacity_factor=1.0,
+                    eval_dropless=False)
+    x = jax.random.normal(jax.random.key(0), (2, 16, 8))
+    variables = moe.init(jax.random.key(1), x)
+    p = jax.tree.map(jnp.copy, variables["params"])
+    p["router"]["kernel"] = jnp.zeros_like(p["router"]["kernel"])
+    p["router"]["bias"] = jnp.array([10.0, 0.0, 0.0, 0.0])
+    _, state = moe.apply({"params": p}, x, mutable=["losses", "metrics"])
+    (rate,) = jax.tree.leaves(state["metrics"])
+    # capacity = int(1.0 * 1 * 16 / 4) = 4 kept of 16 slots per row
+    np.testing.assert_allclose(float(rate), 1.0 - 4 / 16, atol=1e-6)
+
+    # Balanced router at high capacity: (near-)zero drops.
+    moe2 = SwitchFFN(num_experts=4, mlp_ratio=2, capacity_factor=8.0)
+    _, state2 = moe2.apply({"params": variables["params"]}, x,
+                           mutable=["losses", "metrics"])
+    (rate2,) = jax.tree.leaves(state2["metrics"])
+    assert float(rate2) == 0.0
+
+
+def test_eval_dropless_capacity_ignores_capacity_factor():
+    """train=False + eval_dropless: even a capacity_factor that drops
+    hard in training keeps EVERY routed token at eval — worst case all
+    tokens on one expert — and the sown drop rate is exactly 0."""
+    moe = SwitchFFN(num_experts=4, mlp_ratio=2, capacity_factor=0.25,
+                    top_k=2)
+    x = jax.random.normal(jax.random.key(0), (2, 16, 8))
+    variables = moe.init(jax.random.key(1), x)
+    p = jax.tree.map(jnp.copy, variables["params"])
+    # Worst case: every token's top-2 is experts 0 and 1.
+    p["router"]["kernel"] = jnp.zeros_like(p["router"]["kernel"])
+    p["router"]["bias"] = jnp.array([10.0, 8.0, 0.0, 0.0])
+
+    out_tr, st_tr = moe.apply({"params": p}, x, True,
+                              mutable=["losses", "metrics"])
+    out_ev, st_ev = moe.apply({"params": p}, x, False,
+                              mutable=["losses", "metrics"])
+    (rate_tr,) = jax.tree.leaves(st_tr["metrics"])
+    (rate_ev,) = jax.tree.leaves(st_ev["metrics"])
+    assert float(rate_tr) > 0.8  # training capacity drops almost all
+    assert float(rate_ev) == 0.0  # eval is dropless by construction
+    # and the dropped-token rows actually differ (drops zero their slots)
+    assert not np.allclose(np.asarray(out_tr), np.asarray(out_ev))
+
+
+def test_trainer_logs_moe_drop_rate():
+    """End to end: the drop-rate observable surfaces in History under
+    its sown name, averaged across routed blocks."""
+    model = ViT(patch_size=8, embed_dim=32, depth=2, num_heads=4,
+                num_classes=8, moe_experts=4, moe_top_k=1, moe_every=1,
+                attention="reference")
+    ds = SyntheticImageClassification(batch_size=8, image_size=32,
+                                      num_classes=8, seed=0)
+    tr = Trainer(model, optimizer="adamw", learning_rate=1e-3, seed=0)
+    hist = tr.fit(ds, epochs=1, steps_per_epoch=2, verbose=0,
+                  validation_data=ds, validation_steps=1)
+    assert "moe_drop_rate" in hist.history
+    assert "val_moe_drop_rate" in hist.history
+    rate = hist.history["moe_drop_rate"][-1]
+    assert 0.0 <= rate <= 1.0
+    # eval path is dropless
+    assert hist.history["val_moe_drop_rate"][-1] == 0.0
