@@ -88,6 +88,11 @@ def main() -> None:
                    help="also write the JSON record to this path")
     args = p.parse_args()
 
+    if args.experts and args.family != "llama":
+        # GPT's MoE knob exists but takes the module defaults (no
+        # capacity/eval controls); benching it here would emit an
+        # MoE-labeled record for a config the flags don't describe.
+        p.error("--experts requires --family llama")
     if args.vocab is None:
         args.vocab = 50257 if args.family == "gpt" else 32000
     param_dtype = jnp.bfloat16 if args.param_dtype == "bfloat16" \

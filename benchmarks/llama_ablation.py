@@ -1,0 +1,176 @@
+"""Llama-family train-step ablation: where the 42.8-vs-50.6 MFU gap lives.
+
+Round 4 measured llama-small at 42.8% 6ND MFU vs GPT-small's 50.6% at
+the identical B8/S2048 budget — while being FASTER in wall-clock (145.4
+vs 161.8 ms/step). This is the §7b decomposition for the llama family
+(the GPT twin is ``artifacts/gpt_bench/r03_ablation.json``), built from
+in-situ marginal costs of real train-step programs:
+
+- ``full``            — the shipped step (flash GQA + fused CE + adamw);
+- ``no_optimizer``    — value_and_grad only, no update;
+- ``head_ce``         — full − a variant whose loss is a feature-mean
+                        (drops final norm + LM head + CE fwd/bwd);
+- ``attention``       — full − a variant with the attention op stubbed
+                        to identity (drops QK^T/PV and their backward;
+                        q/k/v/o projections remain);
+- ``depth slope``     — per-layer cost from depth 12 vs 6 (amortizes
+                        embed/head/fixed costs out).
+
+Plus the 6ND bookkeeping that explains the MFU arithmetic: llama's
+smaller parameter count (GQA K/V, 32k vocab; N=125M vs GPT's 164M)
+shrinks the 6ND numerator by 24% while the attention S² work — which
+6ND does not credit AND which runs at the D=64 kernel's MXU ceiling
+(§7b) — is identical between the families.
+
+    PYTHONPATH=. python benchmarks/llama_ablation.py \
+        [--out artifacts/gpt_bench/r05_llama_ablation.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from pddl_tpu.models.gpt import fused_lm_loss
+from pddl_tpu.models.llama import Llama
+from pddl_tpu.train.state import TrainState
+
+B, S = 8, 2048
+VOCAB = 32000
+V5E_BF16_PEAK = 197e12
+
+
+def _model(depth=12):
+    return Llama(vocab_size=VOCAB, max_len=S, embed_dim=768, depth=depth,
+                 num_heads=12, num_kv_heads=4, attention="flash",
+                 dtype=jnp.bfloat16, param_dtype=jnp.bfloat16)
+
+
+def _time_step(model, *, optimizer=True, loss="fused_ce", iters=10):
+    tokens = jax.random.randint(jax.random.key(0), (B, S), 0, VOCAB)
+    targets = jax.random.randint(jax.random.key(1), (B, S), 0, VOCAB)
+    tx = optax.adamw(1e-4)
+
+    def init(rng):
+        params = model.init(rng, tokens[:1], train=False)["params"]
+        return TrainState(step=jnp.zeros((), jnp.int32), params=params,
+                          batch_stats={}, opt_state=tx.init(params))
+
+    state = jax.jit(init)(jax.random.key(0))
+
+    def loss_of(params):
+        if loss == "fused_ce":
+            return fused_lm_loss(model, {"params": params}, tokens,
+                                 targets, train=True)
+        # feature-mean: traces embed+blocks+nothing else — the headless
+        # variant (final norm, LM head, CE all gone fwd AND bwd).
+        feats = model.apply({"params": params}, tokens, train=True,
+                            features_only=True)
+        return jnp.mean(feats.astype(jnp.float32))
+
+    if optimizer:
+        def step(state, _):
+            l, grads = jax.value_and_grad(loss_of)(state.params)
+            return state.apply_gradients(tx, grads), l
+    else:
+        def step(state, _):
+            l, grads = jax.value_and_grad(loss_of)(state.params)
+            # Consume the gradients: returning them unused would let XLA
+            # dead-code-eliminate the whole backward and this variant
+            # would silently time forward-only.
+            gsum = sum(jnp.sum(g.astype(jnp.float32))
+                       for g in jax.tree.leaves(grads))
+            # 1e-20, not 0.0: a literal zero multiplier is foldable.
+            return state, l + 1e-20 * gsum
+
+    jstep = jax.jit(step, donate_argnums=(0,))
+    state, l = jstep(state, None)
+    float(l)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        state, l = jstep(state, None)
+    float(l)
+    return (time.perf_counter() - t0) / iters * 1e3  # ms/step
+
+
+class _AttnStub:
+    """Replace the flash attention op with identity in models.llama (it
+    binds the name at import): q/k/v/o projections and RoPE remain, the
+    S² kernel (fwd and bwd) disappears."""
+
+    def __enter__(self):
+        import pddl_tpu.models.llama as ml
+
+        self._saved = ml.flash_attention
+
+        def stub(q, k, v, **kw):
+            return q
+
+        ml.flash_attention = stub
+        return self
+
+    def __exit__(self, *exc):
+        import pddl_tpu.models.llama as ml
+
+        ml.flash_attention = self._saved
+        return False
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--out", default="")
+    args = p.parse_args()
+
+    m12 = _model(12)
+    n_params = sum(
+        x.size for x in jax.tree.leaves(
+            jax.eval_shape(lambda: m12.init(
+                jax.random.key(0),
+                jnp.zeros((1, S), jnp.int32), train=False))["params"]))
+
+    full = _time_step(m12)
+    no_opt = _time_step(m12, optimizer=False)
+    headless = _time_step(m12, loss="features")
+    with _AttnStub():
+        no_attn = _time_step(m12)
+    d6 = _time_step(_model(6))
+    per_layer = (full - d6) / 6
+
+    toks = B * S / (full / 1e3)
+    mfu = 6 * n_params * toks / V5E_BF16_PEAK
+
+    record = {
+        "metric": "llama_small_train_step_ablation_ms",
+        "config": {"batch": B, "seq": S, "depth": 12, "width": 768,
+                   "heads": 12, "kv_heads": 4, "vocab": VOCAB,
+                   "params_m": round(n_params / 1e6, 1),
+                   "dtype": "bfloat16", "attention": "flash",
+                   "fused_ce": True},
+        "decomposition": {
+            "full_step_ms": round(full, 2),
+            "tokens_per_sec": round(toks, 0),
+            "mfu_6nd": round(mfu, 4),
+            "optimizer_in_situ_ms": round(full - no_opt, 2),
+            "head_plus_ce_in_situ_ms": round(full - headless, 2),
+            "attention_in_situ_ms": round(full - no_attn, 2),
+            "per_layer_ms_depth_slope": round(per_layer, 3),
+            "twelve_layers_ms": round(12 * per_layer, 2),
+            "depth6_full_ms": round(d6, 2),
+        },
+        "device": jax.devices()[0].device_kind,
+    }
+    js = json.dumps(record, indent=1)
+    print(js)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(json.dumps(record) + "\n")
+
+
+if __name__ == "__main__":
+    sys.exit(main() or 0)

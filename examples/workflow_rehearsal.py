@@ -1,0 +1,239 @@
+"""Full-resolution reference-workflow rehearsal on the real chip.
+
+The reference IS a workflow, not a library: train ResNet-50 at 224px
+with val-loss-driven callbacks, checkpoint, survive interruption, and
+``model.save`` at the end (``/root/reference/imagenet-resnet50.py:64-72``).
+This driver executes that complete story through the REAL CLI (one
+``python -m pddl_tpu`` process per leg, exactly what a user types) on
+hardware, and asserts every seam:
+
+1. ``single`` preset, synthetic 224×224 data, ResNet-50, enough epochs
+   that the reference's own callbacks FIRE (plateau patience 5 →
+   ReduceLROnPlateau; early-stop patience 10 on a plateauing val loss).
+2. Mid-epoch SIGTERM (a Cloud-TPU preemption) → the PreemptionCheckpoint
+   handler writes a consistent checkpoint and exits cleanly.
+3. Relaunch with ``--resume`` → continues from the interrupted epoch,
+   runs to the early stop, exports the final ``.h5``.
+4. The ``.h5`` re-imports through the Keras-layout mapper and its logits
+   match the orbax checkpoint state bit-for-bit — the train-here/
+   serve-anywhere contract.
+
+Proof obligations checked from artifacts alone (no trust in this
+script's narration): the epoch count in the resumed log is < requested
+(early stop fired), the checkpoint's learning rate ends < the initial
+1e-3 (plateau fired ≥ once), and the logits comparison.
+
+    python examples/workflow_rehearsal.py \
+        [--artifacts-dir artifacts/workflow_rehearsal]
+
+Writes ``rehearsal_log.txt`` (all three legs' stdout) and
+``r05_workflow_rehearsal.json`` (the assertions' measured values).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import signal
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# Smoke mode (tests/test_examples.py, PDDL_EXAMPLE_SMOKE=1): the same
+# four-leg workflow at toy scale on the CPU mesh — tiny model, .npz
+# final artifact (the .h5 exporter is ResNet-50-layout) — so the
+# script's own seams stay covered in the suite while the committed
+# artifact comes from the full-resolution chip run.
+SMOKE = bool(os.environ.get("PDDL_EXAMPLE_SMOKE"))
+
+EPOCHS = 40
+STEPS = 8 if SMOKE else 20
+BATCH = 8 if SMOKE else 32
+IMAGE = 32 if SMOKE else 224
+MODEL = "tiny_resnet" if SMOKE else "resnet50"
+SIGTERM_AFTER = 120 if SMOKE else 600  # CAP on the epoch-marker wait
+# Few classes: the synthetic class-mean task converges in a few epochs
+# and then PLATEAUS — which is exactly what makes the reference's
+# val-loss callbacks (plateau patience 5, early-stop patience 10) fire
+# inside the budget.
+NUM_CLASSES = 8 if SMOKE else 16
+
+
+def _cli(workdir, *extra):
+    final = "final.npz" if SMOKE else "final.h5"
+    return [
+        sys.executable, "-m", "pddl_tpu",
+        "--preset", "single", "--synthetic", "--model", MODEL,
+        "--image-size", str(IMAGE), "--batch", str(BATCH),
+        "--num-classes", str(NUM_CLASSES),
+        "--epochs", str(EPOCHS), "--steps-per-epoch", str(STEPS),
+        "--checkpoint-dir", os.path.join(workdir, "ckpt"),
+        "--save", os.path.join(workdir, final),
+        "--seed", "0", "--verbose", "2", *extra,
+    ]
+
+
+def main() -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--artifacts-dir",
+                   default=os.path.join(REPO, "artifacts",
+                                        "workflow_rehearsal"))
+    p.add_argument("--work-dir",
+                   default="/tmp/pddl_workflow_rehearsal_smoke" if SMOKE
+                   else "/tmp/pddl_workflow_rehearsal")
+    args = p.parse_args()
+    os.makedirs(args.artifacts_dir, exist_ok=True)
+    os.makedirs(args.work_dir, exist_ok=True)
+    log_path = os.path.join(args.artifacts_dir, "rehearsal_log.txt")
+    log = open(log_path, "w")
+
+    def leg(title, cmd, sigterm_after=None):
+        log.write(f"\n===== {title}: {' '.join(cmd)} =====\n")
+        log.flush()
+        t0 = time.time()
+        proc = subprocess.Popen(cmd, cwd=REPO, stdout=log,
+                                stderr=subprocess.STDOUT, text=True)
+        interrupted = None
+        if sigterm_after is not None:
+            # Signal only once training is demonstrably underway (the
+            # epoch marker appears in the log), not after a fixed sleep:
+            # a warm compile cache can finish a whole smoke leg in under
+            # any fixed delay, and then the preemption path was never
+            # exercised. sigterm_after caps the wait.
+            deadline = time.time() + sigterm_after
+            while time.time() < deadline and proc.poll() is None:
+                log.flush()
+                if "Epoch 2/" in open(log_path).read():
+                    break
+                time.sleep(1.0)
+            # The signal only exercises the preemption path if the run
+            # is still alive — record it so the caller can ASSERT the
+            # preemption actually happened.
+            interrupted = proc.poll() is None
+            proc.send_signal(signal.SIGTERM)
+        rc = proc.wait(timeout=3600)
+        dt = time.time() - t0
+        log.write(f"===== {title}: rc={rc} wall={dt:.1f}s "
+                  f"interrupted={interrupted} =====\n")
+        log.flush()
+        return rc, dt, interrupted
+
+    # Leg 1: fresh run, preempted mid-training by a real SIGTERM.
+    # Enough delay to be INSIDE training (past compile) but well before
+    # the natural end.
+    rc1, t1, interrupted = leg("leg1-preempted", _cli(args.work_dir),
+                               sigterm_after=SIGTERM_AFTER)
+    assert interrupted, (
+        f"leg1 finished before the {SIGTERM_AFTER}s SIGTERM — the "
+        "preemption path was never exercised; lower SIGTERM_AFTER or "
+        "raise the epoch budget")
+    ckpt_dir = os.path.join(args.work_dir, "ckpt")
+    steps_saved = sorted(
+        int(d) for d in os.listdir(ckpt_dir) if d.isdigit())
+    assert steps_saved, f"no checkpoint written by preemption (rc={rc1})"
+
+    # Leg 2: resume from the preemption checkpoint; run to completion
+    # (the early stop should end it before EPOCHS).
+    rc2, t2, _ = leg("leg2-resume", _cli(args.work_dir, "--resume"))
+    assert rc2 == 0, f"resume leg failed rc={rc2} (see {log_path})"
+    h5_path = os.path.join(args.work_dir,
+                           "final.npz" if SMOKE else "final.h5")
+    assert os.path.exists(h5_path), "final model artifact was not exported"
+
+    # ---- proof obligations, measured from the artifacts --------------
+    text = open(log_path).read()
+    epochs_leg2 = sorted(set(
+        int(m) for m in re.findall(r"Epoch (\d+)/%d" % EPOCHS, text)))
+    early_stopped = max(epochs_leg2) < EPOCHS
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    sys.path.insert(0, REPO)
+    from pddl_tpu.ckpt.checkpoint import Checkpointer
+    from pddl_tpu.train.state import get_learning_rate
+
+    if SMOKE:
+        from pddl_tpu.models.resnet import tiny_resnet
+
+        model = tiny_resnet(num_classes=NUM_CLASSES)
+    else:
+        from pddl_tpu.models.resnet import ResNet50
+
+        model = ResNet50(num_classes=NUM_CLASSES)
+    x = jax.random.normal(jax.random.key(0), (2, IMAGE, IMAGE, 3))
+    variables = jax.jit(
+        lambda: model.init(jax.random.key(0), x, train=False))()
+
+    # LR in the final checkpoint proves ReduceLROnPlateau fired (0.1x
+    # per firing from the preset's 1e-3).
+    from pddl_tpu.train.state import TrainState, make_optimizer
+
+    tx = make_optimizer("adam", 1e-3)
+    target = TrainState(
+        step=jnp.zeros((), jnp.int32), params=variables["params"],
+        batch_stats=variables.get("batch_stats", {}),
+        opt_state=tx.init(variables["params"]))
+    state = Checkpointer(ckpt_dir, read_only=True).restore(target)
+    final_lr = get_learning_rate(state)
+    plateau_fired = final_lr < 1e-3 * 0.99
+
+    if SMOKE:
+        # .npz round trip: exported params equal the checkpoint's.
+        with np.load(h5_path) as z:
+            flat = jax.tree_util.tree_flatten_with_path(state.params)[0]
+            deltas = []
+            for path, leaf in flat:
+                key = "/".join(str(getattr(p, "key", p)) for p in path)
+                assert key in z.files, (key, z.files[:5])
+                deltas.append(float(np.max(np.abs(
+                    z[key] - np.asarray(leaf)))))
+            logits_delta = max(deltas)
+    else:
+        # .h5 round trip: logits from the re-imported Keras-layout file
+        # must match logits from the orbax state exactly (same arrays,
+        # two serialization paths).
+        from pddl_tpu.ckpt.keras_import import load_keras_resnet50_h5
+
+        h5_vars = load_keras_resnet50_h5(h5_path, variables,
+                                         require_head=True)
+        fwd = jax.jit(lambda v: model.apply(
+            {"params": v["params"], "batch_stats": v["batch_stats"]},
+            x, train=False))
+        logits_h5 = np.asarray(fwd(h5_vars))
+        logits_ckpt = np.asarray(fwd(
+            {"params": state.params, "batch_stats": state.batch_stats}))
+        logits_delta = float(np.max(np.abs(logits_h5 - logits_ckpt)))
+
+    record = {
+        "metric": "workflow_rehearsal",
+        "config": {"preset": "single", "model": MODEL,
+                   "image_size": IMAGE, "batch": BATCH, "epochs": EPOCHS,
+                   "steps_per_epoch": STEPS, "smoke": SMOKE},
+        "leg1_preempted": {"rc": rc1, "wall_s": round(t1, 1),
+                           "checkpoint_steps": steps_saved},
+        "leg2_resume": {"rc": rc2, "wall_s": round(t2, 1),
+                        "epochs_seen": epochs_leg2},
+        "early_stopping_fired": early_stopped,
+        "reduce_lr_fired": plateau_fired,
+        "final_lr": final_lr,
+        "h5_vs_checkpoint_max_logit_delta": logits_delta,
+        "device": jax.devices()[0].device_kind,
+    }
+    out = os.path.join(args.artifacts_dir, "r05_workflow_rehearsal.json")
+    with open(out, "w") as f:
+        json.dump(record, f, indent=1)
+    print(json.dumps(record, indent=1))
+    ok = (early_stopped and plateau_fired and logits_delta == 0.0
+          and rc2 == 0 and interrupted)
+    print("REHEARSAL", "PASS" if ok else "FAIL")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -328,9 +328,9 @@ def load_hf_llama(model_or_dir, variables: PyTree, *,
                 # Mixtral routing is DROPLESS; the dense dispatch drops
                 # overflow beyond capacity_factor*k*S/n tokens per expert
                 # in TRAINING. Eval/serving (train=False) is dropless by
-                # construction when moe_eval_dropless is on (capacity ==
-                # top_k*S covers the all-tokens-to-one-expert worst
-                # case, ops/moe.py) — so inference parity needs no
+                # construction when moe_eval_dropless is on (capacity == S
+                # covers the all-tokens-to-one-expert worst case,
+                # ops/moe.py) — so inference parity needs no
                 # capacity_factor condition. Only a model that turned
                 # dropless eval OFF must carry a worst-case
                 # capacity_factor >= n/k, or an imbalanced prompt

@@ -280,7 +280,7 @@ class LlamaBlock(nn.Module):
     moe_experts: int = 0  # >0: Mixtral-style routed SwiGLU experts
     moe_top_k: int = 2
     moe_capacity_factor: float = 2.0
-    moe_eval_dropless: bool = True  # eval/serving capacity = top_k*S
+    moe_eval_dropless: bool = True  # eval/serving capacity = S (dropless)
     rms_eps: float = 1e-5
     dtype: Any = jnp.float32
     param_dtype: Any = jnp.float32
@@ -354,7 +354,7 @@ class Llama(nn.Module):
     moe_top_k: int = 2  # Mixtral's num_experts_per_tok
     moe_every: int = 1  # Mixtral puts MoE in EVERY layer
     moe_capacity_factor: float = 2.0
-    moe_eval_dropless: bool = True  # eval/serving capacity = top_k*S
+    moe_eval_dropless: bool = True  # eval/serving capacity = S (dropless)
     rms_eps: float = 1e-5
     dtype: Any = jnp.float32
     param_dtype: Any = jnp.float32

@@ -233,6 +233,11 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
 # tiles sits well inside the ~16 MB/core VMEM on every generation) but
 # not re-tuned. To tune a new chip: run benchmarks/attention_bench.py
 # (it sweeps block pairs) and add the winner here.
+# Head-dim note (round 5): the pair was originally tuned at D=64; a
+# 7-pair fwd+bwd re-sweep at D=128 (B8 H16 S2048, the 67.9%-MFU
+# flagship geometry — artifacts/gpt_bench/r05_block_sweep_d128.txt)
+# confirms 512x1024 stays optimal there too (15.9 ms vs 16.6 for the
+# 1024x1024 runner-up), so the table needs no head_dim key.
 TUNED_BLOCKS: dict[str, tuple[int, int]] = {
     "TPU v5 lite": (512, 1024),  # measured
     "TPU v5e": (512, 1024),      # measured (alternate kind string)

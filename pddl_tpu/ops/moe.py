@@ -60,12 +60,14 @@ class SwitchFFN(nn.Module):
     expert_act: str = "gelu"  # "gelu" | "swiglu" (Mixtral)
     normalize_gates: bool = True  # top_k >= 2: g_j / sum_j g_j
     aux_loss_weight: float = 0.01
-    # Eval/serving (train=False) uses capacity == top_k * seq — enough
-    # for the worst case (every token routed to ONE expert), so
-    # inference is DROPLESS regardless of capacity_factor. Real Mixtral
-    # checkpoints assume dropless routing; without this, an imbalanced
-    # prompt silently diverges from the reference logits. The price is
-    # dispatch/combine tensors growing to [B, S, N, top_k*S] at eval.
+    # Eval/serving (train=False) uses capacity == seq — enough for the
+    # worst case: the k choices per token are DISTINCT experts (each
+    # choice zeroes its expert from `remaining`), so one expert can
+    # receive at most S tokens per batch row. Inference is therefore
+    # DROPLESS regardless of capacity_factor. Real Mixtral checkpoints
+    # assume dropless routing; without this, an imbalanced prompt
+    # silently diverges from the reference logits. The price is
+    # dispatch/combine tensors growing to [B, S, N, S] at eval.
     eval_dropless: bool = True
     dtype: Any = jnp.float32
     param_dtype: Any = jnp.float32
@@ -86,7 +88,7 @@ class SwitchFFN(nn.Module):
         # [B, S, N, C] — linear in batch, never quadratic in total tokens.
         # top-2 doubles routed token-slots, so capacity scales with k.
         if not train and self.eval_dropless:
-            capacity = self.top_k * s
+            capacity = s
         else:
             capacity = max(1, int(self.capacity_factor * self.top_k * s / n))
         hidden = self.hidden_dim if self.hidden_dim is not None \

@@ -272,7 +272,13 @@ def make_optimizer(
             tx = optax.chain(optax.clip_by_global_norm(grad_clip_norm), tx)
         if accumulate_steps is not None and accumulate_steps > 1:
             tx = optax.MultiSteps(tx, every_k_schedule=accumulate_steps)
-        return stabilize_moment_dtype(tx)
+        # NO moment-dtype pin here: a prebuilt chain carries Python-float
+        # (weak-typed) hyperparams, so bf16 moments genuinely stay bf16 —
+        # the user's deliberate choice; promoting them would double
+        # moment memory and break restore against old checkpoints. The
+        # promotion premise only holds for the injected factory path
+        # below (f32 hyperparam arrays).
+        return tx
     try:
         factory = _OPTIMIZERS[name.lower()]
     except KeyError:

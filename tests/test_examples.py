@@ -8,7 +8,10 @@ import time
 _ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 _EXAMPLES = sorted(
     f for f in os.listdir(os.path.join(_ROOT, "examples"))
-    if f.endswith(".py")
+    # workflow_rehearsal runs TWO sequential training legs (preempt ->
+    # resume) — too long for this test's shared concurrent deadline; it
+    # gets its own sequential test below.
+    if f.endswith(".py") and f != "workflow_rehearsal.py"
 )
 
 
@@ -69,3 +72,25 @@ def test_examples_run(tmp_path):
         for f in logs.values():
             f.close()
     assert not failures, "\n\n".join(failures)
+
+
+def test_workflow_rehearsal_smoke(tmp_path):
+    """The four-leg reference-workflow rehearsal (preempt -> resume ->
+    export -> re-import check) in smoke mode, run ALONE: two sequential
+    training legs don't fit the concurrent test's shared deadline."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    env["PDDL_EXAMPLE_SMOKE"] = "1"
+    (tmp_path / "sitecustomize.py").write_text("")
+    env["PYTHONPATH"] = (str(tmp_path) + os.pathsep + _ROOT + os.pathsep
+                         + env.get("PYTHONPATH", ""))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_ROOT, "examples",
+                                      "workflow_rehearsal.py"),
+         "--work-dir", str(tmp_path / "work"),
+         "--artifacts-dir", str(tmp_path / "art")],
+        env=env, cwd=_ROOT, capture_output=True, text=True, timeout=540,
+    )
+    assert proc.returncode == 0, proc.stdout[-3000:] + proc.stderr[-2000:]
+    assert "REHEARSAL PASS" in proc.stdout
