@@ -1,0 +1,250 @@
+"""Speculative decoding: multi-token ticks via prompt-lookup drafting.
+
+Why this exists (the measured motivation): ARCHITECTURE.md §7e attributes
+single-stream decode to a **0.289 ms per-tick FIXED serial-latency cost**
+(scan tick machinery + the dependency-chain latency of ~130 small GEMV
+ops) that is batch- and width-INDEPENDENT — the same tick that computes
+one token's logits can compute eight tokens' logits for nearly the same
+wall-clock, because the weight reads and the serial op chain are shared.
+Single-token decode therefore pays the whole fixed cost per token; the
+only lever left standing is fewer, wider ticks. This module is that
+lever.
+
+Scheme (prompt-lookup / n-gram self-drafting — no draft model):
+
+1. DRAFT: find the most recent earlier occurrence of the last ``ngram``
+   tokens in the sequence so far and propose the ``draft_len`` tokens
+   that followed it. On repetitive text (code, logs — e.g. the byte-level
+   Python corpus the convergence tracks train on) this guesses long runs
+   correctly; on text with no self-similarity it simply proposes junk.
+2. VERIFY: run ONE forward over the ``draft_len + 1`` block
+   ``[current, d_1..d_k]`` through the ordinary KV-cache decode module —
+   the same chunked-prefill path :func:`~pddl_tpu.models.gpt.generate`
+   uses for prompts (causal within the block, K/V written at the running
+   index, RoPE/positions from the index) — and greedy-decode every
+   position: ``y_j = argmax(logits_j)``.
+3. ACCEPT the longest prefix with ``d_{j+1} == y_j`` (``m`` drafts), emit
+   ``y_0..y_m`` — ``m + 1`` tokens from one tick — and REWIND the cache
+   index to the position after the last accepted token. Rejected
+   positions hold stale K/V beyond the index; the prefix-bounded cache
+   sweep (`ops/attention.py decode_attention`) never reads past the
+   index, and the next tick's ``draft_len + 1``-wide write overwrites
+   them before the index crosses.
+
+Every emitted token is the argmax of the true model given the true
+prefix, so the output is **bit-identical to greedy** ``generate()`` —
+acceptance rate changes only the speed. Worst case (nothing ever
+matches) each tick still emits one token, i.e. plain greedy decode at
+one verify-width forward per tick.
+
+Batching: acceptance is ``min`` over the batch (the KV caches share one
+scalar index), which stays exact for every row — a row whose drafts
+matched further simply re-derives those tokens next tick. The win is
+largest at B=1, which is exactly where the fixed per-tick cost dominates
+(§7e).
+
+Exclusions, all validated loudly: greedy only (temperature sampling
+would need stochastic verification — rejection sampling — to stay
+unbiased); no sliding-window RING cache (a partially rejected block has
+already overwritten ring slots that rolled out of the window but are
+still inside it for the rewound position — unsound to rewind; models
+whose ``sliding_window`` rounds up to ``>= max_len`` use a full cache
+and remain eligible); no tensor-parallel ``strategy`` yet.
+
+Reference stake: the reference's endpoint is ``model.save`` then serve
+(`/root/reference/imagenet-resnet50.py:72`); this is the serving path's
+throughput story for the LM families.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from pddl_tpu.models.gpt import _decode_cache_shapes
+
+__all__ = ["generate_speculative"]
+
+
+def _ngram_drafts(toks, cur_pos, ngram: int, draft_len: int):
+    """Prompt-lookup draft: ``[B, draft_len]`` continuations of the most
+    recent earlier occurrence of the trailing ``ngram``.
+
+    ``toks`` is the full token buffer ``[B, L]`` (prompt + emitted so
+    far; positions > ``cur_pos`` hold junk), ``cur_pos`` the position of
+    the last known token. All shapes static; `dynamic_slice` clamping
+    makes out-of-range starts harmless (they yield junk drafts, which
+    verification rejects — exactness never depends on the draft).
+    """
+    b, length = toks.shape
+    # Trailing n-gram ending at cur_pos (clamped left at the buffer edge).
+    query = jax.lax.dynamic_slice(
+        toks, (0, cur_pos - (ngram - 1)), (b, ngram))
+    # All length-n windows: wins[i, :, w] = toks[:, w + i].
+    n_win = length - ngram + 1
+    wins = jnp.stack([toks[:, i:i + n_win] for i in range(ngram)], axis=0)
+    hit = jnp.all(wins == query.T[:, :, None], axis=0)  # [B, n_win]
+    # A usable window ends strictly before cur_pos (the window ending AT
+    # cur_pos is the query itself).
+    starts = jnp.arange(n_win)[None, :]
+    usable = hit & (starts <= cur_pos - ngram)
+    best = jnp.max(jnp.where(usable, starts, -1), axis=1)  # [B]
+    found = best >= 0
+
+    def take(row, start):  # per-row continuation after the matched window
+        return jax.lax.dynamic_slice(row, (start,), (draft_len,))
+
+    drafts = jax.vmap(take)(toks, jnp.where(found, best + ngram, 0))
+    # No match → propose the last token repeated: free (the tick runs
+    # anyway) and occasionally right on run-length text.
+    fallback = jnp.broadcast_to(query[:, -1:], (b, draft_len))
+    return jnp.where(found[:, None], drafts, fallback)
+
+
+def _rewind_index(cache, new_index):
+    """Set every cache position counter to ``new_index``.
+
+    The position state in BOTH cache layouts (GPT's embed ``pos_index``
+    + per-block ``cache_index``, llama's per-block ``cache_index``) is
+    exactly the scalar int32 leaves; K/V tensors are rank-4. Stale K/V
+    beyond the index is unreachable (prefix-bounded sweep) until
+    overwritten by the next block write.
+    """
+    return jax.tree.map(
+        lambda leaf: (jnp.full_like(leaf, new_index)
+                      if leaf.ndim == 0 and leaf.dtype == jnp.int32
+                      else leaf),
+        cache)
+
+
+@functools.lru_cache(maxsize=16)
+def _spec_program(dec, prompt_len: int, max_new_tokens: int,
+                  draft_len: int, ngram: int):
+    """One jitted program: prefill + the whole speculative loop.
+
+    Cached on the frozen decode module + statics for the same reason as
+    ``gpt._decode_programs``: serving calls must hit a compiled program,
+    and params stay jit ARGUMENTS (never baked-in constants). The entire
+    generation — prefill, every verify tick, draft lookup, acceptance —
+    is one dispatch, so transport latency is paid once per request.
+    """
+    width = draft_len + 1
+
+    def run(params, prompt):
+        b = prompt.shape[0]
+        cache = jax.tree.map(
+            lambda sd: jnp.zeros(sd.shape, sd.dtype),
+            _decode_cache_shapes(dec, b))
+        logits, mutated = dec.apply(
+            {"params": params, "cache": cache}, prompt,
+            train=False, mutable=["cache"])
+        cache = mutated["cache"]
+        first = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+
+        buf_len = prompt_len + max_new_tokens + width
+        toks = jnp.zeros((b, buf_len), jnp.int32)
+        toks = jax.lax.dynamic_update_slice(toks, prompt, (0, 0))
+        toks = jax.lax.dynamic_update_slice(
+            toks, first[:, None], (0, prompt_len))
+
+        def cond(state):
+            _, n_out, _, _ = state
+            return n_out < max_new_tokens
+
+        def body(state):
+            toks, n_out, cache, ticks = state
+            cur_pos = prompt_len + n_out - 1  # position of the last token
+            drafts = _ngram_drafts(toks, cur_pos, ngram, draft_len)
+            cur = jax.lax.dynamic_slice(toks, (0, cur_pos), (toks.shape[0], 1))
+            block = jnp.concatenate([cur, drafts], axis=1)  # [B, width]
+            logits, mutated = dec.apply(
+                {"params": params, "cache": cache}, block,
+                train=False, mutable=["cache"])
+            cache = mutated["cache"]
+            y = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [B, width]
+            # Longest accepted draft prefix, min over the batch (shared
+            # cache index): cumprod turns the first mismatch into zeros.
+            match = (block[:, 1:] == y[:, :-1]).astype(jnp.int32)
+            accepted = jnp.min(jnp.sum(jnp.cumprod(match, axis=1), axis=1))
+            # y_0..y_accepted are exact greedy tokens; the stale tail is
+            # overwritten before the frontier reaches it (width >= tail).
+            toks = jax.lax.dynamic_update_slice(
+                toks, y, (0, prompt_len + n_out))
+            cache = _rewind_index(cache, cur_pos + accepted + 1)
+            return toks, n_out + accepted + 1, cache, ticks + 1
+
+        toks, n_out, _, ticks = jax.lax.while_loop(
+            cond, body, (toks, jnp.int32(1), cache, jnp.int32(0)))
+        return toks[:, :prompt_len + max_new_tokens], n_out, ticks
+
+    return jax.jit(run)
+
+
+def generate_speculative(
+        model, variables, prompt, max_new_tokens: int, *,
+        draft_len: int = 7, ngram: int = 3,
+        return_stats: bool = False):
+    """Greedy generation, bit-identical to ``generate(temperature=0)``,
+    in (often far) fewer decode ticks. See the module docstring.
+
+    Args:
+      model: a non-decode :class:`~pddl_tpu.models.gpt.GPT` or
+        :class:`~pddl_tpu.models.llama.Llama` (anything
+        ``generate()``-compatible with a full-length KV cache).
+      variables: ``{"params": ...}`` from training / checkpoint import.
+      prompt: int32 ``[B, P]``, ``P >= 1``.
+      max_new_tokens: tokens to append (exact — same contract as
+        ``generate``).
+      draft_len: drafted tokens per tick; the verify block is
+        ``draft_len + 1`` wide. 7 keeps the block at 8 (MXU-lane
+        friendly) and caps the stale-cache tail at one block.
+      ngram: lookup key length. 3 balances precision (fewer spurious
+        matches) against recall on byte-level corpora.
+      return_stats: also return ``{"ticks", "emitted", "tokens_per_tick"}``
+        — the acceptance telemetry a serving stack wants on its dash.
+
+    Returns ``[B, P + max_new_tokens]`` int32, or ``(tokens, stats)``
+    with ``return_stats=True``.
+    """
+    b, p = prompt.shape
+    total = p + max_new_tokens
+    if p < 1:
+        raise ValueError("generate_speculative() needs a non-empty prompt")
+    if max_new_tokens < 1:
+        raise ValueError("max_new_tokens must be >= 1")
+    if draft_len < 1:
+        raise ValueError(f"draft_len must be >= 1, got {draft_len}")
+    if ngram < 1:
+        raise ValueError(f"ngram must be >= 1, got {ngram}")
+    # Cache writes reach index draft_len past the last emitted position.
+    if total + draft_len > model.max_len:
+        raise ValueError(
+            f"prompt + new tokens + draft_len {total + draft_len} exceed "
+            f"max_len {model.max_len} (speculative blocks write "
+            f"draft_len={draft_len} positions of lookahead)")
+    window = getattr(model, "sliding_window", None)
+    if window is not None and -(-window // 128) * 128 < model.max_len:
+        # Ring cache: block writes reuse slots of positions that rolled
+        # out of the window — after a partial rejection those slots are
+        # back INSIDE the rewound position's window, and their history
+        # is gone. Not recoverable; refuse rather than silently corrupt.
+        raise NotImplementedError(
+            "speculative decoding needs a full-length KV cache; "
+            f"sliding_window={window} < max_len={model.max_len} uses a "
+            "ring cache whose slots cannot be rewound")
+
+    dec = model.clone(decode=True)
+    run = _spec_program(dec, p, int(max_new_tokens), int(draft_len),
+                        int(ngram))
+    toks, emitted, ticks = run(variables["params"], prompt)
+    if not return_stats:
+        return toks
+    emitted = int(emitted)
+    ticks = int(ticks)
+    return toks, {
+        "ticks": ticks,
+        "emitted": emitted,
+        "tokens_per_tick": emitted / max(ticks, 1),
+    }
