@@ -63,6 +63,18 @@ class ExperimentConfig:
     # data
     data_dir: Optional[str] = None  # None → synthetic
     image_size: int = 224
+    # synthetic image task only: class-mean separation in noise-std units
+    # (data/synthetic.py signal_strength). The default 1.0 is a WEAK
+    # per-pixel signal — a finite replayed epoch lets a big model
+    # memorize instead of generalize and val sits at chance; raise it
+    # (e.g. 4.0) when a run needs the val metrics to really track
+    # learning, as real ImageNet's do (examples/workflow_rehearsal.py).
+    synthetic_signal: float = 1.0
+    # ResNet family: BatchNorm moving-average momentum override. None →
+    # the Keras-parity 0.99. Lower (e.g. 0.9) for short synthetic runs so
+    # inference-mode val metrics converge within the run — see
+    # models/resnet.py bn_momentum.
+    bn_momentum: Optional[float] = None
     per_replica_batch: int = 32
     val_per_replica_batch: Optional[int] = None
     data_shard: str = "data"  # "data" | "batch" | "none"

@@ -143,6 +143,13 @@ class ResNet(nn.Module):
     dtype: Any = jnp.float32  # compute dtype; bfloat16 for TPU speed
     param_dtype: Any = jnp.float32
     bn_mode: str = "train"  # "train" | "frozen"
+    # Keras-parity 0.99 by default. Lower it (e.g. 0.9) for SHORT runs:
+    # inference-mode metrics read the moving averages, and at 0.99 they
+    # carry ~[momentum^steps] of their zero/one init — ~45% after 80
+    # updates — so val metrics on few-hundred-step runs measure stat
+    # settling, not the model (the reference's 40k-step ImageNet epochs
+    # never see this; tiny synthetic epochs do).
+    bn_momentum: float = BN_MOMENTUM
     axis_name: Optional[str] = None  # per-replica sync-BN axis (shard_map only)
     kernel_init: Callable = nn.initializers.he_normal()
 
@@ -159,7 +166,7 @@ class ResNet(nn.Module):
         norm = functools.partial(
             nn.BatchNorm,
             use_running_average=use_running_average,
-            momentum=BN_MOMENTUM,
+            momentum=self.bn_momentum,
             epsilon=BN_EPSILON,
             dtype=self.dtype,
             param_dtype=self.param_dtype,
