@@ -25,7 +25,7 @@ import jax
 import jax.numpy as jnp
 
 from pddl_tpu.models.gpt import GPT_Small, generate
-from pddl_tpu.models.llama import Llama_Small
+from pddl_tpu.models.llama import Llama_1B, Llama_Small
 
 
 # Peak HBM bandwidth per chip, GB/s — the denominator of the decode
@@ -67,15 +67,17 @@ def _roofline_tokens_per_sec(model, variables, prompt_len: int,
 
 
 def _bench_generate(model, variables, batch: int, prompt_len: int,
-                    new_tokens: int, iters: int = 3) -> float:
+                    new_tokens: int, iters: int = 3,
+                    param_transform=None) -> float:
     prompt = jax.random.randint(jax.random.key(0), (batch, prompt_len),
                                 0, model.vocab_size)
-    out = generate(model, variables, prompt, max_new_tokens=new_tokens)
+    kw = dict(max_new_tokens=new_tokens, param_transform=param_transform)
+    out = generate(model, variables, prompt, **kw)
     int(out[0, -1])  # scalar fetch = sync under tunneled transports
     best = float("inf")
     for _ in range(iters):
         t0 = time.perf_counter()
-        out = generate(model, variables, prompt, max_new_tokens=new_tokens)
+        out = generate(model, variables, prompt, **kw)
         int(out[0, -1])
         best = min(best, time.perf_counter() - t0)
     return batch * new_tokens / best
@@ -85,19 +87,40 @@ def main() -> None:
     p = argparse.ArgumentParser()
     p.add_argument("--prompt-len", type=int, default=64)
     p.add_argument("--new-tokens", type=int, default=256)
+    p.add_argument("--models", default="",
+                   help="comma-joined subset of gpt_small,llama_small,"
+                        "llama_1b (default: the two smalls)")
+    p.add_argument("--int8", action="store_true",
+                   help="also measure weight-only int8 storage "
+                        "(ops/quant.py) — halves the B1 weight-read "
+                        "floor IF XLA streams the int8 (the comparison "
+                        "against the int8 roofline is the check)")
     p.add_argument("--out", default="")
     args = p.parse_args()
 
     # param_dtype=bf16: the serving configuration — decode is weight-
     # bandwidth-bound, so f32 storage would halve throughput for nothing.
-    models = {
-        "gpt_small": GPT_Small(vocab_size=50257, max_len=1024,
-                               dtype=jnp.bfloat16,
-                               param_dtype=jnp.bfloat16),
-        "llama_small": Llama_Small(vocab_size=32000, max_len=1024,
-                                   dtype=jnp.bfloat16,
-                                   param_dtype=jnp.bfloat16),
+    all_models = {
+        "gpt_small": lambda: GPT_Small(vocab_size=50257, max_len=1024,
+                                       dtype=jnp.bfloat16,
+                                       param_dtype=jnp.bfloat16),
+        "llama_small": lambda: Llama_Small(vocab_size=32000, max_len=1024,
+                                           dtype=jnp.bfloat16,
+                                           param_dtype=jnp.bfloat16),
+        # The 1B-on-one-chip headline's serving twin (2.2 GB of bf16
+        # weights: B1 decode is purely weight-read-bound, the int8 case
+        # that matters most).
+        "llama_1b": lambda: Llama_1B(vocab_size=128256, max_len=1024,
+                                     dtype=jnp.bfloat16,
+                                     param_dtype=jnp.bfloat16),
     }
+    names = args.models.split(",") if args.models else [
+        "gpt_small", "llama_small"]
+    unknown = set(names) - set(all_models)
+    if unknown:
+        raise SystemExit(f"unknown --models {sorted(unknown)}; "
+                         f"choose from {sorted(all_models)}")
+    models = {n: all_models[n]() for n in names}
     record = {
         "metric": "greedy_decode_new_tokens_per_sec",
         "unit": "tokens/sec/chip",
@@ -125,6 +148,31 @@ def main() -> None:
                   + (f" ({tps / roof:.0%} of {roof:,.0f} roofline)"
                      if batch == 1 and roof else ""),
                   file=sys.stderr, flush=True)
+        if args.int8:
+            from pddl_tpu.ops.quant import dequantize, quantize_int8
+
+            qvars = {"params": quantize_int8(variables["params"])}
+            # Same roofline formula over the STORED (int8) bytes: the
+            # q-leaf dicts flatten to int8 + scale + dtype-carrier
+            # leaves, so the weight-read numerator is what HBM actually
+            # holds.
+            roof8 = _roofline_tokens_per_sec(model, qvars,
+                                             args.prompt_len,
+                                             args.new_tokens)
+            for batch in (1, 8):
+                tps8 = _bench_generate(model, qvars, batch,
+                                       args.prompt_len, args.new_tokens,
+                                       param_transform=dequantize)
+                record["results"][f"{name}_int8_b{batch}"] = round(tps8, 1)
+                if batch == 1 and roof8 is not None:
+                    record["results"][f"{name}_int8_roofline_b1"] = round(
+                        roof8, 1)
+                    record["results"][f"{name}_int8_roofline_ratio_b1"] = (
+                        round(tps8 / roof8, 3))
+                print(f"{name} int8 B{batch}: {tps8:,.0f} new tokens/s"
+                      + (f" ({tps8 / roof8:.0%} of {roof8:,.0f} int8 "
+                         "roofline)" if batch == 1 and roof8 else ""),
+                      file=sys.stderr, flush=True)
 
     line = json.dumps(record)
     print(line)

@@ -113,6 +113,12 @@ def main() -> None:
     p.add_argument("--new-tokens", type=int, default=256)
     p.add_argument("--draft-len", type=int, default=7)
     p.add_argument("--ngram", type=int, default=3)
+    p.add_argument("--int8", action="store_true",
+                   help="also evaluate weight-only int8 serving: "
+                        "val-loss delta of the quantized model on "
+                        "held-out text, and int8 x speculative "
+                        "throughput (exactness asserted against the "
+                        "quantized model's own greedy decode)")
     p.add_argument("--work-dir", default="/tmp/pddl_specdecode")
     p.add_argument("--out", default="")
     args = p.parse_args()
@@ -161,6 +167,81 @@ def main() -> None:
         _log(f"{kind}: plain {plain:,.0f} tok/s, speculative {spec:,.0f} "
              f"tok/s ({spec / plain:.2f}x, {stats['tokens_per_tick']:.2f} "
              "tokens/tick)")
+
+    if args.int8:
+        from pddl_tpu.ops.quant import (dequantize, quantize_int8,
+                                        quantized_bytes)
+
+        qparams = quantize_int8(params)
+
+        # Quality: mean CE (nats/byte) over held-out windows, quantized
+        # weights vs the bf16 originals — the number a serving owner
+        # trades against the bytes.
+        n_eval, ebatch = 16, 8
+        win = args.seq_len + 1
+        starts = np.linspace(0, len(val_tokens) - win, n_eval * ebatch,
+                             dtype=np.int64)
+        chunks = np.stack([np.asarray(val_tokens[s:s + win])
+                           for s in starts]).astype(np.int32)
+
+        @jax.jit
+        def ce(p, tokens, targets):
+            logits = model.apply({"params": p}, tokens, train=False)
+            logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+            return -jnp.mean(jnp.take_along_axis(
+                logp, targets[..., None], axis=-1))
+
+        def eval_loss(p):
+            losses = [float(ce(p, jnp.asarray(c[:, :-1]),
+                               jnp.asarray(c[:, 1:])))
+                      for c in np.split(chunks, n_eval)]
+            return sum(losses) / len(losses)
+
+        loss_bf16 = eval_loss(params)
+        loss_int8 = eval_loss(dequantize(qparams))
+        stored = quantized_bytes(qparams)
+        dense = quantized_bytes(params)
+
+        # Throughput: int8 x speculative, exact vs the QUANTIZED model's
+        # own greedy decode (int8 changes the weights, so the oracle is
+        # int8 plain generate, not the bf16 series above).
+        qvars = {"params": qparams}
+        ref8 = generate(model, qvars, text_prompt,
+                        max_new_tokens=args.new_tokens,
+                        param_transform=dequantize)
+        out8, stats8 = generate_speculative(
+            model, qvars, text_prompt, args.new_tokens,
+            draft_len=args.draft_len, ngram=args.ngram,
+            return_stats=True, param_transform=dequantize)
+        np.testing.assert_array_equal(np.asarray(out8), np.asarray(ref8))
+        sync = lambda x: int((x[0] if isinstance(x, tuple) else x)[0, -1])
+        t_plain8 = _timed(
+            lambda: generate(model, qvars, text_prompt,
+                             max_new_tokens=args.new_tokens,
+                             param_transform=dequantize), sync)
+        t_spec8 = _timed(
+            lambda: generate_speculative(
+                model, qvars, text_prompt, args.new_tokens,
+                draft_len=args.draft_len, ngram=args.ngram,
+                param_transform=dequantize), sync)
+        record["results"]["int8_val_loss_nats"] = round(loss_int8, 5)
+        record["results"]["bf16_val_loss_nats"] = round(loss_bf16, 5)
+        record["results"]["int8_val_loss_delta_pct"] = round(
+            100.0 * (loss_int8 - loss_bf16) / loss_bf16, 3)
+        record["results"]["int8_stored_mb"] = round(stored["bytes"] / 2**20, 1)
+        record["results"]["bf16_stored_mb"] = round(dense["bytes"] / 2**20, 1)
+        record["results"]["int8_pycorpus_plain_b1"] = round(
+            args.new_tokens / t_plain8, 1)
+        record["results"]["int8_pycorpus_speculative_b1"] = round(
+            args.new_tokens / t_spec8, 1)
+        record["results"]["int8_pycorpus_tokens_per_tick"] = round(
+            stats8["tokens_per_tick"], 3)
+        _log(f"int8: val loss {loss_int8:.5f} vs bf16 {loss_bf16:.5f} "
+             f"({record['results']['int8_val_loss_delta_pct']:+.2f}%), "
+             f"{stored['bytes'] / 2**20:.0f} MB vs "
+             f"{dense['bytes'] / 2**20:.0f} MB; plain "
+             f"{args.new_tokens / t_plain8:,.0f} tok/s, speculative "
+             f"{args.new_tokens / t_spec8:,.0f} tok/s")
 
     line = json.dumps(record)
     print(line)

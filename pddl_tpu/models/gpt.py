@@ -259,7 +259,8 @@ def sample_logits(rng, logits, *, temperature: float = 1.0,
 
 def generate(model: GPT, variables, prompt, max_new_tokens: int, *,
              temperature: float = 0.0, top_k: Optional[int] = None,
-             top_p: Optional[float] = None, rng=None, strategy=None):
+             top_p: Optional[float] = None, rng=None, strategy=None,
+             param_transform=None):
     """Autoregressive sampling with a KV cache.
 
     Args:
@@ -278,6 +279,11 @@ def generate(model: GPT, variables, prompt, max_new_tokens: int, *,
         and each decode step compiles with the two per-block
         all-reduces on ICI — models too big for one chip generate
         without any model change.
+      param_transform: optional module-level function mapping
+        ``variables["params"]`` to apply-ready weights inside the jitted
+        programs — the int8 weight-only serving hook
+        (:func:`pddl_tpu.ops.quant.dequantize`); see `ops/quant.py`.
+        Unsharded path only.
 
     Returns int32 ``[B, P + max_new_tokens]`` (prompt + continuation).
     Execution model: one jitted batched prefill over the whole prompt,
@@ -311,8 +317,12 @@ def generate(model: GPT, variables, prompt, max_new_tokens: int, *,
     if strategy is None:
         cache = fresh_cache()
         step, run = _decode_programs(dec, temperature, top_k, top_p,
-                                     max_new_tokens)
+                                     max_new_tokens, param_transform)
     else:
+        if param_transform is not None:
+            raise NotImplementedError(
+                "param_transform (int8 serving) is unsharded-only: the "
+                "sharding trees below describe the DENSE params layout")
         # One batched transfer for the whole tree; the same sharding tree
         # feeds the jits' in_shardings.
         param_sh = strategy.tree_sharding(params)
@@ -338,7 +348,8 @@ def generate(model: GPT, variables, prompt, max_new_tokens: int, *,
     return jnp.concatenate([prompt, run(params, cache, logits, rng)], axis=1)
 
 
-def _decode_fns(dec, temperature, top_k, top_p, max_new_tokens):
+def _decode_fns(dec, temperature, top_k, top_p, max_new_tokens,
+                param_transform=None):
     """(step_fn, decode_all) python callables for a decode-mode model.
 
     params is an ARGUMENT of both functions, never a closure: closed-over
@@ -346,10 +357,18 @@ def _decode_fns(dec, temperature, top_k, top_p, max_new_tokens):
     into the executable — gigabyte compile payloads (remote-compile
     transports reject them outright) and a recompile for every new
     checkpoint.
+
+    ``param_transform`` (e.g. :func:`pddl_tpu.ops.quant.dequantize`)
+    maps the passed params tree to apply-ready weights INSIDE the jitted
+    programs — so what lives in HBM (and streams per tick) is the
+    transformed-FROM representation, int8 for the quant case, with the
+    convert fused into the consuming matmuls.
     """
+    pt = param_transform or (lambda p: p)
+
     def step_fn(params, cache, tok):
         logits, mutated = dec.apply(
-            {"params": params, "cache": cache}, tok,
+            {"params": pt(params), "cache": cache}, tok,
             train=False, mutable=["cache"],
         )
         return mutated["cache"], logits[:, -1]
@@ -396,7 +415,8 @@ def _decode_cache_shapes(dec, batch: int):
 
 
 @functools.lru_cache(maxsize=16)
-def _decode_programs(dec, temperature, top_k, top_p, max_new_tokens):
+def _decode_programs(dec, temperature, top_k, top_p, max_new_tokens,
+                     param_transform=None):
     """Jitted (prefill_step, decode_scan) for the unsharded path, CACHED
     on the (hashable, frozen) decode module + sampling statics.
 
@@ -406,10 +426,11 @@ def _decode_programs(dec, temperature, top_k, top_p, max_new_tokens):
     checkpoints of the same shape, which are just new jit arguments) hit
     the compiled programs. Entries keep the module and executables alive
     until LRU eviction (maxsize=16) or process exit — deliberate serving
-    behavior, not a leak.
+    behavior, not a leak. ``param_transform`` participates in the key by
+    identity — pass a module-level function (not a lambda) to hit.
     """
     step_fn, decode_all = _decode_fns(dec, temperature, top_k, top_p,
-                                      max_new_tokens)
+                                      max_new_tokens, param_transform)
     return jax.jit(step_fn), jax.jit(decode_all, donate_argnums=(1,))
 
 

@@ -63,6 +63,24 @@ def _default_intermediate_dim(embed_dim: int) -> int:
     return -(-(8 * embed_dim // 3) // 128) * 128
 
 
+def ring_len(sliding_window: Optional[int],
+             max_decode_len: int) -> Optional[int]:
+    """Rolling-cache length for SWA decode: the window rounded up to a
+    lane-friendly multiple of 128 (``>= window`` so the slot being
+    overwritten each step is always already outside the band), or None
+    when a full-length cache is smaller anyway.
+
+    THE single definition of the ring decision — the attention module
+    sizes its cache with it and ``Llama.uses_ring_cache`` (which
+    speculative decoding consults to refuse unrewindable caches) answers
+    from it, so the two can never diverge.
+    """
+    if sliding_window is None:
+        return None
+    ring = -(-sliding_window // 128) * 128
+    return ring if ring < max_decode_len else None
+
+
 def _rms_norm(eps: float, param_dtype, name: str):
     """Family-standard RMSNorm: f32 compute (stable under bf16), learned
     scale in ``param_dtype``."""
@@ -155,14 +173,8 @@ class LlamaAttention(nn.Module):
         return dense(features=e, name="out")(o)
 
     def _ring_len(self) -> Optional[int]:
-        """Rolling-cache length for SWA decode: the window rounded up to
-        a lane-friendly multiple of 128 (``>= window`` so the slot being
-        overwritten each step is always already outside the band), or
-        None when a full-length cache is smaller anyway."""
-        if self.sliding_window is None:
-            return None
-        ring = -(-self.sliding_window // 128) * 128
-        return ring if ring < self.max_decode_len else None
+        """Rolling-cache length for SWA decode (see :func:`ring_len`)."""
+        return ring_len(self.sliding_window, self.max_decode_len)
 
     def _decode_step(self, q, k, v, b, s, head_dim, dense):
         """KV-cache decoding at the bandwidth roofline.
@@ -358,6 +370,15 @@ class Llama(nn.Module):
     rms_eps: float = 1e-5
     dtype: Any = jnp.float32
     param_dtype: Any = jnp.float32
+
+    @property
+    def uses_ring_cache(self) -> bool:
+        """True when SWA decode allocates a rolling ring cache (slots
+        recycle — cannot be rewound; speculative decoding checks this).
+        Same decision, same code as the cache allocation:
+        :func:`ring_len` over the blocks' ``max_decode_len`` (=
+        ``max_len``, line where the blocks are built)."""
+        return ring_len(self.sliding_window, self.max_len) is not None
 
     @nn.compact
     def __call__(self, tokens, *, train: bool = True,

@@ -120,38 +120,50 @@ def _rewind_index(cache, new_index):
 
 
 @functools.lru_cache(maxsize=16)
-def _spec_program(dec, prompt_len: int, max_new_tokens: int,
-                  draft_len: int, ngram: int):
-    """One jitted program: prefill + the whole speculative loop.
+def _spec_programs(dec, draft_len: int, ngram: int, param_transform=None):
+    """Jitted (prefill, loop) pair, cached on the frozen decode module +
+    draft statics — like ``gpt._decode_programs``, params stay jit
+    ARGUMENTS (never baked-in constants).
 
-    Cached on the frozen decode module + statics for the same reason as
-    ``gpt._decode_programs``: serving calls must hit a compiled program,
-    and params stay jit ARGUMENTS (never baked-in constants). The entire
-    generation — prefill, every verify tick, draft lookup, acceptance —
-    is one dispatch, so transport latency is paid once per request.
+    The split mirrors ``generate()``: prefill re-traces per prompt
+    SHAPE (it has to — the prompt is an array), while the speculative
+    loop compiles ONCE per (module, batch, draft config) — the token
+    buffer is fixed at ``max_len + width`` and prompt length / token
+    budget enter as int32 runtime values, so varied-length serving
+    traffic neither recompiles the loop nor thrashes the LRU. Each
+    request is two dispatches (prefill, loop).
+
+    ``param_transform`` (keyed by identity — pass a module-level
+    function) maps the passed params to apply-ready weights inside the
+    programs: int8 weight storage composes with speculation this way.
     """
     width = draft_len + 1
+    buf_len = dec.max_len + width
+    pt = param_transform or (lambda p: p)
 
-    def run(params, prompt):
-        b = prompt.shape[0]
+    def prefill(params, prompt):
+        b, p = prompt.shape
         cache = jax.tree.map(
             lambda sd: jnp.zeros(sd.shape, sd.dtype),
             _decode_cache_shapes(dec, b))
+        # pt applies PER USE SITE (here and in the loop body), never
+        # once up front: a pre-loop transform would be loop-invariant,
+        # and XLA would hoist the dequantized dense weights out of the
+        # while loop — materializing exactly the copy int8 storage is
+        # meant to avoid.
         logits, mutated = dec.apply(
-            {"params": params, "cache": cache}, prompt,
+            {"params": pt(params), "cache": cache}, prompt,
             train=False, mutable=["cache"])
-        cache = mutated["cache"]
         first = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
-
-        buf_len = prompt_len + max_new_tokens + width
         toks = jnp.zeros((b, buf_len), jnp.int32)
         toks = jax.lax.dynamic_update_slice(toks, prompt, (0, 0))
-        toks = jax.lax.dynamic_update_slice(
-            toks, first[:, None], (0, prompt_len))
+        toks = jax.lax.dynamic_update_slice(toks, first[:, None], (0, p))
+        return mutated["cache"], toks
 
+    def loop(params, cache, toks, prompt_len, max_new):
         def cond(state):
             _, n_out, _, _ = state
-            return n_out < max_new_tokens
+            return n_out < max_new
 
         def body(state):
             toks, n_out, cache, ticks = state
@@ -160,7 +172,7 @@ def _spec_program(dec, prompt_len: int, max_new_tokens: int,
             cur = jax.lax.dynamic_slice(toks, (0, cur_pos), (toks.shape[0], 1))
             block = jnp.concatenate([cur, drafts], axis=1)  # [B, width]
             logits, mutated = dec.apply(
-                {"params": params, "cache": cache}, block,
+                {"params": pt(params), "cache": cache}, block,
                 train=False, mutable=["cache"])
             cache = mutated["cache"]
             y = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [B, width]
@@ -177,15 +189,15 @@ def _spec_program(dec, prompt_len: int, max_new_tokens: int,
 
         toks, n_out, _, ticks = jax.lax.while_loop(
             cond, body, (toks, jnp.int32(1), cache, jnp.int32(0)))
-        return toks[:, :prompt_len + max_new_tokens], n_out, ticks
+        return toks, n_out, ticks
 
-    return jax.jit(run)
+    return jax.jit(prefill), jax.jit(loop, donate_argnums=(1, 2))
 
 
 def generate_speculative(
         model, variables, prompt, max_new_tokens: int, *,
         draft_len: int = 7, ngram: int = 3,
-        return_stats: bool = False):
+        return_stats: bool = False, param_transform=None):
     """Greedy generation, bit-identical to ``generate(temperature=0)``,
     in (often far) fewer decode ticks. See the module docstring.
 
@@ -204,6 +216,11 @@ def generate_speculative(
         matches) against recall on byte-level corpora.
       return_stats: also return ``{"ticks", "emitted", "tokens_per_tick"}``
         — the acceptance telemetry a serving stack wants on its dash.
+      param_transform: optional module-level function mapping
+        ``variables["params"]`` to apply-ready weights inside the jitted
+        program (int8 weight-only serving,
+        :func:`pddl_tpu.ops.quant.dequantize`) — same hook as
+        ``generate()``.
 
     Returns ``[B, P + max_new_tokens]`` int32, or ``(tokens, stats)``
     with ``return_stats=True``.
@@ -224,26 +241,34 @@ def generate_speculative(
             f"prompt + new tokens + draft_len {total + draft_len} exceed "
             f"max_len {model.max_len} (speculative blocks write "
             f"draft_len={draft_len} positions of lookahead)")
-    window = getattr(model, "sliding_window", None)
-    if window is not None and -(-window // 128) * 128 < model.max_len:
+    if getattr(model, "uses_ring_cache", False):
         # Ring cache: block writes reuse slots of positions that rolled
         # out of the window — after a partial rejection those slots are
         # back INSIDE the rewound position's window, and their history
         # is gone. Not recoverable; refuse rather than silently corrupt.
+        # (The decision comes from the model — llama.ring_len, the same
+        # function that sizes the cache — so this gate cannot drift.)
         raise NotImplementedError(
             "speculative decoding needs a full-length KV cache; "
-            f"sliding_window={window} < max_len={model.max_len} uses a "
-            "ring cache whose slots cannot be rewound")
+            f"sliding_window={model.sliding_window} uses a ring cache "
+            "whose slots cannot be rewound")
 
     dec = model.clone(decode=True)
-    run = _spec_program(dec, p, int(max_new_tokens), int(draft_len),
-                        int(ngram))
-    toks, emitted, ticks = run(variables["params"], prompt)
+    prefill, loop = _spec_programs(dec, int(draft_len), int(ngram),
+                                   param_transform)
+    cache, toks = prefill(variables["params"], prompt)
+    toks, n_out, ticks = loop(variables["params"], cache, toks,
+                              jnp.int32(p), jnp.int32(max_new_tokens))
+    out = toks[:, :total]
     if not return_stats:
-        return toks
-    emitted = int(emitted)
+        return out
+    # The final tick may overshoot the budget by up to draft_len tokens
+    # that the slice above discards — report only DELIVERED tokens, so
+    # tokens_per_tick is the serving-visible rate, not the raw
+    # acceptance rate.
+    emitted = min(int(n_out), int(max_new_tokens))
     ticks = int(ticks)
-    return toks, {
+    return out, {
         "ticks": ticks,
         "emitted": emitted,
         "tokens_per_tick": emitted / max(ticks, 1),

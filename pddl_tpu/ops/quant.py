@@ -1,0 +1,123 @@
+"""Weight-only int8 storage for serving (w8a16: int8 weights, bf16 math).
+
+Why: single-stream decode reads every matmul parameter once per tick
+(ARCHITECTURE.md §7e — the layer GEMV chain runs near its weight-read
+bound), so the B1 weight-read floor is set by parameter BYTES, not
+FLOPs. Storing matmul kernels as int8 with per-output-channel scales
+halves those bytes against bf16; activations and arithmetic stay in the
+model's compute dtype, so the only numeric change is the weight
+rounding (measured, not assumed — `benchmarks/specdecode_bench.py
+--int8` reports the val-loss delta of the quantized model on held-out
+text alongside the throughput).
+
+Mechanics — deliberately framework-light:
+
+- :func:`quantize_int8` walks a params tree and replaces each eligible
+  kernel ``w`` (ndim >= 2, size >= ``min_elems``, not an embedding) with
+  a dict ``{"qvalue": int8, "scale": f32, "like": dtype-carrier}``:
+  symmetric per-output-channel quantization with the scale reduced over
+  the CONTRACTION axis (axis 0 — every Dense/DenseGeneral kernel in the
+  model families contracts its leading axis), so each output channel
+  spans the full int8 range independently.
+- :func:`dequantize` maps the tree back to dense weights
+  (``q * scale`` in f32, cast to the original dtype recorded by the
+  zero-length ``like`` leaf). It is the ``param_transform`` hook of the
+  decode programs (:func:`pddl_tpu.models.gpt.generate`): applied
+  INSIDE the jitted program, every tick, so the int8 tensors are what
+  lives in (and streams from) HBM — XLA fuses the convert+scale into
+  the consuming matmul's operand read rather than materializing a dense
+  copy.
+- Embeddings are skipped by name (``embed`` in the path): decode
+  GATHERS one row per token — quantizing a table that contributes no
+  streaming traffic buys nothing and the axis-0 scale rule would be
+  wrong for a ``[vocab, features]`` gather anyway. Norm scales/biases
+  fall under ``min_elems``.
+
+Reference stake: the reference's endpoint is ``model.save`` then serve
+(`/root/reference/imagenet-resnet50.py:72`); this is the serving
+memory/bandwidth story for that artifact on TPU.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["quantize_int8", "dequantize", "quantized_bytes"]
+
+_QKEYS = frozenset(("qvalue", "scale", "like"))
+
+
+def _is_qleaf(node) -> bool:
+    return isinstance(node, dict) and set(node.keys()) == _QKEYS
+
+
+def quantize_int8(params, *, min_elems: int = 65536):
+    """Params tree → tree with eligible kernels stored as int8.
+
+    Eligible: array leaves with ``ndim >= 2`` and ``size >= min_elems``
+    whose path does not mention an embedding. The default ``min_elems``
+    keeps every norm/bias (and tiny test-model kernels) in their
+    original dtype — quantizing them saves nothing and costs accuracy.
+    """
+    def walk(node, path):
+        if isinstance(node, dict):
+            return {k: walk(v, path + (k,)) for k, v in node.items()}
+        w = jnp.asarray(node)
+        name = "/".join(str(p) for p in path).lower()
+        if w.ndim < 2 or w.size < min_elems or "embed" in name:
+            return w
+        # Symmetric per-output-channel: reduce |w| over the contraction
+        # axis (0). amax==0 channels (a dead column) get scale 1 to keep
+        # the division finite; their quantized values are all zero.
+        amax = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=0)
+        scale = jnp.where(amax > 0, amax / 127.0, 1.0)
+        q = jnp.clip(jnp.round(w.astype(jnp.float32) / scale),
+                     -127, 127).astype(jnp.int8)
+        return {"qvalue": q, "scale": scale,
+                "like": jnp.zeros((0,), w.dtype)}
+
+    return walk(params, ())
+
+
+def dequantize(qparams):
+    """Inverse of :func:`quantize_int8`; identity on untouched leaves.
+
+    Safe to call inside jit (this is the decode programs'
+    ``param_transform``): the dequant is traced per use site, and the
+    convert+scale fuses into the consuming matmul's operand read.
+    """
+    def walk(node):
+        if _is_qleaf(node):
+            w = node["qvalue"].astype(jnp.float32) * node["scale"]
+            return w.astype(node["like"].dtype)
+        if isinstance(node, dict):
+            return {k: walk(v) for k, v in node.items()}
+        return node
+
+    return walk(qparams)
+
+
+def quantized_bytes(tree) -> Dict[str, int]:
+    """{"bytes": total stored bytes, "quantized_leaves": n} — the memory
+    claim as a measurement, not arithmetic."""
+    total, nq = 0, 0
+
+    def walk(node):
+        nonlocal total, nq
+        if _is_qleaf(node):
+            nq += 1
+            total += (node["qvalue"].size * node["qvalue"].dtype.itemsize
+                      + node["scale"].size * node["scale"].dtype.itemsize)
+            return
+        if isinstance(node, dict):
+            for v in node.values():
+                walk(v)
+            return
+        arr = jnp.asarray(node)
+        total += arr.size * arr.dtype.itemsize
+
+    walk(tree)
+    return {"bytes": int(total), "quantized_leaves": int(nq)}

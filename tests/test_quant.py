@@ -1,0 +1,117 @@
+"""Weight-only int8 serving: quantize/dequantize + the decode hook.
+
+Quality on real text is the bench's job (`specdecode_bench.py --int8`);
+here we pin the mechanics: which leaves quantize, the error bound per
+output channel, the storage halving, and that the ``param_transform``
+hook in both decode paths reproduces exactly what running on the
+dequantized weights produces (the hook moves WHERE dequant happens, not
+what is computed).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pddl_tpu.models.gpt import generate, tiny_gpt
+from pddl_tpu.models.llama import tiny_llama
+from pddl_tpu.models.speculative import generate_speculative
+from pddl_tpu.ops.quant import dequantize, quantize_int8, quantized_bytes
+
+
+def _params(model, prompt):
+    return model.init(jax.random.key(0), prompt, train=False)["params"]
+
+
+def test_roundtrip_error_bounded_per_channel():
+    w = jax.random.normal(jax.random.key(1), (256, 512)) * jnp.linspace(
+        0.01, 10.0, 512)[None, :]  # wildly different channel ranges
+    tree = {"dense": {"kernel": w}}
+    q = quantize_int8(tree, min_elems=1)
+    back = dequantize(q)["dense"]["kernel"]
+    # Symmetric 127-level: per-element error <= scale/2 = amax/254.
+    bound = jnp.max(jnp.abs(w), axis=0) / 254.0
+    assert jnp.all(jnp.abs(back - w) <= bound + 1e-7)
+    # Per-channel matters: the smallest channel's error obeys its OWN
+    # amax bound, orders of magnitude below what the global (per-tensor)
+    # amax would allow.
+    small_err = jnp.max(jnp.abs((back - w)[:, 0]))
+    assert small_err <= jnp.max(jnp.abs(w[:, 0])) / 254.0 + 1e-7
+    assert small_err < jnp.max(jnp.abs(w)) / 254.0 / 50.0
+
+
+def test_eligibility_rules():
+    model = tiny_gpt(vocab_size=32, max_len=64)
+    params = _params(model, jnp.zeros((1, 8), jnp.int32))
+    q = quantize_int8(params, min_elems=128)
+    flat = jax.tree_util.tree_flatten_with_path(
+        q, is_leaf=lambda n: isinstance(n, dict)
+        and set(n) == {"qvalue", "scale", "like"})[0]
+    quantized = {"/".join(str(getattr(p, "key", p)) for p in path)
+                 for path, node in flat
+                 if isinstance(node, dict) and "qvalue" in node}
+    # Embeddings never quantize (gathered, not streamed); biases and
+    # norm scales are 1-D.
+    assert not any("embed" in k.lower() for k in quantized)
+    assert any("lm_head" in k for k in quantized)
+    assert any("block" in k for k in quantized)
+    stats = quantized_bytes(q)
+    dense = quantized_bytes(params)
+    assert stats["quantized_leaves"] > 0
+    # f32 params: int8 storage cuts the quantized share ~4x; overall
+    # strictly smaller.
+    assert stats["bytes"] < dense["bytes"]
+    # Original dtype round-trips through the "like" carrier.
+    leaves = jax.tree.leaves(dequantize(q))
+    assert all(l.dtype == jnp.float32 for l in leaves)
+
+
+def test_amax_zero_channel_is_finite():
+    w = jnp.zeros((64, 8)).at[:, :4].set(1.0)
+    q = quantize_int8({"k": w}, min_elems=1)
+    back = dequantize(q)["k"]
+    assert jnp.all(jnp.isfinite(back))
+    np.testing.assert_array_equal(np.asarray(back), np.asarray(w))
+
+
+@pytest.mark.parametrize("factory", [tiny_gpt, tiny_llama],
+                         ids=["gpt", "llama"])
+def test_generate_param_transform_hook(factory):
+    """generate(qparams, param_transform=dequantize) must equal
+    generate(dequantize(qparams)) — identical weights, identical f32
+    elementwise dequant math, only the jit boundary moves."""
+    model = factory(vocab_size=32, max_len=64)
+    prompt = jnp.tile(jnp.arange(6, dtype=jnp.int32), (2, 2))
+    params = _params(model, prompt)
+    qparams = quantize_int8(params, min_elems=128)
+    ref = generate(model, {"params": dequantize(qparams)}, prompt,
+                   max_new_tokens=16)
+    out = generate(model, {"params": qparams}, prompt, max_new_tokens=16,
+                   param_transform=dequantize)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+def test_speculative_param_transform_hook():
+    model = tiny_gpt(vocab_size=32, max_len=96)
+    prompt = jnp.tile(jnp.arange(7, dtype=jnp.int32), (1, 3))[:, :18]
+    params = _params(model, prompt)
+    qparams = quantize_int8(params, min_elems=128)
+    ref = generate(model, {"params": dequantize(qparams)}, prompt,
+                   max_new_tokens=24)
+    out = generate_speculative(model, {"params": qparams}, prompt, 24,
+                               param_transform=dequantize)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+def test_sharded_generate_rejects_param_transform(mesh4x2):
+    from pddl_tpu.parallel.tensor_parallel import TensorParallelStrategy
+
+    model = tiny_gpt(vocab_size=32, max_len=64)
+    prompt = jnp.zeros((1, 4), jnp.int32)
+    params = _params(model, prompt)
+    strategy = TensorParallelStrategy(model_parallel=2)
+    strategy.setup()
+    with pytest.raises(NotImplementedError, match="unsharded"):
+        generate(model, {"params": quantize_int8(params, min_elems=128)},
+                 prompt, max_new_tokens=4, strategy=strategy,
+                 param_transform=dequantize)

@@ -4,8 +4,8 @@ The contract under test is EXACTNESS: `generate_speculative` must be
 bit-identical to `generate(temperature=0)` for every model family and
 acceptance pattern — matching drafts, mismatching drafts, and the
 mixed-batch case where rows accept different lengths (min-over-batch
-acceptance). Speed is the chip bench's job (`benchmarks/decode_bench.py
---speculative`); here we only assert the mechanism's telemetry moves the
+acceptance). Speed is the chip bench's job
+(`benchmarks/specdecode_bench.py`); here we only assert the mechanism's telemetry moves the
 right way on text the draft CAN predict (a learned periodic sequence).
 """
 
