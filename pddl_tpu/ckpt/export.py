@@ -93,6 +93,7 @@ def export_decode_programs(
     top_k: Optional[int] = None,
     top_p: Optional[float] = None,
     platforms: Optional[Sequence[str]] = None,
+    param_transform=None,
 ) -> dict:
     """Serialize the full GENERATION pipeline as two StableHLO programs.
 
@@ -110,7 +111,12 @@ def export_decode_programs(
       on-device ``lax.scan`` dispatch, sampling included.
 
     Parameters are call ARGUMENTS (new checkpoints of the same shape
-    reuse the artifact; weights never bloat the program). The RNG enters
+    reuse the artifact; weights never bloat the program). With
+    ``param_transform`` (int8 serving: pass the QUANTIZED tree as
+    ``params`` and :func:`pddl_tpu.ops.quant.dequantize` here) the
+    artifact's parameter arguments are the int8+scale leaves and the
+    dequant compiles INTO the programs — the serving runtime ships and
+    holds half the bytes. The RNG enters
     as raw ``uint32[2]`` key data (``jax.random.key_data``) so the
     serving boundary carries no JAX-extended dtypes. The KV-cache tree
     flows between the two calls opaquely — a server treats it as a
@@ -127,7 +133,7 @@ def export_decode_programs(
 
     dec = model.clone(decode=True)
     step_fn, decode_all = _decode_fns(dec, temperature, top_k, top_p,
-                                      max_new_tokens)
+                                      max_new_tokens, param_transform)
     cache_shapes = _decode_cache_shapes(dec, batch)
 
     def prefill(p, prompt):
@@ -159,6 +165,7 @@ def export_decode_programs(
         "max_new_tokens": max_new_tokens, "temperature": temperature,
         "top_k": top_k, "top_p": top_p,
         "platforms": list(pre.platforms),
+        "quantized_params": param_transform is not None,
     }
     return {"prefill": pre.serialize(), "decode": run.serialize(),
             "manifest": manifest}

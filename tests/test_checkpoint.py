@@ -369,3 +369,35 @@ def test_decode_program_export_llama(tmp_path):
     want = generate(model, variables, prompt, 10)
     np.testing.assert_array_equal(np.asarray(toks),
                                   np.asarray(want[:, 8:]))
+
+
+def test_decode_program_export_int8(tmp_path):
+    """Int8 serving exports: the artifact's parameter ARGUMENTS are the
+    int8+scale leaves (half the serving bytes) and the dequant compiles
+    into the programs — reload must reproduce generate() on the same
+    quantized weights exactly."""
+    import jax.numpy as jnp
+
+    from pddl_tpu.ckpt.export import (
+        load_decode_artifact,
+        save_decode_artifact,
+    )
+    from pddl_tpu.models.gpt import generate, tiny_gpt
+    from pddl_tpu.ops.quant import dequantize, quantize_int8
+
+    model = tiny_gpt(vocab_size=32, max_len=64)
+    prompt = jnp.arange(8, dtype=jnp.int32).reshape(2, 4) % 32
+    params = model.init(jax.random.key(0), prompt)["params"]
+    qparams = quantize_int8(params, min_elems=128)
+
+    path = str(tmp_path / "decode_int8.zip")
+    save_decode_artifact(path, model, qparams, batch=2, prompt_len=4,
+                         max_new_tokens=9, param_transform=dequantize)
+    prefill, decode, manifest = load_decode_artifact(path)
+    assert manifest["quantized_params"] is True
+    cache, logits = prefill(qparams, prompt)
+    toks = decode(qparams, cache, logits,
+                  jax.random.key_data(jax.random.key(0)))
+    want = generate(model, {"params": dequantize(qparams)}, prompt, 9)
+    np.testing.assert_array_equal(np.asarray(toks),
+                                  np.asarray(want[:, 4:]))
