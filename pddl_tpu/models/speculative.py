@@ -43,13 +43,18 @@ matched further simply re-derives those tokens next tick. The win is
 largest at B=1, which is exactly where the fixed per-tick cost dominates
 (§7e).
 
+Tensor parallelism composes (``strategy=``): the verify forward runs
+Megatron-sharded with its ICI all-reduces while the draft/accept/rewind
+machinery stays on the replicated token buffer — acceptance depends
+only on logits, which TP reproduces exactly.
+
 Exclusions, all validated loudly: greedy only (temperature sampling
 would need stochastic verification — rejection sampling — to stay
 unbiased); no sliding-window RING cache (a partially rejected block has
 already overwritten ring slots that rolled out of the window but are
 still inside it for the rewound position — unsound to rewind; models
 whose ``sliding_window`` rounds up to ``>= max_len`` use a full cache
-and remain eligible); no tensor-parallel ``strategy`` yet.
+and remain eligible); int8 ``param_transform`` is unsharded-only.
 
 Reference stake: the reference's endpoint is ``model.save`` then serve
 (`/root/reference/imagenet-resnet50.py:72`); this is the serving path's
@@ -119,24 +124,10 @@ def _rewind_index(cache, new_index):
         cache)
 
 
-@functools.lru_cache(maxsize=16)
-def _spec_programs(dec, draft_len: int, ngram: int, param_transform=None):
-    """Jitted (prefill, loop) pair, cached on the frozen decode module +
-    draft statics — like ``gpt._decode_programs``, params stay jit
-    ARGUMENTS (never baked-in constants).
-
-    The split mirrors ``generate()``: prefill re-traces per prompt
-    SHAPE (it has to — the prompt is an array), while the speculative
-    loop compiles ONCE per (module, batch, draft config) — the token
-    buffer is fixed at ``max_len + width`` and prompt length / token
-    budget enter as int32 runtime values, so varied-length serving
-    traffic neither recompiles the loop nor thrashes the LRU. Each
-    request is two dispatches (prefill, loop).
-
-    ``param_transform`` (keyed by identity — pass a module-level
-    function) maps the passed params to apply-ready weights inside the
-    programs: int8 weight storage composes with speculation this way.
-    """
+def _spec_fns(dec, draft_len: int, ngram: int, param_transform=None):
+    """(prefill, loop) python callables — the speculative twin of
+    ``gpt._decode_fns``; the jit wrappers below (unsharded and
+    tensor-parallel) compile exactly these."""
     width = draft_len + 1
     buf_len = dec.max_len + width
     pt = param_transform or (lambda p: p)
@@ -191,13 +182,67 @@ def _spec_programs(dec, draft_len: int, ngram: int, param_transform=None):
             cond, body, (toks, jnp.int32(1), cache, jnp.int32(0)))
         return toks, n_out, ticks
 
+    return prefill, loop
+
+
+@functools.lru_cache(maxsize=16)
+def _spec_programs(dec, draft_len: int, ngram: int, param_transform=None):
+    """Jitted (prefill, loop) pair, cached on the frozen decode module +
+    draft statics — like ``gpt._decode_programs``, params stay jit
+    ARGUMENTS (never baked-in constants).
+
+    The split mirrors ``generate()``: prefill re-traces per prompt
+    SHAPE (it has to — the prompt is an array), while the speculative
+    loop compiles ONCE per (module, batch, draft config) — the token
+    buffer is fixed at ``max_len + width`` and prompt length / token
+    budget enter as int32 runtime values, so varied-length serving
+    traffic neither recompiles the loop nor thrashes the LRU. Each
+    request is two dispatches (prefill, loop).
+
+    ``param_transform`` (keyed by identity — pass a module-level
+    function) maps the passed params to apply-ready weights inside the
+    programs: int8 weight storage composes with speculation this way.
+    """
+    prefill, loop = _spec_fns(dec, draft_len, ngram, param_transform)
     return jax.jit(prefill), jax.jit(loop, donate_argnums=(1, 2))
+
+
+@functools.lru_cache(maxsize=16)
+def _sharded_spec_programs(dec, draft_len: int, ngram: int,
+                           param_sh_def, param_sh_leaves,
+                           cache_sh_def, cache_sh_leaves):
+    """Tensor-parallel twin of :func:`_spec_programs` — same body
+    functions, compiled with the strategy's parameter/cache shardings
+    (the SPMD partitioner inserts the per-block all-reduces on ICI,
+    exactly as in ``gpt._sharded_decode_programs``); the token buffer,
+    logits, and scalars stay replicated. Keys are sharding VALUES
+    (NamedShardings hash by value), so a strategy rebuilt per request
+    still hits the cache.
+    """
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    if not param_sh_leaves:
+        raise ValueError(
+            "sharded speculative decode needs a non-empty params tree "
+            "(got zero parameter leaves — was the model initialized?)")
+    param_sh = jax.tree_util.tree_unflatten(param_sh_def, param_sh_leaves)
+    cache_sh = jax.tree_util.tree_unflatten(cache_sh_def, cache_sh_leaves)
+    repl = NamedSharding(param_sh_leaves[0].mesh, PartitionSpec())
+    prefill, loop = _spec_fns(dec, draft_len, ngram, None)
+    prefill_j = jax.jit(prefill,
+                        in_shardings=(param_sh, repl),
+                        out_shardings=(cache_sh, repl))
+    loop_j = jax.jit(loop, donate_argnums=(1, 2),
+                     in_shardings=(param_sh, cache_sh, repl, repl, repl),
+                     out_shardings=(repl, repl, repl))
+    return prefill_j, loop_j
 
 
 def generate_speculative(
         model, variables, prompt, max_new_tokens: int, *,
         draft_len: int = 7, ngram: int = 3,
-        return_stats: bool = False, param_transform=None):
+        return_stats: bool = False, param_transform=None,
+        strategy=None):
     """Greedy generation, bit-identical to ``generate(temperature=0)``,
     in (often far) fewer decode ticks. See the module docstring.
 
@@ -220,7 +265,14 @@ def generate_speculative(
         ``variables["params"]`` to apply-ready weights inside the jitted
         program (int8 weight-only serving,
         :func:`pddl_tpu.ops.quant.dequantize`) — same hook as
-        ``generate()``.
+        ``generate()``. Unsharded path only.
+      strategy: optional tensor-parallel strategy (mesh already set up),
+        same contract as ``generate()``: weights and KV cache shard
+        Megatron-style over the ``model`` axis, the verify forward runs
+        with the per-block all-reduces on ICI, and the draft/accept/
+        rewind machinery operates on the replicated token buffer —
+        speculation and TP compose because acceptance depends only on
+        logits, which TP reproduces exactly.
 
     Returns ``[B, P + max_new_tokens]`` int32, or ``(tokens, stats)``
     with ``return_stats=True``.
@@ -254,10 +306,27 @@ def generate_speculative(
             "whose slots cannot be rewound")
 
     dec = model.clone(decode=True)
-    prefill, loop = _spec_programs(dec, int(draft_len), int(ngram),
-                                   param_transform)
-    cache, toks = prefill(variables["params"], prompt)
-    toks, n_out, ticks = loop(variables["params"], cache, toks,
+    params = variables["params"]
+    if strategy is None:
+        prefill, loop = _spec_programs(dec, int(draft_len), int(ngram),
+                                       param_transform)
+    else:
+        if param_transform is not None:
+            raise NotImplementedError(
+                "param_transform (int8 serving) is unsharded-only: the "
+                "strategy's sharding trees describe the DENSE params "
+                "layout")
+        param_sh = strategy.tree_sharding(params)
+        params = jax.device_put(params, param_sh)
+        cache_sh = strategy.decode_cache_sharding(
+            _decode_cache_shapes(dec, b))
+        p_leaves, p_def = jax.tree_util.tree_flatten(param_sh)
+        c_leaves, c_def = jax.tree_util.tree_flatten(cache_sh)
+        prefill, loop = _sharded_spec_programs(
+            dec, int(draft_len), int(ngram),
+            p_def, tuple(p_leaves), c_def, tuple(c_leaves))
+    cache, toks = prefill(params, prompt)
+    toks, n_out, ticks = loop(params, cache, toks,
                               jnp.int32(p), jnp.int32(max_new_tokens))
     out = toks[:, :total]
     if not return_stats:

@@ -141,3 +141,36 @@ def test_speculative_swa_full_cache_ok():
     ref = generate(model, variables, prompt, max_new_tokens=24)
     out = generate_speculative(model, variables, prompt, 24)
     np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+def test_speculative_tensor_parallel_matches_single_device(mesh4x2):
+    """Speculation x TP: the sharded verify forward (Megatron weights +
+    head-sharded cache, all-reduces on the mesh) must reproduce the
+    single-device speculative output — which is itself bit-equal to
+    greedy. Drafting/acceptance run on replicated tokens, so the only
+    thing TP can break is the logits, and this catches that."""
+    from pddl_tpu.parallel.tensor_parallel import TensorParallelStrategy
+
+    model = tiny_gpt(vocab_size=16, max_len=96)
+    variables = {"params": model.init(jax.random.key(0),
+                                      jnp.zeros((1, 4), jnp.int32),
+                                      train=False)["params"]}
+    prompt = _repetitive_prompt(1, 12, 16)
+
+    ref = generate(model, variables, prompt, max_new_tokens=24)
+    strategy = TensorParallelStrategy(model_parallel=2)
+    strategy._mesh = mesh4x2
+    out, stats = generate_speculative(model, variables, prompt, 24,
+                                      strategy=strategy,
+                                      return_stats=True)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+    assert stats["emitted"] == 24 and stats["ticks"] >= 1
+
+    # int8 stays unsharded-only, loudly.
+    from pddl_tpu.ops.quant import dequantize, quantize_int8
+
+    with pytest.raises(NotImplementedError, match="unsharded"):
+        generate_speculative(
+            model, {"params": quantize_int8(variables["params"],
+                                            min_elems=128)},
+            prompt, 8, strategy=strategy, param_transform=dequantize)
