@@ -46,7 +46,7 @@ def _log(msg: str) -> None:
 
 
 def _train_on_pycorpus(model, steps: int, seq_len: int, batch: int,
-                       work_dir: str):
+                       work_dir: str, param_update: str = "plain"):
     """Brief byte-level LM training; returns (params, val_tokens)."""
     from examples.real_data_convergence import (_build_atomically,
                                                 build_python_corpus)
@@ -61,6 +61,7 @@ def _train_on_pycorpus(model, steps: int, seq_len: int, batch: int,
         val_batch_size=batch)
     tr = Trainer(model, optimizer="adamw", learning_rate=3e-4,
                  strategy=SingleDeviceStrategy(), seed=0,
+                 param_update=param_update,
                  input_key="tokens", target_key="targets")
     t0 = time.time()
     hist = tr.fit(train_ds, epochs=1, steps_per_epoch=steps, verbose=0)
@@ -119,16 +120,35 @@ def main() -> None:
                         "held-out text, and int8 x speculative "
                         "throughput (exactness asserted against the "
                         "quantized model's own greedy decode)")
+    p.add_argument("--family", default="llama_small",
+                   choices=("llama_small", "llama_1b"),
+                   help="llama_1b: the 1B-on-one-chip serving story -- "
+                        "trained with the safe bf16 recipe (stochastic "
+                        "rounding), where int8 x speculation matters "
+                        "most (the 1B is weight-read-bound)")
     p.add_argument("--work-dir", default="/tmp/pddl_specdecode")
     p.add_argument("--out", default="")
     args = p.parse_args()
 
     # Serving configuration: bf16 storage + compute, same as decode_bench.
-    model = Llama_Small(vocab_size=256, max_len=1024,
-                        dtype=jnp.bfloat16, param_dtype=jnp.bfloat16)
+    if args.family == "llama_1b":
+        from pddl_tpu.models.llama import Llama_1B
+
+        model = Llama_1B(vocab_size=256, max_len=1024,
+                         dtype=jnp.bfloat16, param_dtype=jnp.bfloat16)
+        model_desc = "llama_1b (16x2048, GQA 32/8, vocab 256)"
+        # bf16 params on one chip -> the measured-safe update rule
+        # (docs/CONVERGENCE.md): stochastic rounding, bf16 moments.
+        param_update = "stochastic_round"
+        args.train_batch = min(args.train_batch, 8)
+    else:
+        model = Llama_Small(vocab_size=256, max_len=1024,
+                            dtype=jnp.bfloat16, param_dtype=jnp.bfloat16)
+        model_desc = "llama_small (12x768, GQA 12/4, vocab 256)"
+        param_update = "plain"
     params, val_tokens, final_loss = _train_on_pycorpus(
         model, args.train_steps, args.seq_len, args.train_batch,
-        args.work_dir)
+        args.work_dir, param_update)
     variables = {"params": params}
 
     # Real-text prompt: a held-out Python source window. Random prompt:
@@ -143,7 +163,8 @@ def main() -> None:
         "metric": "speculative_decode_new_tokens_per_sec",
         "unit": "tokens/sec/chip",
         "config": {
-            "model": "llama_small (12x768, GQA 12/4, vocab 256)",
+            "model": model_desc,
+            "param_update": param_update,
             "trained_steps": args.train_steps,
             "final_train_loss_nats": round(final_loss, 4),
             "prompt_len": args.prompt_len, "new_tokens": args.new_tokens,
