@@ -233,7 +233,20 @@ def sample_logits(rng, logits, *, temperature: float = 1.0,
     if temperature <= 0:
         # Greedy limit (filters never change the argmax); avoids the /0.
         return jnp.argmax(logits, axis=-1)
-    logits = logits / temperature
+    return jax.random.categorical(
+        rng, filtered_logits(logits, temperature=temperature,
+                             top_k=top_k, top_p=top_p), axis=-1)
+
+
+def filtered_logits(logits, *, temperature: float,
+                    top_k: Optional[int] = None,
+                    top_p: Optional[float] = None):
+    """The warp+filter pipeline of :func:`sample_logits` WITHOUT the
+    draw: f32 logits whose softmax is the exact sampling distribution.
+    Shared with speculative decoding's verifier, whose accept/residual
+    probabilities must be computed from the same filtered distribution
+    a plain sampler would draw from."""
+    logits = logits.astype(jnp.float32) / temperature
     if top_k is not None:
         kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
         logits = jnp.where(logits < kth, -jnp.inf, logits)
@@ -254,7 +267,7 @@ def sample_logits(rng, logits, *, temperature: float = 1.0,
         inv_idx = jnp.argsort(sort_idx, axis=-1)
         keep = jnp.take_along_axis(keep_sorted, inv_idx, axis=-1)
         logits = jnp.where(keep, logits, -jnp.inf)
-    return jax.random.categorical(rng, logits, axis=-1)
+    return logits
 
 
 def generate(model: GPT, variables, prompt, max_new_tokens: int, *,

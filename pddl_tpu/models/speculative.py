@@ -48,13 +48,19 @@ Megatron-sharded with its ICI all-reduces while the draft/accept/rewind
 machinery stays on the replicated token buffer — acceptance depends
 only on logits, which TP reproduces exactly.
 
-Exclusions, all validated loudly: greedy only (temperature sampling
-would need stochastic verification — rejection sampling — to stay
-unbiased); no sliding-window RING cache (a partially rejected block has
-already overwritten ring slots that rolled out of the window but are
-still inside it for the rewound position — unsound to rewind; models
-whose ``sliding_window`` rounds up to ``>= max_len`` use a full cache
-and remain eligible); int8 ``param_transform`` is unsharded-only.
+Temperature sampling composes too: ``temperature > 0`` switches the
+verifier to SPECULATIVE SAMPLING (rejection scheme — accept draft ``d``
+with probability ``p(d)``, sample the masked residual on rejection, a
+bonus draw when everything survives; see ``_spec_fns``), which draws
+every token from exactly the filtered distribution
+``gpt.sample_logits`` uses — unbiased, just fewer ticks.
+
+Exclusions, all validated loudly: no sliding-window RING cache (a
+partially rejected block has already overwritten ring slots that rolled
+out of the window but are still inside it for the rewound position —
+unsound to rewind; models whose ``sliding_window`` rounds up to
+``>= max_len`` use a full cache and remain eligible); int8
+``param_transform`` is unsharded-only.
 
 Reference stake: the reference's endpoint is ``model.save`` then serve
 (`/root/reference/imagenet-resnet50.py:72`); this is the serving path's
@@ -124,13 +130,38 @@ def _rewind_index(cache, new_index):
         cache)
 
 
-def _spec_fns(dec, draft_len: int, ngram: int, param_transform=None):
+def _spec_fns(dec, draft_len: int, ngram: int, param_transform=None,
+              temperature: float = 0.0, top_k=None, top_p=None):
     """(prefill, loop) python callables — the speculative twin of
     ``gpt._decode_fns``; the jit wrappers below (unsharded and
-    tensor-parallel) compile exactly these."""
+    tensor-parallel) compile exactly these.
+
+    ``temperature > 0`` switches the verifier from exact-greedy
+    acceptance to SPECULATIVE SAMPLING (the standard rejection scheme
+    for a point-mass draft): draft ``d`` under target distribution
+    ``p`` is accepted with probability ``p(d)``; on the first rejection
+    the correction token samples the residual ``norm(max(p - 1_d, 0))``
+    — i.e. ``p`` with ``d`` masked out — and when every draft survives,
+    a bonus token samples ``p`` directly. Every emitted token is an
+    exact draw from the model's (temperature/top-k/top-p filtered)
+    conditional, the same distribution ``gpt.sample_logits`` draws from
+    (the filter pipeline is literally shared: ``gpt.filtered_logits``),
+    so speculation changes the speed, never the distribution. Min-over-
+    batch truncation stays unbiased: a truncated row's later tokens are
+    re-drawn next tick from the correct conditionals with fresh
+    randomness, and its kept tokens used only coins at their own
+    positions.
+    """
     width = draft_len + 1
     buf_len = dec.max_len + width
     pt = param_transform or (lambda p: p)
+    sampling = temperature > 0
+
+    def _warp(logits):  # [..., V] -> f32 filtered sampling logits
+        from pddl_tpu.models.gpt import filtered_logits
+
+        return filtered_logits(logits, temperature=temperature,
+                               top_k=top_k, top_p=top_p)
 
     def prefill(params, prompt):
         b, p = prompt.shape
@@ -145,48 +176,102 @@ def _spec_fns(dec, draft_len: int, ngram: int, param_transform=None):
         logits, mutated = dec.apply(
             {"params": pt(params), "cache": cache}, prompt,
             train=False, mutable=["cache"])
-        first = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
         toks = jnp.zeros((b, buf_len), jnp.int32)
         toks = jax.lax.dynamic_update_slice(toks, prompt, (0, 0))
-        toks = jax.lax.dynamic_update_slice(toks, first[:, None], (0, p))
-        return mutated["cache"], toks
+        return mutated["cache"], toks, logits[:, -1]
 
-    def loop(params, cache, toks, prompt_len, max_new):
+    def loop(params, cache, toks, last_logits, prompt_len, max_new, rng):
+        b = toks.shape[0]
+        if sampling:
+            rng, sub = jax.random.split(rng)
+            first = jax.random.categorical(sub, _warp(last_logits), axis=-1)
+        else:
+            first = jnp.argmax(last_logits, axis=-1)
+        toks = jax.lax.dynamic_update_slice(
+            toks, first.astype(jnp.int32)[:, None], (0, prompt_len))
+
         def cond(state):
-            _, n_out, _, _ = state
+            _, n_out, _, _, _ = state
             return n_out < max_new
 
         def body(state):
-            toks, n_out, cache, ticks = state
+            toks, n_out, cache, ticks, rng = state
             cur_pos = prompt_len + n_out - 1  # position of the last token
             drafts = _ngram_drafts(toks, cur_pos, ngram, draft_len)
-            cur = jax.lax.dynamic_slice(toks, (0, cur_pos), (toks.shape[0], 1))
+            cur = jax.lax.dynamic_slice(toks, (0, cur_pos), (b, 1))
             block = jnp.concatenate([cur, drafts], axis=1)  # [B, width]
             logits, mutated = dec.apply(
                 {"params": pt(params), "cache": cache}, block,
                 train=False, mutable=["cache"])
             cache = mutated["cache"]
-            y = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [B, width]
-            # Longest accepted draft prefix, min over the batch (shared
-            # cache index): cumprod turns the first mismatch into zeros.
-            match = (block[:, 1:] == y[:, :-1]).astype(jnp.int32)
-            accepted = jnp.min(jnp.sum(jnp.cumprod(match, axis=1), axis=1))
-            # y_0..y_accepted are exact greedy tokens; the stale tail is
-            # overwritten before the frontier reaches it (width >= tail).
+            if not sampling:
+                y = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                # Longest accepted draft prefix, min over the batch
+                # (shared cache index): cumprod turns the first mismatch
+                # into zeros.
+                match = (block[:, 1:] == y[:, :-1]).astype(jnp.int32)
+                accepted = jnp.min(
+                    jnp.sum(jnp.cumprod(match, axis=1), axis=1))
+                window = y
+            else:
+                flog = _warp(logits)  # [B, width, V]
+                probs = jax.nn.softmax(flog, axis=-1)
+                rng, k_coin, k_fix = jax.random.split(rng, 3)
+                # Coin j tests draft d_{j+1} against p_j: accept w.p.
+                # p_j(d_{j+1}) (point-mass draft => the accept ratio is
+                # just the target probability).
+                p_draft = jnp.take_along_axis(
+                    probs[:, :-1], drafts[..., None], axis=-1)[..., 0]
+                ok = (jax.random.uniform(k_coin, p_draft.shape)
+                      < p_draft).astype(jnp.int32)
+                m_row = jnp.sum(jnp.cumprod(ok, axis=1), axis=1)
+                accepted = jnp.min(m_row)
+                # Token for slot `accepted`: rows whose own coin
+                # rejected exactly there draw the RESIDUAL (p with the
+                # rejected draft masked); rows truncated by the batch
+                # min keep their accepted draft; when every draft of
+                # every row survived (accepted == draft_len), it's the
+                # bonus draw from p_k.
+                flog_last = jax.lax.dynamic_slice(
+                    flog, (0, accepted, 0), (b, 1, flog.shape[-1]))[:, 0]
+                d_next = jax.lax.dynamic_slice(
+                    block, (0, jnp.minimum(accepted + 1, draft_len)),
+                    (b, 1))[:, 0]
+                rejected_here = (m_row == accepted) & (accepted < draft_len)
+                vocab = flog.shape[-1]
+                mask = (rejected_here[:, None]
+                        & (jax.nn.one_hot(d_next, vocab, dtype=bool)))
+                masked = jnp.where(mask, -jnp.inf, flog_last)
+                # Degenerate residual (the draft carried ~all the mass,
+                # e.g. top_k=1): fall back to the unmasked distribution
+                # rather than sampling from all -inf.
+                has_mass = jnp.any(masked > -jnp.inf, axis=-1,
+                                   keepdims=True)
+                masked = jnp.where(has_mass, masked, flog_last)
+                fix = jax.random.categorical(k_fix, masked, axis=-1)
+                # Write window: accepted drafts verbatim, the correction/
+                # bonus at slot `accepted`; the stale tail beyond it is
+                # overwritten before the frontier reaches it (width >=
+                # tail), same invariant as the greedy path.
+                window = jnp.concatenate(
+                    [drafts, drafts[:, -1:]], axis=1).astype(jnp.int32)
+                window = jax.lax.dynamic_update_slice(
+                    window, fix.astype(jnp.int32)[:, None], (0, accepted))
             toks = jax.lax.dynamic_update_slice(
-                toks, y, (0, prompt_len + n_out))
+                toks, window, (0, prompt_len + n_out))
             cache = _rewind_index(cache, cur_pos + accepted + 1)
-            return toks, n_out + accepted + 1, cache, ticks + 1
+            return toks, n_out + accepted + 1, cache, ticks + 1, rng
 
-        toks, n_out, _, ticks = jax.lax.while_loop(
-            cond, body, (toks, jnp.int32(1), cache, jnp.int32(0)))
+        toks, n_out, _, ticks, _ = jax.lax.while_loop(
+            cond, body, (toks, jnp.int32(1), cache, jnp.int32(0), rng))
         return toks, n_out, ticks
 
     return prefill, loop
 
 
 @functools.lru_cache(maxsize=16)
-def _spec_programs(dec, draft_len: int, ngram: int, param_transform=None):
+def _spec_programs(dec, draft_len: int, ngram: int, param_transform=None,
+                   temperature: float = 0.0, top_k=None, top_p=None):
     """Jitted (prefill, loop) pair, cached on the frozen decode module +
     draft statics — like ``gpt._decode_programs``, params stay jit
     ARGUMENTS (never baked-in constants).
@@ -203,14 +288,17 @@ def _spec_programs(dec, draft_len: int, ngram: int, param_transform=None):
     function) maps the passed params to apply-ready weights inside the
     programs: int8 weight storage composes with speculation this way.
     """
-    prefill, loop = _spec_fns(dec, draft_len, ngram, param_transform)
+    prefill, loop = _spec_fns(dec, draft_len, ngram, param_transform,
+                              temperature, top_k, top_p)
     return jax.jit(prefill), jax.jit(loop, donate_argnums=(1, 2))
 
 
 @functools.lru_cache(maxsize=16)
 def _sharded_spec_programs(dec, draft_len: int, ngram: int,
                            param_sh_def, param_sh_leaves,
-                           cache_sh_def, cache_sh_leaves):
+                           cache_sh_def, cache_sh_leaves,
+                           temperature: float = 0.0, top_k=None,
+                           top_p=None):
     """Tensor-parallel twin of :func:`_spec_programs` — same body
     functions, compiled with the strategy's parameter/cache shardings
     (the SPMD partitioner inserts the per-block all-reduces on ICI,
@@ -228,23 +316,27 @@ def _sharded_spec_programs(dec, draft_len: int, ngram: int,
     param_sh = jax.tree_util.tree_unflatten(param_sh_def, param_sh_leaves)
     cache_sh = jax.tree_util.tree_unflatten(cache_sh_def, cache_sh_leaves)
     repl = NamedSharding(param_sh_leaves[0].mesh, PartitionSpec())
-    prefill, loop = _spec_fns(dec, draft_len, ngram, None)
+    prefill, loop = _spec_fns(dec, draft_len, ngram, None,
+                              temperature, top_k, top_p)
     prefill_j = jax.jit(prefill,
                         in_shardings=(param_sh, repl),
-                        out_shardings=(cache_sh, repl))
+                        out_shardings=(cache_sh, repl, repl))
     loop_j = jax.jit(loop, donate_argnums=(1, 2),
-                     in_shardings=(param_sh, cache_sh, repl, repl, repl),
+                     in_shardings=(param_sh, cache_sh, repl, repl,
+                                   repl, repl, repl),
                      out_shardings=(repl, repl, repl))
     return prefill_j, loop_j
 
 
 def generate_speculative(
         model, variables, prompt, max_new_tokens: int, *,
+        temperature: float = 0.0, top_k=None, top_p=None, rng=None,
         draft_len: int = 7, ngram: int = 3,
         return_stats: bool = False, param_transform=None,
         strategy=None):
-    """Greedy generation, bit-identical to ``generate(temperature=0)``,
-    in (often far) fewer decode ticks. See the module docstring.
+    """Speculative generation: bit-identical to ``generate()`` under
+    greedy, distribution-identical under sampling, in (often far) fewer
+    decode ticks. See the module docstring.
 
     Args:
       model: a non-decode :class:`~pddl_tpu.models.gpt.GPT` or
@@ -254,6 +346,13 @@ def generate_speculative(
       prompt: int32 ``[B, P]``, ``P >= 1``.
       max_new_tokens: tokens to append (exact — same contract as
         ``generate``).
+      temperature / top_k / top_p / rng: the ``generate()`` sampling
+        surface. 0 → greedy (bit-exact vs ``generate``); > 0 →
+        speculative SAMPLING (rejection scheme, ``_spec_fns`` docstring)
+        — every token is an exact draw from the same filtered
+        conditional ``sample_logits`` uses, but the draw SEQUENCE
+        differs from ``generate``'s (different rng consumption), so
+        compare distributions, not token strings.
       draft_len: drafted tokens per tick; the verify block is
         ``draft_len + 1`` wide. 7 keeps the block at 8 (MXU-lane
         friendly) and caps the stale-cache tail at one block.
@@ -287,6 +386,12 @@ def generate_speculative(
         raise ValueError(f"draft_len must be >= 1, got {draft_len}")
     if ngram < 1:
         raise ValueError(f"ngram must be >= 1, got {ngram}")
+    if temperature > 0 and rng is None:
+        raise ValueError("temperature sampling needs an rng key")
+    if temperature <= 0 and (top_k is not None or top_p is not None):
+        raise ValueError(
+            "top_k/top_p require temperature > 0 (greedy decoding would "
+            "silently ignore them)")
     # Cache writes reach index draft_len past the last emitted position.
     if total + draft_len > model.max_len:
         raise ValueError(
@@ -307,9 +412,10 @@ def generate_speculative(
 
     dec = model.clone(decode=True)
     params = variables["params"]
+    sampling = (float(temperature), top_k, top_p)
     if strategy is None:
         prefill, loop = _spec_programs(dec, int(draft_len), int(ngram),
-                                       param_transform)
+                                       param_transform, *sampling)
     else:
         if param_transform is not None:
             raise NotImplementedError(
@@ -324,10 +430,12 @@ def generate_speculative(
         c_leaves, c_def = jax.tree_util.tree_flatten(cache_sh)
         prefill, loop = _sharded_spec_programs(
             dec, int(draft_len), int(ngram),
-            p_def, tuple(p_leaves), c_def, tuple(c_leaves))
-    cache, toks = prefill(params, prompt)
-    toks, n_out, ticks = loop(params, cache, toks,
-                              jnp.int32(p), jnp.int32(max_new_tokens))
+            p_def, tuple(p_leaves), c_def, tuple(c_leaves), *sampling)
+    if rng is None:
+        rng = jax.random.key(0)  # unused under greedy; loop needs a value
+    cache, toks, last_logits = prefill(params, prompt)
+    toks, n_out, ticks = loop(params, cache, toks, last_logits,
+                              jnp.int32(p), jnp.int32(max_new_tokens), rng)
     out = toks[:, :total]
     if not return_stats:
         return out

@@ -174,3 +174,93 @@ def test_speculative_tensor_parallel_matches_single_device(mesh4x2):
             model, {"params": quantize_int8(variables["params"],
                                             min_elems=128)},
             prompt, 8, strategy=strategy, param_transform=dequantize)
+
+
+def test_speculative_sampling_support_and_determinism():
+    """Sampling mode: every emitted token must lie in the SUPPORT of the
+    filtered conditional at its position (recomputed exactly from the
+    full forward) — with top_k=2 that is a sharp check — and the draw
+    must be a pure function of the rng key."""
+    from pddl_tpu.models.gpt import filtered_logits
+
+    model = tiny_gpt(vocab_size=16, max_len=96)
+    prompt = _repetitive_prompt(2, 10, 16)
+    variables = {"params": model.init(jax.random.key(0), prompt,
+                                      train=False)["params"]}
+    out1 = generate_speculative(model, variables, prompt, 30,
+                                temperature=0.9, top_k=2,
+                                rng=jax.random.key(7))
+    out2 = generate_speculative(model, variables, prompt, 30,
+                                temperature=0.9, top_k=2,
+                                rng=jax.random.key(7))
+    np.testing.assert_array_equal(np.asarray(out1), np.asarray(out2))
+    out3 = generate_speculative(model, variables, prompt, 30,
+                                temperature=0.9, top_k=2,
+                                rng=jax.random.key(8))
+    assert not np.array_equal(np.asarray(out1), np.asarray(out3))
+
+    # Support check: token t+1 must have nonzero filtered probability
+    # under the model's own conditional at position t.
+    logits = model.apply(variables, out1[:, :-1], train=False)
+    flog = filtered_logits(logits, temperature=0.9, top_k=2)
+    p = prompt.shape[1]
+    sel = np.take_along_axis(np.asarray(flog),
+                             np.asarray(out1)[:, 1:, None], axis=-1)[..., 0]
+    assert np.all(np.isfinite(sel[:, p - 1:])), "token outside top-k support"
+
+
+def test_speculative_sampling_matches_plain_distribution():
+    """Unbiasedness, empirically: on a near-uniform random model the
+    unigram frequencies of speculative sampling must match plain
+    generate() sampling within sampling noise (fixed seeds, ~1.6k draws
+    each; the speculative path mixes accepted drafts, residual draws,
+    and bonus draws, so a bias in ANY branch shows up here)."""
+    model = tiny_gpt(vocab_size=8, max_len=128)
+    prompt = _repetitive_prompt(8, 10, 8)
+    variables = {"params": model.init(jax.random.key(1), prompt,
+                                      train=False)["params"]}
+    n_new = 100
+    spec = generate_speculative(model, variables, prompt, n_new,
+                                temperature=1.0, rng=jax.random.key(2))
+    plain = generate(model, variables, prompt, n_new,
+                     temperature=1.0, rng=jax.random.key(3))
+    p = prompt.shape[1]
+    f_spec = np.bincount(np.asarray(spec)[:, p:].ravel(), minlength=8)
+    f_plain = np.bincount(np.asarray(plain)[:, p:].ravel(), minlength=8)
+    n = f_spec.sum()
+    # Each frequency ~ Binomial(n, q): compare both against each other
+    # with a 5-sigma-ish band on the difference of proportions.
+    diff = np.abs(f_spec - f_plain) / n
+    sigma = np.sqrt(2 * (f_plain / n) * (1 - f_plain / n) / n)
+    assert np.all(diff < 5 * sigma + 0.01), (f_spec, f_plain)
+
+
+def test_speculative_sampling_validation():
+    model = tiny_gpt(vocab_size=16, max_len=64)
+    prompt = jnp.zeros((1, 4), jnp.int32)
+    variables = {"params": model.init(jax.random.key(0), prompt,
+                                      train=False)["params"]}
+    with pytest.raises(ValueError, match="rng"):
+        generate_speculative(model, variables, prompt, 8, temperature=0.8)
+    with pytest.raises(ValueError, match="top_k/top_p"):
+        generate_speculative(model, variables, prompt, 8, top_k=4)
+
+
+def test_speculative_sampling_tensor_parallel(mesh4x2):
+    """Sampling x TP: runs sharded, same key -> same tokens as the
+    unsharded sampling path (identical logits, identical coins)."""
+    from pddl_tpu.parallel.tensor_parallel import TensorParallelStrategy
+
+    model = tiny_gpt(vocab_size=16, max_len=96)
+    prompt = _repetitive_prompt(1, 10, 16)
+    variables = {"params": model.init(jax.random.key(0), prompt,
+                                      train=False)["params"]}
+    ref = generate_speculative(model, variables, prompt, 20,
+                               temperature=0.8, top_k=4,
+                               rng=jax.random.key(5))
+    strategy = TensorParallelStrategy(model_parallel=2)
+    strategy._mesh = mesh4x2
+    out = generate_speculative(model, variables, prompt, 20,
+                               temperature=0.8, top_k=4,
+                               rng=jax.random.key(5), strategy=strategy)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
