@@ -84,23 +84,44 @@ def _timed(fn, sync, iters: int = 5) -> float:
 
 
 def _bench_pair(model, variables, prompt, new_tokens: int,
-                draft_len: int, ngram: int):
-    """(plain tok/s, spec tok/s, stats) on one prompt batch; asserts
-    speculative output == greedy output before timing."""
-    ref = generate(model, variables, prompt, max_new_tokens=new_tokens)
+                draft_len: int, ngram: int, temperature: float = 0.0):
+    """(plain tok/s, spec tok/s, stats) on one prompt batch.
+
+    Greedy: asserts speculative output == greedy output before timing.
+    Sampling (temperature > 0): outputs are draws, not unique strings —
+    the check becomes the SUPPORT invariant instead (every emitted
+    token has nonzero filtered probability under the model's own
+    recomputed conditional)."""
+    import jax
+
+    sample_kw = ({} if temperature <= 0
+                 else {"temperature": temperature, "rng": jax.random.key(0)})
     out, stats = generate_speculative(
         model, variables, prompt, new_tokens, draft_len=draft_len,
-        ngram=ngram, return_stats=True)
-    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+        ngram=ngram, return_stats=True, **sample_kw)
+    if temperature <= 0:
+        ref = generate(model, variables, prompt, max_new_tokens=new_tokens)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+    else:
+        from pddl_tpu.models.gpt import filtered_logits
+
+        logits = model.apply(variables, out[:, :-1], train=False)
+        flog = filtered_logits(logits, temperature=temperature)
+        sel = np.take_along_axis(
+            np.asarray(flog), np.asarray(out)[:, 1:, None], axis=-1)[..., 0]
+        p = prompt.shape[1]
+        assert np.all(np.isfinite(sel[:, p - 1:])), "token outside support"
 
     b = prompt.shape[0]
     sync = lambda x: int((x[0] if isinstance(x, tuple) else x)[0, -1])
     t_plain = _timed(
-        lambda: generate(model, variables, prompt, max_new_tokens=new_tokens),
+        lambda: generate(model, variables, prompt, max_new_tokens=new_tokens,
+                         **sample_kw),
         sync)
     t_spec = _timed(
         lambda: generate_speculative(model, variables, prompt, new_tokens,
-                                     draft_len=draft_len, ngram=ngram),
+                                     draft_len=draft_len, ngram=ngram,
+                                     **sample_kw),
         sync)
     return b * new_tokens / t_plain, b * new_tokens / t_spec, stats
 
@@ -120,6 +141,11 @@ def main() -> None:
                         "held-out text, and int8 x speculative "
                         "throughput (exactness asserted against the "
                         "quantized model's own greedy decode)")
+    p.add_argument("--temperature", type=float, default=0.0,
+                   help="> 0: measure SAMPLED speculation (rejection "
+                        "verifier; acceptance is probabilistic, so the "
+                        "speedup is the honest serving number for "
+                        "temperature sampling, lower than greedy's)")
     p.add_argument("--family", default="llama_small",
                    choices=("llama_small", "llama_1b"),
                    help="llama_1b: the 1B-on-one-chip serving story -- "
@@ -170,8 +196,13 @@ def main() -> None:
             "prompt_len": args.prompt_len, "new_tokens": args.new_tokens,
             "draft_len": args.draft_len, "ngram": args.ngram,
             "dtype": "bfloat16", "batch": 1,
-            "exactness": "speculative output asserted equal to greedy "
-                         "generate() before every timed series",
+            "temperature": args.temperature,
+            "exactness": (
+                "speculative output asserted equal to greedy generate() "
+                "before every timed series" if args.temperature <= 0 else
+                "sampling mode: support invariant asserted (every "
+                "emitted token has nonzero filtered probability under "
+                "the model's recomputed conditional)"),
         },
         "results": {},
         "device": jax.devices()[0].device_kind,
@@ -179,7 +210,7 @@ def main() -> None:
     for kind, prompt in (("pycorpus", text_prompt), ("random", rand_prompt)):
         plain, spec, stats = _bench_pair(
             model, variables, prompt, args.new_tokens,
-            args.draft_len, args.ngram)
+            args.draft_len, args.ngram, args.temperature)
         record["results"][f"{kind}_plain_b1"] = round(plain, 1)
         record["results"][f"{kind}_speculative_b1"] = round(spec, 1)
         record["results"][f"{kind}_speedup"] = round(spec / plain, 3)
