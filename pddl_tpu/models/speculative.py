@@ -35,7 +35,13 @@ Every emitted token is the argmax of the true model given the true
 prefix, so the output is **bit-identical to greedy** ``generate()`` —
 acceptance rate changes only the speed. Worst case (nothing ever
 matches) each tick still emits one token, i.e. plain greedy decode at
-one verify-width forward per tick.
+one verify-width forward per tick. One hardware nuance, pinned by
+`tests_tpu/`: the k+1-wide verify block and the one-token tick are
+different COMPILED programs, so their bf16 logits can differ by ulps —
+at a genuine numerical tie (untrained models; never trained margins)
+the two argmaxes may break differently, and both outputs are then
+valid greedy decodes. The trained-model chip benches assert
+bit-equality every run.
 
 Batching: acceptance is ``min`` over the batch (the KV caches share one
 scalar index), which stays exact for every row — a row whose drafts

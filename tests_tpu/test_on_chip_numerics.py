@@ -209,3 +209,63 @@ def test_flash_sliding_window_matches_reference_on_chip():
         np.testing.assert_allclose(
             np.asarray(a, np.float32), np.asarray(b, np.float32),
             atol=5e-2, rtol=5e-2, err_msg=f"d{name} (window={w})")
+
+
+def test_speculative_greedy_consistent_on_chip():
+    """The serving path, compiled on hardware. The CPU suite proves
+    bit-exactness vs generate(); on the chip, the k+1-wide verify block
+    and the one-token decode tick are DIFFERENT compiled programs whose
+    bf16 logits legitimately differ by ulps — on an untrained model
+    (near-uniform logits, ties everywhere) that can flip an argmax, so
+    token strings may diverge while both remain valid greedy decodes.
+    The hardware-honest invariant is GREEDY CONSISTENCY: every token the
+    speculative path emitted must be an argmax-or-numerical-tie of the
+    model's own conditional along the speculative output's OWN prefix
+    (the trained-model chip benches additionally observe bit-equality,
+    because trained logits have margins ulps can't cross)."""
+    from pddl_tpu.models.llama import tiny_llama
+    from pddl_tpu.models.speculative import generate_speculative
+
+    model = tiny_llama(vocab_size=64, max_len=256,
+                       dtype=jnp.bfloat16, param_dtype=jnp.bfloat16)
+    prompt = (jnp.tile(jnp.arange(9, dtype=jnp.int32), (2, 6))[:, :48]
+              % 64)
+    variables = {"params": model.init(jax.random.key(0), prompt,
+                                      train=False)["params"]}
+    out, stats = generate_speculative(model, variables, prompt, 64,
+                                      return_stats=True)
+    assert stats["emitted"] == 64 and out.shape == (2, 112)
+    logits = jax.jit(
+        lambda v, t: model.apply(v, t, train=False))(variables, out[:, :-1])
+    lg = np.asarray(logits, np.float32)
+    tok = np.asarray(out)[:, 1:]
+    sel = np.take_along_axis(lg, tok[..., None], axis=-1)[..., 0]
+    gap = lg.max(axis=-1) - sel
+    p = prompt.shape[1]
+    # 0.1 is generous for bf16 ulp noise yet far below any real logit
+    # margin at vocab 64 — a wrong (non-tie) token would blow this up.
+    assert np.all(gap[:, p - 1:] < 0.1), float(gap[:, p - 1:].max())
+
+
+def test_int8_serving_hook_on_chip():
+    """Weight-only int8 through the compiled decode programs: the
+    param_transform hook must reproduce dequantize-then-generate
+    exactly (same weights, same math; only the jit boundary and the
+    HBM representation move)."""
+    from pddl_tpu.models.gpt import generate, tiny_gpt
+    from pddl_tpu.models.speculative import generate_speculative
+    from pddl_tpu.ops.quant import dequantize, quantize_int8
+
+    model = tiny_gpt(vocab_size=64, max_len=256,
+                     dtype=jnp.bfloat16, param_dtype=jnp.bfloat16)
+    prompt = jnp.tile(jnp.arange(7, dtype=jnp.int32), (1, 6))[:, :40]
+    params = model.init(jax.random.key(1), prompt, train=False)["params"]
+    qparams = quantize_int8(params, min_elems=128)
+    ref = generate(model, {"params": dequantize(qparams)}, prompt,
+                   max_new_tokens=48)
+    out = generate(model, {"params": qparams}, prompt, max_new_tokens=48,
+                   param_transform=dequantize)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+    out_spec = generate_speculative(model, {"params": qparams}, prompt,
+                                    48, param_transform=dequantize)
+    np.testing.assert_array_equal(np.asarray(out_spec), np.asarray(ref))
