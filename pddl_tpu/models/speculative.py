@@ -75,7 +75,6 @@ throughput story for the LM families.
 from __future__ import annotations
 
 import functools
-from typing import Any, Optional, Tuple
 
 import jax
 import jax.numpy as jnp

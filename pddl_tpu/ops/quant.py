@@ -40,9 +40,8 @@ memory/bandwidth story for that artifact on TPU.
 
 from __future__ import annotations
 
-from typing import Any, Dict
+from typing import Dict
 
-import jax
 import jax.numpy as jnp
 
 __all__ = ["quantize_int8", "dequantize", "quantized_bytes"]
