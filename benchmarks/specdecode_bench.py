@@ -84,18 +84,25 @@ def _timed(fn, sync, iters: int = 5) -> float:
 
 
 def _bench_pair(model, variables, prompt, new_tokens: int,
-                draft_len: int, ngram: int, temperature: float = 0.0):
+                draft_len: int, ngram: int, temperature: float = 0.0,
+                top_k=None):
     """(plain tok/s, spec tok/s, stats) on one prompt batch.
 
     Greedy: asserts speculative output == greedy output before timing.
     Sampling (temperature > 0): outputs are draws, not unique strings —
-    the check becomes the SUPPORT invariant instead (every emitted
-    token has nonzero filtered probability under the model's own
-    recomputed conditional)."""
+    the check becomes the SUPPORT invariant instead: every emitted
+    token must have nonzero probability under the model's own
+    recomputed FILTERED conditional. The filter must be sharp for the
+    check to discriminate anything (with temperature alone the whole
+    vocab is in support and the assertion is vacuous), which is why the
+    sampled bench runs with ``top_k`` on — generation and verification
+    share the same filter, so a token outside the recomputed top-k set
+    is a real exactness violation."""
     import jax
 
     sample_kw = ({} if temperature <= 0
-                 else {"temperature": temperature, "rng": jax.random.key(0)})
+                 else {"temperature": temperature, "top_k": top_k,
+                       "rng": jax.random.key(0)})
     out, stats = generate_speculative(
         model, variables, prompt, new_tokens, draft_len=draft_len,
         ngram=ngram, return_stats=True, **sample_kw)
@@ -106,7 +113,7 @@ def _bench_pair(model, variables, prompt, new_tokens: int,
         from pddl_tpu.models.gpt import filtered_logits
 
         logits = model.apply(variables, out[:, :-1], train=False)
-        flog = filtered_logits(logits, temperature=temperature)
+        flog = filtered_logits(logits, temperature=temperature, top_k=top_k)
         sel = np.take_along_axis(
             np.asarray(flog), np.asarray(out)[:, 1:, None], axis=-1)[..., 0]
         p = prompt.shape[1]
@@ -146,6 +153,14 @@ def main() -> None:
                         "verifier; acceptance is probabilistic, so the "
                         "speedup is the honest serving number for "
                         "temperature sampling, lower than greedy's)")
+    p.add_argument("--top-k", type=int, default=8,
+                   help="sampled mode only: top-k filter applied to BOTH "
+                        "generation and the support-invariant "
+                        "verification pass. Must be sharp (small) for "
+                        "the invariant to be discriminative — with "
+                        "temperature alone every token is in support "
+                        "and the check is vacuous. 0 disables (and "
+                        "downgrades the exactness claim accordingly)")
     p.add_argument("--family", default="llama_small",
                    choices=("llama_small", "llama_1b"),
                    help="llama_1b: the 1B-on-one-chip serving story -- "
@@ -197,12 +212,18 @@ def main() -> None:
             "draft_len": args.draft_len, "ngram": args.ngram,
             "dtype": "bfloat16", "batch": 1,
             "temperature": args.temperature,
+            "top_k": (args.top_k or None) if args.temperature > 0 else None,
             "exactness": (
                 "speculative output asserted equal to greedy generate() "
                 "before every timed series" if args.temperature <= 0 else
-                "sampling mode: support invariant asserted (every "
-                "emitted token has nonzero filtered probability under "
-                "the model's recomputed conditional)"),
+                f"sampling mode: support invariant asserted against the "
+                f"model's recomputed top_k={args.top_k} filtered "
+                "conditional (generation and verification share the "
+                "sharp filter, so an out-of-support token is a real "
+                "violation)" if args.top_k else
+                "sampling mode: support check run WITHOUT a sharp "
+                "filter (top_k=0) — vacuous at these settings, speed "
+                "numbers only"),
         },
         "results": {},
         "device": jax.devices()[0].device_kind,
@@ -210,7 +231,8 @@ def main() -> None:
     for kind, prompt in (("pycorpus", text_prompt), ("random", rand_prompt)):
         plain, spec, stats = _bench_pair(
             model, variables, prompt, args.new_tokens,
-            args.draft_len, args.ngram, args.temperature)
+            args.draft_len, args.ngram, args.temperature,
+            top_k=(args.top_k or None) if args.temperature > 0 else None)
         record["results"][f"{kind}_plain_b1"] = round(plain, 1)
         record["results"][f"{kind}_speculative_b1"] = round(spec, 1)
         record["results"][f"{kind}_speedup"] = round(spec / plain, 3)

@@ -124,7 +124,14 @@ class _GPTEmbed(nn.Module):
             initialized = self.has_variable("cache", "pos_index")
             idx = self.variable("cache", "pos_index",
                                 lambda: jnp.zeros((), jnp.int32))
-            step_pos = jax.lax.dynamic_slice_in_dim(pos, idx.value, s, axis=1)
+            if idx.value.ndim:
+                # Per-row [B] position vector (the serving engine's slot
+                # model): each row reads its own position embedding.
+                step_pos = jnp.take(
+                    pos[0], idx.value[:, None] + jnp.arange(s), axis=0)
+            else:
+                step_pos = jax.lax.dynamic_slice_in_dim(
+                    pos, idx.value, s, axis=1)
             if initialized:  # init() must return a pristine cache
                 idx.value = idx.value + s
             return x + step_pos.astype(self.dtype)
@@ -270,6 +277,72 @@ def filtered_logits(logits, *, temperature: float,
     return logits
 
 
+def batched_filtered_logits(logits, *, temperature, top_k, top_p):
+    """Per-ROW warp+filter: the :func:`filtered_logits` pipeline with the
+    sampling parameters as ``[B]`` RUNTIME arrays instead of statics —
+    the serving engine's per-slot path, where every tick carries a mixed
+    bag of requests and none of their parameters may enter the compiled
+    program as constants.
+
+    Disabled-filter sentinels (arrays can't carry None): ``top_k <= 0``
+    disables top-k for that row, ``top_p >= 1`` disables nucleus.
+    ``temperature <= 0`` rows are warped at 1.0 to stay finite — greedy
+    selection for them happens in :func:`sample_logits_batched`, which
+    ignores the filtered row entirely.
+
+    Row-by-row this matches ``filtered_logits`` exactly for enabled
+    filters: the top-k threshold is the k-th sorted value (ties at the
+    boundary kept, like ``lax.top_k``'s), and the nucleus keep-set comes
+    from the same stable-descending CDF rule. ``top_k`` trades
+    ``lax.top_k`` (static k) for one full sort shared with the nucleus
+    pass — the price of k as data.
+    """
+    logits = logits.astype(jnp.float32)
+    b, v = logits.shape
+    t = jnp.broadcast_to(jnp.asarray(temperature, jnp.float32), (b,))
+    kk = jnp.broadcast_to(jnp.asarray(top_k, jnp.int32), (b,))
+    pp = jnp.broadcast_to(jnp.asarray(top_p, jnp.float32), (b,))
+    warped = logits / jnp.where(t > 0, t, 1.0)[:, None]
+    sort_idx = jnp.argsort(-warped, axis=-1)  # stable descending
+    sorted_l = jnp.take_along_axis(warped, sort_idx, axis=-1)
+    # Top-k: per-row k-th sorted value as the threshold (same
+    # keep-boundary-ties rule as lax.top_k in filtered_logits).
+    kth = jnp.take_along_axis(
+        sorted_l, (jnp.clip(kk, 1, v) - 1)[:, None], axis=-1)
+    keep_topk = (kk[:, None] <= 0) | (warped >= kth)
+    warped = jnp.where(keep_topk, warped, -jnp.inf)
+    # Nucleus over the top-k-masked values. Masked entries are exactly
+    # the tail of the descending order (values below the threshold), so
+    # the one sort stays valid after masking — no re-sort.
+    sorted_m = jnp.where(
+        jnp.take_along_axis(keep_topk, sort_idx, axis=-1),
+        sorted_l, -jnp.inf)
+    cdf = jnp.cumsum(jax.nn.softmax(sorted_m, axis=-1), axis=-1)
+    keep_sorted = jnp.concatenate(
+        [jnp.zeros_like(cdf[:, :1]), cdf[:, :-1]], axis=-1) < pp[:, None]
+    inv_idx = jnp.argsort(sort_idx, axis=-1)
+    keep = (jnp.take_along_axis(keep_sorted, inv_idx, axis=-1)
+            | (pp[:, None] >= 1.0))
+    return jnp.where(keep, warped, -jnp.inf)
+
+
+def sample_logits_batched(rng, logits, *, temperature, top_k, top_p):
+    """One sampling step over ``[B, V]`` logits with PER-ROW parameters
+    (``[B]`` arrays; sentinels as in :func:`batched_filtered_logits`).
+    Rows with ``temperature <= 0`` take the greedy argmax of the RAW
+    logits (filters never change an argmax); the rest draw one
+    categorical sample from their filtered distribution. Returns int32
+    ``[B]``."""
+    logits = logits.astype(jnp.float32)
+    t = jnp.broadcast_to(
+        jnp.asarray(temperature, jnp.float32), (logits.shape[0],))
+    sampled = jax.random.categorical(
+        rng, batched_filtered_logits(logits, temperature=temperature,
+                                     top_k=top_k, top_p=top_p), axis=-1)
+    return jnp.where(t > 0, sampled,
+                     jnp.argmax(logits, axis=-1)).astype(jnp.int32)
+
+
 def generate(model: GPT, variables, prompt, max_new_tokens: int, *,
              temperature: float = 0.0, top_k: Optional[int] = None,
              top_p: Optional[float] = None, rng=None, strategy=None,
@@ -410,6 +483,93 @@ def _decode_fns(dec, temperature, top_k, top_p, max_new_tokens,
         return jnp.moveaxis(toks[..., 0], 0, 1)  # [T, B, 1] -> [B, T]
 
     return step_fn, decode_all
+
+
+# The cache collections' position-counter leaf names, across every
+# family: GPT's embed keeps `pos_index`, the attention modules (vit MHA
+# and llama GQA) keep `cache_index`. THE single registry — speculative
+# decoding's rewind and the serving engine's slot machinery both match
+# counters by these names (never by scalar-int32 duck typing, which
+# would silently capture any future non-position scalar cache state).
+CACHE_INDEX_KEYS = frozenset({"pos_index", "cache_index"})
+
+
+def is_cache_index_path(path) -> bool:
+    """True when a cache-tree key path names a position counter leaf."""
+    return bool(path) and (
+        str(getattr(path[-1], "key", path[-1])) in CACHE_INDEX_KEYS)
+
+
+def slot_decode_cache(dec, slots: int):
+    """A pooled ``slots``-row decode cache for the serving engine.
+
+    K/V leaves are the batch-1 cache's with the batch dim widened to
+    ``slots`` (one row per request slot); position counters become
+    ``[slots]`` int32 VECTORS — the per-row index form the decode
+    modules and :func:`~pddl_tpu.ops.attention.decode_attention` accept,
+    so every slot advances at its own depth inside one fused tick.
+    """
+    row = _decode_cache_shapes(dec, 1)
+    return jax.tree_util.tree_map_with_path(
+        lambda path, sd: (jnp.zeros((slots,), jnp.int32)
+                          if is_cache_index_path(path)
+                          else jnp.zeros((slots,) + sd.shape[1:], sd.dtype)),
+        row)
+
+
+def set_cache_positions(cache, positions):
+    """Overwrite every position counter of a pooled cache with
+    ``positions [slots]`` (the engine owns the authoritative per-slot
+    positions; the tick program stamps them in before each apply)."""
+    return jax.tree_util.tree_map_with_path(
+        lambda path, leaf: positions if is_cache_index_path(path) else leaf,
+        cache)
+
+
+def insert_cache_slot(cache, row_cache, slot, position):
+    """Insert a finished batch-1 prefill (``row_cache``) as slot ``slot``
+    of a pooled cache, and stamp the slot's position counter to
+    ``position`` (the request's prompt length). K/V rows go through
+    :func:`~pddl_tpu.ops.attention.cache_slot_insert`; the row cache's
+    own scalar counters are discarded — the pool's vectors are
+    authoritative. ``slot``/``position`` are runtime values: one
+    compiled program admits into any slot."""
+    from pddl_tpu.ops.attention import cache_slot_insert
+
+    def _ins(path, pool, row):
+        if is_cache_index_path(path):
+            return pool.at[slot].set(jnp.asarray(position, pool.dtype))
+        return cache_slot_insert(pool, row, slot)
+
+    return jax.tree_util.tree_map_with_path(_ins, cache, row_cache)
+
+
+def prefill_row(dec, params, prompt, length, *, param_transform=None):
+    """One request's prefill on a FRESH batch-1 cache: the serving
+    engine's admission building block (family-generic — duck-typed over
+    GPT/Llama like :func:`generate`).
+
+    ``prompt`` is int32 ``[1, P_pad]`` RIGHT-padded to the engine's
+    fixed prefill width (one compiled program for all prompt lengths);
+    ``length`` (traced int32) is the true token count. Padding is
+    harmless by the same invariant speculative decoding relies on:
+    causal attention means positions ``< length`` never see the junk
+    suffix, the returned logits row is taken at ``length - 1``, and the
+    junk K/V beyond ``length`` sits past the slot's position counter
+    where the prefix-bounded sweep never reads it (decode overwrites it
+    position by position as the request generates).
+
+    Returns ``(row_cache, last_logits [1, V])``.
+    """
+    pt = param_transform or (lambda p: p)
+    cache = jax.tree.map(lambda sd: jnp.zeros(sd.shape, sd.dtype),
+                         _decode_cache_shapes(dec, 1))
+    logits, mutated = dec.apply(
+        {"params": pt(params), "cache": cache}, prompt,
+        train=False, mutable=["cache"])
+    last = jax.lax.dynamic_slice(
+        logits, (0, length - 1, 0), (1, 1, logits.shape[-1]))[:, 0]
+    return mutated["cache"], last
 
 
 @functools.lru_cache(maxsize=16)

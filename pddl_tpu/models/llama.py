@@ -209,7 +209,18 @@ class LlamaAttention(nn.Module):
             "cache", "cache_index", lambda: jnp.zeros((), jnp.int32))
 
         i = index.value
-        q, k = apply_rope_qk(q, k, i + jnp.arange(s), theta=self.rope_theta)
+        if i.ndim and s != 1:
+            # Per-row [B] positions (the serving engine's slot model)
+            # decode one token per row per call; multi-token prefill
+            # happens as a batch-1 row inserted into its slot.
+            raise ValueError(
+                "per-row cache_index supports single-token steps only "
+                f"(got a {s}-token block)")
+        # [..., None] keeps one expression for both index ranks: scalar
+        # i → positions [s]; per-row i → [B, s] (rope broadcasts a head
+        # axis for the 2-D form).
+        q, k = apply_rope_qk(q, k, i[..., None] + jnp.arange(s),
+                             theta=self.rope_theta)
         k = k.astype(self.dtype)
         v = v.astype(self.dtype)
         # Pre-write ring state: the multi-token ring path attends history
@@ -217,7 +228,14 @@ class LlamaAttention(nn.Module):
         # history slots that this block's EARLY queries still need).
         hist_k, hist_v = cached_k.value, cached_v.value
         if initialized:
-            if ring is None:
+            if i.ndim:
+                rows = jnp.arange(b)
+                slot = i % ring if ring is not None else i
+                cached_k.value = cached_k.value.at[rows, :, slot].set(
+                    k[:, :, 0])
+                cached_v.value = cached_v.value.at[rows, :, slot].set(
+                    v[:, :, 0])
+            elif ring is None:
                 cached_k.value = jax.lax.dynamic_update_slice(
                     cached_k.value, k, (0, 0, i, 0))
                 cached_v.value = jax.lax.dynamic_update_slice(

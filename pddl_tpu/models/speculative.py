@@ -122,16 +122,21 @@ def _ngram_drafts(toks, cur_pos, ngram: int, draft_len: int):
 def _rewind_index(cache, new_index):
     """Set every cache position counter to ``new_index``.
 
-    The position state in BOTH cache layouts (GPT's embed ``pos_index``
-    + per-block ``cache_index``, llama's per-block ``cache_index``) is
-    exactly the scalar int32 leaves; K/V tensors are rank-4. Stale K/V
-    beyond the index is unreachable (prefix-bounded sweep) until
+    Counters are matched BY NAME (``pos_index``/``cache_index`` —
+    :data:`pddl_tpu.models.gpt.CACHE_INDEX_KEYS`, the same registry the
+    serving engine's slot machinery uses), never by scalar-int32 duck
+    typing: a future scalar int32 cache leaf that is NOT a position (a
+    step counter, say) must not be silently rewound.
+    ``tests/test_speculative.py`` enumerates the scalar int32 cache
+    leaves of every family, so adding one forces a decision here. Stale
+    K/V beyond the index is unreachable (prefix-bounded sweep) until
     overwritten by the next block write.
     """
-    return jax.tree.map(
-        lambda leaf: (jnp.full_like(leaf, new_index)
-                      if leaf.ndim == 0 and leaf.dtype == jnp.int32
-                      else leaf),
+    from pddl_tpu.models.gpt import is_cache_index_path
+
+    return jax.tree_util.tree_map_with_path(
+        lambda path, leaf: (jnp.full_like(leaf, new_index)
+                            if is_cache_index_path(path) else leaf),
         cache)
 
 
@@ -232,11 +237,14 @@ def _spec_fns(dec, draft_len: int, ngram: int, param_transform=None,
                 m_row = jnp.sum(jnp.cumprod(ok, axis=1), axis=1)
                 accepted = jnp.min(m_row)
                 # Token for slot `accepted`: rows whose own coin
-                # rejected exactly there draw the RESIDUAL (p with the
-                # rejected draft masked); rows truncated by the batch
-                # min keep their accepted draft; when every draft of
-                # every row survived (accepted == draft_len), it's the
-                # bonus draw from p_k.
+                # rejected exactly there (m_row == accepted <
+                # draft_len) draw the RESIDUAL (p with the rejected
+                # draft masked); when every draft of every row survived
+                # (accepted == draft_len, so m_row == accepted for all
+                # rows), it's the bonus draw from p_k. Rows truncated
+                # by the batch min (m_row > accepted) KEEP their
+                # accepted draft — the write below is masked per row,
+                # so an already-paid acceptance is never re-drawn.
                 flog_last = jax.lax.dynamic_slice(
                     flog, (0, accepted, 0), (b, 1, flog.shape[-1]))[:, 0]
                 d_next = jax.lax.dynamic_slice(
@@ -255,13 +263,19 @@ def _spec_fns(dec, draft_len: int, ngram: int, param_transform=None,
                 masked = jnp.where(has_mass, masked, flog_last)
                 fix = jax.random.categorical(k_fix, masked, axis=-1)
                 # Write window: accepted drafts verbatim, the correction/
-                # bonus at slot `accepted`; the stale tail beyond it is
-                # overwritten before the frontier reaches it (width >=
-                # tail), same invariant as the greedy path.
+                # bonus at slot `accepted` ONLY for rows that need one
+                # (m_row == accepted); truncated rows keep the draft
+                # token already sitting in that slot. The stale tail
+                # beyond it is overwritten before the frontier reaches
+                # it (width >= tail), same invariant as the greedy path.
                 window = jnp.concatenate(
                     [drafts, drafts[:, -1:]], axis=1).astype(jnp.int32)
+                kept = jax.lax.dynamic_slice(
+                    window, (0, accepted), (b, 1))[:, 0]
+                slot_tok = jnp.where(m_row == accepted,
+                                     fix.astype(jnp.int32), kept)
                 window = jax.lax.dynamic_update_slice(
-                    window, fix.astype(jnp.int32)[:, None], (0, accepted))
+                    window, slot_tok[:, None], (0, accepted))
             toks = jax.lax.dynamic_update_slice(
                 toks, window, (0, prompt_len + n_out))
             cache = _rewind_index(cache, cur_pos + accepted + 1)

@@ -103,6 +103,14 @@ class MultiHeadAttention(nn.Module):
         in its STORAGE dtype with online softmax, traffic and compute
         bounded by the valid prefix — never an f32 copy of the cache nor
         an ``[s, max_decode_len]`` f32 score materialization.
+
+        ``cache_index`` may be a PER-ROW ``[B]`` vector instead of the
+        scalar the cache initializes with — the continuous-batching
+        serving engine's slot model, where each batch row is an
+        independent request at its own depth (``s == 1`` only: requests
+        prefill as batch-1 rows and are inserted into their slot). Each
+        row's K/V then lands at its own position and the masking in
+        ``decode_attention`` is per row.
         """
         h = self.num_heads
         # During init() the cache variables don't exist yet: create them
@@ -119,10 +127,22 @@ class MultiHeadAttention(nn.Module):
 
         i = index.value
         if initialized:
-            cached_k.value = jax.lax.dynamic_update_slice(
-                cached_k.value, k.astype(self.dtype), (0, 0, i, 0))
-            cached_v.value = jax.lax.dynamic_update_slice(
-                cached_v.value, v.astype(self.dtype), (0, 0, i, 0))
+            if i.ndim:
+                if s != 1:
+                    raise ValueError(
+                        "per-row cache_index supports single-token steps "
+                        f"only (got a {s}-token block); prefill requests "
+                        "as batch-1 rows, then insert into their slot")
+                rows = jnp.arange(b)
+                cached_k.value = cached_k.value.at[rows, :, i].set(
+                    k[:, :, 0].astype(self.dtype))
+                cached_v.value = cached_v.value.at[rows, :, i].set(
+                    v[:, :, 0].astype(self.dtype))
+            else:
+                cached_k.value = jax.lax.dynamic_update_slice(
+                    cached_k.value, k.astype(self.dtype), (0, 0, i, 0))
+                cached_v.value = jax.lax.dynamic_update_slice(
+                    cached_v.value, v.astype(self.dtype), (0, 0, i, 0))
             index.value = i + s
 
         o = decode_attention(q, cached_k.value, cached_v.value, i,

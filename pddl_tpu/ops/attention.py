@@ -928,6 +928,31 @@ def flash_attention_lse(
 _SINGLE_SHOT_MAX_KC_BYTES = 1024 * 1024
 
 
+def cache_slot_insert(pool: jnp.ndarray, row: jnp.ndarray, slot) -> jnp.ndarray:
+    """Insert a batch-1 cache leaf ``row [1, ...]`` as row ``slot`` of the
+    pooled leaf ``pool [S, ...]`` (the serving engine's slot model: one
+    resident cache whose batch dim is a pool of request slots).
+
+    ``slot`` is a traced int32 scalar — slot choice is a runtime value,
+    so admitting into any slot reuses one compiled program. The whole
+    row is overwritten, which is what makes stale K/V from the slot's
+    previous occupant unreachable-by-construction after an admit.
+    """
+    if row.shape != (1,) + pool.shape[1:]:
+        raise ValueError(
+            f"row {row.shape} is not a batch-1 slice of pool {pool.shape}")
+    return jax.lax.dynamic_update_slice(
+        pool, row.astype(pool.dtype), (slot,) + (0,) * (pool.ndim - 1))
+
+
+def cache_slot_reset(pool: jnp.ndarray, slot) -> jnp.ndarray:
+    """Zero one slot row of a pooled cache leaf (evict hygiene — not
+    required for correctness, since :func:`cache_slot_insert` overwrites
+    the whole row on the next admit, but useful for tests/debugging)."""
+    return cache_slot_insert(
+        pool, jnp.zeros((1,) + pool.shape[1:], pool.dtype), slot)
+
+
 def decode_attention(
     q: jnp.ndarray, k_cache: jnp.ndarray, v_cache: jnp.ndarray,
     index, *, window: Optional[int] = None, rolling: bool = False,
@@ -955,8 +980,12 @@ def decode_attention(
       q: ``[B, H, S, D]`` post-RoPE queries (``S`` tokens being decoded).
       k_cache/v_cache: ``[B, H_kv, L, D]`` cache, current tokens already
         written at their slots.
-      index: scalar int32 — tokens in the cache BEFORE this call (query
-        global positions are ``index .. index+S-1``).
+      index: tokens in the cache BEFORE this call (query global
+        positions are ``index .. index+S-1``). Scalar int32, or a
+        PER-ROW ``[B]`` int32 vector — the continuous-batching serving
+        engine's path, where each batch row is an independent request
+        slot at its own depth; masking is then per row and the chunk
+        sweep is bounded by the DEEPEST row.
       window: sliding-window width (Mistral SWA); masks keys below
         ``q_pos - window + 1``.
       rolling: the cache is a RING buffer of size ``L`` (requires
@@ -987,6 +1016,10 @@ def decode_attention(
     b, h, s, d = q.shape
     hkv, cache_len = k_cache.shape[1], k_cache.shape[2]
     rep = _gqa_rep(q, k_cache)
+    index = jnp.asarray(index, jnp.int32)
+    if index.ndim > 1 or (index.ndim == 1 and index.shape[0] != b):
+        raise ValueError(
+            f"index must be a scalar or [B]={b} vector, got {index.shape}")
     if rolling:
         # Both invariants are static; violating either silently loses
         # in-window history, so fail loudly here instead.
@@ -1019,7 +1052,17 @@ def decode_attention(
     # Tokens the cache holds: through this block (written before the
     # call) unless history_only, where the block is attended separately.
     total = index if history_only else index + s
-    q_pos = index + jnp.arange(s)  # global positions of the queries
+    # Global positions of the queries: [s] for a shared scalar index,
+    # [B, s] for the per-row vector path ([..., None] makes the same
+    # expression produce both ranks; every mask term below follows the
+    # same pattern, so the two paths share one masking definition).
+    q_pos = index[..., None] + jnp.arange(s)
+
+    def _bcast(mask):
+        """Lift a mask to broadcast against sb [b, g, r, s, chunk]:
+        shared masks enter as [s, chunk] (or [1, chunk]); per-row masks
+        as [B, s, chunk] (or [B, 1, chunk]) and gain the (g, r) axes."""
+        return mask if mask.ndim == 2 else mask[:, None, None]
 
     def body(c, carry):
         m, l, acc = carry
@@ -1035,7 +1078,10 @@ def decode_attention(
         if rolling:
             # Newest global position congruent to the slot index; jnp's
             # mod is non-negative, so unwritten slots land at p < 0.
-            pos = (total - 1) - (total - 1 - slot) % cache_len
+            # Vector total: [B, 1] against slot [chunk] → per-row [B,
+            # chunk] positions.
+            t1 = total[..., None] - 1
+            pos = t1 - (t1 - slot) % cache_len
             valid = pos >= 0
         else:
             pos = slot
@@ -1043,15 +1089,15 @@ def decode_attention(
         if history_only:
             # strictly pre-block keys; broadcasts against the per-query
             # window term below
-            mask = jnp.broadcast_to(pos[None, :] < index, (s, chunk))
+            mask = pos[..., None, :] < index[..., None, None]
         else:
-            mask = pos[None, :] <= q_pos[:, None]
+            mask = pos[..., None, :] <= q_pos[..., :, None]
         if window is not None:
-            mask &= pos[None, :] > q_pos[:, None] - window
+            mask &= pos[..., None, :] > q_pos[..., :, None] - window
         if valid is not None:
-            mask &= valid[None, :]
+            mask &= valid[..., None, :]
         mask &= dedup[None, :]
-        sb = jnp.where(mask, sb, NEG_INF)  # broadcasts over (b, g, r)
+        sb = jnp.where(_bcast(mask), sb, NEG_INF)
         m_new = jnp.maximum(m, jnp.max(sb, axis=-1, keepdims=True))
         alpha = jnp.exp(m - m_new)
         p = jnp.exp(sb - m_new)
@@ -1061,26 +1107,32 @@ def decode_attention(
             preferred_element_type=jnp.float32)
         return m_new, l, acc
 
-    # Bound the sweep to chunks overlapping the valid prefix. A rolling
-    # cache is dense once wrapped, so every chunk is live after that; the
-    # min() still trims the pre-wrap phase.
-    live = jnp.minimum((total + chunk - 1) // chunk, n_chunks)
+    # Bound the sweep to chunks overlapping the valid prefix — the
+    # DEEPEST row's prefix on the vector path (shallower rows mask the
+    # excess). A rolling cache is dense once wrapped, so every chunk is
+    # live after that; the min() still trims the pre-wrap phase.
+    live = jnp.minimum((jnp.max(total) + chunk - 1) // chunk, n_chunks)
     m0 = jnp.full((b, hkv, rep, s, 1), NEG_INF, jnp.float32)
     l0 = jnp.zeros((b, hkv, rep, s, 1), jnp.float32)
     acc0 = jnp.zeros((b, hkv, rep, s, d), jnp.float32)
     if n_chunks == 1:
         # Whole cache in one pass — no while loop in the program at all.
-        # `live == 0` (empty history under history_only) must still
-        # produce the loop's zero-iteration result: a fully-masked pass
-        # makes every row's p uniform (exp(NEG_INF - NEG_INF) == 1), so
-        # mask the result back to the inits instead of running on trust.
-        m1, l1, acc1 = body(0, (m0, l0, acc0))
-        keep = live > 0
-        m = jnp.where(keep, m1, m0)
-        l = jnp.where(keep, l1, l0)
-        acc = jnp.where(keep, acc1, acc0)
+        m, l, acc = body(0, (m0, l0, acc0))
     else:
         m, l, acc = jax.lax.fori_loop(0, live, body, (m0, l0, acc0))
+    if history_only:
+        # Rows with an empty valid prefix (index 0 — or a zero-depth row
+        # on the vector path) must still produce the zero-iteration
+        # result: a fully-masked pass makes every row's p uniform
+        # (exp(NEG_INF - NEG_INF) == 1), so mask such rows back to the
+        # inits instead of running on trust. Only history_only can be
+        # empty — the regular path always sees at least the current
+        # token (total = index + s >= 1) — so the decode hot path never
+        # pays these wheres.
+        keep = total[..., None, None, None, None] > 0
+        m = jnp.where(keep, m, m0)
+        l = jnp.where(keep, l, l0)
+        acc = jnp.where(keep, acc, acc0)
     out = (acc / jnp.maximum(l, 1e-30)).reshape(b, h, s, d).astype(q.dtype)
     if return_lse:
         # Rows with nothing attended (empty history) keep lse ~ -inf so

@@ -22,11 +22,14 @@ Mechanics — deliberately framework-light:
 - :func:`dequantize` maps the tree back to dense weights
   (``q * scale`` in f32, cast to the original dtype recorded by the
   zero-length ``like`` leaf). It is the ``param_transform`` hook of the
-  decode programs (:func:`pddl_tpu.models.gpt.generate`): applied
-  INSIDE the jitted program, every tick, so the int8 tensors are what
-  lives in (and streams from) HBM — XLA fuses the convert+scale into
-  the consuming matmul's operand read rather than materializing a dense
-  copy.
+  decode programs (:func:`pddl_tpu.models.gpt.generate`,
+  :func:`~pddl_tpu.models.speculative.generate_speculative`, and the
+  online engine :class:`pddl_tpu.serve.ServeEngine` — the hook applies
+  inside the engine's prefill and fused tick, so int8 serving composes
+  with continuous batching unchanged): applied INSIDE the jitted
+  program, every tick, so the int8 tensors are what lives in (and
+  streams from) HBM — XLA fuses the convert+scale into the consuming
+  matmul's operand read rather than materializing a dense copy.
 - Embeddings are skipped by name (``embed`` in the path): decode
   GATHERS one row per token — quantizing a table that contributes no
   streaming traffic buys nothing and the axis-0 scale rule would be

@@ -59,10 +59,17 @@ def apply_rope(x: jnp.ndarray, cos: jnp.ndarray, sin: jnp.ndarray) -> jnp.ndarra
 
 def apply_rope_qk(q: jnp.ndarray, k: jnp.ndarray, positions: jnp.ndarray,
                   *, theta: float = 10000.0) -> tuple[jnp.ndarray, jnp.ndarray]:
-    """Apply RoPE to query/key ``[B, H, S, D]`` at integer ``positions [S]``.
+    """Apply RoPE to query/key ``[B, H, S, D]`` at integer ``positions``.
+
+    ``positions`` is ``[S]`` (shared across the batch — training and
+    single-request decode) or ``[B, S]`` (per-row positions — the
+    serving engine's slot model, where each batch row is a request at
+    its own depth; the tables gain a broadcast head axis).
 
     q and k may carry different head counts (grouped-query attention);
     the same tables broadcast over both.
     """
     cos, sin = rope_cos_sin(positions, q.shape[-1], theta=theta)
+    if positions.ndim == 2:  # [B, S, D] → [B, 1, S, D] over heads
+        cos, sin = cos[:, None], sin[:, None]
     return apply_rope(q, cos, sin), apply_rope(k, cos, sin)

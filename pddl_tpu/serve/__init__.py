@@ -1,0 +1,31 @@
+"""Online serving: continuous batching over the compiled decode path.
+
+`engine.py` is the step loop (slot pool, fused per-slot decode tick),
+`scheduler.py` the admission policy (FCFS + load shedding + prefill
+budget), `request.py` the per-request lifecycle, `metrics.py` the
+telemetry. See `docs/SERVING.md` § "Online serving".
+"""
+
+from pddl_tpu.serve.engine import ServeEngine
+from pddl_tpu.serve.metrics import ServeMetrics
+from pddl_tpu.serve.request import (
+    FinishReason,
+    QueueFull,
+    Request,
+    RequestHandle,
+    RequestState,
+    SamplingParams,
+)
+from pddl_tpu.serve.scheduler import FCFSScheduler
+
+__all__ = [
+    "FCFSScheduler",
+    "FinishReason",
+    "QueueFull",
+    "Request",
+    "RequestHandle",
+    "RequestState",
+    "SamplingParams",
+    "ServeEngine",
+    "ServeMetrics",
+]

@@ -1,0 +1,398 @@
+"""Continuous-batching online serving engine over the decode path.
+
+`docs/SERVING.md` measured a strong SINGLE-request path (decode scan,
+speculative decoding, int8); the ROADMAP's north star is heavy traffic
+from many users. The gap between those is this engine: Orca-style
+iteration-level scheduling (OSDI '22) — requests join and leave the
+running batch at TOKEN granularity instead of waiting for the slowest
+member of a fixed batch, which is worth roughly an order of magnitude
+of aggregate tokens/s at realistic request mixes (vLLM, SOSP '23).
+
+The slot model, under JAX's fixed-shape discipline:
+
+- ONE resident compiled decode program with a fixed pool of ``S``
+  batch slots: the pooled KV cache is ``[S, H_kv, L, D]`` per layer
+  with PER-SLOT position counters (``[S]`` int32 — the vector-index
+  decode path in `ops/attention.py` / the model families), so every
+  slot advances at its own depth inside one fused tick.
+- Each ``step()``: (a) ADMIT queued requests into free slots — a
+  batch-1 prefill over the right-padded prompt
+  (:func:`~pddl_tpu.models.gpt.prefill_row`), inserted into the slot
+  (:func:`~pddl_tpu.models.gpt.insert_cache_slot`), first token
+  sampled immediately (that's TTFT); (b) one fused DECODE TICK for all
+  live slots with per-slot sampling params as batched runtime arrays
+  (:func:`~pddl_tpu.models.gpt.sample_logits_batched`); (c) EVICT
+  finished slots (eos / length / cancel / deadline) host-side — the
+  next admit overwrites the whole cache row, so stale K/V is
+  unreachable by construction.
+- Exactly FOUR compiled programs (prefill, insert, tick, first-token
+  sample), each traced once at ``warmup()`` and never again: prompt
+  lengths enter as a traced ``length`` over one fixed padded width,
+  slots/positions/sampling params are runtime arrays, and the pooled
+  cache is DONATED through insert and tick so the resident buffers are
+  reused in place. ``compile_counts()`` exposes the executable counts;
+  the suite pins them at 1 after a mixed workload.
+
+Dead slots tick too (fixed shapes — their writes land at parked
+position 0 and are overwritten by the next admit); the cost is one
+batch row of compute, which is what buys zero recompiles.
+
+int8 serving composes exactly like ``generate()``: pass
+``param_transform=pddl_tpu.ops.quant.dequantize`` and the int8 tensors
+are what lives in HBM, dequantized inside the compiled programs.
+
+Ring-cache (rolling SWA) models are refused for now: slot reuse over a
+ring whose slots already wrapped needs per-slot wrap bookkeeping this
+engine doesn't carry yet. Full-length-cache models (GPT, Llama, SWA
+with ``window >= max_len``) are all eligible.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import numpy as np
+
+from pddl_tpu.models.gpt import (
+    _decode_cache_shapes,
+    insert_cache_slot,
+    prefill_row,
+    sample_logits_batched,
+    set_cache_positions,
+    slot_decode_cache,
+)
+from pddl_tpu.serve.metrics import ServeMetrics
+from pddl_tpu.serve.request import (
+    FinishReason,
+    Request,
+    RequestHandle,
+    RequestState,
+    SamplingParams,
+)
+from pddl_tpu.serve.scheduler import FCFSScheduler
+
+
+class ServeEngine:
+    """Online multiplexer of generate requests onto one decode program.
+
+    Args:
+      model: a non-decode GPT/Llama (anything ``generate()``-compatible
+        with a full-length KV cache); the decode twin is cloned here.
+      variables: ``{"params": ...}`` — kept on device, always a jit
+        ARGUMENT (new same-shape checkpoints never recompile).
+      max_slots: the batch-slot pool size ``S`` — the max concurrent
+        requests in one fused tick.
+      prefill_len: the fixed padded prompt width (every prompt must fit;
+        one compiled prefill serves all lengths). Defaults to
+        ``model.max_len // 2``.
+      max_queue_depth / prefill_token_budget: admission knobs, see
+        `scheduler.py`.
+      eos_token: optional stop token (included in the stream when hit).
+      param_transform: the ``generate()`` int8 hook — applied INSIDE the
+        compiled programs (:mod:`pddl_tpu.ops.quant`).
+      rng: sampling key, split once per tick and per admission (the
+        fused tick draws for every row and greedy rows discard the
+        draw — fixed work, no recompile — so the key stream advances
+        even for an all-greedy workload).
+      clock: injectable monotonic clock (tests drive deadlines with a
+        fake one).
+    """
+
+    def __init__(self, model, variables, *, max_slots: int = 8,
+                 prefill_len: Optional[int] = None,
+                 max_queue_depth: int = 64,
+                 prefill_token_budget: Optional[int] = None,
+                 eos_token: Optional[int] = None,
+                 param_transform=None, rng=None,
+                 clock=time.monotonic):
+        if max_slots < 1:
+            raise ValueError(f"max_slots must be >= 1, got {max_slots}")
+        if getattr(model, "uses_ring_cache", False):
+            raise NotImplementedError(
+                "the serving engine needs full-length KV caches; "
+                f"sliding_window={model.sliding_window} allocates a "
+                "rolling ring cache whose slot reuse is not supported yet")
+        self.model = model
+        self.max_slots = int(max_slots)
+        self.prefill_len = int(prefill_len if prefill_len is not None
+                               else model.max_len // 2)
+        if not 1 <= self.prefill_len <= model.max_len:
+            raise ValueError(
+                f"prefill_len {self.prefill_len} outside [1, "
+                f"{model.max_len}]")
+        self.eos_token = eos_token
+        self._clock = clock
+        self._params = variables["params"]
+        self._dec = model.clone(decode=True)
+        self._rng = rng if rng is not None else jax.random.key(0)
+        self.scheduler = FCFSScheduler(
+            max_queue_depth=max_queue_depth,
+            prefill_token_budget=prefill_token_budget)
+        self.metrics = ServeMetrics()
+
+        # One handle per occupied slot; all other per-slot state lives
+        # in the arrays below (positions) or is derivable from the
+        # handle (tokens emitted = len(handle.tokens)) — no duplicated
+        # bookkeeping to keep in lockstep.
+        self._slots: List[Optional[RequestHandle]] = [None] * self.max_slots
+        # Engine-owned per-slot state, stamped into the programs each
+        # tick (positions are authoritative HERE, not in the cache —
+        # the tick program overwrites the cache's counters on entry).
+        self._positions = np.zeros(self.max_slots, np.int32)
+        self._tokens = np.zeros(self.max_slots, np.int32)
+        self._temps = np.zeros(self.max_slots, np.float32)
+        self._top_ks = np.zeros(self.max_slots, np.int32)
+        self._top_ps = np.full(self.max_slots, 2.0, np.float32)
+
+        dec, pt = self._dec, param_transform
+
+        def _prefill(params, prompt, length):
+            return prefill_row(dec, params, prompt, length,
+                               param_transform=pt)
+
+        def _tick(params, cache, positions, tokens, temps, top_ks, top_ps,
+                  rng):
+            rng, sub = jax.random.split(rng)
+            cache = set_cache_positions(cache, positions)
+            logits, mutated = dec.apply(
+                {"params": (pt(params) if pt is not None else params),
+                 "cache": cache},
+                tokens[:, None], train=False, mutable=["cache"])
+            nxt = sample_logits_batched(
+                sub, logits[:, -1], temperature=temps, top_k=top_ks,
+                top_p=top_ps)
+            return mutated["cache"], nxt, rng
+
+        def _sample_first(logits, temp, top_k, top_p, rng):
+            rng, sub = jax.random.split(rng)
+            tok = sample_logits_batched(sub, logits, temperature=temp,
+                                        top_k=top_k, top_p=top_p)
+            return tok, rng
+
+        def _insert(cache, row_cache, slot, position):
+            # A per-engine closure (not the bare module-level function):
+            # jax.jit keyed on the same function object would SHARE its
+            # tracing cache across engines, making compile_counts()
+            # report other instances' pool shapes.
+            return insert_cache_slot(cache, row_cache, slot, position)
+
+        # The four resident programs. The pooled cache is donated
+        # through insert and tick — the engine always adopts the
+        # returned tree, so the resident HBM buffers are reused in
+        # place and a stale reference can never be used by mistake.
+        self._prefill_p = jax.jit(_prefill)
+        self._insert_p = jax.jit(_insert, donate_argnums=(0,))
+        self._tick_p = jax.jit(_tick, donate_argnums=(1,))
+        self._sample_first_p = jax.jit(_sample_first)
+
+        self._cache = slot_decode_cache(dec, self.max_slots)
+        self._warm = False
+
+    # -------------------------------------------------------- submission
+    def submit(self, prompt: Sequence[int], max_new_tokens: int, *,
+               sampling: Optional[SamplingParams] = None,
+               deadline_s: Optional[float] = None) -> RequestHandle:
+        """Queue one request; returns its streaming handle.
+
+        Raises :class:`~pddl_tpu.serve.request.QueueFull` when the
+        admission-control queue is at depth (the metrics count the
+        rejection either way)."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if prompt.size < 1:
+            raise ValueError("prompt must have at least one token")
+        if prompt.size > self.prefill_len:
+            raise ValueError(
+                f"prompt length {prompt.size} exceeds the engine's "
+                f"prefill_len {self.prefill_len}")
+        if max_new_tokens < 1:
+            raise ValueError(
+                f"max_new_tokens must be >= 1, got {max_new_tokens}")
+        if prompt.size + max_new_tokens > self.model.max_len:
+            raise ValueError(
+                f"prompt + new tokens {prompt.size + max_new_tokens} "
+                f"exceed max_len {self.model.max_len}")
+        req = Request(prompt=prompt.tolist(),
+                      max_new_tokens=int(max_new_tokens),
+                      sampling=sampling or SamplingParams(),
+                      deadline_s=deadline_s)
+        handle = RequestHandle(req, arrival_s=self._clock())
+        try:
+            self.scheduler.submit(handle)
+        except Exception:
+            self.metrics.record_rejected()
+            raise
+        return handle
+
+    # ---------------------------------------------------------- plumbing
+    def warmup(self) -> None:
+        """Trace/compile all four programs before traffic (one dummy
+        admission into slot 0 + one all-dead tick; the junk K/V lands at
+        parked positions and is overwritten by the first real admit).
+        Implicit on the first ``step()`` if not called."""
+        if self._warm:
+            return
+        dummy = np.zeros((1, self.prefill_len), np.int32)
+        row, logits = self._prefill_p(self._params, dummy, 1)
+        self._cache = self._insert_p(self._cache, row, 0, 0)
+        tok, self._rng = self._sample_first_p(
+            logits, np.float32(0.0), np.int32(0), np.float32(2.0),
+            self._rng)
+        self._cache, nxt, self._rng = self._tick_p(
+            self._params, self._cache, self._positions, self._tokens,
+            self._temps, self._top_ks, self._top_ps, self._rng)
+        jax.block_until_ready((tok, nxt))
+        self._warm = True
+
+    def compile_counts(self) -> Dict[str, int]:
+        """Compiled-executable count per resident program (the
+        zero-recompiles-after-warmup contract: all four stay at 1)."""
+        return {
+            "prefill": self._prefill_p._cache_size(),
+            "insert": self._insert_p._cache_size(),
+            "tick": self._tick_p._cache_size(),
+            "sample_first": self._sample_first_p._cache_size(),
+        }
+
+    @property
+    def live_slots(self) -> int:
+        return sum(s is not None for s in self._slots)
+
+    @property
+    def has_work(self) -> bool:
+        return self.live_slots > 0 or self.scheduler.depth > 0
+
+    def _free_slot_ids(self) -> List[int]:
+        return [i for i, s in enumerate(self._slots) if s is None]
+
+    def _evict(self, slot_id: int, state: RequestState,
+               reason: FinishReason) -> None:
+        handle = self._slots[slot_id]
+        assert handle is not None
+        handle.state = state
+        handle.finish_reason = reason
+        handle.finish_s = self._clock()
+        self.metrics.record_finish(reason.value)
+        self._slots[slot_id] = None
+        # Park the dead row: position 0, greedy params. Its future junk
+        # writes land at position 0 and the next admit overwrites the
+        # whole cache row anyway.
+        self._positions[slot_id] = 0
+        self._tokens[slot_id] = 0
+        self._temps[slot_id] = 0.0
+        self._top_ks[slot_id] = 0
+        self._top_ps[slot_id] = 2.0
+
+    def _expired(self, handle: RequestHandle, now: float) -> bool:
+        return (handle.request.deadline_s is not None
+                and now - handle.arrival_s > handle.request.deadline_s)
+
+    def _reap(self) -> None:
+        """Cancellations and deadlines, checked at tick granularity."""
+        now = self._clock()
+        for sid, handle in enumerate(self._slots):
+            if handle is None:
+                continue
+            if handle.cancelled:
+                self._evict(sid, RequestState.CANCELLED,
+                            FinishReason.CANCELLED)
+            elif self._expired(handle, now):
+                self._evict(sid, RequestState.TIMED_OUT,
+                            FinishReason.TIMED_OUT)
+
+    def _admit(self) -> None:
+        free = self._free_slot_ids()
+        if not free:
+            return
+
+        def _queued_cancel(handle):
+            handle.finish_s = self._clock()
+            self.metrics.record_finish(FinishReason.CANCELLED.value)
+
+        for handle in self.scheduler.admit(len(free),
+                                           on_cancelled=_queued_cancel):
+            if self._expired(handle, self._clock()):
+                # Died in the queue: never pay its prefill (the most
+                # expensive dispatch) nor emit a post-deadline token —
+                # under sustained overload this is exactly where
+                # deadlines earn their keep. The slot stays free for
+                # the next admission.
+                handle.state = RequestState.TIMED_OUT
+                handle.finish_reason = FinishReason.TIMED_OUT
+                handle.finish_s = self._clock()
+                self.metrics.record_finish(FinishReason.TIMED_OUT.value)
+                continue
+            sid = free.pop(0)
+            req = handle.request
+            plen = len(req.prompt)
+            padded = np.zeros((1, self.prefill_len), np.int32)
+            padded[0, :plen] = req.prompt
+            row, logits = self._prefill_p(self._params, padded, plen)
+            self._cache = self._insert_p(self._cache, row, sid, plen)
+            t, k, p = req.sampling.as_arrays()
+            tok, self._rng = self._sample_first_p(
+                logits, np.float32(t), np.int32(k), np.float32(p),
+                self._rng)
+            first = int(tok[0])
+            now = self._clock()
+            handle.tokens.append(first)
+            handle.ttft_s = now - handle.arrival_s
+            self.metrics.record_first_token(handle.ttft_s)
+            self._slots[sid] = handle
+            self._positions[sid] = plen
+            self._tokens[sid] = first
+            self._temps[sid] = t
+            self._top_ks[sid] = k
+            self._top_ps[sid] = p
+            # A one-token request (or an immediate eos) finishes at
+            # admission without ever joining a tick.
+            if self.eos_token is not None and first == self.eos_token:
+                self._evict(sid, RequestState.FINISHED, FinishReason.EOS)
+            elif req.max_new_tokens == 1:
+                self._evict(sid, RequestState.FINISHED, FinishReason.LENGTH)
+
+    def step(self) -> int:
+        """One engine tick: reap → admit → one fused decode tick for all
+        live slots → evict finished. Returns tokens emitted this step
+        (admission first-tokens included)."""
+        if not self._warm:
+            self.warmup()
+        t0 = self._clock()
+        emitted_before = self.metrics.tokens_emitted
+        self._reap()
+        self._admit()
+        live = [i for i, s in enumerate(self._slots) if s is not None]
+        if live:
+            self._cache, nxt, self._rng = self._tick_p(
+                self._params, self._cache, self._positions, self._tokens,
+                self._temps, self._top_ks, self._top_ps, self._rng)
+            nxt = np.asarray(nxt)  # the per-tick host sync (streaming)
+            for sid in live:
+                handle = self._slots[sid]
+                tok = int(nxt[sid])
+                handle.tokens.append(tok)
+                self._positions[sid] += 1
+                self._tokens[sid] = tok
+                if self.eos_token is not None and tok == self.eos_token:
+                    self._evict(sid, RequestState.FINISHED,
+                                FinishReason.EOS)
+                elif len(handle.tokens) >= handle.request.max_new_tokens:
+                    self._evict(sid, RequestState.FINISHED,
+                                FinishReason.LENGTH)
+        now = self._clock()
+        tick_tokens = len(live)
+        self.metrics.record_tick(
+            now, self.scheduler.depth, len(live), self.max_slots,
+            tick_tokens, now - t0)
+        return self.metrics.tokens_emitted - emitted_before
+
+    def run(self, max_steps: Optional[int] = None) -> None:
+        """Drive ``step()`` until queue and slots drain (or the step
+        budget runs out) — the synchronous serving loop."""
+        steps = 0
+        while self.has_work:
+            self.step()
+            steps += 1
+            if max_steps is not None and steps >= max_steps:
+                break

@@ -1,0 +1,120 @@
+"""Serving telemetry: the numbers an online engine is judged by.
+
+Single-request serving is judged by tokens/s; ONLINE serving is judged
+by the latency/throughput trade under load — so the engine records, per
+tick and per request:
+
+- **TTFT** (time to first token, queue wait included) — the user-felt
+  responsiveness number; p50/p99 because the tail IS the product.
+- **per-token latency** — inter-token gap once streaming.
+- **queue depth / slot occupancy** — the load signals the admission
+  knobs (`scheduler.py`) act on.
+- **tokens/s** — aggregate decoded throughput over the engine's active
+  window.
+
+Exposed through the existing :mod:`pddl_tpu.utils.summary` plumbing
+(:func:`~pddl_tpu.utils.summary.format_table`) for humans, and as a
+plain dict (:meth:`ServeMetrics.snapshot`) for benches/dashboards —
+`benchmarks/serve_bench.py` writes the snapshot into the repo's
+standard JSON-artifact shape.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from pddl_tpu.utils.summary import format_table
+
+
+def _pct(values: List[float], q: float) -> Optional[float]:
+    if not values:
+        return None
+    return float(np.percentile(np.asarray(values, np.float64), q))
+
+
+class ServeMetrics:
+    """Accumulates engine telemetry; cheap enough to leave always-on
+    (a few floats per tick — never a device sync of its own)."""
+
+    def __init__(self) -> None:
+        self.ttft_s: List[float] = []
+        self.token_latency_s: List[float] = []
+        self.queue_depth: List[int] = []
+        self.occupancy: List[float] = []
+        self.tokens_emitted = 0
+        self.requests_finished = 0
+        self.requests_rejected = 0
+        self.requests_timed_out = 0
+        self.requests_cancelled = 0
+        self._first_activity_s: Optional[float] = None
+        self._last_activity_s: Optional[float] = None
+
+    # ------------------------------------------------------ recording
+    def record_tick(self, now_s: float, queue_depth: int, live_slots: int,
+                    total_slots: int, new_tokens: int,
+                    tick_seconds: float) -> None:
+        self.queue_depth.append(queue_depth)
+        self.occupancy.append(live_slots / max(total_slots, 1))
+        self.tokens_emitted += new_tokens
+        if new_tokens:
+            # One fused tick serves every live slot, so the inter-token
+            # gap each STREAM sees is the whole tick's wall time — one
+            # sample per token emitted this tick.
+            self.token_latency_s.extend([tick_seconds] * new_tokens)
+        if self._first_activity_s is None:
+            self._first_activity_s = now_s
+        self._last_activity_s = now_s
+
+    def record_first_token(self, ttft_s: float) -> None:
+        self.ttft_s.append(ttft_s)
+        self.tokens_emitted += 1
+
+    def record_finish(self, reason_value: str) -> None:
+        """One request departed. ``requests_finished`` counts ONLY
+        successful completions (length/eos); cancellations and timeouts
+        go to their own counters — the three are disjoint, so a success
+        rate is finished / (finished + cancelled + timed_out +
+        rejected) with no hidden convention."""
+        if reason_value == "timed_out":
+            self.requests_timed_out += 1
+        elif reason_value == "cancelled":
+            self.requests_cancelled += 1
+        else:
+            self.requests_finished += 1
+
+    def record_rejected(self) -> None:
+        self.requests_rejected += 1
+
+    # ------------------------------------------------------ reporting
+    def snapshot(self) -> Dict[str, object]:
+        """The dashboard dict: counters plus latency percentiles (None
+        where nothing was recorded yet)."""
+        window = None
+        if (self._first_activity_s is not None
+                and self._last_activity_s is not None):
+            window = self._last_activity_s - self._first_activity_s
+        return {
+            "requests_finished": self.requests_finished,
+            "requests_rejected": self.requests_rejected,
+            "requests_timed_out": self.requests_timed_out,
+            "requests_cancelled": self.requests_cancelled,
+            "tokens_emitted": self.tokens_emitted,
+            "tokens_per_s": (self.tokens_emitted / window
+                             if window else None),
+            "ttft_p50_s": _pct(self.ttft_s, 50),
+            "ttft_p99_s": _pct(self.ttft_s, 99),
+            "token_latency_p50_s": _pct(self.token_latency_s, 50),
+            "token_latency_p99_s": _pct(self.token_latency_s, 99),
+            "mean_queue_depth": (float(np.mean(self.queue_depth))
+                                 if self.queue_depth else None),
+            "mean_slot_occupancy": (float(np.mean(self.occupancy))
+                                    if self.occupancy else None),
+        }
+
+    def summary(self) -> str:
+        """Human-readable table via the shared summary plumbing."""
+        rows = {k: ("-" if v is None else v)
+                for k, v in self.snapshot().items()}
+        return format_table("Serving metrics:", rows)

@@ -1,0 +1,135 @@
+"""Request lifecycle for the online serving engine.
+
+The reference's endpoint is "save the model, then serve it"
+(`/root/reference/imagenet-resnet50.py:72`); the batch serving story
+(`docs/SERVING.md`) measured the single-request path. This module is
+the per-request half of the ONLINE layer: what a caller submits, the
+states a request moves through, and the handle it streams tokens from.
+
+Design constraints, inherited from the engine:
+
+- The engine is single-threaded and caller-driven (``engine.step()``),
+  so handles need no locking — cancellation is a flag the engine
+  honors at its next tick, not a cross-thread interrupt.
+- Sampling parameters are PER-REQUEST runtime values (batched into
+  ``[slots]`` arrays each tick), never compiled statics — hence the
+  array sentinels on :class:`SamplingParams`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import itertools
+from typing import List, Optional, Sequence
+
+
+class QueueFull(RuntimeError):
+    """Typed admission-control rejection: the engine's queue is at its
+    ``max_queue_depth``. Carries the depth so callers can implement
+    backpressure (retry-after, load-shed upstream) without parsing
+    strings."""
+
+    def __init__(self, queue_depth: int, max_queue_depth: int):
+        self.queue_depth = queue_depth
+        self.max_queue_depth = max_queue_depth
+        super().__init__(
+            f"serving queue full ({queue_depth}/{max_queue_depth}); "
+            "shed load upstream or raise max_queue_depth")
+
+
+class RequestState(enum.Enum):
+    QUEUED = "queued"
+    RUNNING = "running"
+    FINISHED = "finished"
+    CANCELLED = "cancelled"
+    TIMED_OUT = "timed_out"
+
+
+class FinishReason(enum.Enum):
+    LENGTH = "length"        # emitted max_new_tokens
+    EOS = "eos"              # hit the engine's eos token (included)
+    CANCELLED = "cancelled"  # handle.cancel()
+    TIMED_OUT = "timed_out"  # deadline_s exceeded
+
+
+@dataclasses.dataclass(frozen=True)
+class SamplingParams:
+    """Per-request sampling configuration (the ``generate()`` surface).
+
+    ``temperature <= 0`` is greedy; ``top_k``/``top_p`` then must be
+    unset (mirroring ``generate()``'s loud error — greedy would
+    silently ignore them)."""
+
+    temperature: float = 0.0
+    top_k: Optional[int] = None
+    top_p: Optional[float] = None
+
+    def __post_init__(self):
+        if self.top_k is not None and int(self.top_k) < 1:
+            raise ValueError(f"top_k must be >= 1, got {self.top_k}")
+        if self.top_p is not None and not 0.0 < float(self.top_p) <= 1.0:
+            raise ValueError(f"top_p must be in (0, 1], got {self.top_p}")
+        if self.temperature <= 0 and (self.top_k is not None
+                                      or self.top_p is not None):
+            raise ValueError(
+                "top_k/top_p require temperature > 0 (greedy decoding "
+                "would silently ignore them)")
+
+    # Array-side sentinels (arrays can't carry None): see
+    # gpt.batched_filtered_logits.
+    def as_arrays(self) -> tuple:
+        return (float(self.temperature),
+                int(self.top_k) if self.top_k is not None else 0,
+                float(self.top_p) if self.top_p is not None else 2.0)
+
+
+_ids = itertools.count()
+
+
+@dataclasses.dataclass
+class Request:
+    """One generate request as the scheduler sees it."""
+
+    prompt: Sequence[int]
+    max_new_tokens: int
+    sampling: SamplingParams = SamplingParams()
+    deadline_s: Optional[float] = None  # wall budget from submit()
+    request_id: int = dataclasses.field(default_factory=lambda: next(_ids))
+
+
+class RequestHandle:
+    """The caller's view of a submitted request.
+
+    ``tokens`` grows as the engine streams (generated tokens only, eos
+    included when hit); ``state``/``finish_reason`` settle when the
+    request leaves its slot. ``cancel()`` is honored at the engine's
+    next step — a queued request never runs, a running one is evicted
+    mid-decode with the tokens emitted so far intact.
+    """
+
+    def __init__(self, request: Request, arrival_s: float):
+        self.request = request
+        self.arrival_s = arrival_s
+        self.tokens: List[int] = []
+        self.state = RequestState.QUEUED
+        self.finish_reason: Optional[FinishReason] = None
+        self.ttft_s: Optional[float] = None  # submit → first token
+        self.finish_s: Optional[float] = None
+        self._cancel = False
+
+    def cancel(self) -> None:
+        self._cancel = True
+
+    @property
+    def cancelled(self) -> bool:
+        return self._cancel
+
+    @property
+    def done(self) -> bool:
+        return self.state in (RequestState.FINISHED, RequestState.CANCELLED,
+                              RequestState.TIMED_OUT)
+
+    def __repr__(self) -> str:  # debugging aid, not an API
+        return (f"RequestHandle(id={self.request.request_id}, "
+                f"state={self.state.value}, tokens={len(self.tokens)})")

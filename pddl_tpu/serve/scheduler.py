@@ -1,0 +1,85 @@
+"""Admission control for the serving engine: FCFS with two knobs.
+
+Orca (OSDI '22) separates the SCHEDULING policy from the iteration-level
+execution engine; this module is the policy half, deliberately small:
+
+- **max_queue_depth** — the load-shedding knob. A full queue rejects at
+  ``submit()`` with a typed :class:`~pddl_tpu.serve.request.QueueFull`
+  so upstream can backpressure instead of building unbounded latency.
+- **prefill_token_budget** — the head-of-line-blocking knob. Admission
+  each tick is FCFS but stops once the admitted prompts' combined
+  length would exceed the budget: prefill work is O(prompt), and an
+  unbounded admission burst would stall every RUNNING request's next
+  token behind it. At least one request is always admitted when a slot
+  is free (a single over-budget prompt must not deadlock).
+
+The queue holds handles, not raw requests, so cancellation of a QUEUED
+request is just a skip at pop time.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Deque, List, Optional
+
+from pddl_tpu.serve.request import (
+    FinishReason,
+    QueueFull,
+    RequestHandle,
+    RequestState,
+)
+
+
+class FCFSScheduler:
+    """First-come-first-served admission with load shedding and a
+    per-tick prefill budget."""
+
+    def __init__(self, *, max_queue_depth: int = 64,
+                 prefill_token_budget: Optional[int] = None):
+        if max_queue_depth < 1:
+            raise ValueError(
+                f"max_queue_depth must be >= 1, got {max_queue_depth}")
+        if prefill_token_budget is not None and prefill_token_budget < 1:
+            raise ValueError(
+                f"prefill_token_budget must be >= 1, got "
+                f"{prefill_token_budget}")
+        self.max_queue_depth = max_queue_depth
+        self.prefill_token_budget = prefill_token_budget
+        self._queue: Deque[RequestHandle] = deque()
+
+    @property
+    def depth(self) -> int:
+        return len(self._queue)
+
+    def submit(self, handle: RequestHandle) -> None:
+        """Enqueue, or shed load with a typed rejection."""
+        if len(self._queue) >= self.max_queue_depth:
+            raise QueueFull(len(self._queue), self.max_queue_depth)
+        self._queue.append(handle)
+
+    def admit(self, free_slots: int,
+              on_cancelled=None) -> List[RequestHandle]:
+        """Pop up to ``free_slots`` admissible handles FCFS, bounded by
+        the prefill token budget; cancelled queued handles are dropped
+        (marked CANCELLED) in passing — ``on_cancelled(handle)`` lets
+        the engine account them in its metrics."""
+        admitted: List[RequestHandle] = []
+        budget = self.prefill_token_budget
+        spent = 0
+        while self._queue and len(admitted) < free_slots:
+            head = self._queue[0]
+            if head.cancelled:
+                self._queue.popleft()
+                head.state = RequestState.CANCELLED
+                head.finish_reason = FinishReason.CANCELLED
+                if on_cancelled is not None:
+                    on_cancelled(head)
+                continue
+            cost = len(head.request.prompt)
+            if budget is not None and admitted and spent + cost > budget:
+                break  # FCFS: never skip the head for a cheaper request
+            self._queue.popleft()
+            head.state = RequestState.RUNNING
+            admitted.append(head)
+            spent += cost
+        return admitted

@@ -17,6 +17,30 @@ import numpy as np
 PyTree = Any
 
 
+def format_table(title: str, rows: dict[str, Any]) -> str:
+    """Aligned two-column text table in :func:`param_summary`'s house
+    style, for summary surfaces whose rows are plain key → value (the
+    serving engine's :meth:`~pddl_tpu.serve.metrics.ServeMetrics.summary`).
+    ``param_summary`` itself keeps its hand-rolled layout — its TOTAL
+    and batch-stats rows carry trailing annotations this two-column
+    form doesn't express. Numbers get thousands separators; floats
+    keep 3 decimals."""
+    def _fmt(v: Any) -> str:
+        if isinstance(v, bool):
+            return str(v)
+        if isinstance(v, int):
+            return f"{v:,}"
+        if isinstance(v, float):
+            return f"{v:,.3f}"
+        return str(v)
+
+    lines = [title]
+    width = max((len(k) for k in rows), default=10)
+    for key, value in rows.items():
+        lines.append(f"  {key:<{width}}  {_fmt(value):>14}")
+    return "\n".join(lines)
+
+
 def param_summary(params: PyTree, batch_stats: PyTree | None = None) -> str:
     """Human-readable per-module parameter table + totals."""
     by_module: dict[str, int] = {}

@@ -1,0 +1,312 @@
+"""Continuous-batching serving engine (`pddl_tpu/serve/`), CPU.
+
+The contracts under test:
+
+- **Exactness**: a greedy request served through the slot-pooled engine
+  emits exactly what single-request ``generate()`` emits — admit order,
+  slot reuse, and neighbors in the batch must not change anyone's
+  tokens (both families: GPT scalar-MHA cache, Llama GQA + RoPE).
+- **Isolation**: per-slot sampling parameters are runtime arrays; one
+  tick serves a greedy request next to a hot-temperature one without
+  either leaking into the other.
+- **Lifecycle**: admit → stream → evict for length/eos; cancellation
+  and deadlines evict mid-decode with tokens-so-far intact; a full
+  queue sheds load with the typed ``QueueFull``.
+- **Fixed-shape discipline**: after ``warmup()`` a mixed workload
+  (different prompt lengths, sampling params, request sizes) compiles
+  NOTHING new — all four resident programs stay at exactly one
+  executable.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pddl_tpu.models.gpt import (
+    batched_filtered_logits,
+    filtered_logits,
+    generate,
+    tiny_gpt,
+)
+from pddl_tpu.models.llama import tiny_llama
+from pddl_tpu.serve import (
+    FinishReason,
+    QueueFull,
+    RequestState,
+    SamplingParams,
+    ServeEngine,
+)
+
+
+@pytest.fixture(scope="module")
+def gpt_setup():
+    model = tiny_gpt(vocab_size=32, max_len=64)
+    prompt = jnp.ones((1, 8), jnp.int32)
+    params = model.init(jax.random.key(0), prompt, train=False)["params"]
+    return model, {"params": params}
+
+
+def _ref_greedy(model, variables, prompt, n_new):
+    out = generate(model, variables,
+                   jnp.asarray(prompt, jnp.int32)[None], n_new)
+    return np.asarray(out)[0, len(prompt):].tolist()
+
+
+class _FakeClock:
+    def __init__(self):
+        self.now = 0.0
+
+    def __call__(self):
+        return self.now
+
+
+def test_admit_evict_slot_reuse_matches_generate(gpt_setup):
+    """More requests than slots: every slot is reused, every request's
+    greedy stream equals its single-request generate() — the whole
+    point of iteration-level scheduling is that batching is invisible
+    to each stream."""
+    model, variables = gpt_setup
+    eng = ServeEngine(model, variables, max_slots=2, prefill_len=16)
+    eng.warmup()
+    prompts = [np.arange(1 + 2 * i, dtype=np.int32)[:9] % 32
+               for i in range(5)]
+    lengths = [4, 7, 3, 6, 5]
+    handles = [eng.submit(p, n) for p, n in zip(prompts, lengths)]
+    eng.run(max_steps=100)
+    for h, p, n in zip(handles, prompts, lengths):
+        assert h.state == RequestState.FINISHED
+        assert h.finish_reason == FinishReason.LENGTH
+        assert h.tokens == _ref_greedy(model, variables, p, n)
+    # 5 requests through 2 slots: reuse is structural, and occupancy
+    # telemetry saw the pool actually multiplexed.
+    snap = eng.metrics.snapshot()
+    assert snap["requests_finished"] == 5
+    assert snap["tokens_emitted"] == sum(lengths)
+    assert snap["mean_slot_occupancy"] > 0.5
+
+
+def test_llama_family_through_engine():
+    """The GQA + RoPE family (per-row rotary positions, grouped cache)
+    through the same engine, exact vs generate()."""
+    model = tiny_llama(vocab_size=32, max_len=64)
+    prompt = jnp.ones((1, 8), jnp.int32)
+    variables = {"params": model.init(jax.random.key(1), prompt,
+                                      train=False)["params"]}
+    eng = ServeEngine(model, variables, max_slots=2, prefill_len=16)
+    prompts = [(np.arange(6) * 5 + i) % 32 for i in range(3)]
+    handles = [eng.submit(p, 5) for p in prompts]
+    eng.run(max_steps=100)
+    for h, p in zip(handles, prompts):
+        assert h.tokens == _ref_greedy(model, variables, p, 5)
+
+
+def test_per_slot_sampling_isolation(gpt_setup):
+    """Three requests in one tick with different sampling params. The
+    discriminative pair: greedy and (temperature=1, top_k=1) must BOTH
+    reproduce their solo greedy streams (top-1 sampling is argmax), so
+    a hot-temperature neighbor in the same fused tick proves per-slot
+    parameters don't leak across rows."""
+    model, variables = gpt_setup
+    eng = ServeEngine(model, variables, max_slots=3, prefill_len=16,
+                      rng=jax.random.key(7))
+    pa = (np.arange(5) * 3) % 32
+    pb = (np.arange(7) * 2 + 1) % 32
+    pc = (np.arange(4) + 11) % 32
+    ha = eng.submit(pa, 6)  # greedy
+    hb = eng.submit(pb, 6, sampling=SamplingParams(temperature=1.0, top_k=1))
+    hc = eng.submit(pc, 6, sampling=SamplingParams(temperature=8.0))
+    eng.run(max_steps=50)
+    assert ha.tokens == _ref_greedy(model, variables, pa, 6)
+    assert hb.tokens == _ref_greedy(model, variables, pb, 6)
+    assert all(0 <= t < 32 for t in hc.tokens) and len(hc.tokens) == 6
+
+
+def test_batched_filter_matches_static_per_row():
+    """The per-slot sampler's filter pipeline must equal the compiled
+    single-request one row by row (same top-k tie rule, same nucleus
+    CDF rule) — the engine's sampling is generate()'s, just batched."""
+    logits = jax.random.normal(jax.random.key(3), (4, 33)) * 3.0
+    cfgs = [(1.0, 5, 0.9), (0.7, 0, 2.0), (2.0, 1, 2.0), (0.5, 0, 0.3)]
+    t = jnp.array([c[0] for c in cfgs])
+    k = jnp.array([c[1] for c in cfgs], jnp.int32)
+    p = jnp.array([c[2] for c in cfgs])
+    batched = batched_filtered_logits(logits, temperature=t, top_k=k,
+                                      top_p=p)
+    for i, (ti, ki, pi) in enumerate(cfgs):
+        ref = filtered_logits(logits[i:i + 1], temperature=ti,
+                              top_k=ki or None,
+                              top_p=pi if pi <= 1.0 else None)
+        np.testing.assert_allclose(np.asarray(batched[i:i + 1]),
+                                   np.asarray(ref), rtol=1e-6,
+                                   err_msg=f"row {i} cfg {cfgs[i]}")
+
+
+def test_cancellation_mid_decode_frees_the_slot(gpt_setup):
+    """Cancel a running request: evicted at the next step with its
+    tokens-so-far intact, and a queued request takes over the slot."""
+    model, variables = gpt_setup
+    eng = ServeEngine(model, variables, max_slots=1, prefill_len=16)
+    long_h = eng.submit(np.arange(4) % 32, 40)
+    queued_p = (np.arange(5) + 2) % 32
+    queued_h = eng.submit(queued_p, 4)
+    for _ in range(3):
+        eng.step()
+    assert long_h.state == RequestState.RUNNING
+    assert queued_h.state == RequestState.QUEUED
+    emitted_at_cancel = len(long_h.tokens)
+    assert emitted_at_cancel >= 1
+    long_h.cancel()
+    eng.run(max_steps=50)
+    assert long_h.state == RequestState.CANCELLED
+    assert long_h.finish_reason == FinishReason.CANCELLED
+    assert len(long_h.tokens) == emitted_at_cancel  # stream stopped
+    assert queued_h.state == RequestState.FINISHED
+    assert queued_h.tokens == _ref_greedy(model, variables, queued_p, 4)
+
+
+def test_cancelling_a_queued_request_never_runs(gpt_setup):
+    model, variables = gpt_setup
+    eng = ServeEngine(model, variables, max_slots=1, prefill_len=16)
+    running = eng.submit(np.arange(4) % 32, 3)
+    queued = eng.submit(np.arange(5) % 32, 3)
+    queued.cancel()
+    eng.run(max_steps=50)
+    assert running.state == RequestState.FINISHED
+    assert queued.state == RequestState.CANCELLED
+    assert queued.tokens == []
+
+
+def test_deadline_timeout_evicts(gpt_setup):
+    """An injectable clock drives the deadline: the request times out
+    mid-decode, keeps its partial stream, and is counted."""
+    model, variables = gpt_setup
+    clock = _FakeClock()
+    eng = ServeEngine(model, variables, max_slots=1, prefill_len=16,
+                      clock=clock)
+    h = eng.submit(np.arange(4) % 32, 40, deadline_s=10.0)
+    eng.step()
+    assert h.state == RequestState.RUNNING
+    partial = len(h.tokens)
+    assert partial >= 1
+    clock.now = 11.0  # past the deadline
+    eng.step()
+    assert h.state == RequestState.TIMED_OUT
+    assert h.finish_reason == FinishReason.TIMED_OUT
+    assert len(h.tokens) == partial
+    snap = eng.metrics.snapshot()
+    assert snap["requests_timed_out"] == 1
+    assert snap["requests_finished"] == 0  # counters are disjoint
+
+
+def test_deadline_expired_in_queue_never_pays_prefill(gpt_setup):
+    """A request whose deadline passes while QUEUED is timed out at
+    admission — no prefill dispatch, no post-deadline token, the slot
+    goes to the next admissible request."""
+    model, variables = gpt_setup
+    clock = _FakeClock()
+    eng = ServeEngine(model, variables, max_slots=1, prefill_len=16,
+                      clock=clock)
+    running = eng.submit(np.arange(4) % 32, 30)
+    doomed = eng.submit(np.arange(5) % 32, 4, deadline_s=5.0)
+    fine = eng.submit((np.arange(6) + 1) % 32, 3)
+    for _ in range(3):
+        eng.step()
+    clock.now = 6.0  # doomed expires in the queue; running keeps going
+    running.cancel()
+    eng.run(max_steps=100)
+    assert doomed.state == RequestState.TIMED_OUT
+    assert doomed.tokens == []  # never ran
+    assert fine.state == RequestState.FINISHED
+    assert fine.tokens == _ref_greedy(model, variables, (np.arange(6) + 1) % 32, 3)
+
+
+def test_queue_full_sheds_load_typed(gpt_setup):
+    model, variables = gpt_setup
+    eng = ServeEngine(model, variables, max_slots=1, prefill_len=16,
+                      max_queue_depth=2)
+    for _ in range(2):
+        eng.submit(np.arange(4) % 32, 2)
+    with pytest.raises(QueueFull) as exc:
+        eng.submit(np.arange(4) % 32, 2)
+    assert exc.value.queue_depth == 2
+    assert exc.value.max_queue_depth == 2
+    assert eng.metrics.snapshot()["requests_rejected"] == 1
+    eng.run(max_steps=50)  # the accepted two still complete
+    assert eng.metrics.snapshot()["requests_finished"] == 2
+
+
+def test_zero_recompiles_after_warmup(gpt_setup):
+    """THE fixed-shape contract: one warmup, then a deliberately mixed
+    workload — different prompt lengths, temperatures, top-k/top-p,
+    request sizes, slot churn — and every resident program still has
+    exactly ONE compiled executable."""
+    model, variables = gpt_setup
+    eng = ServeEngine(model, variables, max_slots=2, prefill_len=16,
+                      rng=jax.random.key(9))
+    eng.warmup()
+    assert all(v == 1 for v in eng.compile_counts().values()), \
+        eng.compile_counts()
+    mixed = [
+        (np.arange(3) % 32, 2, SamplingParams()),
+        (np.arange(9) % 32, 7, SamplingParams(temperature=0.8, top_k=4)),
+        (np.arange(14) % 32, 1, SamplingParams(temperature=1.5, top_p=0.7)),
+        (np.arange(5) % 32, 9,
+         SamplingParams(temperature=0.3, top_k=2, top_p=0.95)),
+        (np.arange(16) % 32, 3, SamplingParams()),
+    ]
+    handles = [eng.submit(p, n, sampling=s) for p, n, s in mixed]
+    eng.run(max_steps=200)
+    assert all(h.state == RequestState.FINISHED for h in handles)
+    assert all(v == 1 for v in eng.compile_counts().values()), \
+        eng.compile_counts()
+
+
+def test_int8_serving_composes_through_engine(gpt_setup):
+    """The generate() int8 hook through the engine: int8 params +
+    param_transform reproduce the dequantized model's greedy streams
+    exactly (same weights, same math — only the HBM representation and
+    the jit boundary move)."""
+    from pddl_tpu.ops.quant import dequantize, quantize_int8
+
+    model, variables = gpt_setup
+    qparams = quantize_int8(variables["params"], min_elems=128)
+    dense = {"params": dequantize(qparams)}
+    eng = ServeEngine(model, {"params": qparams}, max_slots=2,
+                      prefill_len=16, param_transform=dequantize)
+    prompts = [(np.arange(6) + i) % 32 for i in range(3)]
+    handles = [eng.submit(p, 5) for p in prompts]
+    eng.run(max_steps=50)
+    for h, p in zip(handles, prompts):
+        assert h.tokens == _ref_greedy(model, dense, p, 5)
+
+
+def test_eos_finishes_early(gpt_setup):
+    """Whatever greedy emits 2 tokens in, declaring that token eos must
+    stop the stream right there with reason EOS (token included)."""
+    model, variables = gpt_setup
+    p = np.arange(6) % 32
+    ref = _ref_greedy(model, variables, p, 3)
+    eng = ServeEngine(model, variables, max_slots=1, prefill_len=16,
+                      eos_token=ref[1])
+    h = eng.submit(p, 20)
+    eng.run(max_steps=50)
+    assert h.state == RequestState.FINISHED
+    assert h.finish_reason == FinishReason.EOS
+    assert h.tokens == ref[:2]
+
+
+def test_submit_validation_and_ring_refusal(gpt_setup):
+    model, variables = gpt_setup
+    eng = ServeEngine(model, variables, max_slots=1, prefill_len=8)
+    with pytest.raises(ValueError, match="prefill_len"):
+        eng.submit(np.zeros(9, np.int32), 4)
+    with pytest.raises(ValueError, match="max_len"):
+        eng.submit(np.zeros(8, np.int32), 64)
+    with pytest.raises(ValueError, match="at least one token"):
+        eng.submit(np.zeros(0, np.int32), 4)
+    with pytest.raises(ValueError, match="top_k/top_p"):
+        SamplingParams(top_k=4)
+    swa = tiny_llama(vocab_size=32, max_len=1024, sliding_window=64)
+    with pytest.raises(NotImplementedError, match="ring"):
+        ServeEngine(swa, variables, max_slots=1)

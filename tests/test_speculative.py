@@ -264,3 +264,41 @@ def test_speculative_sampling_tensor_parallel(mesh4x2):
                                temperature=0.8, top_k=4,
                                rng=jax.random.key(5), strategy=strategy)
     np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+def test_cache_position_counters_are_exactly_the_scalar_int32_leaves():
+    """The loud-failure registry for `_rewind_index` (and the serving
+    engine's slot machinery): position counters are matched BY NAME
+    (gpt.CACHE_INDEX_KEYS). Enumerate every scalar int32 leaf of each
+    family's decode cache and require the name registry to cover it —
+    a future scalar int32 cache leaf that is NOT a position counter
+    fails here and forces an explicit decision in both consumers."""
+    from pddl_tpu.models.gpt import CACHE_INDEX_KEYS, is_cache_index_path
+    from pddl_tpu.models.speculative import _rewind_index
+
+    for factory in (tiny_gpt, tiny_llama):
+        model = factory(vocab_size=16, max_len=64)
+        dec = model.clone(decode=True)
+        dummy = jnp.zeros((1, 1), jnp.int32)
+        cache = jax.eval_shape(
+            lambda d=dec: d.init(jax.random.key(0), dummy, train=False)
+        )["cache"]
+        leaves = jax.tree_util.tree_leaves_with_path(cache)
+        scalar_int32 = [(path, leaf) for path, leaf in leaves
+                        if leaf.ndim == 0 and leaf.dtype == jnp.int32]
+        assert scalar_int32, "decode cache lost its position counters?"
+        for path, _ in scalar_int32:
+            name = str(getattr(path[-1], "key", path[-1]))
+            assert is_cache_index_path(path), (
+                f"scalar int32 cache leaf {name!r} is not a registered "
+                f"position counter {sorted(CACHE_INDEX_KEYS)}: teach "
+                "_rewind_index/the serving engine about it explicitly")
+        # And the name match must hit every counter: rewinding a real
+        # cache rewrites exactly the registered leaves.
+        real = jax.tree.map(lambda sd: jnp.zeros(sd.shape, sd.dtype), cache)
+        wound = _rewind_index(real, jnp.int32(7))
+        for path, leaf in jax.tree_util.tree_leaves_with_path(wound):
+            if is_cache_index_path(path):
+                assert int(leaf) == 7
+            else:
+                assert leaf.shape != ()  # K/V tensors untouched by name

@@ -263,9 +263,26 @@ def test_int8_serving_hook_on_chip():
     qparams = quantize_int8(params, min_elems=128)
     ref = generate(model, {"params": dequantize(qparams)}, prompt,
                    max_new_tokens=48)
+    # Plain generate: the hook moves only the jit boundary and the HBM
+    # representation, the compiled program is otherwise the same — this
+    # leg stays BIT-equal.
     out = generate(model, {"params": qparams}, prompt, max_new_tokens=48,
                    param_transform=dequantize)
     np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+    # Speculative leg: the k+1-wide verify block and the one-token tick
+    # are DIFFERENT compiled programs whose bf16 logits can differ by
+    # ulps — on an untrained model that can flip an argmax at a genuine
+    # tie (see test_speculative_greedy_consistent_on_chip), so assert
+    # GREEDY CONSISTENCY along the speculative output's own prefix
+    # against the dequantized model's conditional, not bit-equality.
     out_spec = generate_speculative(model, {"params": qparams}, prompt,
                                     48, param_transform=dequantize)
-    np.testing.assert_array_equal(np.asarray(out_spec), np.asarray(ref))
+    logits = jax.jit(
+        lambda p, t: model.apply({"params": p}, t, train=False))(
+            dequantize(qparams), out_spec[:, :-1])
+    lg = np.asarray(logits, np.float32)
+    tok = np.asarray(out_spec)[:, 1:]
+    sel = np.take_along_axis(lg, tok[..., None], axis=-1)[..., 0]
+    gap = lg.max(axis=-1) - sel
+    p = prompt.shape[1]
+    assert np.all(gap[:, p - 1:] < 0.1), float(gap[:, p - 1:].max())
