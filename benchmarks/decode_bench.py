@@ -19,13 +19,13 @@ import argparse
 import json
 import os
 import sys
-import time
 
 import jax
 import jax.numpy as jnp
 
 from pddl_tpu.models.gpt import GPT_Small, generate
 from pddl_tpu.models.llama import Llama_1B, Llama_Small
+from pddl_tpu.utils.bench_artifact import provenance, timed_stats
 
 
 # Peak HBM bandwidth per chip, GB/s — the denominator of the decode
@@ -67,20 +67,20 @@ def _roofline_tokens_per_sec(model, variables, prompt_len: int,
 
 
 def _bench_generate(model, variables, batch: int, prompt_len: int,
-                    new_tokens: int, iters: int = 3,
-                    param_transform=None) -> float:
+                    new_tokens: int, n_repeats: int = 3,
+                    param_transform=None):
+    """(median tokens/s, spread_pct) over ``n_repeats`` timed runs —
+    the artifact-discipline shape (median headline + drift-detecting
+    spread; `pddl_tpu/utils/bench_artifact.py`)."""
     prompt = jax.random.randint(jax.random.key(0), (batch, prompt_len),
                                 0, model.vocab_size)
     kw = dict(max_new_tokens=new_tokens, param_transform=param_transform)
     out = generate(model, variables, prompt, **kw)
     int(out[0, -1])  # scalar fetch = sync under tunneled transports
-    best = float("inf")
-    for _ in range(iters):
-        t0 = time.perf_counter()
-        out = generate(model, variables, prompt, **kw)
-        int(out[0, -1])
-        best = min(best, time.perf_counter() - t0)
-    return batch * new_tokens / best
+    stats = timed_stats(
+        lambda: generate(model, variables, prompt, **kw),
+        lambda o: int(o[0, -1]), n_repeats=n_repeats)
+    return batch * new_tokens / stats["median_s"], stats["spread_pct"]
 
 
 def main() -> None:
@@ -95,6 +95,9 @@ def main() -> None:
                         "(ops/quant.py) — halves the B1 weight-read "
                         "floor IF XLA streams the int8 (the comparison "
                         "against the int8 roofline is the check)")
+    p.add_argument("--repeats", type=int, default=3,
+                   help="timed repetitions per series (>= 3; median is "
+                        "the headline, spread the drift detector)")
     p.add_argument("--out", default="")
     args = p.parse_args()
 
@@ -126,6 +129,7 @@ def main() -> None:
         "unit": "tokens/sec/chip",
         "config": {"prompt_len": args.prompt_len,
                    "new_tokens": args.new_tokens, "dtype": "bfloat16"},
+        "provenance": provenance(args.repeats),
         "results": {},
         "device": jax.devices()[0].device_kind,
     }
@@ -137,14 +141,19 @@ def main() -> None:
         roof = _roofline_tokens_per_sec(model, variables,
                                         args.prompt_len, args.new_tokens)
         for batch in (1, 8):
-            tps = _bench_generate(model, variables, batch,
-                                  args.prompt_len, args.new_tokens)
+            tps, spread = _bench_generate(model, variables, batch,
+                                          args.prompt_len,
+                                          args.new_tokens,
+                                          n_repeats=args.repeats)
             record["results"][f"{name}_b{batch}"] = round(tps, 1)
+            record["results"][f"{name}_b{batch}_spread_pct"] = round(
+                spread, 2)
             if batch == 1 and roof is not None:
                 record["results"][f"{name}_roofline_b1"] = round(roof, 1)
                 record["results"][f"{name}_roofline_ratio_b1"] = round(
                     tps / roof, 3)
-            print(f"{name} B{batch}: {tps:,.0f} new tokens/s"
+            print(f"{name} B{batch}: {tps:,.0f} new tokens/s "
+                  f"(spread {spread:.1f}%)"
                   + (f" ({tps / roof:.0%} of {roof:,.0f} roofline)"
                      if batch == 1 and roof else ""),
                   file=sys.stderr, flush=True)
@@ -160,16 +169,21 @@ def main() -> None:
                                              args.prompt_len,
                                              args.new_tokens)
             for batch in (1, 8):
-                tps8 = _bench_generate(model, qvars, batch,
-                                       args.prompt_len, args.new_tokens,
-                                       param_transform=dequantize)
+                tps8, spread8 = _bench_generate(model, qvars, batch,
+                                                args.prompt_len,
+                                                args.new_tokens,
+                                                n_repeats=args.repeats,
+                                                param_transform=dequantize)
                 record["results"][f"{name}_int8_b{batch}"] = round(tps8, 1)
+                record["results"][f"{name}_int8_b{batch}_spread_pct"] = (
+                    round(spread8, 2))
                 if batch == 1 and roof8 is not None:
                     record["results"][f"{name}_int8_roofline_b1"] = round(
                         roof8, 1)
                     record["results"][f"{name}_int8_roofline_ratio_b1"] = (
                         round(tps8 / roof8, 3))
-                print(f"{name} int8 B{batch}: {tps8:,.0f} new tokens/s"
+                print(f"{name} int8 B{batch}: {tps8:,.0f} new tokens/s "
+                      f"(spread {spread8:.1f}%)"
                       + (f" ({tps8 / roof8:.0%} of {roof8:,.0f} int8 "
                          "roofline)" if batch == 1 and roof8 else ""),
                       file=sys.stderr, flush=True)

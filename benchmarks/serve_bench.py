@@ -13,6 +13,18 @@ the ONLINE layer (`pddl_tpu/serve/`) the way a serving owner would:
    system is judged by: aggregate tokens/s, p50/p99 TTFT (queue wait
    included), queue depth, slot occupancy, and shed load at the
    oversaturated point.
+3. **Shared-prefix workload** (`--prefix-shared-frac`, default 80%) —
+   the prefix-cache lever (`pddl_tpu/serve/kvcache/`): the same
+   requests through the engine with the radix prefix cache ON vs OFF;
+   the TTFT ratio is what block-granular KV reuse buys when traffic
+   shares a system prompt. Hit rate, prefill tokens saved, and the
+   compile counts (zero recompiles with the cache on too) land in the
+   artifact.
+
+Timing follows the artifact discipline of
+`pddl_tpu/utils/bench_artifact.py`: every headline number is a median
+over `--repeats >= 3` runs with the spread recorded, and the record
+carries the emitting tree's git commit.
 
 Weights are random (throughput does not depend on training); programs
 are compiled at warmup and the bench records the engine's
@@ -37,6 +49,7 @@ import numpy as np
 
 from pddl_tpu.models.gpt import GPT, generate
 from pddl_tpu.serve import QueueFull, SamplingParams, ServeEngine
+from pddl_tpu.utils.bench_artifact import median_spread, provenance
 
 
 def _log(msg: str) -> None:
@@ -50,37 +63,123 @@ def _make_requests(n: int, prompt_len: int, new_tokens: int, vocab: int,
             for _ in range(n)]
 
 
-def _sequential_baseline(model, variables, prompts, new_tokens: int):
+def _sequential_baseline(model, variables, prompts, new_tokens: int,
+                         repeats: int = 3):
     """Run-to-completion: each request is one generate() call (compiled
-    once — same shapes reuse the cached decode scan)."""
+    once — same shapes reuse the cached decode scan). Median tok/s over
+    ``repeats`` passes, spread recorded."""
     # Warm the compiled programs outside the timed window, like the
     # decode benches do.
     warm = generate(model, variables, jnp.asarray(prompts[0])[None],
                     new_tokens)
     jax.block_until_ready(warm)
-    t0 = time.perf_counter()
-    for p in prompts:
-        out = generate(model, variables, jnp.asarray(p)[None], new_tokens)
-    jax.block_until_ready(out)
-    dt = time.perf_counter() - t0
-    return len(prompts) * new_tokens / dt
+    samples = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        for p in prompts:
+            out = generate(model, variables, jnp.asarray(p)[None],
+                           new_tokens)
+        jax.block_until_ready(out)
+        samples.append(len(prompts) * new_tokens
+                       / (time.perf_counter() - t0))
+    return median_spread(samples)
 
 
 def _engine_concurrent(model, variables, prompts, new_tokens: int,
-                       slots: int, prefill_len: int):
-    """All requests submitted up front (closed-loop, max concurrency)."""
+                       slots: int, prefill_len: int, repeats: int = 3):
+    """All requests submitted up front (closed-loop, max concurrency).
+    The legacy head-to-head leg runs with the prefix cache OFF so the
+    continuous-batching ratio stays comparable across rounds (prompts
+    here are random — nothing to share anyway)."""
     eng = ServeEngine(model, variables, max_slots=slots,
                       prefill_len=prefill_len,
-                      max_queue_depth=len(prompts) + 1)
+                      max_queue_depth=len(prompts) + 1,
+                      prefix_cache_blocks=0)
     eng.warmup()
-    t0 = time.perf_counter()
-    handles = [eng.submit(p, new_tokens) for p in prompts]
-    eng.run(max_steps=100000)
-    dt = time.perf_counter() - t0
-    assert all(h.done for h in handles)
-    total = sum(len(h.tokens) for h in handles)
-    assert total == len(prompts) * new_tokens
-    return total / dt, eng
+    samples = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        handles = [eng.submit(p, new_tokens) for p in prompts]
+        eng.run(max_steps=100000)
+        dt = time.perf_counter() - t0
+        assert all(h.done for h in handles)
+        assert sum(len(h.tokens) for h in handles) \
+            == len(prompts) * new_tokens
+        samples.append(len(prompts) * new_tokens / dt)
+    med, spread = median_spread(samples)
+    return med, spread, eng
+
+
+def _prefix_ttft_leg(model, variables, *, n_requests: int,
+                     prompt_len: int, shared_frac: float, new_tokens: int,
+                     slots: int, prefill_len: int, block_size: int,
+                     chunk: int, vocab: int, repeats: int, seed: int = 3):
+    """The prefix-cache lever: identical shared-prefix workload through
+    the engine with the radix cache ON vs OFF; returns the artifact
+    fragment (median mean-TTFT ratio over ``repeats``, hit telemetry,
+    compile counts).
+
+    The leg keeps ``n_requests <= slots`` and short decodes so the
+    whole burst admits in one pass and TTFT measures ADMISSION — the
+    prefill path the prefix cache actually shortens. (With requests
+    queuing behind long decodes, TTFT is decode-capacity wait that no
+    prefill lever can touch, and the ratio would understate the cache
+    by construction.)"""
+    rng = np.random.default_rng(seed)
+    shared_len = int(prompt_len * shared_frac)
+    shared = rng.integers(0, vocab, size=shared_len).astype(np.int32)
+    prompts = [np.concatenate([
+        shared,
+        rng.integers(0, vocab, size=prompt_len - shared_len)
+        .astype(np.int32)]) for _ in range(n_requests)]
+    # Pool sized for the workload (one shared chain + each request's
+    # unique suffix blocks, with slack) instead of the engine's generic
+    # auto-sizing — the leg measures reuse, not eviction.
+    pool_blocks = (2 + prompt_len // block_size
+                   + n_requests * ((prompt_len - shared_len) // block_size
+                                   + 2))
+
+    def run_once(prefix_blocks):
+        eng = ServeEngine(
+            model, variables, max_slots=slots, prefill_len=prefill_len,
+            max_queue_depth=n_requests + 1,
+            prefix_cache_blocks=prefix_blocks,
+            prefix_block_size=block_size,
+            prefix_chunk=chunk if prefix_blocks else None)
+        eng.warmup()
+        handles = [eng.submit(p, new_tokens) for p in prompts]
+        eng.run(max_steps=100000)
+        assert all(h.done for h in handles)
+        ttfts = [h.ttft_s for h in handles]
+        return float(np.mean(ttfts)), eng
+
+    on_ttfts, off_ttfts = [], []
+    eng_on = eng_off = None
+    for _ in range(repeats):
+        t_on, eng_on = run_once(pool_blocks)
+        t_off, eng_off = run_once(0)
+        on_ttfts.append(t_on)
+        off_ttfts.append(t_off)
+    on_med, on_spread = median_spread(on_ttfts)
+    off_med, off_spread = median_spread(off_ttfts)
+    snap = eng_on.metrics.snapshot()
+    return {
+        "shared_frac": shared_frac,
+        "prompt_len": prompt_len,
+        "n_requests": n_requests,
+        "prefix_block_size": block_size,
+        "prefix_chunk": chunk,
+        "mean_ttft_prefix_off_s": round(off_med, 5),
+        "mean_ttft_prefix_on_s": round(on_med, 5),
+        "ttft_reduction_x": round(off_med / on_med, 3),
+        "spread_pct": round(max(on_spread, off_spread), 2),
+        "prefix_hit_rate": round(snap["prefix_hit_rate"], 3),
+        "prefill_tokens_saved": snap["prefill_tokens_saved"],
+        "prefix_blocks_live": snap["prefix_blocks_live"],
+        "prefix_evictions": snap["prefix_evictions"],
+        "engine_compile_counts_prefix_on": eng_on.compile_counts(),
+        "engine_compile_counts_prefix_off": eng_off.compile_counts(),
+    }
 
 
 def _poisson_load(model, variables, offered_rps: float, n_requests: int,
@@ -93,9 +192,12 @@ def _poisson_load(model, variables, offered_rps: float, n_requests: int,
     arrivals = np.cumsum(rng.exponential(1.0 / offered_rps, n_requests))
     prompts = _make_requests(n_requests, prompt_len, new_tokens, vocab,
                              seed=seed + 1)
+    # Prefix cache off: the Poisson prompts are random (nothing to
+    # share), and the load curve stays comparable with r06.
     eng = ServeEngine(model, variables, max_slots=slots,
                       prefill_len=prefill_len,
-                      max_queue_depth=max_queue_depth)
+                      max_queue_depth=max_queue_depth,
+                      prefix_cache_blocks=0)
     eng.warmup()
     rejected = 0
     i = 0
@@ -147,6 +249,29 @@ def main() -> None:
     p.add_argument("--poisson-requests", type=int, default=24,
                    help="requests per Poisson load point")
     p.add_argument("--max-queue-depth", type=int, default=16)
+    p.add_argument("--skip-poisson", action="store_true",
+                   help="head-to-head + prefix legs only (the Poisson "
+                        "curve runs in real time and dominates wall "
+                        "clock)")
+    p.add_argument("--prefix-requests", type=int, default=24,
+                   help="requests in the shared-prefix TTFT leg (the "
+                        "leg runs them at n_requests slots with short "
+                        "decodes, so TTFT measures the admission "
+                        "prefill the cache shortens)")
+    p.add_argument("--prefix-prompt-len", type=int, default=384,
+                   help="shared-prefix leg prompt length (long prompts "
+                        "are the cache's home turf — suffix compute "
+                        "stays 1-shared_frac of the prompt while the "
+                        "per-admission fixed costs amortize)")
+    p.add_argument("--prefix-new-tokens", type=int, default=8)
+    p.add_argument("--prefix-shared-frac", type=float, default=0.8)
+    p.add_argument("--prefix-block-size", type=int, default=8)
+    p.add_argument("--prefix-chunk", type=int, default=80,
+                   help="narrow suffix-chunk width (~ the uncached "
+                        "suffix at the default shared fraction)")
+    p.add_argument("--repeats", type=int, default=3,
+                   help="timed repetitions per headline number (median "
+                        "+ spread recorded)")
     p.add_argument("--out", default="")
     args = p.parse_args()
 
@@ -163,16 +288,17 @@ def main() -> None:
                              args.new_tokens, args.vocab)
     _log(f"head-to-head: {args.concurrent} requests x "
          f"{args.new_tokens} tokens, {model_desc}")
-    seq_tps = _sequential_baseline(model, variables, prompts,
-                                   args.new_tokens)
-    eng_tps, eng = _engine_concurrent(model, variables, prompts,
-                                      args.new_tokens, args.slots,
-                                      args.prefill_len)
+    seq_tps, seq_spread = _sequential_baseline(
+        model, variables, prompts, args.new_tokens, repeats=args.repeats)
+    eng_tps, eng_spread, eng = _engine_concurrent(
+        model, variables, prompts, args.new_tokens, args.slots,
+        args.prefill_len, repeats=args.repeats)
     counts = eng.compile_counts()
     speedup = eng_tps / seq_tps
-    _log(f"sequential generate(): {seq_tps:,.0f} tok/s; engine "
-         f"({args.slots} slots): {eng_tps:,.0f} tok/s ({speedup:.2f}x); "
-         f"compile counts {counts}")
+    _log(f"sequential generate(): {seq_tps:,.0f} tok/s (spread "
+         f"{seq_spread:.1f}%); engine ({args.slots} slots): "
+         f"{eng_tps:,.0f} tok/s (spread {eng_spread:.1f}%, "
+         f"{speedup:.2f}x); compile counts {counts}")
 
     # Offered loads relative to the measured closed-loop capacity:
     # comfortable, busy, oversaturated (the admission-control point).
@@ -192,16 +318,36 @@ def main() -> None:
             "scheduler": "FCFS, prefill-token budget, typed QueueFull "
                          "shedding",
         },
+        "provenance": provenance(args.repeats),
         "results": {
             "concurrent_sequential_tokens_per_s": round(seq_tps, 1),
+            "concurrent_sequential_spread_pct": round(seq_spread, 2),
             "concurrent_engine_tokens_per_s": round(eng_tps, 1),
+            "concurrent_engine_spread_pct": round(eng_spread, 2),
             "concurrent_speedup": round(speedup, 3),
             "engine_compile_counts_after_run": counts,
             "poisson": [],
         },
         "device": jax.devices()[0].device_kind,
     }
-    for frac in (0.3, 0.6, 1.2):
+
+    prefix = _prefix_ttft_leg(
+        model, variables, n_requests=args.prefix_requests,
+        prompt_len=args.prefix_prompt_len,
+        shared_frac=args.prefix_shared_frac,
+        new_tokens=args.prefix_new_tokens, slots=args.prefix_requests,
+        prefill_len=max(args.prefill_len, args.prefix_prompt_len),
+        block_size=args.prefix_block_size, chunk=args.prefix_chunk,
+        vocab=args.vocab, repeats=args.repeats)
+    record["results"]["prefix"] = prefix
+    _log(f"shared-prefix x{args.prefix_shared_frac}: mean TTFT "
+         f"{prefix['mean_ttft_prefix_off_s']}s off -> "
+         f"{prefix['mean_ttft_prefix_on_s']}s on "
+         f"({prefix['ttft_reduction_x']}x, hit rate "
+         f"{prefix['prefix_hit_rate']}, saved "
+         f"{prefix['prefill_tokens_saved']} prefill tokens)")
+
+    for frac in (() if args.skip_poisson else (0.3, 0.6, 1.2)):
         res = _poisson_load(
             model, variables, offered_rps=frac * cap_rps,
             n_requests=args.poisson_requests,

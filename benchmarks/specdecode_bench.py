@@ -39,6 +39,7 @@ import numpy as np
 from pddl_tpu.models.gpt import generate
 from pddl_tpu.models.llama import Llama_Small
 from pddl_tpu.models.speculative import generate_speculative
+from pddl_tpu.utils.bench_artifact import provenance, timed_stats
 
 
 def _log(msg: str) -> None:
@@ -74,19 +75,12 @@ def _train_on_pycorpus(model, steps: int, seq_len: int, batch: int,
     return params, val_tokens, float(hist.history["loss"][-1])
 
 
-def _timed(fn, sync, iters: int = 5) -> float:
-    best = float("inf")
-    for _ in range(iters):
-        t0 = time.perf_counter()
-        sync(fn())
-        best = min(best, time.perf_counter() - t0)
-    return best
-
-
 def _bench_pair(model, variables, prompt, new_tokens: int,
                 draft_len: int, ngram: int, temperature: float = 0.0,
-                top_k=None):
-    """(plain tok/s, spec tok/s, stats) on one prompt batch.
+                top_k=None, n_repeats: int = 3):
+    """(plain tok/s, spec tok/s, stats, spreads) on one prompt batch —
+    timing is median-over-``n_repeats`` with spread recorded
+    (`pddl_tpu/utils/bench_artifact.py` discipline).
 
     Greedy: asserts speculative output == greedy output before timing.
     Sampling (temperature > 0): outputs are draws, not unique strings —
@@ -121,16 +115,18 @@ def _bench_pair(model, variables, prompt, new_tokens: int,
 
     b = prompt.shape[0]
     sync = lambda x: int((x[0] if isinstance(x, tuple) else x)[0, -1])
-    t_plain = _timed(
+    s_plain = timed_stats(
         lambda: generate(model, variables, prompt, max_new_tokens=new_tokens,
                          **sample_kw),
-        sync)
-    t_spec = _timed(
+        sync, n_repeats=n_repeats)
+    s_spec = timed_stats(
         lambda: generate_speculative(model, variables, prompt, new_tokens,
                                      draft_len=draft_len, ngram=ngram,
                                      **sample_kw),
-        sync)
-    return b * new_tokens / t_plain, b * new_tokens / t_spec, stats
+        sync, n_repeats=n_repeats)
+    spreads = {"plain": s_plain["spread_pct"], "spec": s_spec["spread_pct"]}
+    return (b * new_tokens / s_plain["median_s"],
+            b * new_tokens / s_spec["median_s"], stats, spreads)
 
 
 def main() -> None:
@@ -161,6 +157,16 @@ def main() -> None:
                         "temperature alone every token is in support "
                         "and the check is vacuous. 0 disables (and "
                         "downgrades the exactness claim accordingly)")
+    p.add_argument("--batches", default="1",
+                   help="comma-joined batch sizes, e.g. 1,4,8. B>1 "
+                        "quantifies the min-over-batch acceptance cost "
+                        "(the KV caches share one scalar index, so each "
+                        "tick emits the batch's WORST row's acceptance "
+                        "— see speculative.py; the suite pins the "
+                        "behavior in tests/test_speculative.py)")
+    p.add_argument("--repeats", type=int, default=3,
+                   help="timed repetitions per series (>= 3; median is "
+                        "the headline, spread the drift detector)")
     p.add_argument("--family", default="llama_small",
                    choices=("llama_small", "llama_1b"),
                    help="llama_1b: the 1B-on-one-chip serving story -- "
@@ -192,13 +198,23 @@ def main() -> None:
         args.work_dir, param_update)
     variables = {"params": params}
 
-    # Real-text prompt: a held-out Python source window. Random prompt:
+    # Real-text prompts: held-out Python source windows at spread-out
+    # offsets (B>1 rows are DISTINCT windows — realistic mixed traffic,
+    # each row drafting off its own self-similarity). Random prompts:
     # uniform bytes — the lookup's adversarial case.
-    start = len(val_tokens) // 3
-    text_prompt = jnp.asarray(
-        val_tokens[start:start + args.prompt_len], jnp.int32)[None, :]
-    rand_prompt = jax.random.randint(
-        jax.random.key(7), (1, args.prompt_len), 0, 256, dtype=jnp.int32)
+    batches = [int(b) for b in args.batches.split(",")]
+
+    def text_prompt(b):
+        starts = [len(val_tokens) // 3 + i * (args.prompt_len + 37)
+                  for i in range(b)]
+        return jnp.stack([jnp.asarray(
+            val_tokens[s:s + args.prompt_len], jnp.int32)
+            for s in starts])
+
+    def rand_prompt(b):
+        return jax.random.randint(
+            jax.random.key(7), (b, args.prompt_len), 0, 256,
+            dtype=jnp.int32)
 
     record = {
         "metric": "speculative_decode_new_tokens_per_sec",
@@ -225,22 +241,37 @@ def main() -> None:
                 "filter (top_k=0) — vacuous at these settings, speed "
                 "numbers only"),
         },
+        "provenance": provenance(args.repeats),
         "results": {},
         "device": jax.devices()[0].device_kind,
     }
-    for kind, prompt in (("pycorpus", text_prompt), ("random", rand_prompt)):
-        plain, spec, stats = _bench_pair(
-            model, variables, prompt, args.new_tokens,
-            args.draft_len, args.ngram, args.temperature,
-            top_k=(args.top_k or None) if args.temperature > 0 else None)
-        record["results"][f"{kind}_plain_b1"] = round(plain, 1)
-        record["results"][f"{kind}_speculative_b1"] = round(spec, 1)
-        record["results"][f"{kind}_speedup"] = round(spec / plain, 3)
-        record["results"][f"{kind}_tokens_per_tick"] = round(
-            stats["tokens_per_tick"], 3)
-        _log(f"{kind}: plain {plain:,.0f} tok/s, speculative {spec:,.0f} "
-             f"tok/s ({spec / plain:.2f}x, {stats['tokens_per_tick']:.2f} "
-             "tokens/tick)")
+    record["config"]["batches"] = batches
+    for b in batches:
+        for kind, prompt in (("pycorpus", text_prompt(b)),
+                             ("random", rand_prompt(b))):
+            plain, spec, stats, spreads = _bench_pair(
+                model, variables, prompt, args.new_tokens,
+                args.draft_len, args.ngram, args.temperature,
+                top_k=(args.top_k or None) if args.temperature > 0
+                else None, n_repeats=args.repeats)
+            # B1 keeps the legacy key names so artifact consumers (and
+            # round-over-round diffs) stay comparable.
+            suffix = f"b{b}" if b > 1 else "b1"
+            key = (f"{kind}_speedup" if b == 1
+                   else f"{kind}_speedup_{suffix}")
+            record["results"][f"{kind}_plain_{suffix}"] = round(plain, 1)
+            record["results"][f"{kind}_speculative_{suffix}"] = round(
+                spec, 1)
+            record["results"][key] = round(spec / plain, 3)
+            record["results"][f"{kind}_tokens_per_tick"
+                              + ("" if b == 1 else f"_{suffix}")] = round(
+                stats["tokens_per_tick"], 3)
+            record["results"][f"{kind}_{suffix}_spread_pct"] = round(
+                max(spreads.values()), 2)
+            _log(f"{kind} B{b}: plain {plain:,.0f} tok/s, speculative "
+                 f"{spec:,.0f} tok/s ({spec / plain:.2f}x, "
+                 f"{stats['tokens_per_tick']:.2f} tokens/tick, spread "
+                 f"{max(spreads.values()):.1f}%)")
 
     if args.int8:
         from pddl_tpu.ops.quant import (dequantize, quantize_int8,
@@ -280,24 +311,27 @@ def main() -> None:
         # own greedy decode (int8 changes the weights, so the oracle is
         # int8 plain generate, not the bf16 series above).
         qvars = {"params": qparams}
-        ref8 = generate(model, qvars, text_prompt,
+        prompt8 = text_prompt(1)
+        ref8 = generate(model, qvars, prompt8,
                         max_new_tokens=args.new_tokens,
                         param_transform=dequantize)
         out8, stats8 = generate_speculative(
-            model, qvars, text_prompt, args.new_tokens,
+            model, qvars, prompt8, args.new_tokens,
             draft_len=args.draft_len, ngram=args.ngram,
             return_stats=True, param_transform=dequantize)
         np.testing.assert_array_equal(np.asarray(out8), np.asarray(ref8))
         sync = lambda x: int((x[0] if isinstance(x, tuple) else x)[0, -1])
-        t_plain8 = _timed(
-            lambda: generate(model, qvars, text_prompt,
+        t_plain8 = timed_stats(
+            lambda: generate(model, qvars, prompt8,
                              max_new_tokens=args.new_tokens,
-                             param_transform=dequantize), sync)
-        t_spec8 = _timed(
+                             param_transform=dequantize), sync,
+            n_repeats=args.repeats)["median_s"]
+        t_spec8 = timed_stats(
             lambda: generate_speculative(
-                model, qvars, text_prompt, args.new_tokens,
+                model, qvars, prompt8, args.new_tokens,
                 draft_len=args.draft_len, ngram=args.ngram,
-                param_transform=dequantize), sync)
+                param_transform=dequantize), sync,
+            n_repeats=args.repeats)["median_s"]
         record["results"]["int8_val_loss_nats"] = round(loss_int8, 5)
         record["results"]["bf16_val_loss_nats"] = round(loss_bf16, 5)
         record["results"]["int8_val_loss_delta_pct"] = round(

@@ -572,6 +572,39 @@ def prefill_row(dec, params, prompt, length, *, param_transform=None):
     return mutated["cache"], last
 
 
+def prefill_row_from(dec, params, prompt, length, row_cache, start, *,
+                     param_transform=None):
+    """Chunked prefill CONTINUING an existing batch-1 row cache: the
+    prefix-cache admission building block (family-generic like
+    :func:`prefill_row` — GPT's decode embed and the Llama/vit decode
+    attention both run multi-token blocks at any starting index).
+
+    ``row_cache`` already holds ``start`` valid tokens of K/V (e.g. a
+    gathered shared-prefix chain); ``prompt`` is int32 ``[1, C]``
+    RIGHT-padded, ``length <= C`` its true token count, both traced —
+    one compiled program per chunk width. The chunk's tokens take global
+    positions ``start .. start+C-1``, so the caller must keep
+    ``start + C <= dec.max_len`` (the embed/cache dynamic slices CLAMP
+    out-of-range starts, which would silently mis-position the block).
+    Padding junk is harmless by the :func:`prefill_row` invariant:
+    causal masking hides it from positions ``< start + length``, its K/V
+    lands beyond the position counter the caller stamps at insert, and
+    decode overwrites it before the counter crosses.
+
+    Returns ``(row_cache, last_logits [1, V])`` with the logits row
+    taken at ``length - 1`` (only the FINAL chunk's logits are
+    meaningful to sample from).
+    """
+    pt = param_transform or (lambda p: p)
+    cache = set_cache_positions(row_cache, jnp.asarray(start, jnp.int32))
+    logits, mutated = dec.apply(
+        {"params": pt(params), "cache": cache}, prompt,
+        train=False, mutable=["cache"])
+    last = jax.lax.dynamic_slice(
+        logits, (0, length - 1, 0), (1, 1, logits.shape[-1]))[:, 0]
+    return mutated["cache"], last
+
+
 @functools.lru_cache(maxsize=16)
 def _decode_cache_shapes(dec, batch: int):
     """KV-cache ShapeDtypeStructs for a decode module at a batch size.

@@ -194,6 +194,18 @@ class LlamaAttention(nn.Module):
           index) runs the flash kernel on the block itself merged with a
           pre-write history sweep in logsumexp space — O(block) score
           memory, never ``[B,H,S,max_len]`` f32.
+
+        Cache-content contract the serving layer builds on: the cache
+        stores POST-RoPE keys rotated at their ABSOLUTE positions, so an
+        entry depends only on (prompt tokens, position, params) — never
+        on which request computed it. This is what makes the prefix
+        cache's shared KV blocks (`pddl_tpu/serve/kvcache/`) bit-valid
+        across requests, and what `gpt.prefill_row_from` relies on when
+        it continues a row cache assembled from gathered blocks: a
+        suffix chunk at starting index ``i`` reproduces exactly the K/V
+        a full prefill would have written there. (The caller keeps
+        ``i + s <= max_decode_len`` — the cache write's dynamic slice
+        CLAMPS out-of-range starts rather than failing.)
         """
         hkv = self.num_kv_heads
         ring = self._ring_len()

@@ -953,6 +953,65 @@ def cache_slot_reset(pool: jnp.ndarray, slot) -> jnp.ndarray:
         pool, jnp.zeros((1,) + pool.shape[1:], pool.dtype), slot)
 
 
+def cache_blocks_gather(pool: jnp.ndarray, block_ids) -> jnp.ndarray:
+    """Gather KV blocks ``block_ids [M]`` from a block-pool leaf
+    ``[N, ..., block_size, D]`` into one contiguous batch-1 cache prefix
+    ``[1, ..., M*block_size, D]`` (block ``j``'s tokens land at positions
+    ``[j*block_size, (j+1)*block_size)``).
+
+    The prefix-cache twin of :func:`cache_slot_insert`: ``block_ids`` is
+    a runtime int32 vector of FIXED length, so one compiled program
+    serves every hit depth — callers pad short chains with the reserved
+    scratch block (id 0), whose junk lands at positions the suffix
+    prefill overwrites or the slot's position counter parks. The gather
+    COPIES: a admitted request's slot never aliases pool storage, which
+    is what makes pool eviction safe while the request decodes
+    (copy-on-write by construction).
+    """
+    block_ids = jnp.asarray(block_ids, jnp.int32)
+    if block_ids.ndim != 1:
+        raise ValueError(f"block_ids must be [M], got {block_ids.shape}")
+    if pool.ndim < 3:
+        raise ValueError(
+            f"pool leaf must be [N, ..., block_size, D], got {pool.shape}")
+    m = block_ids.shape[0]
+    bs, d = pool.shape[-2], pool.shape[-1]
+    g = jnp.take(pool, block_ids, axis=0)      # [M, ..., bs, D]
+    g = jnp.moveaxis(g, 0, -3)                 # [..., M, bs, D]
+    return g.reshape(g.shape[:-3] + (m * bs, d))[None]
+
+
+def cache_blocks_scatter(pool: jnp.ndarray, row: jnp.ndarray, block_ids,
+                         start_block) -> jnp.ndarray:
+    """Write a batch-1 cache row's tokens
+    ``[start_block*block_size, (start_block+M)*block_size)`` into pool
+    blocks ``block_ids [M]`` of a ``[N, ..., block_size, D]`` leaf — the
+    donation half of the prefix cache (a finished prefill's prompt K/V
+    becomes shared, immutable pool blocks).
+
+    ``start_block`` is a traced int32 block index; ``block_ids`` is a
+    fixed-length runtime vector (pad with the scratch block 0 — its
+    content is junk by contract and never reachable through the radix
+    index). Out-of-range source positions are clamped per token rather
+    than shifting the whole slice, so padded tail blocks read junk
+    without corrupting the real blocks' mapping.
+    """
+    block_ids = jnp.asarray(block_ids, jnp.int32)
+    if block_ids.ndim != 1:
+        raise ValueError(f"block_ids must be [M], got {block_ids.shape}")
+    if row.shape[0] != 1 or row.ndim != pool.ndim:
+        raise ValueError(
+            f"row {row.shape} is not a batch-1 cache leaf matching pool "
+            f"{pool.shape}")
+    m = block_ids.shape[0]
+    bs, d = pool.shape[-2], pool.shape[-1]
+    pos = jnp.asarray(start_block, jnp.int32) * bs + jnp.arange(m * bs)
+    window = jnp.take(row[0], jnp.minimum(pos, row.shape[-2] - 1), axis=-2)
+    blocks = window.reshape(window.shape[:-2] + (m, bs, d))
+    blocks = jnp.moveaxis(blocks, -3, 0)       # [M, ..., bs, D]
+    return pool.at[block_ids].set(blocks.astype(pool.dtype))
+
+
 def decode_attention(
     q: jnp.ndarray, k_cache: jnp.ndarray, v_cache: jnp.ndarray,
     index, *, window: Optional[int] = None, rolling: bool = False,

@@ -3,10 +3,12 @@
 `engine.py` is the step loop (slot pool, fused per-slot decode tick),
 `scheduler.py` the admission policy (FCFS + load shedding + prefill
 budget), `request.py` the per-request lifecycle, `metrics.py` the
-telemetry. See `docs/SERVING.md` § "Online serving".
+telemetry, `kvcache/` the prefix-aware KV reuse layer (radix index +
+device block pool). See `docs/SERVING.md` § "Online serving".
 """
 
 from pddl_tpu.serve.engine import ServeEngine
+from pddl_tpu.serve.kvcache import RadixPrefixCache
 from pddl_tpu.serve.metrics import ServeMetrics
 from pddl_tpu.serve.request import (
     FinishReason,
@@ -22,6 +24,7 @@ __all__ = [
     "FCFSScheduler",
     "FinishReason",
     "QueueFull",
+    "RadixPrefixCache",
     "Request",
     "RequestHandle",
     "RequestState",

@@ -37,9 +37,28 @@ Dead slots tick too (fixed shapes — their writes land at parked
 position 0 and are overwritten by the next admit); the cost is one
 batch row of compute, which is what buys zero recompiles.
 
+Prefix-aware KV reuse (`pddl_tpu/serve/kvcache/`): production traffic
+is dominated by shared prompt prefixes (system prompts, few-shot
+templates — the vLLM/SGLang observation), so admission consults a
+host-side radix index over token ids (`kvcache/radix.py`) backed by a
+device-resident pool of fixed-size KV token blocks
+(`kvcache/block_pool.py`). On a hit, the matched chain's blocks are
+GATHERED (copied) into the request's fresh row cache and only the
+uncached SUFFIX is prefilled — in fixed-width chunks, so compute and
+the admission budget both scale with the suffix, not the prompt. After
+prefill, the prompt's uncovered full blocks are DONATED (copied) back
+into the pool under refcounts; both directions copy, so a concurrent
+hit never aliases a live slot and LRU eviction never reaches under a
+decoding request. Token-exactness is structural: both families' caches
+are position-absolute (GPT adds position embeddings before the blocks;
+Llama caches post-RoPE keys), so a shared-prefix block is bit-valid
+for every request with those prompt tokens.
+
 int8 serving composes exactly like ``generate()``: pass
 ``param_transform=pddl_tpu.ops.quant.dequantize`` and the int8 tensors
-are what lives in HBM, dequantized inside the compiled programs.
+are what lives in HBM, dequantized inside the compiled programs (the
+prefix-cache programs included — what the pool stores is K/V, which
+int8 weight storage never touches).
 
 Ring-cache (rolling SWA) models are refused for now: slot reuse over a
 ring whose slots already wrapped needs per-slot wrap bookkeeping this
@@ -53,15 +72,23 @@ import time
 from typing import Dict, List, Optional, Sequence
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from pddl_tpu.models.gpt import (
     _decode_cache_shapes,
     insert_cache_slot,
     prefill_row,
+    prefill_row_from,
     sample_logits_batched,
     set_cache_positions,
     slot_decode_cache,
+)
+from pddl_tpu.serve.kvcache import (
+    RadixPrefixCache,
+    donate_prefix_blocks,
+    gather_prefix_into_row,
+    kv_block_pool,
 )
 from pddl_tpu.serve.metrics import ServeMetrics
 from pddl_tpu.serve.request import (
@@ -98,6 +125,20 @@ class ServeEngine:
         even for an all-greedy workload).
       clock: injectable monotonic clock (tests drive deadlines with a
         fake one).
+      prefix_cache_blocks: KV block-pool size (block 0 is a reserved
+        scratch sink). ``None`` (default) auto-sizes to hold about two
+        full prompts per slot; ``0`` disables prefix caching entirely
+        (the original four-program engine). Requires
+        ``prefill_len + prefix_chunk <= max_len`` (chunk positions must
+        never clamp) and a usable block (``prefix_block_size <
+        prefill_len``) — violations raise rather than silently degrade.
+      prefix_block_size: tokens per shared KV block — the reuse (and
+        radix-tree) granularity. Smaller blocks match more of a prefix
+        but cost more pool rows per prompt.
+      prefix_chunk: suffix-prefill chunk width (one compiled program;
+        admission prefills ``ceil(suffix/chunk)`` chunks, so prefill
+        work scales with the UNCACHED suffix). Default
+        ``max(prefix_block_size, prefill_len // 4)``.
     """
 
     def __init__(self, model, variables, *, max_slots: int = 8,
@@ -106,7 +147,10 @@ class ServeEngine:
                  prefill_token_budget: Optional[int] = None,
                  eos_token: Optional[int] = None,
                  param_transform=None, rng=None,
-                 clock=time.monotonic):
+                 clock=time.monotonic,
+                 prefix_cache_blocks: Optional[int] = None,
+                 prefix_block_size: int = 8,
+                 prefix_chunk: Optional[int] = None):
         if max_slots < 1:
             raise ValueError(f"max_slots must be >= 1, got {max_slots}")
         if getattr(model, "uses_ring_cache", False):
@@ -132,11 +176,54 @@ class ServeEngine:
             prefill_token_budget=prefill_token_budget)
         self.metrics = ServeMetrics()
 
+        # Prefix-cache configuration (static — the compiled programs'
+        # shapes derive from these).
+        bs = int(prefix_block_size)
+        if bs < 1:
+            raise ValueError(
+                f"prefix_block_size must be >= 1, got {bs}")
+        # A prefix hit must leave >= 1 suffix token to produce the
+        # sampled-from logits, so the longest matchable chain is
+        # (prefill_len - 1) tokens, floor-blocked.
+        self._match_cap = (self.prefill_len - 1) // bs
+        self._donate_cap = self.prefill_len // bs
+        chunk = (int(prefix_chunk) if prefix_chunk is not None
+                 else max(bs, self.prefill_len // 4))
+        if prefix_cache_blocks is None:
+            pool_blocks = (2 * self.max_slots * max(self._donate_cap, 1)
+                           + 1) if self._match_cap >= 1 else 0
+        else:
+            pool_blocks = int(prefix_cache_blocks)
+        self._prefix_on = pool_blocks > 0
+        if self._prefix_on:
+            if self._match_cap < 1:
+                raise ValueError(
+                    f"prefix_block_size {bs} leaves no cacheable block "
+                    f"under prefill_len {self.prefill_len} (need "
+                    f"block_size < prefill_len); pass "
+                    "prefix_cache_blocks=0 to disable prefix caching")
+            if not 1 <= chunk or self.prefill_len + chunk > model.max_len:
+                raise ValueError(
+                    f"prefix_chunk {chunk} needs 1 <= chunk and "
+                    f"prefill_len + chunk <= max_len "
+                    f"({self.prefill_len} + {chunk} > {model.max_len}): "
+                    "a chunk starting at the deepest cached offset would "
+                    "clamp its positions")
+            if pool_blocks < 2:
+                raise ValueError(
+                    f"prefix_cache_blocks must be >= 2 (block 0 is the "
+                    f"reserved scratch sink), got {pool_blocks}")
+        self.prefix_block_size = bs
+        self._chunk = chunk
+
         # One handle per occupied slot; all other per-slot state lives
         # in the arrays below (positions) or is derivable from the
         # handle (tokens emitted = len(handle.tokens)) — no duplicated
         # bookkeeping to keep in lockstep.
         self._slots: List[Optional[RequestHandle]] = [None] * self.max_slots
+        # The radix node each occupied slot pinned at admission
+        # (refcount released at evict).
+        self._slot_nodes: List[Optional[object]] = [None] * self.max_slots
         # Engine-owned per-slot state, stamped into the programs each
         # tick (positions are authoritative HERE, not in the cache —
         # the tick program overwrites the cache's counters on entry).
@@ -151,6 +238,34 @@ class ServeEngine:
         def _prefill(params, prompt, length):
             return prefill_row(dec, params, prompt, length,
                                param_transform=pt)
+
+        def _gather(pool, block_ids, row):
+            # Overwrite the RESIDENT row cache's prefix region
+            # [0, match_cap*bs) with the matched chain (row donated —
+            # the admission pipeline reuses one set of row buffers).
+            # Everything beyond is stale: scratch-padded gather junk,
+            # or the previous admission's K/V — all of it either
+            # overwritten by the suffix chunks or parked beyond the
+            # position counter the slot insert stamps, exactly the
+            # invariant the padded one-shot prefill already relies on.
+            return gather_prefix_into_row(pool, row, block_ids)
+
+        def _chunk_prefill(params, row, tokens, length, start):
+            # One fixed-width suffix chunk continuing the row cache at
+            # global offset `start` (all of length/start runtime values).
+            return prefill_row_from(dec, params, tokens, length, row,
+                                    start, param_transform=pt)
+
+        def _chunk_prefill_wide(params, row, tokens, length, start):
+            # The same computation at the wide width — a DISTINCT
+            # function object, so its jit cache (and compile_counts
+            # entry) never shares entries with the narrow program's
+            # (same reason _insert is a per-engine closure).
+            return prefill_row_from(dec, params, tokens, length, row,
+                                    start, param_transform=pt)
+
+        def _donate(pool, row, block_ids, start_block):
+            return donate_prefix_blocks(pool, row, block_ids, start_block)
 
         def _tick(params, cache, positions, tokens, temps, top_ks, top_ps,
                   rng):
@@ -178,14 +293,54 @@ class ServeEngine:
             # report other instances' pool shapes.
             return insert_cache_slot(cache, row_cache, slot, position)
 
-        # The four resident programs. The pooled cache is donated
-        # through insert and tick — the engine always adopts the
-        # returned tree, so the resident HBM buffers are reused in
-        # place and a stale reference can never be used by mistake.
-        self._prefill_p = jax.jit(_prefill)
+        # The resident programs (four without prefix caching; gather /
+        # chunk-prefill / donate replace the one-shot prefill with it
+        # on). Donation discipline: the pooled slot cache is donated
+        # through insert and tick, the row cache through each suffix
+        # chunk, and the block pool through donate — the engine always
+        # adopts the returned trees, so the resident HBM buffers are
+        # reused in place and a stale reference can never be used by
+        # mistake.
         self._insert_p = jax.jit(_insert, donate_argnums=(0,))
         self._tick_p = jax.jit(_tick, donate_argnums=(1,))
         self._sample_first_p = jax.jit(_sample_first)
+        if self._prefix_on:
+            self._prefill_p = None
+            self._gather_p = jax.jit(_gather, donate_argnums=(2,))
+            self._chunk_p = jax.jit(_chunk_prefill, donate_argnums=(1,))
+            # A second, WIDE chunk program (full prefill_len) for cold /
+            # barely-cached prompts: one fixed per-apply cost instead of
+            # ceil(plen/chunk) of them, so enabling the prefix cache
+            # never slows a cold admission below the one-shot prefill.
+            # Two separate jits (not two shapes through one jit) keep
+            # the one-executable-per-program pin meaningful. The wide
+            # program can start as deep as prefill_len/4 (the width
+            # policy's threshold), so it also needs its positions to
+            # stay in range at that offset.
+            self._has_wide = (
+                self._chunk < self.prefill_len
+                and self.prefill_len + self.prefill_len // 4
+                <= model.max_len)
+            self._chunk_wide_p = (jax.jit(_chunk_prefill_wide,
+                                          donate_argnums=(1,))
+                                  if self._has_wide else None)
+            self._donate_p = jax.jit(_donate, donate_argnums=(0,))
+            self._pool = kv_block_pool(dec, pool_blocks, bs)
+            self._prefix = RadixPrefixCache(bs, pool_blocks)
+            # The resident admission row cache: donated through gather
+            # and every chunk, adopted back each time — one set of
+            # batch-1 buffers serves every admission.
+            self._row = jax.tree.map(
+                lambda sd: jnp.zeros(sd.shape, sd.dtype),
+                _decode_cache_shapes(dec, 1))
+        else:
+            self._prefill_p = jax.jit(_prefill)
+            self._gather_p = self._chunk_p = self._donate_p = None
+            self._chunk_wide_p = None
+            self._has_wide = False
+            self._pool = None
+            self._prefix = None
+            self._row = None
 
         self._cache = slot_decode_cache(dec, self.max_slots)
         self._warm = False
@@ -227,14 +382,33 @@ class ServeEngine:
 
     # ---------------------------------------------------------- plumbing
     def warmup(self) -> None:
-        """Trace/compile all four programs before traffic (one dummy
-        admission into slot 0 + one all-dead tick; the junk K/V lands at
-        parked positions and is overwritten by the first real admit).
-        Implicit on the first ``step()`` if not called."""
+        """Trace/compile every resident program before traffic (one
+        dummy admission into slot 0 + one all-dead tick; the junk K/V
+        lands at parked positions and is overwritten by the first real
+        admit — the dummy gather/donate use only the scratch block, so
+        the radix index stays empty). Implicit on the first ``step()``
+        if not called."""
         if self._warm:
             return
-        dummy = np.zeros((1, self.prefill_len), np.int32)
-        row, logits = self._prefill_p(self._params, dummy, 1)
+        if self._prefix_on:
+            row = self._gather_p(
+                self._pool, np.zeros(self._match_cap, np.int32),
+                self._row)
+            row, logits = self._chunk_p(
+                self._params, row, np.zeros((1, self._chunk), np.int32),
+                np.int32(1), np.int32(0))
+            if self._has_wide:
+                row, logits = self._chunk_wide_p(
+                    self._params, row,
+                    np.zeros((1, self.prefill_len), np.int32),
+                    np.int32(1), np.int32(0))
+            self._pool = self._donate_p(
+                self._pool, row, np.zeros(self._donate_cap, np.int32),
+                np.int32(0))
+            self._row = row
+        else:
+            dummy = np.zeros((1, self.prefill_len), np.int32)
+            row, logits = self._prefill_p(self._params, dummy, 1)
         self._cache = self._insert_p(self._cache, row, 0, 0)
         tok, self._rng = self._sample_first_p(
             logits, np.float32(0.0), np.int32(0), np.float32(2.0),
@@ -247,13 +421,30 @@ class ServeEngine:
 
     def compile_counts(self) -> Dict[str, int]:
         """Compiled-executable count per resident program (the
-        zero-recompiles-after-warmup contract: all four stay at 1)."""
-        return {
-            "prefill": self._prefill_p._cache_size(),
+        zero-recompiles-after-warmup contract: every entry stays at 1).
+        With prefix caching on, admission runs gather → N×chunk-prefill
+        → donate instead of the one-shot prefill — chunk width, block-id
+        vector lengths, and every offset/length are fixed shapes or
+        runtime values, so the program set stays closed here too."""
+        counts = {
             "insert": self._insert_p._cache_size(),
             "tick": self._tick_p._cache_size(),
             "sample_first": self._sample_first_p._cache_size(),
         }
+        if self._prefix_on:
+            counts["gather"] = self._gather_p._cache_size()
+            counts["chunk_prefill"] = self._chunk_p._cache_size()
+            if self._has_wide:
+                counts["chunk_prefill_wide"] = \
+                    self._chunk_wide_p._cache_size()
+            counts["donate"] = self._donate_p._cache_size()
+        else:
+            counts["prefill"] = self._prefill_p._cache_size()
+        return counts
+
+    @property
+    def prefix_cache_enabled(self) -> bool:
+        return self._prefix_on
 
     @property
     def live_slots(self) -> int:
@@ -275,6 +466,12 @@ class ServeEngine:
         handle.finish_s = self._clock()
         self.metrics.record_finish(reason.value)
         self._slots[slot_id] = None
+        if self._slot_nodes[slot_id] is not None:
+            # Release the request's pin on its prefix chain: the blocks
+            # stay cached (that's the point) but become LRU-evictable
+            # once no live slot or deeper chain needs them.
+            self._prefix.unpin(self._slot_nodes[slot_id])
+            self._slot_nodes[slot_id] = None
         # Park the dead row: position 0, greedy params. Its future junk
         # writes land at position 0 and the next admit overwrites the
         # whole cache row anyway.
@@ -301,6 +498,98 @@ class ServeEngine:
                 self._evict(sid, RequestState.TIMED_OUT,
                             FinishReason.TIMED_OUT)
 
+    def _match_blocks(self, prompt) -> int:
+        """Cap on the matchable chain for one prompt (blocks): leave at
+        least one suffix token, never exceed the gather vector."""
+        return min(self._match_cap, (len(prompt) - 1) // self.prefix_block_size)
+
+    def _prefill_cost(self, handle) -> int:
+        """Admission-budget charge: the UNCACHED suffix length (a cached
+        prefix costs no prefill work). A pop-time estimate — the match
+        also refreshes the chain's LRU stamp, so a same-tick eviction
+        stealing it needs a fully-pinned pool; if that happens the
+        request simply re-prefills more than charged (see
+        ``FCFSScheduler.admit``)."""
+        prompt = handle.request.prompt
+        match = self._prefix.match(prompt,
+                                   max_blocks=self._match_blocks(prompt))
+        return len(prompt) - match.n_blocks * self.prefix_block_size
+
+    def _prefill_into_row(self, prompt: np.ndarray):
+        """Prefill one prompt into a row cache, reusing any cached
+        prefix: gather the matched chain into the resident row buffers,
+        chunk-prefill the suffix, donate the prompt's uncovered full
+        blocks, pin the chain.
+        Returns ``(row_cache, last_logits, pinned_node_or_None)``."""
+        plen = prompt.size
+        bs = self.prefix_block_size
+        if not self._prefix_on:
+            padded = np.zeros((1, self.prefill_len), np.int32)
+            padded[0, :plen] = prompt
+            row, logits = self._prefill_p(self._params, padded, plen)
+            return row, logits, None
+        match = self._prefix.match(prompt,
+                                   max_blocks=self._match_blocks(prompt))
+        n_cached = match.n_blocks * bs
+        if match.n_blocks > 0:
+            ids = np.zeros(self._match_cap, np.int32)  # scratch-padded
+            ids[:match.n_blocks] = match.block_ids
+            row = self._gather_p(self._pool, ids, self._row)
+        else:
+            # Full miss: no gather dispatch — the chunks overwrite
+            # [0, plen) of the resident row and everything beyond parks
+            # past the position counter the insert stamps.
+            row = self._row
+        # Fixed-width chunks over the suffix — every (tokens, length,
+        # start) is a runtime value, so the program set stays closed.
+        # Width policy (coarse cost model — each apply pays a fixed
+        # dispatch/tick cost plus per-token compute): a long remainder
+        # (>= 3/4 of the wide width) takes the WIDE program in one
+        # apply, so a cold prompt costs what the one-shot prefill did;
+        # short suffixes — the prefix-hit case — take narrow chunks and
+        # pay only for the uncached tail.
+        off, logits = n_cached, None
+        while off < plen:
+            rem = plen - off
+            if self._has_wide and 4 * rem >= 3 * self.prefill_len:
+                width, prog = self.prefill_len, self._chunk_wide_p
+            else:
+                width, prog = self._chunk, self._chunk_p
+            w = min(width, rem)
+            chunk_toks = np.zeros((1, width), np.int32)
+            chunk_toks[0, :w] = prompt[off:off + w]
+            row, logits = prog(self._params, row, chunk_toks,
+                               np.int32(w), np.int32(off))
+            off += w
+        # Donate the prompt's uncovered FULL blocks. Pin the matched
+        # chain first so this admission's own eviction pass (inside
+        # allocate) can never free the blocks just gathered from.
+        node = match.node
+        self._prefix.pin(node)
+        want = plen // bs - match.n_blocks
+        if want > 0:
+            new_ids = self._prefix.allocate(min(want, self._donate_cap))
+            if new_ids:
+                tip = self._prefix.extend(
+                    node,
+                    prompt[n_cached:n_cached + len(new_ids) * bs],
+                    new_ids)
+                dids = np.zeros(self._donate_cap, np.int32)
+                dids[:len(new_ids)] = new_ids
+                self._pool = self._donate_p(self._pool, row, dids,
+                                            np.int32(match.n_blocks))
+                self._prefix.unpin(node)
+                self._prefix.pin(tip)
+                node = tip
+        self.metrics.record_prefix_lookup(
+            n_cached, blocks_live=self._prefix.blocks_live,
+            evictions=self._prefix.evictions)
+        # Adopt the row buffers for the next admission (the slot insert
+        # COPIES the row, so reuse is safe and saves a fresh full-length
+        # cache allocation per admission).
+        self._row = row
+        return row, logits, node
+
     def _admit(self) -> None:
         free = self._free_slot_ids()
         if not free:
@@ -310,8 +599,13 @@ class ServeEngine:
             handle.finish_s = self._clock()
             self.metrics.record_finish(FinishReason.CANCELLED.value)
 
-        for handle in self.scheduler.admit(len(free),
-                                           on_cancelled=_queued_cancel):
+        # The suffix-priced cost_fn walks the radix tree per pop; only
+        # pay that when a budget actually consumes the result.
+        use_cost = (self._prefix_on
+                    and self.scheduler.prefill_token_budget is not None)
+        for handle in self.scheduler.admit(
+                len(free), on_cancelled=_queued_cancel,
+                cost_fn=self._prefill_cost if use_cost else None):
             if self._expired(handle, self._clock()):
                 # Died in the queue: never pay its prefill (the most
                 # expensive dispatch) nor emit a post-deadline token —
@@ -326,9 +620,9 @@ class ServeEngine:
             sid = free.pop(0)
             req = handle.request
             plen = len(req.prompt)
-            padded = np.zeros((1, self.prefill_len), np.int32)
-            padded[0, :plen] = req.prompt
-            row, logits = self._prefill_p(self._params, padded, plen)
+            row, logits, node = self._prefill_into_row(
+                np.asarray(req.prompt, np.int32))
+            self._slot_nodes[sid] = node
             self._cache = self._insert_p(self._cache, row, sid, plen)
             t, k, p = req.sampling.as_arrays()
             tok, self._rng = self._sample_first_p(

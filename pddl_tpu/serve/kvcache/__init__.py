@@ -1,0 +1,26 @@
+"""Prefix-aware KV reuse under the serving engine.
+
+`block_pool.py` is the DEVICE half: a resident pool of fixed-size
+token blocks per K/V cache leaf plus the tree-level gather (pool →
+slot prefix) and donate (slot prompt → pool blocks) assembly over the
+single-leaf primitives in :mod:`pddl_tpu.ops.attention`.
+`radix.py` is the HOST half: a refcounted, LRU-evicted radix tree over
+token ids mapping prompt prefixes to stored block chains.
+
+See `docs/SERVING.md` § "Prefix caching" for the design and the
+engine integration (`pddl_tpu/serve/engine.py`).
+"""
+
+from pddl_tpu.serve.kvcache.block_pool import (
+    donate_prefix_blocks,
+    gather_prefix_into_row,
+    kv_block_pool,
+)
+from pddl_tpu.serve.kvcache.radix import RadixPrefixCache
+
+__all__ = [
+    "RadixPrefixCache",
+    "donate_prefix_blocks",
+    "gather_prefix_into_row",
+    "kv_block_pool",
+]

@@ -1,0 +1,201 @@
+"""Host-side radix index over prompt token ids → KV block chains.
+
+The RadixAttention idea (SGLang), at block granularity (vLLM's paged
+unit): a tree whose every node owns exactly ONE pool block — the K/V of
+``block_size`` tokens — keyed by those tokens, so a root-to-node path
+spells a prompt prefix and the path's block ids are the chain the
+engine gathers into a new request's slot. Host-side only: the tree
+holds ids and token tuples, never device arrays.
+
+Invariants (property-tested in ``tests/test_prefix_cache.py``):
+
+- **Accounting**: every non-scratch pool block is either on the free
+  list or owned by exactly one live node; ``blocks_live + blocks_free
+  == num_blocks - 1`` at all times.
+- **Refcounts**: ``pin(node)`` increments every node on the root path,
+  ``unpin`` decrements it; a request pins the deepest node it matched
+  or extended for its whole slot residency, so every ancestor of an
+  in-use chain is protected.
+- **Eviction**: only LEAF nodes with ``ref == 0`` are evictable, least
+  recently accessed first — an interior node always outlives its
+  children, so a stored chain can never lose an ancestor block while a
+  descendant (or a pinned user) remains.
+
+Single-threaded by design, like the engine that drives it: the engine
+is caller-driven (``step()``), so no locking — and because the device
+GATHER copies blocks into the slot before admission returns, eviction
+of an unpinned chain is always safe even if a past hit is still
+decoding from its private copy.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+from collections import deque
+from typing import Deque, Dict, List, Optional, Sequence, Tuple
+
+from pddl_tpu.serve.kvcache.block_pool import SCRATCH_BLOCK
+
+
+class _Node:
+    """One cached block: ``key`` is its block's token tuple, ``block_id``
+    its pool row. The root is a sentinel (no key, no block)."""
+
+    __slots__ = ("key", "block_id", "parent", "children", "ref",
+                 "last_access")
+
+    def __init__(self, key: Optional[tuple], block_id: Optional[int],
+                 parent: Optional["_Node"]):
+        self.key = key
+        self.block_id = block_id
+        self.parent = parent
+        self.children: Dict[tuple, "_Node"] = {}
+        self.ref = 0
+        self.last_access = 0
+
+
+@dataclasses.dataclass
+class PrefixMatch:
+    """Longest stored chain for a prompt: ``node`` is the deepest match
+    (the root for a full miss), ``block_ids`` its root path's blocks."""
+
+    node: _Node
+    block_ids: List[int]
+
+    @property
+    def n_blocks(self) -> int:
+        return len(self.block_ids)
+
+
+class RadixPrefixCache:
+    """Refcounted, LRU-evicted radix index over a block pool.
+
+    Args:
+      block_size: tokens per block (the pool's token granularity).
+      num_blocks: pool rows INCLUDING the reserved scratch sink (id 0),
+        so ``num_blocks - 1`` blocks are allocatable.
+    """
+
+    def __init__(self, block_size: int, num_blocks: int):
+        if block_size < 1:
+            raise ValueError(f"block_size must be >= 1, got {block_size}")
+        if num_blocks < 2:
+            raise ValueError(
+                f"num_blocks must be >= 2 (block {SCRATCH_BLOCK} is the "
+                f"scratch sink), got {num_blocks}")
+        self.block_size = block_size
+        self.num_blocks = num_blocks
+        self._free: Deque[int] = deque(range(1, num_blocks))
+        self._root = _Node(None, None, None)
+        self._clock = itertools.count(1)
+        self.evictions = 0
+
+    # ------------------------------------------------------------ stats
+    @property
+    def blocks_free(self) -> int:
+        return len(self._free)
+
+    @property
+    def blocks_live(self) -> int:
+        return (self.num_blocks - 1) - len(self._free)
+
+    # ------------------------------------------------------------ match
+    def match(self, tokens: Sequence[int],
+              max_blocks: Optional[int] = None) -> PrefixMatch:
+        """Walk the longest stored chain of full-block matches of
+        ``tokens`` (optionally capped at ``max_blocks``), refreshing the
+        chain's LRU stamps. Never pins — callers pin explicitly."""
+        now = next(self._clock)
+        node = self._root
+        ids: List[int] = []
+        limit = len(tokens) // self.block_size
+        if max_blocks is not None:
+            limit = min(limit, max_blocks)
+        for j in range(limit):
+            key = tuple(int(t) for t in
+                        tokens[j * self.block_size:(j + 1) * self.block_size])
+            child = node.children.get(key)
+            if child is None:
+                break
+            node = child
+            node.last_access = now
+            ids.append(node.block_id)
+        return PrefixMatch(node, ids)
+
+    # -------------------------------------------------------- refcounts
+    def pin(self, node: _Node) -> None:
+        """Protect ``node`` and its whole root path from eviction (one
+        live user). Pinning the root is a no-op chain of length 0."""
+        while node is not self._root:
+            node.ref += 1
+            node = node.parent
+
+    def unpin(self, node: _Node) -> None:
+        while node is not self._root:
+            if node.ref <= 0:
+                raise RuntimeError(
+                    "unpin without a matching pin (refcount underflow) — "
+                    "an engine slot released its prefix chain twice")
+            node.ref -= 1
+            node = node.parent
+
+    # ------------------------------------------------------- allocation
+    def allocate(self, n: int) -> List[int]:
+        """Up to ``n`` free block ids, LRU-evicting unpinned leaves as
+        needed. May return FEWER than asked (everything else is pinned)
+        — the caller donates a shorter chain prefix, never fails."""
+        while len(self._free) < n and self._evict_one():
+            pass
+        take = min(n, len(self._free))
+        return [self._free.popleft() for _ in range(take)]
+
+    def _evict_one(self) -> bool:
+        victim = None
+        stack = [self._root]
+        while stack:
+            node = stack.pop()
+            stack.extend(node.children.values())
+            if (node is not self._root and not node.children
+                    and node.ref == 0
+                    and (victim is None
+                         or node.last_access < victim.last_access)):
+                victim = node
+        if victim is None:
+            return False
+        del victim.parent.children[victim.key]
+        self._free.append(victim.block_id)
+        self.evictions += 1
+        return True
+
+    # --------------------------------------------------------- insertion
+    def extend(self, node: _Node, tokens: Sequence[int],
+               block_ids: Sequence[int]) -> _Node:
+        """Attach ``len(block_ids)`` new child blocks under ``node``,
+        one per consecutive ``block_size``-token chunk of ``tokens``
+        (the donated suffix blocks, in chain order). Returns the new
+        chain tip. ``tokens`` may cover more chunks than ids (a partial
+        donation when the allocator ran dry); extra chunks are simply
+        not stored."""
+        now = next(self._clock)
+        for j, bid in enumerate(block_ids):
+            if bid == SCRATCH_BLOCK:
+                raise ValueError("the scratch block cannot join the index")
+            key = tuple(int(t) for t in
+                        tokens[j * self.block_size:(j + 1) * self.block_size])
+            if len(key) != self.block_size:
+                raise ValueError(
+                    f"chunk {j} has {len(key)} tokens, need a full "
+                    f"{self.block_size}-token block")
+            if key in node.children:
+                # A concurrent admission in the same tick already stored
+                # this chunk: keep the existing node, return the id to
+                # the free list (ours was never written into the tree).
+                self._free.append(bid)
+                node = node.children[key]
+            else:
+                child = _Node(key, bid, node)
+                node.children[key] = child
+                node = child
+            node.last_access = now
+        return node

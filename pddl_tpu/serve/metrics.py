@@ -48,6 +48,15 @@ class ServeMetrics:
         self.requests_rejected = 0
         self.requests_timed_out = 0
         self.requests_cancelled = 0
+        # Prefix-cache telemetry (all zero when the cache is disabled):
+        # one lookup per admission, hits counted at block granularity —
+        # `prefill_tokens_saved` is the cached-token total the engine
+        # did NOT re-prefill, the cache's whole value in one number.
+        self.prefix_lookups = 0
+        self.prefix_hits = 0
+        self.prefill_tokens_saved = 0
+        self.prefix_evictions = 0
+        self.prefix_blocks_live = 0  # gauge, engine-stamped per admission
         self._first_activity_s: Optional[float] = None
         self._last_activity_s: Optional[float] = None
 
@@ -87,6 +96,19 @@ class ServeMetrics:
     def record_rejected(self) -> None:
         self.requests_rejected += 1
 
+    def record_prefix_lookup(self, tokens_saved: int, *, blocks_live: int,
+                             evictions: int) -> None:
+        """One admission-time prefix-cache lookup: ``tokens_saved`` is
+        the matched (not re-prefilled) token count, 0 for a miss;
+        ``blocks_live``/``evictions`` snapshot the pool state so the
+        gauges need no separate plumbing."""
+        self.prefix_lookups += 1
+        if tokens_saved > 0:
+            self.prefix_hits += 1
+            self.prefill_tokens_saved += int(tokens_saved)
+        self.prefix_blocks_live = int(blocks_live)
+        self.prefix_evictions = int(evictions)
+
     # ------------------------------------------------------ reporting
     def snapshot(self) -> Dict[str, object]:
         """The dashboard dict: counters plus latency percentiles (None
@@ -111,6 +133,13 @@ class ServeMetrics:
                                  if self.queue_depth else None),
             "mean_slot_occupancy": (float(np.mean(self.occupancy))
                                     if self.occupancy else None),
+            "prefix_lookups": self.prefix_lookups,
+            "prefix_hits": self.prefix_hits,
+            "prefix_hit_rate": (self.prefix_hits / self.prefix_lookups
+                                if self.prefix_lookups else None),
+            "prefill_tokens_saved": self.prefill_tokens_saved,
+            "prefix_blocks_live": self.prefix_blocks_live,
+            "prefix_evictions": self.prefix_evictions,
         }
 
     def summary(self) -> str:

@@ -58,11 +58,23 @@ class FCFSScheduler:
         self._queue.append(handle)
 
     def admit(self, free_slots: int,
-              on_cancelled=None) -> List[RequestHandle]:
+              on_cancelled=None, cost_fn=None) -> List[RequestHandle]:
         """Pop up to ``free_slots`` admissible handles FCFS, bounded by
         the prefill token budget; cancelled queued handles are dropped
         (marked CANCELLED) in passing — ``on_cancelled(handle)`` lets
-        the engine account them in its metrics."""
+        the engine account them in its metrics.
+
+        ``cost_fn(handle) -> int`` overrides the budget charge per
+        request (default: full prompt length). The prefix-cache engine
+        charges the UNCACHED SUFFIX length — a cached prefix costs no
+        prefill work, so it must not consume admission budget either.
+        The charge is a pop-time ESTIMATE: same-tick donations usually
+        shrink the real work below it, but under pool pressure an
+        earlier admission's eviction pass can reclaim a later request's
+        matched (not-yet-pinned) chain, in which case that request
+        re-prefills more than it was charged — a bounded latency
+        wobble, never a correctness issue (the second match at prefill
+        time is authoritative)."""
         admitted: List[RequestHandle] = []
         budget = self.prefill_token_budget
         spent = 0
@@ -75,7 +87,8 @@ class FCFSScheduler:
                 if on_cancelled is not None:
                     on_cancelled(head)
                 continue
-            cost = len(head.request.prompt)
+            cost = (cost_fn(head) if cost_fn is not None
+                    else len(head.request.prompt))
             if budget is not None and admitted and spent + cost > budget:
                 break  # FCFS: never skip the head for a cheaper request
             self._queue.popleft()

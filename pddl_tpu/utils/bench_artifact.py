@@ -1,0 +1,88 @@
+"""Bench-artifact provenance and repeat-timing discipline.
+
+Round 5's verdict found the committed serving docs and artifacts
+disagreeing (2.02x in prose vs 1.505x in the final-tree JSON; two r5
+artifacts 26% apart on an identical config) because numbers were
+measured on MIXED TREES with single-shot timings. This module is the
+fix, shared by every serving bench (`serve_bench.py`,
+`decode_bench.py`, `specdecode_bench.py`):
+
+- :func:`provenance` stamps ``{git_commit, dirty, n_repeats}`` into the
+  record, so any artifact can be traced to the exact tree it measured
+  (and a dirty tree is visible, not hidden).
+- :func:`timed_stats` runs ``n_repeats >= 3`` timed repetitions and
+  returns ``{median, spread_pct, samples}`` — the median is the
+  headline, the spread is the drift detector (a >5% spread means the
+  number is weather, not signal, and the docs must say so).
+
+Keep the repo's sync discipline: the ``sync`` callable must FETCH A
+VALUE from the result (``int(out[0, -1])``-style), because
+``block_until_ready`` is not a reliable barrier on tunneled transports
+(ARCHITECTURE.md §7e, round-5 re-measurement note).
+"""
+
+from __future__ import annotations
+
+import statistics
+import subprocess
+import time
+from typing import Callable, Dict, List
+
+
+def git_commit() -> Dict[str, object]:
+    """``{commit, dirty}`` of the working tree, or ``unknown`` outside
+    a repo — never raises (benches must run anywhere)."""
+    try:
+        commit = subprocess.run(
+            ["git", "rev-parse", "HEAD"], capture_output=True, text=True,
+            check=True, timeout=10).stdout.strip()
+        dirty = bool(subprocess.run(
+            ["git", "status", "--porcelain"], capture_output=True,
+            text=True, check=True, timeout=10).stdout.strip())
+        return {"commit": commit, "dirty": dirty}
+    except Exception:  # noqa: BLE001 - no git, not a repo, timeout: all fine
+        return {"commit": "unknown", "dirty": None}
+
+
+def provenance(n_repeats: int) -> Dict[str, object]:
+    """The artifact-level provenance block every serving bench embeds
+    as ``record["provenance"]``."""
+    g = git_commit()
+    return {
+        "git_commit": g["commit"],
+        "git_dirty": g["dirty"],
+        "n_repeats": int(n_repeats),
+        "timing": "median over n_repeats; spread_pct = "
+                  "100*(max-min)/median",
+    }
+
+
+def median_spread(samples: List[float]) -> tuple:
+    """``(median, spread_pct)`` of a sample list — ONE definition of
+    both statistics (``statistics.median``, even-length averaging), so
+    no bench can drift to a different convention. Requires >= 3
+    samples: a single sample cannot expose drift."""
+    if len(samples) < 3:
+        raise ValueError(
+            f"need >= 3 samples for a meaningful spread, got "
+            f"{len(samples)}")
+    med = statistics.median(samples)
+    return med, 100.0 * (max(samples) - min(samples)) / med
+
+
+def timed_stats(fn: Callable, sync: Callable, *,
+                n_repeats: int = 3) -> Dict[str, object]:
+    """Median/spread wall-clock of ``sync(fn())`` over ``n_repeats``
+    repetitions (>= 3 enforced via :func:`median_spread`). The caller
+    warms compilation before the first call."""
+    samples: List[float] = []
+    for _ in range(max(n_repeats, 0)):
+        t0 = time.perf_counter()
+        sync(fn())
+        samples.append(time.perf_counter() - t0)
+    med, spread = median_spread(samples)
+    return {
+        "median_s": med,
+        "spread_pct": spread,
+        "samples_s": [round(s, 6) for s in samples],
+    }

@@ -54,6 +54,39 @@ def native_build_error(tfrecord: bool = False) -> str:
         return str(e)
 
 
+@pytest.fixture()
+def pin_zero_recompiles():
+    """THE serve-layer fixed-shape contract as a reusable fixture: every
+    resident compiled program of a registered engine has exactly ONE
+    executable right after warmup AND still exactly one when the test
+    ends — whatever mixed workload ran in between compiled nothing new.
+
+    Usage::
+
+        eng = pin_zero_recompiles(ServeEngine(model, variables, ...))
+
+    The fixture warms the engine, asserts the post-warmup counts, and
+    re-asserts at teardown, so every serve-layer test that builds an
+    engine through it gets the zero-recompile pin for free
+    (`test_serve_engine.py`, `test_prefix_cache.py`).
+    """
+    engines = []
+
+    def register(engine):
+        engine.warmup()
+        counts = engine.compile_counts()
+        assert all(v == 1 for v in counts.values()), \
+            f"program(s) compiled more than once at warmup: {counts}"
+        engines.append(engine)
+        return engine
+
+    yield register
+    for engine in engines:
+        counts = engine.compile_counts()
+        assert all(v == 1 for v in counts.values()), \
+            f"workload recompiled resident program(s): {counts}"
+
+
 @pytest.fixture(scope="session")
 def eight_devices():
     devs = jax.devices()

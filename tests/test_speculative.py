@@ -68,6 +68,47 @@ def test_speculative_exact_across_hyperparams(draft_len, ngram):
     np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
 
 
+def test_min_over_batch_acceptance_cost_is_the_worst_row():
+    """Quantifies what shared-scalar-index acceptance costs beyond B1
+    (VERDICT r5 item 3): the KV caches share ONE scalar index, so each
+    batched tick emits min-over-rows acceptance + 1.
+
+    A deliberately lopsided batch — a repetitive row the drafter nails
+    next to a random row it can't — must (a) stay bit-exact per row
+    against each row's SOLO run (truncation re-derives, never corrupts),
+    and (b) pay the worst row's tick count: batched ticks >= the max of
+    the solo tick counts, and >= the good row's solo ticks alone (the
+    fast row is dragged down — THE measured cost `specdecode_bench.py
+    --batches 1,4,8` quantifies at serving shapes)."""
+    model = tiny_gpt(vocab_size=32, max_len=96)
+    fast_row = _repetitive_prompt(1, 14, 32)
+    slow_row = _rand_prompt(9, 1, 14, 32)
+    batch = jnp.concatenate([fast_row, slow_row], axis=0)
+    variables = {"params": model.init(jax.random.key(4), batch,
+                                      train=False)["params"]}
+    n_new = 36
+    solo_stats = {}
+    for name, row in (("fast", fast_row), ("slow", slow_row)):
+        ref = generate(model, variables, row, max_new_tokens=n_new)
+        out, stats = generate_speculative(model, variables, row, n_new,
+                                          draft_len=7, ngram=3,
+                                          return_stats=True)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+        solo_stats[name] = stats
+    out_b, stats_b = generate_speculative(model, variables, batch, n_new,
+                                          draft_len=7, ngram=3,
+                                          return_stats=True)
+    # (a) exactness: the batch emits exactly the stacked solo streams
+    ref_b = generate(model, variables, batch, max_new_tokens=n_new)
+    np.testing.assert_array_equal(np.asarray(out_b), np.asarray(ref_b))
+    # (b) the min-over-batch price, pinned: the batch can never finish
+    # in fewer ticks than its worst member, and the fast row's solo
+    # rate is strictly better than what it gets inside the batch.
+    assert stats_b["ticks"] >= max(s["ticks"] for s in solo_stats.values())
+    assert (solo_stats["fast"]["tokens_per_tick"]
+            >= stats_b["tokens_per_tick"])
+
+
 def test_speculative_single_token_and_short_prompt():
     """Edge shapes: P=1 (n-gram underflows, clamped) and N=1 (one tick)."""
     model = tiny_gpt(vocab_size=16, max_len=64)
