@@ -127,11 +127,14 @@ class ServeEngine:
         fake one).
       prefix_cache_blocks: KV block-pool size (block 0 is a reserved
         scratch sink). ``None`` (default) auto-sizes to hold about two
-        full prompts per slot; ``0`` disables prefix caching entirely
-        (the original four-program engine). Requires
-        ``prefill_len + prefix_chunk <= max_len`` (chunk positions must
-        never clamp) and a usable block (``prefix_block_size <
-        prefill_len``) — violations raise rather than silently degrade.
+        full prompts per slot — or disables caching cleanly when no
+        block can ever fit (``prefix_block_size >= prefill_len``, e.g.
+        very short engines; check ``prefix_cache_enabled``). ``0``
+        disables prefix caching entirely (the original four-program
+        engine). An EXPLICIT size demands a workable config: it
+        requires ``prefill_len + prefix_chunk <= max_len`` (chunk
+        positions must never clamp) and a usable block size —
+        violations then raise rather than silently degrade.
       prefix_block_size: tokens per shared KV block — the reuse (and
         radix-tree) granularity. Smaller blocks match more of a prefix
         but cost more pool rows per prompt.
@@ -561,23 +564,28 @@ class ServeEngine:
             row, logits = prog(self._params, row, chunk_toks,
                                np.int32(w), np.int32(off))
             off += w
-        # Donate the prompt's uncovered FULL blocks. Pin the matched
-        # chain first so this admission's own eviction pass (inside
-        # allocate) can never free the blocks just gathered from.
-        node = match.node
+        # Donate the prompt's uncovered FULL blocks. First descend any
+        # chain ALREADY stored past the (capped) gather match — those
+        # chunks must not have fresh blocks allocated, or a full pool
+        # would evict useful blocks to supply ids the index hands
+        # straight back. Pin before allocating so this admission's own
+        # eviction pass can never free the blocks just gathered from.
+        node, stored_blocks = self._prefix.descend(
+            match.node, prompt, match.n_blocks)
         self._prefix.pin(node)
-        want = plen // bs - match.n_blocks
+        want = plen // bs - stored_blocks
         if want > 0:
             new_ids = self._prefix.allocate(min(want, self._donate_cap))
             if new_ids:
                 tip = self._prefix.extend(
                     node,
-                    prompt[n_cached:n_cached + len(new_ids) * bs],
+                    prompt[stored_blocks * bs:
+                           (stored_blocks + len(new_ids)) * bs],
                     new_ids)
                 dids = np.zeros(self._donate_cap, np.int32)
                 dids[:len(new_ids)] = new_ids
                 self._pool = self._donate_p(self._pool, row, dids,
-                                            np.int32(match.n_blocks))
+                                            np.int32(stored_blocks))
                 self._prefix.unpin(node)
                 self._prefix.pin(tip)
                 node = tip

@@ -123,6 +123,32 @@ class RadixPrefixCache:
             ids.append(node.block_id)
         return PrefixMatch(node, ids)
 
+    def descend(self, node: _Node, tokens: Sequence[int],
+                start_block: int) -> Tuple[_Node, int]:
+        """Walk already-stored children of ``node`` along ``tokens``
+        from ``start_block`` on, refreshing LRU stamps; returns the
+        deepest stored node and its block depth. The donation-side
+        dedup: chunks the index already holds (e.g. beyond a capped
+        gather match, or stored by an earlier identical prompt) must
+        not have fresh blocks allocated — under a full pool that
+        allocation would LRU-evict a USEFUL block to supply one that
+        ``extend`` would immediately hand back."""
+        now = next(self._clock)
+        j = start_block
+        while True:
+            key = tuple(int(t) for t in
+                        tokens[j * self.block_size:
+                               (j + 1) * self.block_size])
+            if len(key) != self.block_size:
+                break
+            child = node.children.get(key)
+            if child is None:
+                break
+            node = child
+            node.last_access = now
+            j += 1
+        return node, j
+
     # -------------------------------------------------------- refcounts
     def pin(self, node: _Node) -> None:
         """Protect ``node`` and its whole root path from eviction (one
@@ -145,28 +171,35 @@ class RadixPrefixCache:
         """Up to ``n`` free block ids, LRU-evicting unpinned leaves as
         needed. May return FEWER than asked (everything else is pinned)
         — the caller donates a shorter chain prefix, never fails."""
-        while len(self._free) < n and self._evict_one():
-            pass
+        if len(self._free) < n:
+            self._reclaim(n - len(self._free))
         take = min(n, len(self._free))
         return [self._free.popleft() for _ in range(take)]
 
-    def _evict_one(self) -> bool:
-        victim = None
-        stack = [self._root]
-        while stack:
-            node = stack.pop()
-            stack.extend(node.children.values())
-            if (node is not self._root and not node.children
-                    and node.ref == 0
-                    and (victim is None
-                         or node.last_access < victim.last_access)):
-                victim = node
-        if victim is None:
-            return False
-        del victim.parent.children[victim.key]
-        self._free.append(victim.block_id)
-        self.evictions += 1
-        return True
+    def _reclaim(self, need: int) -> None:
+        """Evict up to ``need`` unpinned LEAVES, least recently accessed
+        first. One DFS collects the whole evictable set per pass (not
+        one full-tree scan PER block — allocation bursts sit on the
+        admission/TTFT path); evicting a leaf can expose its parent as
+        a new evictable leaf, so passes repeat until satisfied or
+        nothing is evictable."""
+        while need > 0:
+            victims = []
+            stack = [self._root]
+            while stack:
+                node = stack.pop()
+                stack.extend(node.children.values())
+                if (node is not self._root and not node.children
+                        and node.ref == 0):
+                    victims.append(node)
+            if not victims:
+                return
+            victims.sort(key=lambda v: v.last_access)
+            for victim in victims[:need]:
+                del victim.parent.children[victim.key]
+                self._free.append(victim.block_id)
+                self.evictions += 1
+            need -= min(need, len(victims))
 
     # --------------------------------------------------------- insertion
     def extend(self, node: _Node, tokens: Sequence[int],

@@ -125,6 +125,25 @@ def test_zero_recompiles_across_hit_miss_evict(gpt_setup,
     assert eng.metrics.prefix_evictions > 0  # pressure actually happened
 
 
+def test_block_aligned_repeat_never_thrashes_a_full_pool(gpt_setup):
+    """Donation dedup: a block-aligned prompt's tail block can never be
+    GATHERED (the match cap leaves one suffix token) but it IS stored —
+    re-admitting the same prompt must descend the stored chain instead
+    of allocating a fresh block, or a full pool would LRU-evict a
+    useful block to supply an id the index hands straight back."""
+    model, variables = gpt_setup
+    eng = ServeEngine(model, variables, max_slots=1, prefill_len=16,
+                      prefix_block_size=8, prefix_cache_blocks=3)
+    p = (np.arange(16) * 3 + 5) % 32  # 2 blocks, exactly fills the pool
+    for _ in range(3):
+        h = eng.submit(p, 3)
+        eng.run(max_steps=50)
+        assert h.tokens == _ref_greedy(model, variables, p, 3)
+    assert eng.metrics.prefix_evictions == 0  # repeats allocate nothing
+    assert eng.metrics.prefix_blocks_live == 2
+    assert eng.metrics.prefix_hits == 2
+
+
 def test_suffix_priced_admission_budget(gpt_setup):
     """The budget charges the uncached suffix: two shared-prefix
     requests co-admit under a budget that would serialize them cold
