@@ -20,6 +20,15 @@ the ONLINE layer (`pddl_tpu/serve/`) the way a serving owner would:
    shares a system prompt. Hit rate, prefill tokens saved, and the
    compile counts (zero recompiles with the cache on too) land in the
    artifact.
+4. **Fault leg** (`--fault-rate`, default 1%; `--faults-only` for a
+   standalone artifact) — the resilience tax (`pddl_tpu/serve/faults.py`
+   + the engine retry/replay/degraded paths): the same closed-loop
+   workload clean vs under a seeded 1%-per-dispatch injected fault mix
+   (transient device errors + RESOURCE_EXHAUSTED at a tenth the rate).
+   The headline is the PAIRED tok/s and mean-TTFT ratios — a
+   fault-tolerant engine degrades gracefully (ratio near 1, every
+   request terminal), a fail-stop one cliffs to zero. Retries, replays,
+   degraded entries, and failed-request counts land in the artifact.
 
 Timing follows the artifact discipline of
 `pddl_tpu/utils/bench_artifact.py`: every headline number is a median
@@ -48,12 +57,40 @@ import jax.numpy as jnp
 import numpy as np
 
 from pddl_tpu.models.gpt import GPT, generate
-from pddl_tpu.serve import QueueFull, SamplingParams, ServeEngine
+from pddl_tpu.serve import (
+    FaultPlan,
+    QueueFull,
+    RequestState,
+    SamplingParams,
+    ServeEngine,
+)
 from pddl_tpu.utils.bench_artifact import median_spread, provenance
 
 
 def _log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
+
+
+def _write_record(record: dict, out: str) -> None:
+    """One artifact-write path for every leg combination: JSON line to
+    stdout, plus the ``--out`` file when given."""
+    line = json.dumps(record)
+    print(line)
+    if out:
+        out_dir = os.path.dirname(out)
+        if out_dir:
+            os.makedirs(out_dir, exist_ok=True)
+        with open(out, "w") as f:
+            f.write(line + "\n")
+
+
+def _log_fault_leg(faults: dict) -> None:
+    _log(f"faults x{faults['fault_rate_per_dispatch']:.1%}: throughput "
+         f"retained {faults['throughput_retained_x']}x (pairs "
+         f"{faults['throughput_retained_per_pair']}), TTFT "
+         f"{faults['clean_mean_ttft_s']}s -> "
+         f"{faults['faulted_mean_ttft_s']}s, counters "
+         f"{faults['faulted_last_run_counters']}")
 
 
 def _make_requests(n: int, prompt_len: int, new_tokens: int, vocab: int,
@@ -189,6 +226,79 @@ def _prefix_ttft_leg(model, variables, *, n_requests: int,
     }
 
 
+def _fault_leg(model, variables, *, n_requests: int, prompt_len: int,
+               new_tokens: int, slots: int, prefill_len: int,
+               fault_rate: float, vocab: int, repeats: int, seed: int = 11):
+    """Graceful-degradation measurement: the same closed-loop workload
+    clean vs under seeded injection at ``fault_rate`` per device
+    dispatch (transient errors, plus RESOURCE_EXHAUSTED at a tenth the
+    rate so the degraded path fires too). PAIRED runs per repeat —
+    host-load drift cancels in the per-pair ratio. Throughput counts
+    DELIVERED tokens (a failed request's partial stream included), so
+    a crash-looping engine cannot hide behind survivors."""
+    prompts = _make_requests(n_requests, prompt_len, new_tokens, vocab,
+                             seed=seed)
+
+    def run_once(rate, run_seed):
+        plan = (FaultPlan(seed=run_seed, transient_rate=rate,
+                          oom_rate=rate / 10.0) if rate > 0 else None)
+        eng = ServeEngine(model, variables, max_slots=slots,
+                          prefill_len=prefill_len,
+                          max_queue_depth=n_requests + 1,
+                          fault_plan=plan, retry_backoff_s=0.005)
+        eng.warmup()
+        t0 = time.perf_counter()
+        handles = [eng.submit(p, new_tokens) for p in prompts]
+        eng.run(max_steps=200000)
+        dt = time.perf_counter() - t0
+        assert all(h.done for h in handles), "engine failed to drain"
+        delivered = sum(len(h.tokens) for h in handles)
+        ttft = float(np.mean([h.ttft_s for h in handles
+                              if h.ttft_s is not None]))
+        finished = sum(h.state == RequestState.FINISHED for h in handles)
+        return delivered / dt, ttft, finished, eng
+
+    tps_ratios, ttft_ratios = [], []
+    clean_tps_all, fault_tps_all = [], []
+    clean_ttft_all, fault_ttft_all = [], []
+    finished_min = n_requests
+    eng_fault = None
+    for i in range(repeats):
+        c_tps, c_ttft, _, _ = run_once(0.0, seed + i)
+        f_tps, f_ttft, f_fin, eng_fault = run_once(fault_rate, seed + i)
+        clean_tps_all.append(c_tps)
+        fault_tps_all.append(f_tps)
+        clean_ttft_all.append(c_ttft)
+        fault_ttft_all.append(f_ttft)
+        tps_ratios.append(f_tps / c_tps)
+        ttft_ratios.append(f_ttft / c_ttft)
+        finished_min = min(finished_min, f_fin)
+    tps_med, tps_spread = median_spread(tps_ratios)
+    snap = eng_fault.metrics.snapshot()
+    return {
+        "fault_rate_per_dispatch": fault_rate,
+        "oom_rate_per_dispatch": fault_rate / 10.0,
+        "n_requests": n_requests,
+        "new_tokens": new_tokens,
+        "clean_tokens_per_s": round(median_spread(clean_tps_all)[0], 1),
+        "faulted_tokens_per_s": round(median_spread(fault_tps_all)[0], 1),
+        "throughput_retained_x": round(tps_med, 3),
+        "throughput_retained_per_pair": [round(r, 3) for r in tps_ratios],
+        "throughput_retained_spread_pct": round(tps_spread, 2),
+        "clean_mean_ttft_s": round(median_spread(clean_ttft_all)[0], 5),
+        "faulted_mean_ttft_s": round(median_spread(fault_ttft_all)[0], 5),
+        "ttft_inflation_per_pair": [round(r, 3) for r in ttft_ratios],
+        "min_requests_finished_faulted": finished_min,
+        "faulted_last_run_counters": {
+            "retries": snap["retries"],
+            "replays": snap["replays"],
+            "degraded_entries": snap["degraded_entries"],
+            "requests_failed": snap["requests_failed"],
+        },
+        "engine_compile_counts_faulted": eng_fault.compile_counts(),
+    }
+
+
 def _poisson_load(model, variables, offered_rps: float, n_requests: int,
                   prompt_len: int, new_tokens: int, vocab: int,
                   slots: int, prefill_len: int, max_queue_depth: int,
@@ -276,6 +386,13 @@ def main() -> None:
     p.add_argument("--prefix-chunk", type=int, default=80,
                    help="narrow suffix-chunk width (~ the uncached "
                         "suffix at the default shared fraction)")
+    p.add_argument("--fault-rate", type=float, default=0.01,
+                   help="injected fault probability per device dispatch "
+                        "in the fault leg (transient; OOM rides at a "
+                        "tenth of it); 0 skips the leg")
+    p.add_argument("--faults-only", action="store_true",
+                   help="run ONLY the fault leg and write a standalone "
+                        "artifact (r08_serve_faults.json)")
     p.add_argument("--repeats", type=int, default=3,
                    help="timed repetitions per headline number (median "
                         "+ spread recorded)")
@@ -290,6 +407,36 @@ def main() -> None:
     variables = {"params": params}
     model_desc = (f"gpt {args.depth}x{args.embed_dim} "
                   f"(vocab {args.vocab}, max_len {args.max_len})")
+
+    if args.faults_only:
+        _log(f"fault leg only: {2 * args.concurrent} requests x "
+             f"{args.new_tokens} tokens at {args.fault_rate:.1%} "
+             f"injected faults, {model_desc}")
+        faults = _fault_leg(
+            model, variables, n_requests=2 * args.concurrent,
+            prompt_len=args.prompt_len, new_tokens=args.new_tokens,
+            slots=args.slots, prefill_len=args.prefill_len,
+            fault_rate=args.fault_rate, vocab=args.vocab,
+            repeats=args.repeats)
+        record = {
+            "metric": "online_serving_fault_tolerance",
+            "unit": "ratio (faulted / clean, paired runs)",
+            "config": {
+                "model": model_desc,
+                "slots": args.slots,
+                "prefill_len": args.prefill_len,
+                "prompt_len": args.prompt_len,
+                "recovery": "retry (bounded exp backoff) + replay "
+                            "(prompt re-prefill, tokens re-fed) + "
+                            "degraded prefix cache on OOM",
+            },
+            "provenance": provenance(args.repeats),
+            "results": {"faults": faults},
+            "device": jax.devices()[0].device_kind,
+        }
+        _log_fault_leg(faults)
+        _write_record(record, args.out)
+        return
 
     prompts = _make_requests(args.concurrent, args.prompt_len,
                              args.new_tokens, args.vocab)
@@ -354,6 +501,16 @@ def main() -> None:
          f"{prefix['prefix_hit_rate']}, saved "
          f"{prefix['prefill_tokens_saved']} prefill tokens)")
 
+    if args.fault_rate > 0:
+        faults = _fault_leg(
+            model, variables, n_requests=2 * args.concurrent,
+            prompt_len=args.prompt_len, new_tokens=args.new_tokens,
+            slots=args.slots, prefill_len=args.prefill_len,
+            fault_rate=args.fault_rate, vocab=args.vocab,
+            repeats=args.repeats)
+        record["results"]["faults"] = faults
+        _log_fault_leg(faults)
+
     for frac in (() if args.skip_poisson else (0.3, 0.6, 1.2)):
         res = _poisson_load(
             model, variables, offered_rps=frac * cap_rps,
@@ -371,14 +528,7 @@ def main() -> None:
              f"{res['mean_slot_occupancy']}, rejected "
              f"{res['requests_rejected_queue_full']}")
 
-    line = json.dumps(record)
-    print(line)
-    if args.out:
-        out_dir = os.path.dirname(args.out)
-        if out_dir:
-            os.makedirs(out_dir, exist_ok=True)
-        with open(args.out, "w") as f:
-            f.write(line + "\n")
+    _write_record(record, args.out)
 
 
 if __name__ == "__main__":

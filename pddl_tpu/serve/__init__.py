@@ -1,13 +1,24 @@
 """Online serving: continuous batching over the compiled decode path.
 
 `engine.py` is the step loop (slot pool, fused per-slot decode tick),
-`scheduler.py` the admission policy (FCFS + load shedding + prefill
-budget), `request.py` the per-request lifecycle, `metrics.py` the
-telemetry, `kvcache/` the prefix-aware KV reuse layer (radix index +
-device block pool). See `docs/SERVING.md` § "Online serving".
+`scheduler.py` the admission policy (FCFS + load shedding + deadline
+shed + prefill budget), `request.py` the per-request lifecycle,
+`metrics.py` the telemetry, `kvcache/` the prefix-aware KV reuse layer
+(radix index + device block pool), `faults.py` seeded deterministic
+fault injection, `drain.py` the SIGTERM drain/restore snapshot. See
+`docs/SERVING.md` § "Online serving" and `docs/OPERATIONS.md`
+§ "Failure modes & recovery (serving)".
 """
 
 from pddl_tpu.serve.engine import ServeEngine
+from pddl_tpu.serve.faults import (
+    FaultKind,
+    FaultPlan,
+    FaultSpec,
+    InjectedResourceExhausted,
+    InjectedTransientError,
+    KillPoint,
+)
 from pddl_tpu.serve.kvcache import RadixPrefixCache
 from pddl_tpu.serve.metrics import ServeMetrics
 from pddl_tpu.serve.request import (
@@ -22,7 +33,13 @@ from pddl_tpu.serve.scheduler import FCFSScheduler
 
 __all__ = [
     "FCFSScheduler",
+    "FaultKind",
+    "FaultPlan",
+    "FaultSpec",
     "FinishReason",
+    "InjectedResourceExhausted",
+    "InjectedTransientError",
+    "KillPoint",
     "QueueFull",
     "RadixPrefixCache",
     "Request",

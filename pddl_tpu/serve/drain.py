@@ -1,0 +1,120 @@
+"""Drain & restore: the serving analog of checkpoint-on-SIGTERM.
+
+The training side already survives Cloud-TPU preemption
+(`utils/preemption.py`: SIGTERM → flag → save a consistent TrainState
+at the next batch boundary → ``--resume``). The serving side loses the
+whole queue on the same signal — unless in-flight requests are
+snapshotted and resumed. This module is that snapshot's serialization:
+``ServeEngine.drain()`` collects every queued + running request's HOST
+state (prompt, tokens generated so far, sampling params, deadline
+budget), these helpers write/read it, and ``ServeEngine.restore()``
+resubmits the lot into a fresh engine where the replay path rebuilds
+each running request's KV token-exactly (prompt re-prefilled, known
+tokens re-fed through the normal fused tick).
+
+Why JSON and not the orbax ``ckpt`` machinery: the snapshot contains NO
+device state. KV caches are deliberately excluded — they are pure
+functions of (params, tokens), recomputing them costs one replay
+prefill per request, and shipping them would tie the snapshot to one
+cache layout/shape config. What this file DOES reuse from the ckpt
+discipline is crash-safety: the snapshot is written to a temp file and
+atomically renamed (the same torn-write rule `ckpt/checkpoint.py`
+enforces via orbax's tmp-dir protocol), so a kill mid-drain leaves
+either the old snapshot or the new one, never a half-written file.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List
+
+from pddl_tpu.serve.request import (
+    Request,
+    RequestHandle,
+    RequestState,
+    SamplingParams,
+)
+
+SNAPSHOT_VERSION = 1
+
+
+def encode_handle(handle: RequestHandle, now_s: float) -> Dict[str, object]:
+    """One request's restorable host state. ``elapsed_s`` (age at drain
+    time) rather than an absolute arrival lets the restoring engine —
+    whose clock has a different epoch — keep deadline semantics: the
+    wall budget already consumed stays consumed."""
+    sampling = handle.request.sampling
+    return {
+        "prompt": [int(t) for t in handle.request.prompt],
+        "max_new_tokens": int(handle.request.max_new_tokens),
+        "sampling": {
+            "temperature": float(sampling.temperature),
+            "top_k": (int(sampling.top_k)
+                      if sampling.top_k is not None else None),
+            "top_p": (float(sampling.top_p)
+                      if sampling.top_p is not None else None),
+        },
+        "deadline_s": (float(handle.request.deadline_s)
+                       if handle.request.deadline_s is not None else None),
+        "elapsed_s": max(0.0, float(now_s - handle.arrival_s)),
+        "tokens": [int(t) for t in handle.tokens],
+        "ttft_s": (float(handle.ttft_s)
+                   if handle.ttft_s is not None else None),
+    }
+
+
+def decode_handle(entry: Dict[str, object], now_s: float) -> RequestHandle:
+    """Rebuild a QUEUED handle from a snapshot entry. A non-empty
+    ``tokens`` list marks it for the engine's replay admission (KV
+    rebuilt from prompt + tokens, stream continued token-exactly); an
+    empty one re-enters as a fresh request."""
+    s = entry.get("sampling") or {}
+    req = Request(
+        prompt=[int(t) for t in entry["prompt"]],
+        max_new_tokens=int(entry["max_new_tokens"]),
+        sampling=SamplingParams(
+            temperature=float(s.get("temperature", 0.0)),
+            top_k=s.get("top_k"),
+            top_p=s.get("top_p"),
+        ),
+        deadline_s=entry.get("deadline_s"),
+    )
+    handle = RequestHandle(
+        req, arrival_s=float(now_s) - float(entry.get("elapsed_s", 0.0)))
+    handle.tokens = [int(t) for t in entry.get("tokens", [])]
+    handle.ttft_s = entry.get("ttft_s")
+    handle.state = RequestState.QUEUED
+    return handle
+
+
+def save_snapshot(snapshot: Dict[str, object], path: str) -> None:
+    """Atomic write (tmp + rename): a kill mid-drain must leave either
+    the previous snapshot or this one, never a torn file."""
+    directory = os.path.dirname(os.path.abspath(path))
+    os.makedirs(directory, exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(snapshot, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def load_snapshot(path: str) -> Dict[str, object]:
+    with open(path) as f:
+        snapshot = json.load(f)
+    version = snapshot.get("version")
+    if version != SNAPSHOT_VERSION:
+        raise ValueError(
+            f"serve drain snapshot version {version!r} unsupported "
+            f"(this build reads version {SNAPSHOT_VERSION})")
+    return snapshot
+
+
+def restored_handles(snapshot: Dict[str, object],
+                     now_s: float) -> List[RequestHandle]:
+    """Decode every request of a snapshot, preserving its order (the
+    drain writes running-first FCFS order, so restore admission keeps
+    the original service order)."""
+    return [decode_handle(e, now_s) for e in snapshot["requests"]]

@@ -64,12 +64,34 @@ Ring-cache (rolling SWA) models are refused for now: slot reuse over a
 ring whose slots already wrapped needs per-slot wrap bookkeeping this
 engine doesn't carry yet. Full-length-cache models (GPT, Llama, SWA
 with ``window >= max_len``) are all eligible.
+
+Fault tolerance (`serve/faults.py`, `serve/drain.py`,
+`docs/OPERATIONS.md` § "Failure modes & recovery"): every device
+dispatch goes through one guarded boundary. Transient device errors
+retry with bounded exponential backoff; when retries run out (or a
+real error may have consumed a donated buffer) the affected slots'
+KV is declared LOST and the requests REPLAY — the prompt re-prefills
+through the normal admission path and the already-emitted tokens are
+re-fed one per fused tick (known token in, sampled output discarded)
+until the stream's live edge is rebuilt, which is token-exact because
+the caches are position-absolute and costs no new compiled program in
+either prefix mode. RESOURCE_EXHAUSTED flips the engine DEGRADED:
+prefix-cache donations stop, unpinned pool blocks flush, serving
+continues on the cold path, and the cache re-arms after a cool-down.
+A request whose replays exceed ``max_replays`` fails terminally
+(``FinishReason.ERROR``) instead of crash-looping the engine. SIGTERM
+(via ``install_drain_handler``) stops admission and snapshots every
+queued + running request's host state to disk; a fresh engine
+``restore()``s the snapshot and resumes each stream token-exactly
+through the same replay machinery.
 """
 
 from __future__ import annotations
 
+import signal
 import time
-from typing import Dict, List, Optional, Sequence
+from collections import deque
+from typing import Deque, Dict, List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -84,21 +106,58 @@ from pddl_tpu.models.gpt import (
     set_cache_positions,
     slot_decode_cache,
 )
+from pddl_tpu.serve import drain as drain_io
+from pddl_tpu.serve.faults import (
+    InjectedResourceExhausted,
+    InjectedTransientError,
+    classify,
+)
 from pddl_tpu.serve.kvcache import (
     RadixPrefixCache,
     donate_prefix_blocks,
     gather_prefix_into_row,
     kv_block_pool,
+    pool_nbytes,
 )
 from pddl_tpu.serve.metrics import ServeMetrics
 from pddl_tpu.serve.request import (
     FinishReason,
+    QueueFull,
     Request,
     RequestHandle,
     RequestState,
     SamplingParams,
 )
 from pddl_tpu.serve.scheduler import FCFSScheduler
+
+
+class _SlotStateLost(RuntimeError):
+    """Internal escalation: a device call outlasted its retry budget
+    (or failed in a way that may have consumed a donated buffer), so
+    whatever slot/row state it touched must be rebuilt, not reused.
+    Never escapes the engine — admission turns it into a request
+    replay/failure, the tick into a full live-slot replay.
+    ``consumed`` names the resident resource (``cache``/``row``/
+    ``pool``) a REAL mid-dispatch error may have eaten through
+    donation; ``None`` for injected faults, which fire before the
+    program runs and consume nothing."""
+
+    def __init__(self, site: str, cause: BaseException,
+                 consumed: Optional[str] = None):
+        self.site = site
+        self.consumed = consumed
+        super().__init__(f"device call {site!r} lost after retries: {cause}")
+
+
+# Which resident donated tree each site's program consumes on dispatch
+# (prefill and sample_first donate nothing). A REAL error from one of
+# these can leave the donated input deleted, so it is never re-dispatched
+# — the escalation path rebuilds the resource instead.
+_DONATED_BY_SITE = {
+    "tick": "cache", "insert": "cache",
+    "gather": "row", "chunk_prefill": "row", "chunk_prefill_wide": "row",
+    "donate": "pool",
+}
 
 
 class ServeEngine:
@@ -142,6 +201,21 @@ class ServeEngine:
         admission prefills ``ceil(suffix/chunk)`` chunks, so prefill
         work scales with the UNCACHED suffix). Default
         ``max(prefix_block_size, prefill_len // 4)``.
+      fault_plan: optional :class:`~pddl_tpu.serve.faults.FaultPlan`
+        consulted before every device dispatch (chaos tests, fault
+        benches). ``None`` in production — real device errors take the
+        same recovery paths, the plan only makes them injectable.
+      max_retries: transient-error retries per device call before the
+        touched slot state is declared lost and requests replay.
+      retry_backoff_s: base of the bounded exponential backoff
+        (``base * 2**attempt``) between retries.
+      backoff_sleep: how the backoff waits (default ``time.sleep``;
+        tests pass a no-op or a fake-clock advancer).
+      max_replays: slot-state rebuilds per request before it fails
+        terminally with ``FinishReason.ERROR``.
+      degraded_cooldown_s: how long an OOM keeps the prefix cache
+        degraded (donations off) before re-arming; a repeat OOM inside
+        the window pushes the re-arm out again.
     """
 
     def __init__(self, model, variables, *, max_slots: int = 8,
@@ -153,7 +227,12 @@ class ServeEngine:
                  clock=time.monotonic,
                  prefix_cache_blocks: Optional[int] = None,
                  prefix_block_size: int = 8,
-                 prefix_chunk: Optional[int] = None):
+                 prefix_chunk: Optional[int] = None,
+                 fault_plan=None, max_retries: int = 3,
+                 retry_backoff_s: float = 0.02,
+                 backoff_sleep=time.sleep,
+                 max_replays: int = 3,
+                 degraded_cooldown_s: float = 5.0):
         if max_slots < 1:
             raise ValueError(f"max_slots must be >= 1, got {max_slots}")
         if getattr(model, "uses_ring_cache", False):
@@ -178,6 +257,31 @@ class ServeEngine:
             max_queue_depth=max_queue_depth,
             prefill_token_budget=prefill_token_budget)
         self.metrics = ServeMetrics()
+
+        # Resilience state (`serve/faults.py` taxonomy; docs/OPERATIONS
+        # § "Failure modes & recovery").
+        if max_retries < 0:
+            raise ValueError(f"max_retries must be >= 0, got {max_retries}")
+        if max_replays < 0:
+            raise ValueError(f"max_replays must be >= 0, got {max_replays}")
+        self._faults = fault_plan
+        self._max_retries = int(max_retries)
+        self._retry_backoff_s = float(retry_backoff_s)
+        self._backoff_sleep = backoff_sleep
+        self._max_replays = int(max_replays)
+        self._degraded_cooldown_s = float(degraded_cooldown_s)
+        self._degraded = False
+        self._degraded_entered_s = 0.0
+        self._degraded_until_s = 0.0
+        self._step_idx = 0
+        # Handles popped from the queue but not yet slotted: a kill
+        # mid-admission must not lose them from the drain snapshot.
+        self._admitting: Deque[RequestHandle] = deque()
+        self._drain_flag = False
+        self._drained = False
+        self._drain_path: Optional[str] = None
+        self._snapshot: Optional[Dict[str, object]] = None
+        self._prev_handlers: Dict[int, object] = {}
 
         # Prefix-cache configuration (static — the compiled programs'
         # shapes derive from these).
@@ -356,7 +460,15 @@ class ServeEngine:
 
         Raises :class:`~pddl_tpu.serve.request.QueueFull` when the
         admission-control queue is at depth (the metrics count the
-        rejection either way)."""
+        rejection either way); the raised instance carries a
+        ``retry_after_s`` hint — queue depth x the recent
+        per-admission interval — once the engine has admitted enough
+        traffic to estimate one. After :meth:`drain` the engine
+        accepts nothing (the process is on its way out)."""
+        if self._drained:
+            raise RuntimeError(
+                "engine is drained (snapshot taken, admission stopped); "
+                "restore the snapshot into a fresh engine")
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.size < 1:
             raise ValueError("prompt must have at least one token")
@@ -378,6 +490,14 @@ class ServeEngine:
         handle = RequestHandle(req, arrival_s=self._clock())
         try:
             self.scheduler.submit(handle)
+        except QueueFull as e:
+            self.metrics.record_rejected()
+            # Re-raise with the polite-backpressure hint the scheduler
+            # cannot compute (it has no latency telemetry).
+            raise QueueFull(
+                e.queue_depth, e.max_queue_depth,
+                retry_after_s=self.metrics.estimate_retry_after_s(
+                    e.queue_depth)) from None
         except Exception:
             self.metrics.record_rejected()
             raise
@@ -450,11 +570,35 @@ class ServeEngine:
         return self._prefix_on
 
     @property
+    def degraded(self) -> bool:
+        """True while an OOM has the prefix cache shed and donations
+        off (serving continues on the cold path); re-arms after
+        ``degraded_cooldown_s`` without another OOM."""
+        return self._degraded
+
+    @property
+    def prefix_pool_nbytes(self) -> int:
+        """Device bytes the resident KV block pool holds (0 with the
+        cache off) — the HBM degraded mode can shed, the number to
+        weigh against OOM headroom when sizing ``prefix_cache_blocks``
+        (docs/OPERATIONS.md § "Failure modes & recovery")."""
+        return pool_nbytes(self._pool) if self._prefix_on else 0
+
+    @property
+    def drained(self) -> bool:
+        """True once :meth:`drain` snapshotted the engine: admission is
+        stopped and ``step()`` is a no-op — restore into a fresh
+        engine."""
+        return self._drained
+
+    @property
     def live_slots(self) -> int:
         return sum(s is not None for s in self._slots)
 
     @property
     def has_work(self) -> bool:
+        if self._drained:
+            return False
         return self.live_slots > 0 or self.scheduler.depth > 0
 
     def _free_slot_ids(self) -> List[int]:
@@ -468,6 +612,98 @@ class ServeEngine:
         handle.finish_reason = reason
         handle.finish_s = self._clock()
         self.metrics.record_finish(reason.value)
+        self._park_slot(slot_id)
+
+    # --------------------------------------------------- fault handling
+    def _device_call(self, site: str, fn, *args):
+        """The ONE guarded device-dispatch boundary: consult the fault
+        plan, classify failures, retry transients with bounded
+        exponential backoff, flip degraded on OOM (no blind retry — an
+        allocation failure won't pass until memory is shed, and the
+        degraded flush plus the caller's rebuild IS the shedding), and
+        escalate to :class:`_SlotStateLost` when the budget runs out.
+        ``KillPoint`` is a BaseException — it passes through everything
+        here, like the SIGKILL it stands for. Injected faults fire
+        BEFORE ``fn`` runs, so retrying never touches a half-consumed
+        donated buffer; a REAL error from a donated-buffer program is
+        never re-dispatched (its donated input may already be deleted)
+        — it escalates immediately, tagged with the consumed resource
+        so the recovery path rebuilds it."""
+        attempt = 0
+        while True:
+            try:
+                if self._faults is not None:
+                    self._faults.check(site)
+                return fn(*args)
+            except Exception as e:
+                kind = classify(e)
+                if kind is None:
+                    raise  # not a device fault: bugs stay loud
+                injected = isinstance(e, (InjectedTransientError,
+                                          InjectedResourceExhausted))
+                consumed = None if injected else _DONATED_BY_SITE.get(site)
+                if kind == "oom":
+                    self._enter_degraded()
+                    raise _SlotStateLost(site, e, consumed) from e
+                if consumed is not None:
+                    raise _SlotStateLost(site, e, consumed) from e
+                attempt += 1
+                if attempt > self._max_retries:
+                    raise _SlotStateLost(site, e) from e
+                self.metrics.record_retry(site)
+                self._backoff_sleep(
+                    self._retry_backoff_s * (2 ** (attempt - 1)))
+
+    def _enter_degraded(self) -> None:
+        """OOM response: flush every unpinned prefix block (the one
+        large sheddable HBM consumer), stop donations, keep serving on
+        the cold path. Live slots' pinned chains stay — their gathered
+        copies are private and their index entries must survive until
+        unpin. A repeat OOM pushes the re-arm time out."""
+        now = self._clock()
+        if not self._degraded:
+            self._degraded = True
+            self._degraded_entered_s = now
+            self.metrics.record_degraded_entry()
+            if self._prefix_on:
+                self._prefix.flush_unpinned()
+        self._degraded_until_s = now + self._degraded_cooldown_s
+
+    def _maybe_rearm_degraded(self) -> None:
+        now = self._clock()
+        if self._degraded and now >= self._degraded_until_s:
+            self._degraded = False
+            self.metrics.record_degraded_exit(now - self._degraded_entered_s)
+
+    def _reset_prefix_pool(self) -> None:
+        """A REAL failure of the donating scatter may have consumed the
+        resident pool buffers: reallocate them (same shapes — nothing
+        recompiles) and start a fresh index, since every stored chain
+        points into the dead storage. Live slots keep decoding — their
+        gathered copies are private — and their pins die with the old
+        tree."""
+        if not self._prefix_on:
+            return
+        self._pool = kv_block_pool(self._dec, self._prefix.num_blocks,
+                                   self.prefix_block_size)
+        self._prefix = RadixPrefixCache(self.prefix_block_size,
+                                        self._prefix.num_blocks)
+        self._slot_nodes = [None] * self.max_slots
+
+    def _recover_consumed(self, lost: _SlotStateLost) -> None:
+        """Rebuild whatever resident donated tree a real mid-dispatch
+        error may have eaten (`_SlotStateLost.consumed`). The row cache
+        is rebuilt unconditionally by the admission unwind; the slot
+        pool rebuild doubles as a full live-slot replay."""
+        if lost.consumed == "cache":
+            self._lose_live_slots()
+        elif lost.consumed == "pool":
+            self._reset_prefix_pool()
+
+    def _park_slot(self, slot_id: int) -> None:
+        """Park a vacated row: position 0, greedy params. Its future
+        junk writes land at position 0 and the next admit overwrites
+        the whole cache row anyway."""
         self._slots[slot_id] = None
         if self._slot_nodes[slot_id] is not None:
             # Release the request's pin on its prefix chain: the blocks
@@ -475,14 +711,44 @@ class ServeEngine:
             # once no live slot or deeper chain needs them.
             self._prefix.unpin(self._slot_nodes[slot_id])
             self._slot_nodes[slot_id] = None
-        # Park the dead row: position 0, greedy params. Its future junk
-        # writes land at position 0 and the next admit overwrites the
-        # whole cache row anyway.
         self._positions[slot_id] = 0
         self._tokens[slot_id] = 0
         self._temps[slot_id] = 0.0
         self._top_ks[slot_id] = 0
         self._top_ps[slot_id] = 2.0
+
+    def _mark_replay(self, handle: RequestHandle) -> bool:
+        """Charge one replay against ``handle``; True = requeue it for
+        a slot-state rebuild, False = replay budget exhausted, request
+        settled FAILED/ERROR (the engine keeps serving everyone
+        else)."""
+        handle.replays += 1
+        handle.replay_pending = []
+        if handle.replays > self._max_replays:
+            handle.state = RequestState.FAILED
+            handle.finish_reason = FinishReason.ERROR
+            handle.finish_s = self._clock()
+            self.metrics.record_finish(FinishReason.ERROR.value)
+            return False
+        self.metrics.record_replay()
+        return True
+
+    def _lose_live_slots(self) -> None:
+        """The fused tick's retry budget ran out: every live slot's KV
+        must be presumed gone (the pooled cache is donated through the
+        tick). Reallocate the pool cache (same shapes — nothing
+        recompiles), release every pin, and requeue the live requests
+        FCFS-front for replay; each rebuilds token-exactly from prompt
+        + emitted tokens at its re-admission."""
+        lost = [(sid, h) for sid, h in enumerate(self._slots)
+                if h is not None]
+        self._cache = slot_decode_cache(self._dec, self.max_slots)
+        requeue: List[RequestHandle] = []
+        for sid, handle in lost:
+            self._park_slot(sid)
+            if self._mark_replay(handle):
+                requeue.append(handle)
+        self.scheduler.requeue_front(requeue)
 
     def _expired(self, handle: RequestHandle, now: float) -> bool:
         return (handle.request.deadline_s is not None
@@ -512,8 +778,11 @@ class ServeEngine:
         also refreshes the chain's LRU stamp, so a same-tick eviction
         stealing it needs a fully-pinned pool; if that happens the
         request simply re-prefills more than charged (see
-        ``FCFSScheduler.admit``)."""
+        ``FCFSScheduler.admit``). Degraded mode charges the full prompt
+        (the cache is not consulted on the cold path)."""
         prompt = handle.request.prompt
+        if self._degraded:
+            return len(prompt)
         match = self._prefix.match(prompt,
                                    max_blocks=self._match_blocks(prompt))
         return len(prompt) - match.n_blocks * self.prefix_block_size
@@ -529,15 +798,25 @@ class ServeEngine:
         if not self._prefix_on:
             padded = np.zeros((1, self.prefill_len), np.int32)
             padded[0, :plen] = prompt
-            row, logits = self._prefill_p(self._params, padded, plen)
+            row, logits = self._device_call(
+                "prefill", self._prefill_p, self._params, padded, plen)
             return row, logits, None
-        match = self._prefix.match(prompt,
-                                   max_blocks=self._match_blocks(prompt))
-        n_cached = match.n_blocks * bs
-        if match.n_blocks > 0:
+        # Degraded mode (post-OOM cool-down): the cache is neither
+        # consulted nor grown — a pure cold chunked prefill, so serving
+        # continues while the pool stays shed.
+        use_prefix = not self._degraded
+        if use_prefix:
+            match = self._prefix.match(prompt,
+                                       max_blocks=self._match_blocks(prompt))
+            n_cached = match.n_blocks * bs
+        else:
+            match, n_cached = None, 0
+        if n_cached > 0:
             ids = np.zeros(self._match_cap, np.int32)  # scratch-padded
             ids[:match.n_blocks] = match.block_ids
-            row = self._gather_p(self._pool, ids, self._row)
+            row = self._device_call("gather", self._gather_p,
+                                    self._pool, ids, self._row)
+            self._row = row
         else:
             # Full miss: no gather dispatch — the chunks overwrite
             # [0, plen) of the resident row and everything beyond parks
@@ -550,26 +829,40 @@ class ServeEngine:
         # (>= 3/4 of the wide width) takes the WIDE program in one
         # apply, so a cold prompt costs what the one-shot prefill did;
         # short suffixes — the prefix-hit case — take narrow chunks and
-        # pay only for the uncached tail.
+        # pay only for the uncached tail. The resident row is adopted
+        # after EVERY dispatch (each chunk donates it), so a mid-chunk
+        # fault escalation never leaves `self._row` pointing at a
+        # consumed buffer.
         off, logits = n_cached, None
         while off < plen:
             rem = plen - off
             if self._has_wide and 4 * rem >= 3 * self.prefill_len:
                 width, prog = self.prefill_len, self._chunk_wide_p
+                site = "chunk_prefill_wide"
             else:
                 width, prog = self._chunk, self._chunk_p
+                site = "chunk_prefill"
             w = min(width, rem)
             chunk_toks = np.zeros((1, width), np.int32)
             chunk_toks[0, :w] = prompt[off:off + w]
-            row, logits = prog(self._params, row, chunk_toks,
-                               np.int32(w), np.int32(off))
+            row, logits = self._device_call(
+                site, prog, self._params, row, chunk_toks,
+                np.int32(w), np.int32(off))
+            self._row = row
             off += w
+        if not use_prefix:
+            return row, logits, None
         # Donate the prompt's uncovered FULL blocks. First descend any
         # chain ALREADY stored past the (capped) gather match — those
         # chunks must not have fresh blocks allocated, or a full pool
         # would evict useful blocks to supply ids the index hands
         # straight back. Pin before allocating so this admission's own
         # eviction pass can never free the blocks just gathered from.
+        # Donation order is write-then-index: the pool scatter runs
+        # BEFORE `extend` attaches the ids, so a fault mid-donation can
+        # never leave the index pointing at blocks that hold junk — the
+        # unwind releases the unattached ids and the pin, restoring the
+        # pre-admission refcount baseline exactly.
         node, stored_blocks = self._prefix.descend(
             match.node, prompt, match.n_blocks)
         self._prefix.pin(node)
@@ -577,15 +870,21 @@ class ServeEngine:
         if want > 0:
             new_ids = self._prefix.allocate(min(want, self._donate_cap))
             if new_ids:
+                dids = np.zeros(self._donate_cap, np.int32)
+                dids[:len(new_ids)] = new_ids
+                try:
+                    self._pool = self._device_call(
+                        "donate", self._donate_p, self._pool, row, dids,
+                        np.int32(stored_blocks))
+                except _SlotStateLost:
+                    self._prefix.release(new_ids)
+                    self._prefix.unpin(node)
+                    raise
                 tip = self._prefix.extend(
                     node,
                     prompt[stored_blocks * bs:
                            (stored_blocks + len(new_ids)) * bs],
                     new_ids)
-                dids = np.zeros(self._donate_cap, np.int32)
-                dids[:len(new_ids)] = new_ids
-                self._pool = self._donate_p(self._pool, row, dids,
-                                            np.int32(stored_blocks))
                 self._prefix.unpin(node)
                 self._prefix.pin(tip)
                 node = tip
@@ -607,86 +906,164 @@ class ServeEngine:
             handle.finish_s = self._clock()
             self.metrics.record_finish(FinishReason.CANCELLED.value)
 
+        def _queued_expired(handle):
+            # Died in the queue, shed by the scheduler at pop time:
+            # never pay its prefill (the most expensive dispatch) nor
+            # emit a post-deadline token — under sustained overload
+            # this is exactly where deadlines earn their keep. The
+            # slot stays free for the next admission.
+            handle.finish_s = self._clock()
+            self.metrics.record_finish(FinishReason.DEADLINE.value)
+
         # The suffix-priced cost_fn walks the radix tree per pop; only
         # pay that when a budget actually consumes the result.
         use_cost = (self._prefix_on
                     and self.scheduler.prefill_token_budget is not None)
-        for handle in self.scheduler.admit(
-                len(free), on_cancelled=_queued_cancel,
-                cost_fn=self._prefill_cost if use_cost else None):
-            if self._expired(handle, self._clock()):
-                # Died in the queue: never pay its prefill (the most
-                # expensive dispatch) nor emit a post-deadline token —
-                # under sustained overload this is exactly where
-                # deadlines earn their keep. The slot stays free for
-                # the next admission.
-                handle.state = RequestState.TIMED_OUT
-                handle.finish_reason = FinishReason.TIMED_OUT
-                handle.finish_s = self._clock()
-                self.metrics.record_finish(FinishReason.TIMED_OUT.value)
-                continue
+        # A kill mid-admission can leave a handle parked in
+        # `_admitting`; it owns the first free slot before anything new
+        # is popped.
+        self._admitting.extend(self.scheduler.admit(
+            len(free) - len(self._admitting), on_cancelled=_queued_cancel,
+            on_expired=_queued_expired, now_fn=self._clock,
+            cost_fn=self._prefill_cost if use_cost else None))
+        while self._admitting and free:
+            handle = self._admitting[0]
             sid = free.pop(0)
-            req = handle.request
-            plen = len(req.prompt)
-            row, logits, node = self._prefill_into_row(
-                np.asarray(req.prompt, np.int32))
-            self._slot_nodes[sid] = node
-            self._cache = self._insert_p(self._cache, row, sid, plen)
-            t, k, p = req.sampling.as_arrays()
-            tok, self._rng = self._sample_first_p(
-                logits, np.float32(t), np.int32(k), np.float32(p),
-                self._rng)
-            first = int(tok[0])
+            try:
+                self._admit_one(sid, handle)
+            except _SlotStateLost as lost:
+                # The per-request unwind already released any pin; the
+                # slot never became live. Rebuild the resident row
+                # buffers defensively (a real device error may have
+                # consumed them via donation) — same shapes, nothing
+                # recompiles — rebuild anything else the failed dispatch
+                # consumed (slot pool → live-slot replay; block pool →
+                # fresh pool + index), and charge the request a replay.
+                free.insert(0, sid)
+                if self._prefix_on:
+                    self._row = jax.tree.map(
+                        lambda sd: jnp.zeros(sd.shape, sd.dtype),
+                        _decode_cache_shapes(self._dec, 1))
+                self._recover_consumed(lost)
+                if self._mark_replay(handle):
+                    self.scheduler.requeue_front([handle])
+            self._admitting.popleft()
+
+    def _admit_one(self, sid: int, handle: RequestHandle) -> None:
+        """Admit one popped handle into slot ``sid``. Two shapes: a
+        FRESH request samples its first token from the prefill logits
+        (that's TTFT); a REPLAYED one (``handle.tokens`` non-empty —
+        fault recovery or drain/restore) rebuilds its KV from the
+        prompt here and re-feeds the emitted tokens through the coming
+        ticks, so no token is ever re-sampled or double-streamed."""
+        req = handle.request
+        plen = len(req.prompt)
+        replay = bool(handle.tokens)
+        row, logits, node = self._prefill_into_row(
+            np.asarray(req.prompt, np.int32))
+        t, k, p = req.sampling.as_arrays()
+        try:
+            self._cache = self._device_call(
+                "insert", self._insert_p, self._cache, row, sid, plen)
+            if replay:
+                first = handle.tokens[0]
+                handle.replay_pending = list(handle.tokens[1:])
+            else:
+                tok, self._rng = self._device_call(
+                    "sample_first", self._sample_first_p, logits,
+                    np.float32(t), np.int32(k), np.float32(p), self._rng)
+                first = int(tok[0])
+        except _SlotStateLost:
+            if node is not None:
+                self._prefix.unpin(node)
+            raise
+        self._slot_nodes[sid] = node
+        if not replay:
             now = self._clock()
             handle.tokens.append(first)
             handle.ttft_s = now - handle.arrival_s
             self.metrics.record_first_token(handle.ttft_s)
-            self._slots[sid] = handle
-            self._positions[sid] = plen
-            self._tokens[sid] = first
-            self._temps[sid] = t
-            self._top_ks[sid] = k
-            self._top_ps[sid] = p
-            # A one-token request (or an immediate eos) finishes at
-            # admission without ever joining a tick.
-            if self.eos_token is not None and first == self.eos_token:
-                self._evict(sid, RequestState.FINISHED, FinishReason.EOS)
-            elif req.max_new_tokens == 1:
-                self._evict(sid, RequestState.FINISHED, FinishReason.LENGTH)
+            self.metrics.record_admission(now)
+        self._slots[sid] = handle
+        self._positions[sid] = plen
+        self._tokens[sid] = first
+        self._temps[sid] = t
+        self._top_ks[sid] = k
+        self._top_ps[sid] = p
+        if replay:
+            # Finish conditions were already evaluated for every
+            # re-fed token before the fault; re-checking would double
+            # count. The stream resumes at its live edge.
+            return
+        # A one-token request (or an immediate eos) finishes at
+        # admission without ever joining a tick.
+        if self.eos_token is not None and first == self.eos_token:
+            self._evict(sid, RequestState.FINISHED, FinishReason.EOS)
+        elif req.max_new_tokens == 1:
+            self._evict(sid, RequestState.FINISHED, FinishReason.LENGTH)
 
     def step(self) -> int:
-        """One engine tick: reap → admit → one fused decode tick for all
-        live slots → evict finished. Returns tokens emitted this step
-        (admission first-tokens included)."""
+        """One engine tick: (drain check) → reap → admit → one fused
+        decode tick for all live slots → evict finished. Returns tokens
+        emitted this step (admission first-tokens included; replay
+        re-feeds emit nothing — those tokens were already streamed).
+        After a drain this is a no-op returning 0."""
         if not self._warm:
             self.warmup()
+        if self._drain_flag and not self._drained:
+            # SIGTERM arrived (flag set by the async-signal-safe
+            # handler): snapshot and stop at this step boundary — the
+            # serving analog of PreemptionCheckpoint's batch-boundary
+            # save.
+            self.drain(self._drain_path)
+        if self._drained:
+            return 0
+        if self._faults is not None:
+            self._faults.on_step(self._step_idx)
+        self._step_idx += 1
         t0 = self._clock()
         emitted_before = self.metrics.tokens_emitted
+        self._maybe_rearm_degraded()
         self._reap()
         self._admit()
         live = [i for i, s in enumerate(self._slots) if s is not None]
+        new_tokens = 0
         if live:
-            self._cache, nxt, self._rng = self._tick_p(
-                self._params, self._cache, self._positions, self._tokens,
-                self._temps, self._top_ks, self._top_ps, self._rng)
-            nxt = np.asarray(nxt)  # the per-tick host sync (streaming)
-            for sid in live:
-                handle = self._slots[sid]
-                tok = int(nxt[sid])
-                handle.tokens.append(tok)
-                self._positions[sid] += 1
-                self._tokens[sid] = tok
-                if self.eos_token is not None and tok == self.eos_token:
-                    self._evict(sid, RequestState.FINISHED,
-                                FinishReason.EOS)
-                elif len(handle.tokens) >= handle.request.max_new_tokens:
-                    self._evict(sid, RequestState.FINISHED,
-                                FinishReason.LENGTH)
+            try:
+                self._cache, nxt, self._rng = self._device_call(
+                    "tick", self._tick_p, self._params, self._cache,
+                    self._positions, self._tokens, self._temps,
+                    self._top_ks, self._top_ps, self._rng)
+            except _SlotStateLost:
+                self._lose_live_slots()
+                nxt = None
+            if nxt is not None:
+                nxt = np.asarray(nxt)  # per-tick host sync (streaming)
+                for sid in live:
+                    handle = self._slots[sid]
+                    if handle.replay_pending:
+                        # Rebuilding lost KV: the tick just re-wrote
+                        # this row's next known token — feed the
+                        # following one, discard the sampled output
+                        # (the caller already has these tokens).
+                        self._tokens[sid] = handle.replay_pending.pop(0)
+                        self._positions[sid] += 1
+                        continue
+                    tok = int(nxt[sid])
+                    handle.tokens.append(tok)
+                    new_tokens += 1
+                    self._positions[sid] += 1
+                    self._tokens[sid] = tok
+                    if self.eos_token is not None and tok == self.eos_token:
+                        self._evict(sid, RequestState.FINISHED,
+                                    FinishReason.EOS)
+                    elif len(handle.tokens) >= handle.request.max_new_tokens:
+                        self._evict(sid, RequestState.FINISHED,
+                                    FinishReason.LENGTH)
         now = self._clock()
-        tick_tokens = len(live)
         self.metrics.record_tick(
             now, self.scheduler.depth, len(live), self.max_slots,
-            tick_tokens, now - t0)
+            new_tokens, now - t0)
         return self.metrics.tokens_emitted - emitted_before
 
     def run(self, max_steps: Optional[int] = None) -> None:
@@ -698,3 +1075,76 @@ class ServeEngine:
             steps += 1
             if max_steps is not None and steps >= max_steps:
                 break
+
+    # ----------------------------------------------------- drain/restore
+    def install_drain_handler(self, path: Optional[str] = None,
+                              signals=(signal.SIGTERM,)) -> None:
+        """Arm checkpoint-on-SIGTERM for the serving side (the analog of
+        `utils/preemption.PreemptionCheckpoint`): the handler only sets
+        a flag (async-signal-safe); the actual :meth:`drain` — snapshot
+        to ``path``, stop admission — happens at the next ``step()``
+        boundary on the serving thread, so the snapshot is a consistent
+        request set, never a torn mid-dispatch capture."""
+        self._drain_path = path
+
+        def _on_signal(signum, frame):  # flag only: async-signal-safe
+            self._drain_flag = True
+
+        for sig in signals:
+            self._prev_handlers[sig] = signal.signal(sig, _on_signal)
+
+    def uninstall_drain_handler(self) -> None:
+        """Put the previous signal handlers back (tests; in production
+        the process exits after the drain)."""
+        for sig, old in self._prev_handlers.items():
+            signal.signal(sig, old)
+        self._prev_handlers.clear()
+
+    def drain(self, path: Optional[str] = None) -> Dict[str, object]:
+        """Snapshot every in-flight request's host state and stop.
+
+        Running slots first (FCFS owes them the earliest re-admission),
+        then any handle caught mid-admission, then the queue — each as
+        (prompt, tokens generated so far, sampling params, remaining
+        deadline budget). No device state is saved: KV is a pure
+        function of (params, tokens) and the restore path recomputes it
+        token-exactly via the replay machinery. Idempotent; with
+        ``path`` the snapshot is also written atomically
+        (`serve/drain.py`). After the drain the engine admits nothing
+        and ``step()`` is a no-op."""
+        if self._drained:
+            return self._snapshot
+        now = self._clock()
+        # Slot index is reuse order, not arrival order — sort so the
+        # restore really does re-admit the oldest stream first.
+        handles = sorted((h for h in self._slots if h is not None),
+                         key=lambda h: h.arrival_s)
+        handles.extend(self._admitting)
+        handles.extend(self.scheduler.drain())
+        self._snapshot = {
+            "version": drain_io.SNAPSHOT_VERSION,
+            "drained_unix_s": time.time(),
+            "requests": [drain_io.encode_handle(h, now) for h in handles],
+        }
+        self._drained = True
+        self._drain_flag = True
+        if path is not None:
+            drain_io.save_snapshot(self._snapshot, path)
+        return self._snapshot
+
+    def restore(self, source) -> List[RequestHandle]:
+        """Resubmit a drain snapshot (dict or path) into THIS engine —
+        call on a fresh engine with the same model/config. Requests
+        that were running resume token-exactly: their handles re-enter
+        the queue with tokens-so-far attached, and replay admission
+        rebuilds each one's KV from prompt + tokens before the stream
+        continues (``handle.tokens`` of the returned handles already
+        contains the pre-drain tokens, so a completed restore holds
+        each request's FULL stream). Depth limits don't apply — every
+        one of these was already admitted once. Returns the new
+        handles in service order."""
+        if isinstance(source, str):
+            source = drain_io.load_snapshot(source)
+        handles = drain_io.restored_handles(source, self._clock())
+        self.scheduler.restore(handles)
+        return handles

@@ -64,6 +64,15 @@ def kv_block_pool(dec, num_blocks: int, block_size: int):
     return jax.tree_util.tree_map_with_path(_leaf, row)
 
 
+def pool_nbytes(pool) -> int:
+    """Device bytes a block pool's leaves occupy — the HBM the engine's
+    degraded mode can shed (the number the failure-modes runbook in
+    `docs/OPERATIONS.md` reasons about when sizing pools against OOM
+    headroom)."""
+    return sum(int(leaf.size) * leaf.dtype.itemsize
+               for leaf in jax.tree.leaves(pool))
+
+
 def gather_prefix_into_row(pool, row_cache, block_ids):
     """Copy pool blocks ``block_ids [M]`` into positions
     ``[0, M*block_size)`` of every K/V leaf of a batch-1 row cache

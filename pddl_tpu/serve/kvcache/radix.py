@@ -166,7 +166,29 @@ class RadixPrefixCache:
             node.ref -= 1
             node = node.parent
 
+    def flush_unpinned(self) -> int:
+        """Degraded-mode flush: evict EVERY unpinned block (the chains
+        live slots still pin stay — their gathered copies are already
+        private, but their index entries must remain consistent until
+        unpin). Returns the number of blocks freed. Used by the engine
+        when a RESOURCE_EXHAUSTED surfaces: the prefix cache is the one
+        large optional HBM consumer, so shedding it is the graceful
+        response before any request has to fail."""
+        before = self.blocks_free
+        self._reclaim(self.blocks_live)
+        return self.blocks_free - before
+
     # ------------------------------------------------------- allocation
+    def release(self, block_ids: List[int]) -> None:
+        """Return ids from :meth:`allocate` that were never attached via
+        :meth:`extend` (a failed donation unwinding). Releasing an
+        attached block this way would double-own it — that path must go
+        through eviction instead."""
+        for bid in block_ids:
+            if bid == SCRATCH_BLOCK:
+                raise ValueError("the scratch block is never allocated")
+            self._free.append(bid)
+
     def allocate(self, n: int) -> List[int]:
         """Up to ``n`` free block ids, LRU-evicting unpinned leaves as
         needed. May return FEWER than asked (everything else is pinned)

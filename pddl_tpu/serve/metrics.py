@@ -21,7 +21,8 @@ standard JSON-artifact shape.
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional
+from collections import deque
+from typing import Deque, Dict, List, Optional
 
 import numpy as np
 
@@ -57,6 +58,20 @@ class ServeMetrics:
         self.prefill_tokens_saved = 0
         self.prefix_evictions = 0
         self.prefix_blocks_live = 0  # gauge, engine-stamped per admission
+        # Resilience telemetry (`serve/faults.py`, engine retry/replay/
+        # degraded paths): all zero on a fault-free engine.
+        self.retries = 0             # failed device calls retried
+        self.retry_sites: Dict[str, int] = {}
+        self.replays = 0             # slot-state rebuilds (KV recomputed)
+        self.requests_failed = 0     # terminal FinishReason.ERROR
+        self.requests_deadline_shed = 0  # FinishReason.DEADLINE at pop
+        self.degraded_entries = 0    # times the engine flipped degraded
+        self.degraded_time_s = 0.0   # wall time spent degraded (closed
+        #                              intervals; re-arm stamps them)
+        # Recent admission timestamps: the QueueFull retry_after_s
+        # estimator (a short window so the hint tracks CURRENT service
+        # rate, not the all-time average).
+        self._admission_times: Deque[float] = deque(maxlen=32)
         self._first_activity_s: Optional[float] = None
         self._last_activity_s: Optional[float] = None
 
@@ -82,19 +97,62 @@ class ServeMetrics:
 
     def record_finish(self, reason_value: str) -> None:
         """One request departed. ``requests_finished`` counts ONLY
-        successful completions (length/eos); cancellations and timeouts
-        go to their own counters — the three are disjoint, so a success
-        rate is finished / (finished + cancelled + timed_out +
+        successful completions (length/eos); cancellations, timeouts,
+        pop-time deadline sheds, and fault failures each go to their
+        own counter — all disjoint, so a success rate is finished /
+        (finished + cancelled + timed_out + deadline_shed + failed +
         rejected) with no hidden convention."""
         if reason_value == "timed_out":
             self.requests_timed_out += 1
+        elif reason_value == "deadline":
+            self.requests_deadline_shed += 1
         elif reason_value == "cancelled":
             self.requests_cancelled += 1
+        elif reason_value == "error":
+            self.requests_failed += 1
         else:
             self.requests_finished += 1
 
     def record_rejected(self) -> None:
         self.requests_rejected += 1
+
+    # ------------------------------------------------------- resilience
+    def record_retry(self, site: str) -> None:
+        self.retries += 1
+        self.retry_sites[site] = self.retry_sites.get(site, 0) + 1
+
+    def record_replay(self) -> None:
+        self.replays += 1
+
+    def record_degraded_entry(self) -> None:
+        self.degraded_entries += 1
+
+    def record_degraded_exit(self, seconds: float) -> None:
+        self.degraded_time_s += max(0.0, float(seconds))
+
+    def record_admission(self, now_s: float) -> None:
+        """One FRESH request admitted (replays excluded — they consume
+        admission work but represent no new queue progress, and the
+        retry_after hint estimates how fast the queue drains)."""
+        self._admission_times.append(float(now_s))
+
+    def recent_admission_interval_s(self) -> Optional[float]:
+        """Mean gap between recent admissions, or ``None`` before two
+        were observed."""
+        times = self._admission_times
+        if len(times) < 2:
+            return None
+        return (times[-1] - times[0]) / (len(times) - 1)
+
+    def estimate_retry_after_s(self, queue_depth: int) -> Optional[float]:
+        """The QueueFull backpressure hint: the queue ahead of a new
+        arrival times the recent per-admission interval — roughly when
+        a queue slot frees up. An estimate from a sliding window, not a
+        promise; ``None`` until the engine has admitted twice."""
+        interval = self.recent_admission_interval_s()
+        if interval is None:
+            return None
+        return max(interval, 0.0) * max(int(queue_depth), 1)
 
     def record_prefix_lookup(self, tokens_saved: int, *, blocks_live: int,
                              evictions: int) -> None:
@@ -140,6 +198,12 @@ class ServeMetrics:
             "prefill_tokens_saved": self.prefill_tokens_saved,
             "prefix_blocks_live": self.prefix_blocks_live,
             "prefix_evictions": self.prefix_evictions,
+            "retries": self.retries,
+            "replays": self.replays,
+            "requests_failed": self.requests_failed,
+            "requests_deadline_shed": self.requests_deadline_shed,
+            "degraded_entries": self.degraded_entries,
+            "degraded_time_s": round(self.degraded_time_s, 6),
         }
 
     def summary(self) -> str:

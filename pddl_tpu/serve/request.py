@@ -26,16 +26,24 @@ from typing import List, Optional, Sequence
 
 class QueueFull(RuntimeError):
     """Typed admission-control rejection: the engine's queue is at its
-    ``max_queue_depth``. Carries the depth so callers can implement
-    backpressure (retry-after, load-shed upstream) without parsing
-    strings."""
+    ``max_queue_depth``. Carries the depth — and, when the engine has
+    seen enough traffic to estimate one, a ``retry_after_s`` hint
+    (queue depth x the recent per-admission interval from
+    ``ServeMetrics``) — so upstream backpressure can be polite
+    (honor the hint) instead of blind hammering, without parsing
+    strings. ``retry_after_s`` is ``None`` before the estimator warms
+    up (fewer than two admissions observed)."""
 
-    def __init__(self, queue_depth: int, max_queue_depth: int):
+    def __init__(self, queue_depth: int, max_queue_depth: int,
+                 retry_after_s: Optional[float] = None):
         self.queue_depth = queue_depth
         self.max_queue_depth = max_queue_depth
+        self.retry_after_s = retry_after_s
+        hint = (f"; retry after ~{retry_after_s:.3f}s"
+                if retry_after_s is not None else "")
         super().__init__(
             f"serving queue full ({queue_depth}/{max_queue_depth}); "
-            "shed load upstream or raise max_queue_depth")
+            f"shed load upstream or raise max_queue_depth{hint}")
 
 
 class RequestState(enum.Enum):
@@ -44,13 +52,18 @@ class RequestState(enum.Enum):
     FINISHED = "finished"
     CANCELLED = "cancelled"
     TIMED_OUT = "timed_out"
+    FAILED = "failed"        # replay budget exhausted (see FinishReason.ERROR)
 
 
 class FinishReason(enum.Enum):
     LENGTH = "length"        # emitted max_new_tokens
     EOS = "eos"              # hit the engine's eos token (included)
     CANCELLED = "cancelled"  # handle.cancel()
-    TIMED_OUT = "timed_out"  # deadline_s exceeded
+    TIMED_OUT = "timed_out"  # deadline_s exceeded while running
+    DEADLINE = "deadline"    # deadline already expired at pop time (shed
+    #                          by the scheduler before any prefill work)
+    ERROR = "error"          # device faults outlasted the retry + replay
+    #                          budget: the request fails, the engine lives
 
 
 @dataclasses.dataclass(frozen=True)
@@ -106,6 +119,14 @@ class RequestHandle:
     request leaves its slot. ``cancel()`` is honored at the engine's
     next step — a queued request never runs, a running one is evicted
     mid-decode with the tokens emitted so far intact.
+
+    ``replays`` counts how many times the engine rebuilt this request's
+    slot state after a device fault (each rebuild re-prefills the
+    prompt and re-feeds ``tokens`` through the tick — the stream the
+    caller sees never repeats or loses a token); past the engine's
+    ``max_replays`` the request settles FAILED/ERROR instead of
+    crash-looping. ``replay_pending`` is engine-internal: the
+    already-emitted tokens still to re-feed during a replay.
     """
 
     def __init__(self, request: Request, arrival_s: float):
@@ -116,6 +137,8 @@ class RequestHandle:
         self.finish_reason: Optional[FinishReason] = None
         self.ttft_s: Optional[float] = None  # submit → first token
         self.finish_s: Optional[float] = None
+        self.replays = 0
+        self.replay_pending: List[int] = []
         self._cancel = False
 
     def cancel(self) -> None:
@@ -128,7 +151,7 @@ class RequestHandle:
     @property
     def done(self) -> bool:
         return self.state in (RequestState.FINISHED, RequestState.CANCELLED,
-                              RequestState.TIMED_OUT)
+                              RequestState.TIMED_OUT, RequestState.FAILED)
 
     def __repr__(self) -> str:  # debugging aid, not an API
         return (f"RequestHandle(id={self.request.request_id}, "

@@ -58,11 +58,20 @@ class FCFSScheduler:
         self._queue.append(handle)
 
     def admit(self, free_slots: int,
-              on_cancelled=None, cost_fn=None) -> List[RequestHandle]:
+              on_cancelled=None, on_expired=None, now_fn=None,
+              cost_fn=None) -> List[RequestHandle]:
         """Pop up to ``free_slots`` admissible handles FCFS, bounded by
         the prefill token budget; cancelled queued handles are dropped
         (marked CANCELLED) in passing — ``on_cancelled(handle)`` lets
         the engine account them in its metrics.
+
+        Deadline-aware shedding: with ``now_fn`` supplied, a queued
+        handle whose deadline already expired is skipped-and-failed at
+        pop time (state TIMED_OUT, reason DEADLINE, ``on_expired``
+        called) BEFORE it can burn prefill budget or a slot — under
+        sustained overload the queue wait is exactly where deadlines
+        die, and paying a full prefill to emit zero useful tokens would
+        steal the budget from requests that can still make theirs.
 
         ``cost_fn(handle) -> int`` overrides the budget charge per
         request (default: full prompt length). The prefix-cache engine
@@ -87,6 +96,15 @@ class FCFSScheduler:
                 if on_cancelled is not None:
                     on_cancelled(head)
                 continue
+            if (now_fn is not None
+                    and head.request.deadline_s is not None
+                    and now_fn() - head.arrival_s > head.request.deadline_s):
+                self._queue.popleft()
+                head.state = RequestState.TIMED_OUT
+                head.finish_reason = FinishReason.DEADLINE
+                if on_expired is not None:
+                    on_expired(head)
+                continue
             cost = (cost_fn(head) if cost_fn is not None
                     else len(head.request.prompt))
             if budget is not None and admitted and spent + cost > budget:
@@ -96,3 +114,28 @@ class FCFSScheduler:
             admitted.append(head)
             spent += cost
         return admitted
+
+    # ------------------------------------------------- resilience hooks
+    def requeue_front(self, handles: List[RequestHandle]) -> None:
+        """Put replayed handles back at the queue HEAD in the given
+        order (they were admitted before anything currently queued, so
+        FCFS owes them the next free slots). Bypasses
+        ``max_queue_depth`` deliberately: these requests were already
+        accepted once — shedding them now would turn a transient device
+        fault into a visible rejection."""
+        for handle in reversed(handles):
+            handle.state = RequestState.QUEUED
+            self._queue.appendleft(handle)
+
+    def drain(self) -> List[RequestHandle]:
+        """Pop every queued handle (FCFS order) for a drain snapshot;
+        the queue is left empty so a post-drain step admits nothing."""
+        out = list(self._queue)
+        self._queue.clear()
+        return out
+
+    def restore(self, handles: List[RequestHandle]) -> None:
+        """Re-enqueue restored handles in snapshot order. Like
+        :meth:`requeue_front`, depth limits do not apply — every one of
+        these was admitted by the drained engine."""
+        self._queue.extend(handles)

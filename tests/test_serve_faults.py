@@ -1,0 +1,560 @@
+"""Fault tolerance of the serving engine (`pddl_tpu/serve/faults.py`,
+engine retry/replay/degraded/drain paths), CPU.
+
+The contracts under test:
+
+- **Chaos matrix** (seeds x fault kinds, `@pytest.mark.chaos`): under
+  seeded injection of transient errors, RESOURCE_EXHAUSTED, and latency
+  spikes, the engine never crashes, every admitted request reaches a
+  terminal state, every SURVIVING (FINISHED) request's stream is
+  token-identical to the fault-free run, and zero recompiles after
+  warmup still holds across retry/replay/degraded transitions.
+- **Retry**: a transient burst within the retry budget recovers in
+  place — same tokens, no replay.
+- **Replay**: a burst past the budget declares the slot KV lost; the
+  request is rebuilt token-exactly from prompt + emitted tokens via the
+  normal admission path plus re-fed ticks (no new compiled program).
+- **Failure isolation**: a request that outlives ``max_replays`` ends
+  FAILED/``FinishReason.ERROR``; the engine itself keeps serving.
+- **Degraded mode**: an OOM flushes unpinned prefix blocks, turns
+  donations off, keeps serving on the cold path, and re-arms after the
+  cool-down — all token-exact.
+- **Drain/restore**: SIGTERM (and even a hard kill-point mid-step)
+  snapshots queued + running requests; a fresh engine restores and
+  resumes each stream token-exactly.
+- **Refcount hygiene**: storms of cancelled/faulted/deadline admissions
+  leave the radix index at its refcount baseline (no pinned-chain
+  leak).
+"""
+
+import signal
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pddl_tpu.models.gpt import generate, tiny_gpt
+from pddl_tpu.serve import (
+    FaultKind,
+    FaultPlan,
+    FaultSpec,
+    FinishReason,
+    KillPoint,
+    QueueFull,
+    RequestState,
+    ServeEngine,
+)
+from pddl_tpu.serve.scheduler import FCFSScheduler
+from pddl_tpu.serve.request import Request, RequestHandle
+
+
+@pytest.fixture(scope="module")
+def gpt_setup():
+    model = tiny_gpt(vocab_size=32, max_len=64)
+    prompt = jnp.ones((1, 8), jnp.int32)
+    params = model.init(jax.random.key(0), prompt, train=False)["params"]
+    return model, {"params": params}
+
+
+def _ref_greedy(model, variables, prompt, n_new):
+    out = generate(model, variables,
+                   jnp.asarray(prompt, jnp.int32)[None], n_new)
+    return np.asarray(out)[0, len(prompt):].tolist()
+
+
+class _FakeClock:
+    def __init__(self):
+        self.now = 0.0
+
+    def __call__(self):
+        return self.now
+
+
+def _no_sleep(_):
+    pass
+
+
+_WORKLOAD = [((np.arange(9) * 5 + 1) % 32, 6),
+             ((np.arange(12) * 3 + 7) % 32, 5),
+             ((np.arange(9) * 5 + 1) % 32, 4),   # shared prefix with #0
+             ((np.arange(6) + 17) % 32, 7),
+             ((np.arange(14) * 7 + 2) % 32, 3)]
+
+
+@pytest.fixture(scope="module")
+def workload_refs(gpt_setup):
+    model, variables = gpt_setup
+    return [_ref_greedy(model, variables, p, n) for p, n in _WORKLOAD]
+
+
+def _next_step(eng):
+    """The (step, site) coordinate the engine's NEXT step() will use."""
+    return eng._step_idx
+
+
+# ------------------------------------------------------------ chaos matrix
+_PROFILES = {
+    "transient": dict(transient_rate=0.08, max_random_injections=12),
+    "oom": dict(oom_rate=0.05, max_random_injections=6),
+    "latency": dict(latency_rate=0.25, latency_s=1e-4,
+                    max_random_injections=30),
+    "mixed": dict(transient_rate=0.05, oom_rate=0.02, latency_rate=0.1,
+                  latency_s=1e-4, max_random_injections=20),
+}
+
+
+@pytest.mark.chaos
+@pytest.mark.parametrize("seed", [0, 1, 2])
+@pytest.mark.parametrize("profile", sorted(_PROFILES))
+def test_chaos_matrix(gpt_setup, workload_refs, pin_zero_recompiles,
+                      seed, profile):
+    """Seeded chaos: no crash, every request terminal, survivors
+    token-identical to the fault-free run, zero recompiles throughout.
+    (The seed-0 column doubles as the tier-1 smoke; the whole matrix is
+    fast enough to stay un-`slow`.)"""
+    model, variables = gpt_setup
+    plan = FaultPlan(seed=seed, sleep_fn=_no_sleep, **_PROFILES[profile])
+    eng = pin_zero_recompiles(ServeEngine(
+        model, variables, max_slots=2, prefill_len=16,
+        fault_plan=plan, backoff_sleep=_no_sleep))
+    handles = [eng.submit(p, n) for p, n in _WORKLOAD]
+    eng.run(max_steps=600)
+    assert not eng.has_work, "engine failed to drain under chaos"
+    for h, ref in zip(handles, workload_refs):
+        assert h.done, f"request {h} never reached a terminal state"
+        if h.state == RequestState.FINISHED:
+            assert h.tokens == ref, \
+                f"surviving stream diverged under {profile}/seed {seed}"
+    # The engine is still serviceable after the storm (plan exhausted
+    # its injection cap, so this completes clean).
+    p, n = _WORKLOAD[0]
+    again = eng.submit(p, n)
+    eng.run(max_steps=100)
+    assert again.tokens == workload_refs[0]
+
+
+# -------------------------------------------------------- targeted faults
+def test_transient_tick_retry_recovers_in_place(gpt_setup,
+                                                pin_zero_recompiles):
+    """A transient burst within max_retries recovers inside the retry
+    loop: same stream, retries counted, no replay charged."""
+    model, variables = gpt_setup
+    p, n = (np.arange(7) * 4 + 3) % 32, 6
+    ref = _ref_greedy(model, variables, p, n)
+    plan = FaultPlan(scheduled=[FaultSpec(step=2, site="tick",
+                                          kind=FaultKind.TRANSIENT,
+                                          count=2)])
+    eng = pin_zero_recompiles(ServeEngine(
+        model, variables, max_slots=2, prefill_len=16, fault_plan=plan,
+        max_retries=3, backoff_sleep=_no_sleep))
+    h = eng.submit(p, n)
+    eng.run(max_steps=100)
+    assert h.state == RequestState.FINISHED
+    assert h.tokens == ref
+    assert eng.metrics.retries == 2
+    assert eng.metrics.retry_sites == {"tick": 2}
+    assert eng.metrics.replays == 0
+
+
+def test_tick_retries_exhausted_replays_token_exact(gpt_setup,
+                                                    pin_zero_recompiles):
+    """Past the retry budget the live slots' KV is declared lost: both
+    running requests replay (prompt re-prefilled, emitted tokens re-fed
+    through the fused tick) and still finish token-exact."""
+    model, variables = gpt_setup
+    reqs = [((np.arange(8) * 3 + 1) % 32, 7), ((np.arange(5) + 9) % 32, 6)]
+    refs = [_ref_greedy(model, variables, p, n) for p, n in reqs]
+    plan = FaultPlan(scheduled=[FaultSpec(step=3, site="tick",
+                                          kind=FaultKind.TRANSIENT,
+                                          count=8)])
+    eng = pin_zero_recompiles(ServeEngine(
+        model, variables, max_slots=2, prefill_len=16, fault_plan=plan,
+        max_retries=2, backoff_sleep=_no_sleep))
+    handles = [eng.submit(p, n) for p, n in reqs]
+    eng.run(max_steps=100)
+    for h, ref in zip(handles, refs):
+        assert h.state == RequestState.FINISHED
+        assert h.tokens == ref
+        assert h.replays == 1
+    assert eng.metrics.replays == 2
+    assert eng.metrics.retries == 2  # the budget's two actual retries
+
+
+def test_replay_budget_exhausted_fails_request_not_engine(gpt_setup):
+    """Every tick failing forever: requests settle FAILED/ERROR after
+    max_replays instead of crash-looping; the engine survives and keeps
+    answering."""
+    model, variables = gpt_setup
+    plan = FaultPlan(sites=("tick",), transient_rate=1.0)
+    eng = ServeEngine(model, variables, max_slots=2, prefill_len=16,
+                      fault_plan=plan, max_retries=1, max_replays=2,
+                      backoff_sleep=_no_sleep)
+    handles = [eng.submit((np.arange(4) + i) % 32, 5) for i in range(2)]
+    eng.run(max_steps=60)
+    assert not eng.has_work
+    for h in handles:
+        assert h.state == RequestState.FAILED
+        assert h.finish_reason == FinishReason.ERROR
+        assert h.replays == 3  # budget + the final straw
+        assert len(h.tokens) == 1  # the admission-time first token
+    snap = eng.metrics.snapshot()
+    assert snap["requests_failed"] == 2
+    assert snap["requests_finished"] == 0
+    assert eng.step() == 0  # still alive, just idle
+
+
+def test_oom_degrades_flushes_and_rearms(gpt_setup):
+    """RESOURCE_EXHAUSTED on the gather path: unpinned pool blocks are
+    flushed, donations stop, serving continues cold and token-exact,
+    and the prefix cache re-arms (hits resume) after the cool-down."""
+    model, variables = gpt_setup
+    clock = _FakeClock()
+    p = (np.arange(12) * 5 + 1) % 32
+    ref = _ref_greedy(model, variables, p, 4)
+    plan = FaultPlan()
+    eng = ServeEngine(model, variables, max_slots=1, prefill_len=16,
+                      clock=clock, fault_plan=plan,
+                      degraded_cooldown_s=5.0, backoff_sleep=_no_sleep)
+    assert eng.prefix_cache_enabled
+    h0 = eng.submit(p, 4)
+    eng.run(max_steps=50)
+    assert h0.tokens == ref
+    assert eng._prefix.blocks_live > 0
+    assert eng.prefix_pool_nbytes > 0  # the sheddable-HBM gauge
+    # The NEXT admission's gather (a prefix hit on p's chain) OOMs.
+    plan._sched[(_next_step(eng), "gather")] = [FaultKind.OOM]
+    h1 = eng.submit(p, 4)
+    eng.run(max_steps=50)
+    assert h1.state == RequestState.FINISHED
+    assert h1.tokens == ref  # replayed cold, still exact
+    assert h1.replays == 1
+    assert eng.degraded
+    assert eng._prefix.blocks_live == 0  # flushed (nothing was pinned)
+    assert eng.metrics.degraded_entries == 1
+    # While degraded: no lookups, no donations, still exact.
+    lookups_during = eng.metrics.prefix_lookups
+    h2 = eng.submit(p, 4)
+    eng.run(max_steps=50)
+    assert h2.tokens == ref
+    assert eng.metrics.prefix_lookups == lookups_during
+    assert eng._prefix.blocks_live == 0
+    # Past the cool-down the cache re-arms: donation resumes, then hits.
+    clock.now += 6.0
+    h3 = eng.submit(p, 4)
+    eng.run(max_steps=50)
+    assert not eng.degraded
+    assert eng.metrics.degraded_time_s > 0
+    assert h3.tokens == ref
+    assert eng._prefix.blocks_live > 0  # donated again
+    hits_before = eng.metrics.prefix_hits
+    h4 = eng.submit(p, 4)
+    eng.run(max_steps=50)
+    assert h4.tokens == ref
+    assert eng.metrics.prefix_hits == hits_before + 1  # cache is back
+
+
+def test_real_error_on_donated_program_never_redispatches(gpt_setup):
+    """A REAL device error (not an injected pre-dispatch fault) from a
+    donated-buffer program may have consumed its input, so the engine
+    must escalate immediately — rebuild the slot pool and replay —
+    instead of retrying into a deleted array. Simulated with a fake
+    XlaRuntimeError from the insert program."""
+    model, variables = gpt_setup
+    FakeXla = type("XlaRuntimeError", (RuntimeError,), {})
+    reqs = [((np.arange(6) * 3 + 2) % 32, 6), ((np.arange(9) + 5) % 32, 5)]
+    refs = [_ref_greedy(model, variables, p, n) for p, n in reqs]
+    eng = ServeEngine(model, variables, max_slots=2, prefill_len=16,
+                      backoff_sleep=_no_sleep)
+    eng.warmup()
+    h0 = eng.submit(*reqs[0])
+    eng.step()
+    assert h0.state == RequestState.RUNNING
+    real_insert, calls = eng._insert_p, {"n": 0}
+
+    def flaky_insert(*args):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise FakeXla("INTERNAL: interconnect hiccup mid-dispatch")
+        return real_insert(*args)
+
+    eng._insert_p = flaky_insert
+    try:
+        h1 = eng.submit(*reqs[1])
+        eng.run(max_steps=100)
+    finally:
+        eng._insert_p = real_insert
+    for h, ref in zip((h0, h1), refs):
+        assert h.state == RequestState.FINISHED
+        assert h.tokens == ref
+    # Escalated, not retried: the failing dispatch was never re-issued
+    # (call 2 is the replay admission's fresh insert), the mid-stream
+    # neighbor was replayed off the rebuilt pool cache too.
+    assert eng.metrics.retries == 0
+    assert h0.replays == 1 and h1.replays == 1
+
+
+# -------------------------------------------------------- drain / restore
+def _drain_restore_roundtrip(model, variables, eng_a, snapshot_source):
+    """Restore ``snapshot_source`` into a fresh engine and pin every
+    stream token-exact against the fault-free reference."""
+    eng_b = ServeEngine(model, variables, max_slots=2, prefill_len=16)
+    restored = eng_b.restore(snapshot_source)
+    eng_b.run(max_steps=200)
+    return eng_b, restored
+
+
+def test_sigterm_drain_restore_roundtrip(gpt_setup, tmp_path):
+    """The acceptance round-trip: SIGTERM → flag → drain at the next
+    step boundary (snapshot on disk, admission stopped) → fresh engine
+    restores → every in-flight request resumes token-exactly."""
+    model, variables = gpt_setup
+    reqs = [((np.arange(6) * 3 + 2) % 32, 8), ((np.arange(9) + 4) % 32, 7),
+            ((np.arange(5) * 7 + 1) % 32, 6), ((np.arange(7) + 11) % 32, 5)]
+    refs = [_ref_greedy(model, variables, p, n) for p, n in reqs]
+    path = str(tmp_path / "serve_drain.json")
+    eng_a = ServeEngine(model, variables, max_slots=2, prefill_len=16)
+    eng_a.install_drain_handler(path)
+    try:
+        handles_a = [eng_a.submit(p, n) for p, n in reqs]
+        for _ in range(3):
+            eng_a.step()
+        # Two running mid-stream, two still queued.
+        assert sum(h.state == RequestState.RUNNING for h in handles_a) == 2
+        partial = [list(h.tokens) for h in handles_a]
+        assert any(partial)
+        signal.raise_signal(signal.SIGTERM)
+        assert eng_a.step() == 0  # the drain step emits nothing
+    finally:
+        eng_a.uninstall_drain_handler()
+    assert eng_a.drained and not eng_a.has_work
+    with pytest.raises(RuntimeError, match="drained"):
+        eng_a.submit(reqs[0][0], 2)
+    eng_b, restored = _drain_restore_roundtrip(model, variables, eng_a, path)
+    assert len(restored) == 4
+    # Drain order is running-first; match each restored handle to its
+    # original by prompt.
+    by_prompt = {tuple(h.request.prompt): h for h in restored}
+    for (p, n), ref, part in zip(reqs, refs, partial):
+        h = by_prompt[tuple(int(t) for t in p)]
+        assert h.state == RequestState.FINISHED
+        assert h.tokens == ref          # full stream, token-exact
+        assert h.tokens[:len(part)] == part  # resumed, not re-sampled
+    # Previously-running requests keep their measured TTFT.
+    assert by_prompt[tuple(int(t) for t in reqs[0][0])].ttft_s is not None
+
+
+def test_kill_point_mid_step_state_still_drainable(gpt_setup):
+    """A hard kill-point (BaseException) aborts step() like a real
+    SIGKILL would; the host-side request state survives, drains, and
+    restores token-exactly — the harshest recovery path."""
+    model, variables = gpt_setup
+    reqs = [((np.arange(8) * 5 + 3) % 32, 7), ((np.arange(6) + 1) % 32, 6),
+            ((np.arange(10) * 3 + 9) % 32, 5)]
+    refs = [_ref_greedy(model, variables, p, n) for p, n in reqs]
+    plan = FaultPlan(scheduled=[FaultSpec(step=2, site="tick",
+                                          kind=FaultKind.KILL)])
+    eng_a = ServeEngine(model, variables, max_slots=2, prefill_len=16,
+                        fault_plan=plan, backoff_sleep=_no_sleep)
+    handles = [eng_a.submit(p, n) for p, n in reqs]
+    with pytest.raises(KillPoint):
+        eng_a.run(max_steps=100)
+    assert any(h.tokens for h in handles)  # it died mid-flight
+    snapshot = eng_a.drain()
+    assert len(snapshot["requests"]) == 3
+    eng_b, restored = _drain_restore_roundtrip(model, variables, eng_a,
+                                               snapshot)
+    by_prompt = {tuple(h.request.prompt): h for h in restored}
+    for (p, n), ref in zip(reqs, refs):
+        h = by_prompt[tuple(int(t) for t in p)]
+        assert h.state == RequestState.FINISHED
+        assert h.tokens == ref
+
+
+def test_drain_preserves_remaining_deadline_budget(gpt_setup):
+    """Deadline semantics survive the round trip: wall budget consumed
+    before the drain stays consumed in the restoring engine."""
+    model, variables = gpt_setup
+    clock_a = _FakeClock()
+    eng_a = ServeEngine(model, variables, max_slots=1, prefill_len=16,
+                        clock=clock_a)
+    h = eng_a.submit(np.arange(4) % 32, 30, deadline_s=10.0)
+    eng_a.step()
+    clock_a.now = 7.0  # 7s of the 10s budget burned
+    snapshot = eng_a.drain()
+    clock_b = _FakeClock()
+    clock_b.now = 100.0  # a different epoch entirely
+    eng_b = ServeEngine(model, variables, max_slots=1, prefill_len=16,
+                        clock=clock_b)
+    (restored,) = eng_b.restore(snapshot)
+    eng_b.step()
+    assert restored.state == RequestState.RUNNING
+    clock_b.now += 4.0  # 7 + 4 > 10: the budget is spent
+    eng_b.step()
+    assert restored.state == RequestState.TIMED_OUT
+
+
+# ---------------------------------------------------- backpressure hints
+def test_queue_full_carries_retry_after_hint(gpt_setup):
+    model, variables = gpt_setup
+    clock = _FakeClock()
+    eng = ServeEngine(model, variables, max_slots=1, prefill_len=16,
+                      max_queue_depth=2, clock=clock)
+    # Cold engine: no admission history yet, the hint is honestly None.
+    cold = ServeEngine(model, variables, max_slots=1, prefill_len=16,
+                       max_queue_depth=1)
+    cold.submit(np.arange(4) % 32, 2)
+    with pytest.raises(QueueFull) as exc:
+        cold.submit(np.arange(4) % 32, 2)
+    assert exc.value.retry_after_s is None
+    # Build admission history at ~1 admission/s.
+    for i in range(4):
+        eng.submit((np.arange(4) + i) % 32, 2)
+        eng.run(max_steps=10)
+        clock.now += 1.0
+    # Now saturate: one long request holds the slot, two fill the queue.
+    eng.submit(np.arange(5) % 32, 30)
+    eng.step()
+    eng.submit((np.arange(5) + 1) % 32, 2)
+    eng.submit((np.arange(5) + 2) % 32, 2)
+    with pytest.raises(QueueFull) as exc:
+        eng.submit((np.arange(5) + 3) % 32, 2)
+    hint = exc.value.retry_after_s
+    assert hint is not None
+    # depth 2 x ~1s/admission: the hint scales with the queue ahead.
+    assert 1.0 <= hint <= 4.0
+    assert "retry after" in str(exc.value)
+
+
+def test_deadline_shed_at_pop_time(gpt_setup):
+    """Scheduler-level shedding: a queued handle whose deadline expired
+    is failed at pop time with FinishReason.DEADLINE — before it can
+    burn prefill budget or a slot."""
+    sched = FCFSScheduler(max_queue_depth=8)
+    fresh = RequestHandle(Request(prompt=[1, 2], max_new_tokens=2),
+                          arrival_s=0.0)
+    doomed = RequestHandle(Request(prompt=[3, 4], max_new_tokens=2,
+                                   deadline_s=5.0), arrival_s=0.0)
+    sched.submit(doomed)
+    sched.submit(fresh)
+    shed = []
+    admitted = sched.admit(2, on_expired=shed.append, now_fn=lambda: 9.0)
+    assert admitted == [fresh]
+    assert shed == [doomed]
+    assert doomed.state == RequestState.TIMED_OUT
+    assert doomed.finish_reason == FinishReason.DEADLINE
+    # Engine-level accounting: the shed lands in its own counter.
+    model, variables = gpt_setup
+    clock = _FakeClock()
+    eng = ServeEngine(model, variables, max_slots=1, prefill_len=16,
+                      clock=clock)
+    running = eng.submit(np.arange(4) % 32, 30)
+    dead = eng.submit(np.arange(5) % 32, 4, deadline_s=5.0)
+    eng.step()
+    clock.now = 6.0
+    running.cancel()
+    eng.run(max_steps=50)
+    assert dead.state == RequestState.TIMED_OUT
+    assert dead.finish_reason == FinishReason.DEADLINE
+    assert dead.tokens == []
+    snap = eng.metrics.snapshot()
+    assert snap["requests_deadline_shed"] == 1
+    assert snap["requests_timed_out"] == 0  # disjoint counters
+
+
+# -------------------------------------------------------- refcount hygiene
+def _refcount_baseline(prefix):
+    """(all refs zero, accounting exact) over the whole radix tree."""
+    stack = [prefix._root]
+    while stack:
+        node = stack.pop()
+        stack.extend(node.children.values())
+        if node is not prefix._root and node.ref != 0:
+            return False
+    return (prefix.blocks_live + prefix.blocks_free
+            == prefix.num_blocks - 1)
+
+
+@pytest.mark.chaos
+def test_cancel_storm_refcounts_return_to_baseline(gpt_setup,
+                                                   pin_zero_recompiles):
+    """A seeded storm of shared-prefix admissions — half cancelled at
+    random moments, deadlines expiring in the queue, faults injected
+    throughout — must leave every radix refcount at zero and the block
+    accounting exact once the engine drains: no unwind path may leak a
+    pinned chain."""
+    model, variables = gpt_setup
+    rng = np.random.default_rng(42)
+    clock = _FakeClock()
+    plan = FaultPlan(seed=7, transient_rate=0.05, oom_rate=0.02,
+                     max_random_injections=25, sleep_fn=_no_sleep)
+    eng = pin_zero_recompiles(ServeEngine(
+        model, variables, max_slots=2, prefill_len=16, clock=clock,
+        prefix_cache_blocks=6, max_queue_depth=64, fault_plan=plan,
+        degraded_cooldown_s=3.0, backoff_sleep=_no_sleep))
+    shared = (np.arange(8) * 3 + 2) % 32
+    handles = []
+    for round_i in range(6):
+        for j in range(4):
+            tail = rng.integers(0, 32, size=int(rng.integers(1, 7)))
+            prompt = np.concatenate([shared, tail]).astype(np.int32)[:15]
+            deadline = 4.0 if rng.random() < 0.3 else None
+            handles.append(eng.submit(prompt, int(rng.integers(2, 6)),
+                                      deadline_s=deadline))
+        for _ in range(int(rng.integers(1, 4))):
+            eng.step()
+            clock.now += 0.5
+            for h in handles:
+                if not h.done and rng.random() < 0.25:
+                    h.cancel()
+    eng.run(max_steps=400)
+    assert not eng.has_work
+    assert all(h.done for h in handles)
+    assert _refcount_baseline(eng._prefix), \
+        "cancel/fault storm leaked a pinned prefix chain"
+    # The engine is healthy: one more request completes exact.
+    clock.now += 10.0  # clear any degraded window
+    p = (np.arange(10) * 5 + 3) % 32
+    h = eng.submit(p, 4)
+    eng.run(max_steps=50)
+    assert h.tokens == _ref_greedy(model, variables, p, 4)
+    assert _refcount_baseline(eng._prefix)
+
+
+# ------------------------------------------------------------- fault plan
+def test_fault_plan_determinism_and_validation():
+    """Same seed + same call sequence = same injections; bad configs
+    are loud."""
+    def drive(plan):
+        fired = []
+        plan.on_step(0)
+        for i in range(200):
+            try:
+                plan.check("tick")
+            except Exception as e:
+                fired.append((i, type(e).__name__))
+        return fired
+
+    a = drive(FaultPlan(seed=3, transient_rate=0.1, oom_rate=0.05,
+                        sleep_fn=_no_sleep))
+    b = drive(FaultPlan(seed=3, transient_rate=0.1, oom_rate=0.05,
+                        sleep_fn=_no_sleep))
+    c = drive(FaultPlan(seed=4, transient_rate=0.1, oom_rate=0.05,
+                        sleep_fn=_no_sleep))
+    assert a and a == b
+    assert a != c
+    with pytest.raises(ValueError, match="sum to <= 1"):
+        FaultPlan(transient_rate=0.8, oom_rate=0.4)
+    with pytest.raises(ValueError, match="unknown fault site"):
+        FaultPlan(sites=("warp_core",))
+    with pytest.raises(ValueError, match="unknown scheduled site"):
+        FaultPlan(scheduled=[FaultSpec(0, "nope", FaultKind.KILL)])
+    plan = FaultPlan(seed=0, latency_rate=1.0, latency_s=2.5,
+                     max_random_injections=3, sleep_fn=_no_sleep)
+    slept = []
+    plan._sleep = slept.append
+    plan.on_step(0)
+    for _ in range(10):
+        plan.check("tick")
+    assert slept == [2.5] * 3  # latency fires, then the cap holds
+    assert plan.injected[FaultKind.LATENCY] == 3
