@@ -58,6 +58,7 @@ import numpy as np
 
 from pddl_tpu.models.gpt import GPT, generate
 from pddl_tpu.serve import (
+    FaultKind,
     FaultPlan,
     QueueFull,
     RequestState,
@@ -89,8 +90,9 @@ def _log_fault_leg(faults: dict) -> None:
          f"retained {faults['throughput_retained_x']}x (pairs "
          f"{faults['throughput_retained_per_pair']}), TTFT "
          f"{faults['clean_mean_ttft_s']}s -> "
-         f"{faults['faulted_mean_ttft_s']}s, counters "
-         f"{faults['faulted_last_run_counters']}")
+         f"{faults['faulted_mean_ttft_s']}s, injected "
+         f"{faults['faults_injected_total']}, recovery "
+         f"{faults['recovery_counters_total']}")
 
 
 def _make_requests(n: int, prompt_len: int, new_tokens: int, vocab: int,
@@ -256,16 +258,23 @@ def _fault_leg(model, variables, *, n_requests: int, prompt_len: int,
         ttft = float(np.mean([h.ttft_s for h in handles
                               if h.ttft_s is not None]))
         finished = sum(h.state == RequestState.FINISHED for h in handles)
-        return delivered / dt, ttft, finished, eng
+        return delivered / dt, ttft, finished, eng, plan
 
     tps_ratios, ttft_ratios = [], []
     clean_tps_all, fault_tps_all = [], []
     clean_ttft_all, fault_ttft_all = [], []
     finished_min = n_requests
     eng_fault = None
+    # Injections and recovery work summed over ALL faulted repeats —
+    # last-run-only counters can honestly read 0 at a 1% rate, which
+    # would make the artifact look like nothing was survived.
+    injected_total = {k.value: 0 for k in FaultKind}
+    counters_total = {"retries": 0, "replays": 0, "degraded_entries": 0,
+                      "requests_failed": 0}
     for i in range(repeats):
-        c_tps, c_ttft, _, _ = run_once(0.0, seed + i)
-        f_tps, f_ttft, f_fin, eng_fault = run_once(fault_rate, seed + i)
+        c_tps, c_ttft, _, _, _ = run_once(0.0, seed + i)
+        f_tps, f_ttft, f_fin, eng_fault, plan = run_once(fault_rate,
+                                                         seed + i)
         clean_tps_all.append(c_tps)
         fault_tps_all.append(f_tps)
         clean_ttft_all.append(c_ttft)
@@ -273,8 +282,12 @@ def _fault_leg(model, variables, *, n_requests: int, prompt_len: int,
         tps_ratios.append(f_tps / c_tps)
         ttft_ratios.append(f_ttft / c_ttft)
         finished_min = min(finished_min, f_fin)
+        for kind, count in plan.injected.items():
+            injected_total[kind.value] += count
+        snap_i = eng_fault.metrics.snapshot()
+        for key in counters_total:
+            counters_total[key] += snap_i[key]
     tps_med, tps_spread = median_spread(tps_ratios)
-    snap = eng_fault.metrics.snapshot()
     return {
         "fault_rate_per_dispatch": fault_rate,
         "oom_rate_per_dispatch": fault_rate / 10.0,
@@ -289,12 +302,8 @@ def _fault_leg(model, variables, *, n_requests: int, prompt_len: int,
         "faulted_mean_ttft_s": round(median_spread(fault_ttft_all)[0], 5),
         "ttft_inflation_per_pair": [round(r, 3) for r in ttft_ratios],
         "min_requests_finished_faulted": finished_min,
-        "faulted_last_run_counters": {
-            "retries": snap["retries"],
-            "replays": snap["replays"],
-            "degraded_entries": snap["degraded_entries"],
-            "requests_failed": snap["requests_failed"],
-        },
+        "faults_injected_total": injected_total,
+        "recovery_counters_total": counters_total,
         "engine_compile_counts_faulted": eng_fault.compile_counts(),
     }
 
