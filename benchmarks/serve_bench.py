@@ -29,6 +29,19 @@ the ONLINE layer (`pddl_tpu/serve/`) the way a serving owner would:
    fault-tolerant engine degrades gracefully (ratio near 1, every
    request terminal), a fail-stop one cliffs to zero. Retries, replays,
    degraded entries, and failed-request counts land in the artifact.
+5. **Observability leg** (`--obs-only` for a standalone artifact) —
+   the tracing tax (`pddl_tpu/obs/`): the same closed-loop workload
+   with per-request tracing OFF (the default no-op tracer) vs ON
+   (spans + JSONL sink). The paired ratio is the cost of turning the
+   Dapper-style timeline on; the tracing-OFF number is directly
+   comparable to the r08 fault-leg clean throughput (same config), so
+   the artifact shows the instrumented engine did not regress the
+   uninstrumented one. `--trace out.jsonl` additionally writes a full
+   span/tick/metrics event log as a bench artifact.
+
+Every record embeds the engine's final `ServeMetrics.snapshot()`, so
+artifacts carry tail latencies (TTFT/token-latency p50/p99), not just
+throughput.
 
 Timing follows the artifact discipline of
 `pddl_tpu/utils/bench_artifact.py`: every headline number is a median
@@ -49,7 +62,9 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import shutil
 import sys
+import tempfile
 import time
 
 import jax
@@ -57,6 +72,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from pddl_tpu.models.gpt import GPT, generate
+from pddl_tpu.obs import JsonlEventLog, RequestTracer
 from pddl_tpu.serve import (
     FaultKind,
     FaultPlan,
@@ -305,7 +321,154 @@ def _fault_leg(model, variables, *, n_requests: int, prompt_len: int,
         "faults_injected_total": injected_total,
         "recovery_counters_total": counters_total,
         "engine_compile_counts_faulted": eng_fault.compile_counts(),
+        # Tail latencies, not just throughput: the faulted engine's
+        # full final snapshot rides in the artifact.
+        "serve_metrics_snapshot": eng_fault.metrics.snapshot(),
     }
+
+
+def _obs_leg(model, variables, *, n_requests: int, prompt_len: int,
+             new_tokens: int, slots: int, prefill_len: int, vocab: int,
+             repeats: int, seed: int = 5):
+    """The tracing tax: the same closed-loop workload with per-request
+    tracing OFF (the engine default — the no-op tracer) vs ON (a
+    `RequestTracer` streaming every span to a JSONL sink). PAIRED runs
+    per repeat so host-load drift cancels in the ratio. The OFF number
+    is the instrumented engine at its production default; the
+    acceptance gate compares it against the pre-obs engine's committed
+    clean throughput (r08 fault leg, identical config)."""
+    prompts = _make_requests(n_requests, prompt_len, new_tokens, vocab,
+                             seed=seed)
+    tmpdir = tempfile.mkdtemp(prefix="serve_obs_")
+
+    def run_once(tracer):
+        eng = ServeEngine(model, variables, max_slots=slots,
+                          prefill_len=prefill_len,
+                          max_queue_depth=n_requests + 1,
+                          tracer=tracer)
+        eng.warmup()
+        t0 = time.perf_counter()
+        handles = [eng.submit(p, new_tokens) for p in prompts]
+        eng.run(max_steps=200000)
+        dt = time.perf_counter() - t0
+        assert all(h.done for h in handles)
+        assert sum(len(h.tokens) for h in handles) \
+            == n_requests * new_tokens
+        return n_requests * new_tokens / dt, eng
+
+    off_tps, on_tps, ratios = [], [], []
+    spans_total = records_total = 0
+    eng_on = None
+    try:
+        for i in range(repeats):
+            t_off, _ = run_once(None)
+            with JsonlEventLog(os.path.join(tmpdir,
+                                            f"trace_{i}.jsonl")) as log:
+                tracer = RequestTracer(sink=log)
+                t_on, eng_on = run_once(tracer)
+            off_tps.append(t_off)
+            on_tps.append(t_on)
+            ratios.append(t_on / t_off)
+            spans_total += tracer.spans_finished
+            records_total += log.records_written
+    finally:
+        shutil.rmtree(tmpdir, ignore_errors=True)
+    off_med, off_spread = median_spread(off_tps)
+    on_med, _ = median_spread(on_tps)
+    ratio_med, ratio_spread = median_spread(ratios)
+    # The committed pre-obs baseline at this exact config, when present
+    # (r08's fault-leg clean run: same requests x tokens x slots) —
+    # resolved against the repo, not the caller's cwd.
+    baseline = None
+    r08 = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))),
+        "artifacts", "gpt_bench", "r08_serve_faults.json")
+    try:
+        with open(r08) as f:
+            baseline = json.load(f)["results"]["faults"][
+                "clean_tokens_per_s"]
+    except Exception:  # noqa: BLE001 - artifact absent: ratio omitted
+        pass
+    ring_last = eng_on.telemetry.summary()
+    return {
+        "n_requests": n_requests,
+        "new_tokens": new_tokens,
+        "tokens_per_s_tracing_off": round(off_med, 1),
+        "tokens_per_s_tracing_off_spread_pct": round(off_spread, 2),
+        "tokens_per_s_tracing_on": round(on_med, 1),
+        "tracing_on_over_off_x": round(ratio_med, 3),
+        "tracing_on_over_off_per_pair": [round(r, 3) for r in ratios],
+        "spread_pct": round(ratio_spread, 2),
+        "baseline_r08_clean_tokens_per_s": baseline,
+        "tracing_off_vs_r08_clean_x": (
+            round(off_med / baseline, 3) if baseline else None),
+        "trace_spans_finished_total": spans_total,
+        "trace_records_written_total": records_total,
+        "ring_ticks_recorded_last_repeat": ring_last["ticks"],
+        "ring_tick_wall_p99_s_last_repeat": round(
+            ring_last["tick_wall_p99_s"], 6),
+        "engine_compile_counts_traced": eng_on.compile_counts(),
+        "serve_metrics_snapshot": eng_on.metrics.snapshot(),
+    }
+
+
+def _write_trace_artifact(model, variables, prompts, new_tokens: int,
+                          slots: int, prefill_len: int, path: str) -> int:
+    """One fully traced closed-loop pass whose span log IS the bench
+    artifact: every request's span, every engine tick (the tracer's
+    ``emit_ticks`` stream — complete, unlike the capacity-bounded
+    ring), the ring's per-site-wall records for the final window, and
+    the final metrics snapshot — a self-contained timeline
+    (`docs/OPERATIONS.md` § Observability)."""
+    with JsonlEventLog(path) as log:
+        eng = ServeEngine(model, variables, max_slots=slots,
+                          prefill_len=prefill_len,
+                          max_queue_depth=len(prompts) + 1,
+                          tracer=RequestTracer(sink=log, emit_ticks=True))
+        eng.warmup()
+        handles = [eng.submit(p, new_tokens) for p in prompts]
+        eng.run(max_steps=200000)
+        assert all(h.done for h in handles)
+        ring = eng.telemetry
+        # The ring window is capacity-bounded; say so in the artifact
+        # instead of letting a truncated dump read as the whole run.
+        log.write({"kind": "ring_window", "recorded": len(ring),
+                   "total_ticks": ring.total_appended,
+                   "truncated": ring.total_appended > len(ring)})
+        for rec in ring.snapshot():
+            # A DISTINCT kind from the tracer's own "tick" records:
+            # ring records carry tick_wall_s/tokens/retries, tracer
+            # ticks carry wall_s/new_tokens — one kind per shape.
+            rec["kind"] = "ring_tick"
+            log.write(rec)
+        log.write({"kind": "metrics",
+                   "snapshot": eng.metrics.snapshot()})
+        return log.records_written
+
+
+def _maybe_write_trace(args, model, variables) -> None:
+    """The shared ``--trace`` leg: ONE workload shape (2x concurrent
+    closed-loop, the fault/obs-leg shape) regardless of which flag
+    combination invoked the bench."""
+    if not args.trace:
+        return
+    n = _write_trace_artifact(
+        model, variables,
+        _make_requests(2 * args.concurrent, args.prompt_len,
+                       args.new_tokens, args.vocab),
+        args.new_tokens, args.slots, args.prefill_len, args.trace)
+    _log(f"trace artifact: {n} records -> {args.trace}")
+
+
+def _log_obs_leg(obs: dict) -> None:
+    vs_r08 = obs["tracing_off_vs_r08_clean_x"]
+    _log(f"observability: {obs['tokens_per_s_tracing_off']} tok/s "
+         f"tracing off -> {obs['tokens_per_s_tracing_on']} tok/s on "
+         f"({obs['tracing_on_over_off_x']}x, pairs "
+         f"{obs['tracing_on_over_off_per_pair']}); vs r08 clean "
+         f"{f'{vs_r08}x' if vs_r08 is not None else 'n/a'}; "
+         f"{obs['trace_spans_finished_total']} spans, "
+         f"{obs['trace_records_written_total']} records")
 
 
 def _poisson_load(model, variables, offered_rps: float, n_requests: int,
@@ -402,6 +565,14 @@ def main() -> None:
     p.add_argument("--faults-only", action="store_true",
                    help="run ONLY the fault leg and write a standalone "
                         "artifact (r08_serve_faults.json)")
+    p.add_argument("--obs-only", action="store_true",
+                   help="run ONLY the observability leg (tracing "
+                        "on/off paired overhead) and write a "
+                        "standalone artifact (r09_serve_obs.json)")
+    p.add_argument("--trace", default="",
+                   help="also write a fully traced pass's span/tick/"
+                        "metrics event log to this JSONL path as a "
+                        "bench artifact")
     p.add_argument("--repeats", type=int, default=3,
                    help="timed repetitions per headline number (median "
                         "+ spread recorded)")
@@ -416,6 +587,37 @@ def main() -> None:
     variables = {"params": params}
     model_desc = (f"gpt {args.depth}x{args.embed_dim} "
                   f"(vocab {args.vocab}, max_len {args.max_len})")
+
+    if args.obs_only:
+        _log(f"observability leg only: {2 * args.concurrent} requests "
+             f"x {args.new_tokens} tokens, tracing off vs on, "
+             f"{model_desc}")
+        obs = _obs_leg(
+            model, variables, n_requests=2 * args.concurrent,
+            prompt_len=args.prompt_len, new_tokens=args.new_tokens,
+            slots=args.slots, prefill_len=args.prefill_len,
+            vocab=args.vocab, repeats=args.repeats)
+        record = {
+            "metric": "online_serving_observability_overhead",
+            "unit": "ratio (tracing on / off, paired runs)",
+            "config": {
+                "model": model_desc,
+                "slots": args.slots,
+                "prefill_len": args.prefill_len,
+                "prompt_len": args.prompt_len,
+                "observability": "per-request spans (obs/trace.py) -> "
+                                 "JSONL sink (obs/export.py); per-tick "
+                                 "telemetry ring always on "
+                                 "(obs/ring.py)",
+            },
+            "provenance": provenance(args.repeats),
+            "results": {"obs": obs},
+            "device": jax.devices()[0].device_kind,
+        }
+        _log_obs_leg(obs)
+        _maybe_write_trace(args, model, variables)
+        _write_record(record, args.out)
+        return
 
     if args.faults_only:
         _log(f"fault leg only: {2 * args.concurrent} requests x "
@@ -444,6 +646,7 @@ def main() -> None:
             "device": jax.devices()[0].device_kind,
         }
         _log_fault_leg(faults)
+        _maybe_write_trace(args, model, variables)
         _write_record(record, args.out)
         return
 
@@ -489,6 +692,9 @@ def main() -> None:
             "concurrent_engine_spread_pct": round(eng_spread, 2),
             "concurrent_speedup": round(speedup, 3),
             "engine_compile_counts_after_run": counts,
+            # Tail latencies for the head-to-head engine run, not just
+            # the throughput headline.
+            "serve_metrics_snapshot": eng.metrics.snapshot(),
             "poisson": [],
         },
         "device": jax.devices()[0].device_kind,
@@ -519,6 +725,15 @@ def main() -> None:
             repeats=args.repeats)
         record["results"]["faults"] = faults
         _log_fault_leg(faults)
+
+    obs = _obs_leg(
+        model, variables, n_requests=2 * args.concurrent,
+        prompt_len=args.prompt_len, new_tokens=args.new_tokens,
+        slots=args.slots, prefill_len=args.prefill_len,
+        vocab=args.vocab, repeats=args.repeats)
+    record["results"]["obs"] = obs
+    _log_obs_leg(obs)
+    _maybe_write_trace(args, model, variables)
 
     for frac in (() if args.skip_poisson else (0.3, 0.6, 1.2)):
         res = _poisson_load(

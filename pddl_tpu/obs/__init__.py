@@ -1,0 +1,47 @@
+"""Unified observability for the serving (and training) stack.
+
+`trace.py` — Dapper-style per-request spans (queue → admission →
+prefix match → prefill chunks → decode ticks → retries/replays →
+finish), zero-cost when disabled via the no-op :class:`NullTracer`.
+`ring.py` — the engine's fixed-capacity per-tick telemetry ring.
+`export.py` — dependency-free exporters: atomic-append JSONL event
+log, Prometheus text exposition over ``ServeMetrics`` + engine +
+StepTimer + device-memory gauges, and an optional stdlib ``/metrics``
+HTTP endpoint. See docs/OPERATIONS.md § "Observability (serving)".
+"""
+
+from pddl_tpu.obs.export import (
+    SERVE_COUNTER_KEYS,
+    JsonlEventLog,
+    MetricsHTTPServer,
+    device_memory_gauges,
+    engine_gauges,
+    parse_prometheus_text,
+    read_jsonl,
+    render_prometheus,
+    serve_exposition,
+)
+from pddl_tpu.obs.ring import TelemetryRing
+from pddl_tpu.obs.trace import (
+    NULL_TRACER,
+    NullTracer,
+    RequestTracer,
+    Span,
+)
+
+__all__ = [
+    "JsonlEventLog",
+    "MetricsHTTPServer",
+    "NULL_TRACER",
+    "NullTracer",
+    "RequestTracer",
+    "SERVE_COUNTER_KEYS",
+    "Span",
+    "TelemetryRing",
+    "device_memory_gauges",
+    "engine_gauges",
+    "parse_prometheus_text",
+    "read_jsonl",
+    "render_prometheus",
+    "serve_exposition",
+]

@@ -1,0 +1,360 @@
+"""Dependency-free exporters for the observability layer.
+
+Two consumers, two formats, zero new dependencies:
+
+- **JSONL event log** (:class:`JsonlEventLog`): one schema-versioned
+  JSON object per line — span records from `obs/trace.py`, tick
+  records from the engine's telemetry ring, whatever a bench wants to
+  append. Writes are single ``os.write`` calls on an ``O_APPEND``
+  descriptor, so concurrent writers interleave at LINE granularity
+  (the same torn-write discipline `serve/drain.py` applies to its
+  snapshot) and ``tail -f`` always sees whole records.
+- **Prometheus text exposition** (:func:`render_prometheus` and the
+  :func:`serve_exposition` convenience): the v0.0.4 text format over
+  ``ServeMetrics.snapshot()`` plus engine gauges (`engine_gauges`:
+  ``prefix_pool_nbytes``, ``live_slots``, ``degraded``, per-site
+  ``compile_counts``), the training `StepTimer` snapshot, and
+  device-memory stats (`device_memory_gauges`) — training and serving
+  share one export path. The renderer enumerates EVERY key of the
+  snapshot it is handed (unknown keys render as gauges), which is what
+  makes the snapshot-drift guard in `tests/test_obs.py` structural: a
+  new counter cannot silently skip export.
+
+:func:`parse_prometheus_text` is the strict round-trip parser the
+tests pin the renderer against (and a convenience for scrape tooling);
+:class:`MetricsHTTPServer` serves ``collect()`` at ``/metrics`` from a
+stdlib ``http.server`` daemon thread for anything that scrapes.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import re
+import threading
+from typing import Callable, Dict, Mapping, Optional, Tuple
+
+SCHEMA_VERSION = 1
+
+# ---------------------------------------------------------------- JSONL
+
+
+class JsonlEventLog:
+    """Atomic-append JSONL writer: one record, one line, one write.
+
+    Each record gains ``schema`` (the event-log schema version) unless
+    it already carries one. The descriptor is opened ``O_APPEND`` and
+    every line lands in a single ``os.write``, so a reader (or a
+    second writer) never sees a torn line.
+    """
+
+    def __init__(self, path: str):
+        self.path = path
+        directory = os.path.dirname(os.path.abspath(path))
+        os.makedirs(directory, exist_ok=True)
+        self._fd: Optional[int] = os.open(
+            path, os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
+        self.records_written = 0
+
+    def write(self, record: Mapping[str, object]) -> None:
+        if self._fd is None:
+            raise ValueError(f"event log {self.path!r} is closed")
+        rec = dict(record)
+        rec.setdefault("schema", SCHEMA_VERSION)
+        line = json.dumps(rec, separators=(",", ":"),
+                          allow_nan=False, default=_json_default)
+        data = (line + "\n").encode("utf-8")
+        # os.write may land a partial write (ENOSPC, signals); finish
+        # the line before counting the record as written.
+        while data:
+            n = os.write(self._fd, data)
+            data = data[n:]
+        self.records_written += 1
+
+    def close(self) -> None:
+        if self._fd is not None:
+            os.close(self._fd)
+            self._fd = None
+
+    def __enter__(self) -> "JsonlEventLog":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def _json_default(obj):
+    """Tolerate numpy scalars riding in telemetry records."""
+    item = getattr(obj, "item", None)
+    if callable(item):
+        return item()
+    raise TypeError(f"not JSON serializable: {type(obj).__name__}")
+
+
+def read_jsonl(path: str):
+    """Parse every line of an event log (tooling/test convenience)."""
+    with open(path) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+# ----------------------------------------------------------- Prometheus
+
+# ServeMetrics.snapshot() keys that are monotonic counters (everything
+# else renders as a gauge). Keep in sync with
+# `pddl_tpu/serve/metrics.py` — the drift guard asserts every snapshot
+# key is exported either way, so a missing entry here degrades a
+# counter to a gauge, never drops it.
+SERVE_COUNTER_KEYS = frozenset({
+    "requests_finished", "requests_rejected", "requests_timed_out",
+    "requests_cancelled", "requests_failed", "requests_deadline_shed",
+    "tokens_emitted", "prefix_lookups", "prefix_hits",
+    "prefill_tokens_saved", "prefix_evictions", "retries", "replays",
+    "degraded_entries", "degraded_time_s",
+})
+
+_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+
+
+def _fmt_value(v) -> str:
+    if v is None:
+        return "NaN"
+    if isinstance(v, bool):
+        return "1" if v else "0"
+    f = float(v)
+    if math.isnan(f):
+        return "NaN"
+    if math.isinf(f):
+        return "+Inf" if f > 0 else "-Inf"
+    return repr(f) if isinstance(v, float) else str(int(v))
+
+
+def _escape_label(v: str) -> str:
+    return v.replace("\\", r"\\").replace('"', r'\"').replace("\n", r"\n")
+
+
+def render_prometheus(snapshot: Mapping[str, object], *,
+                      prefix: str = "pddl",
+                      counters: frozenset = frozenset(),
+                      help_text: Optional[Mapping[str, str]] = None) -> str:
+    """Render a flat snapshot dict as Prometheus text exposition.
+
+    EVERY key renders: scalars become ``{prefix}_{key}`` (counters per
+    ``counters`` get the conventional ``_total`` suffix), ``None``
+    renders as ``NaN`` (present-but-unobserved beats absent — a scrape
+    can tell "no samples yet" from "metric vanished"), booleans as
+    0/1, and Mapping values become one labeled series
+    ``{prefix}_{key}{{key="..."}}`` per entry (``compile_counts``,
+    per-device memory). Keys must already be exposition-legal
+    (``[a-zA-Z0-9_]``) — snapshots in this repo are.
+    """
+    lines = []
+    for key in snapshot:
+        value = snapshot[key]
+        name = f"{prefix}_{key}"
+        if not _NAME_RE.match(name):
+            raise ValueError(f"metric name {name!r} is not "
+                             "exposition-legal")
+        is_counter = key in counters
+        if is_counter and not name.endswith("_total"):
+            name += "_total"
+        if help_text and key in help_text:
+            lines.append(f"# HELP {name} {help_text[key]}")
+        if isinstance(value, Mapping):
+            lines.append(f"# TYPE {name} gauge")
+            for label_val in sorted(value):
+                lines.append(
+                    f'{name}{{key="{_escape_label(str(label_val))}"}} '
+                    f"{_fmt_value(value[label_val])}")
+        else:
+            lines.append(f"# TYPE {name} "
+                         f"{'counter' if is_counter else 'gauge'}")
+            lines.append(f"{name} {_fmt_value(value)}")
+    return "\n".join(lines) + "\n"
+
+
+_SAMPLE_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)"          # metric name
+    r"(?:\{([^{}]*)\})?"                     # optional label set
+    r" (NaN|[+-]Inf|[+-]?[0-9]*\.?[0-9]+(?:[eE][+-]?[0-9]+)?)$")
+_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+_TYPE_RE = re.compile(
+    r"^# TYPE ([a-zA-Z_:][a-zA-Z0-9_:]*) "
+    r"(counter|gauge|histogram|summary|untyped)$")
+
+
+def parse_prometheus_text(text: str) -> Tuple[
+        Dict[Tuple[str, Tuple[Tuple[str, str], ...]], float],
+        Dict[str, str]]:
+    """STRICT parse of the text exposition format.
+
+    Returns ``(samples, types)``: ``samples`` maps
+    ``(name, sorted-label-pairs)`` to the float value, ``types`` maps
+    metric name to its declared ``# TYPE``. Any line that is neither a
+    well-formed sample, a ``# TYPE``/``# HELP`` comment, nor blank
+    raises ``ValueError`` — this is the round-trip referee for
+    :func:`render_prometheus`, so leniency here would hide renderer
+    bugs.
+    """
+    samples: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], float] = {}
+    types: Dict[str, str] = {}
+    for lineno, line in enumerate(text.splitlines(), 1):
+        if not line.strip():
+            continue
+        if line.startswith("#"):
+            m = _TYPE_RE.match(line)
+            if m:
+                if m.group(1) in types:
+                    raise ValueError(
+                        f"line {lineno}: duplicate # TYPE for "
+                        f"{m.group(1)!r}")
+                types[m.group(1)] = m.group(2)
+                continue
+            if line.startswith("# HELP "):
+                continue
+            raise ValueError(f"line {lineno}: malformed comment "
+                             f"{line!r}")
+        m = _SAMPLE_RE.match(line)
+        if not m:
+            raise ValueError(f"line {lineno}: malformed sample {line!r}")
+        name, labels_raw, value = m.group(1), m.group(2), m.group(3)
+        labels: Tuple[Tuple[str, str], ...] = ()
+        if labels_raw:
+            parsed = _LABEL_RE.findall(labels_raw)
+            # Re-render to catch trailing junk the findall skipped.
+            rebuilt = ",".join(f'{k}="{v}"' for k, v in parsed)
+            if rebuilt != labels_raw.rstrip(","):
+                raise ValueError(
+                    f"line {lineno}: malformed labels {labels_raw!r}")
+            labels = tuple(sorted(parsed))
+        key = (name, labels)
+        if key in samples:
+            raise ValueError(f"line {lineno}: duplicate sample {key}")
+        samples[key] = float(value)
+    return samples, types
+
+
+# ------------------------------------------------------- gauge sources
+
+
+def engine_gauges(engine) -> Dict[str, object]:
+    """The live-engine gauges the exposition carries beyond
+    ``ServeMetrics``: slot occupancy, queue depth, the degraded flag,
+    the sheddable prefix-pool HBM, drain state, and the per-site
+    compiled-executable counts (any value above 1 in a scrape is a
+    recompile — the zero-recompile contract as a dashboard line)."""
+    return {
+        "live_slots": engine.live_slots,
+        "max_slots": engine.max_slots,
+        "queue_depth": engine.scheduler.depth,
+        "degraded": engine.degraded,
+        "drained": engine.drained,
+        "prefix_pool_nbytes": engine.prefix_pool_nbytes,
+        "compile_counts": engine.compile_counts(),
+    }
+
+
+def device_memory_gauges() -> Dict[str, object]:
+    """`utils/profiling.device_memory_stats` reshaped for the renderer:
+    one labeled series per stat, one label per device."""
+    from pddl_tpu.utils.profiling import device_memory_stats
+
+    stats = device_memory_stats()
+    out: Dict[str, Dict[str, int]] = {
+        "bytes_in_use": {}, "peak_bytes_in_use": {}, "bytes_limit": {}}
+    for dev, fields in stats.items():
+        for k in out:
+            out[k][dev] = fields[k]
+    return out
+
+
+def serve_exposition(metrics, engine=None, *,
+                     step_timer=None,
+                     device_memory: bool = False) -> str:
+    """The one scrape body: serving metrics (+ engine gauges + ring
+    summary when an engine is given), optionally the training
+    `StepTimer` snapshot and per-device memory — training and serving
+    through a single export path."""
+    parts = [render_prometheus(metrics.snapshot(), prefix="pddl_serve",
+                               counters=SERVE_COUNTER_KEYS)]
+    if engine is not None:
+        parts.append(render_prometheus(engine_gauges(engine),
+                                       prefix="pddl_serve_engine"))
+        summary = engine.telemetry.summary()
+        # The ring summary's non-scalar fields are labeled series
+        # already shaped for the renderer; drop the step window (ids,
+        # not measurements).
+        summary.pop("window_first_step", None)
+        summary.pop("window_last_step", None)
+        parts.append(render_prometheus(summary, prefix="pddl_serve_ring"))
+    if step_timer is not None:
+        parts.append(render_prometheus(
+            step_timer.snapshot(), prefix="pddl_train_step",
+            counters=frozenset({"steps_timed"})))
+    if device_memory:
+        parts.append(render_prometheus(device_memory_gauges(),
+                                       prefix="pddl_device_memory"))
+    return "".join(parts)
+
+
+# ------------------------------------------------------- HTTP endpoint
+
+
+class MetricsHTTPServer:
+    """``/metrics`` on a stdlib HTTP server (daemon thread).
+
+    ``collect`` is called per scrape and must return the exposition
+    text (build it with :func:`serve_exposition`); a raising collect
+    answers 500 with the error text instead of killing the thread.
+    ``port=0`` binds an ephemeral port (tests); read it back from
+    ``server.port``.
+    """
+
+    def __init__(self, collect: Callable[[], str],
+                 host: str = "127.0.0.1", port: int = 0):
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 - stdlib API name
+                if self.path.split("?")[0] != "/metrics":
+                    self.send_error(404, "only /metrics is served")
+                    return
+                try:
+                    body = collect().encode("utf-8")
+                except Exception as e:  # noqa: BLE001 - scrape must not kill
+                    body = f"collect failed: {e}\n".encode("utf-8")
+                    self.send_response(500)
+                else:
+                    self.send_response(200)
+                self.send_header(
+                    "Content-Type",
+                    "text/plain; version=0.0.4; charset=utf-8")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args):  # quiet: scrapes are chatty
+                pass
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self.host = host
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True,
+            name="pddl-metrics-http")
+        self._thread.start()
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}/metrics"
+
+    def close(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        self._thread.join(timeout=5.0)
+
+    def __enter__(self) -> "MetricsHTTPServer":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -116,5 +116,11 @@ def restored_handles(snapshot: Dict[str, object],
                      now_s: float) -> List[RequestHandle]:
     """Decode every request of a snapshot, preserving its order (the
     drain writes running-first FCFS order, so restore admission keeps
-    the original service order)."""
+    the original service order).
+
+    Restore reads ONLY ``requests``: the snapshot's optional
+    ``telemetry`` block (the draining engine's ring summary,
+    `obs/ring.py` — tick-wall percentiles, retries, degraded ticks in
+    the final window) is postmortem context for a human reading the
+    file, never an input to the fresh engine."""
     return [decode_handle(e, now_s) for e in snapshot["requests"]]

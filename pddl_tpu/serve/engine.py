@@ -106,6 +106,8 @@ from pddl_tpu.models.gpt import (
     set_cache_positions,
     slot_decode_cache,
 )
+from pddl_tpu.obs.ring import TelemetryRing
+from pddl_tpu.obs.trace import NULL_TRACER
 from pddl_tpu.serve import drain as drain_io
 from pddl_tpu.serve.faults import (
     InjectedResourceExhausted,
@@ -216,6 +218,17 @@ class ServeEngine:
       degraded_cooldown_s: how long an OOM keeps the prefix cache
         degraded (donations off) before re-arming; a repeat OOM inside
         the window pushes the re-arm out again.
+      tracer: optional per-request tracer
+        (:class:`~pddl_tpu.obs.trace.RequestTracer`); ``None`` installs
+        the no-op :data:`~pddl_tpu.obs.trace.NULL_TRACER` — tracing
+        disabled costs nothing (no per-tick allocation, no device
+        sync, pinned by `tests/test_obs.py`). Swap at runtime with
+        :meth:`set_tracer`.
+      telemetry_capacity: per-tick telemetry ring size
+        (:class:`~pddl_tpu.obs.ring.TelemetryRing` on
+        ``self.telemetry``): one record per ``step()`` with occupancy,
+        queue depth, tokens, retries, and per-site dispatch wall time;
+        the oldest record is overwritten, so memory is bounded forever.
     """
 
     def __init__(self, model, variables, *, max_slots: int = 8,
@@ -232,7 +245,8 @@ class ServeEngine:
                  retry_backoff_s: float = 0.02,
                  backoff_sleep=time.sleep,
                  max_replays: int = 3,
-                 degraded_cooldown_s: float = 5.0):
+                 degraded_cooldown_s: float = 5.0,
+                 tracer=None, telemetry_capacity: int = 512):
         if max_slots < 1:
             raise ValueError(f"max_slots must be >= 1, got {max_slots}")
         if getattr(model, "uses_ring_cache", False):
@@ -257,6 +271,16 @@ class ServeEngine:
             max_queue_depth=max_queue_depth,
             prefill_token_budget=prefill_token_budget)
         self.metrics = ServeMetrics()
+
+        # Observability (`pddl_tpu/obs/`): the tracer defaults to the
+        # shared no-op object, so a disabled engine pays one method
+        # call per hook and allocates nothing; the telemetry ring is
+        # always on (a dict of scalars per tick, bounded capacity).
+        self._tracer = NULL_TRACER
+        self.telemetry = TelemetryRing(telemetry_capacity)
+        self._site_wall: Dict[str, float] = {}
+        self._last_wall_s = 0.0
+        self._cur_step = 0
 
         # Resilience state (`serve/faults.py` taxonomy; docs/OPERATIONS
         # § "Failure modes & recovery").
@@ -451,6 +475,29 @@ class ServeEngine:
 
         self._cache = slot_decode_cache(dec, self.max_slots)
         self._warm = False
+        if tracer is not None:
+            self.set_tracer(tracer)
+
+    # ----------------------------------------------------- observability
+    @property
+    def tracer(self):
+        """The installed tracer (the shared no-op object when tracing
+        is disabled — check ``tracer.enabled``)."""
+        return self._tracer
+
+    def set_tracer(self, tracer) -> None:
+        """Install (or, with ``None``, remove) a per-request tracer.
+
+        Also wires the fault plan's injection observer so every
+        injected fault surfaces as an engine event with the same
+        ``(step, site)`` coordinates the plan fired at — including
+        LATENCY faults, which raise nothing and would otherwise be
+        invisible to the engine."""
+        self._tracer = NULL_TRACER if tracer is None else tracer
+        if self._faults is not None:
+            self._faults.on_inject = (
+                self._tracer.on_fault_injected if self._tracer.enabled
+                else None)
 
     # -------------------------------------------------------- submission
     def submit(self, prompt: Sequence[int], max_new_tokens: int, *,
@@ -501,6 +548,7 @@ class ServeEngine:
         except Exception:
             self.metrics.record_rejected()
             raise
+        self._tracer.on_submit(handle, self.scheduler.depth)
         return handle
 
     # ---------------------------------------------------------- plumbing
@@ -612,6 +660,7 @@ class ServeEngine:
         handle.finish_reason = reason
         handle.finish_s = self._clock()
         self.metrics.record_finish(reason.value)
+        self._tracer.on_finish(handle, reason.value)
         self._park_slot(slot_id)
 
     # --------------------------------------------------- fault handling
@@ -634,7 +683,17 @@ class ServeEngine:
             try:
                 if self._faults is not None:
                     self._faults.check(site)
-                return fn(*args)
+                t0 = time.perf_counter()
+                out = fn(*args)
+                # Dispatch wall time (the programs are async — this is
+                # host-side dispatch + any implicit transfer wait, never
+                # an added device sync), accumulated per site for the
+                # telemetry ring and handed to the tracer's
+                # prefill-chunk events via `_last_wall_s`.
+                dt = time.perf_counter() - t0
+                self._site_wall[site] = self._site_wall.get(site, 0.0) + dt
+                self._last_wall_s = dt
+                return out
             except Exception as e:
                 kind = classify(e)
                 if kind is None:
@@ -651,6 +710,7 @@ class ServeEngine:
                 if attempt > self._max_retries:
                     raise _SlotStateLost(site, e) from e
                 self.metrics.record_retry(site)
+                self._tracer.on_retry(self._cur_step, site, attempt)
                 self._backoff_sleep(
                     self._retry_backoff_s * (2 ** (attempt - 1)))
 
@@ -665,6 +725,7 @@ class ServeEngine:
             self._degraded = True
             self._degraded_entered_s = now
             self.metrics.record_degraded_entry()
+            self._tracer.on_degraded_entry(self._cur_step)
             if self._prefix_on:
                 self._prefix.flush_unpinned()
         self._degraded_until_s = now + self._degraded_cooldown_s
@@ -674,6 +735,8 @@ class ServeEngine:
         if self._degraded and now >= self._degraded_until_s:
             self._degraded = False
             self.metrics.record_degraded_exit(now - self._degraded_entered_s)
+            self._tracer.on_degraded_exit(
+                self._cur_step, now - self._degraded_entered_s)
 
     def _reset_prefix_pool(self) -> None:
         """A REAL failure of the donating scatter may have consumed the
@@ -729,8 +792,11 @@ class ServeEngine:
             handle.finish_reason = FinishReason.ERROR
             handle.finish_s = self._clock()
             self.metrics.record_finish(FinishReason.ERROR.value)
+            self._tracer.on_replay(handle, self._cur_step, False)
+            self._tracer.on_finish(handle, FinishReason.ERROR.value)
             return False
         self.metrics.record_replay()
+        self._tracer.on_replay(handle, self._cur_step, True)
         return True
 
     def _lose_live_slots(self) -> None:
@@ -787,19 +853,23 @@ class ServeEngine:
                                    max_blocks=self._match_blocks(prompt))
         return len(prompt) - match.n_blocks * self.prefix_block_size
 
-    def _prefill_into_row(self, prompt: np.ndarray):
+    def _prefill_into_row(self, prompt: np.ndarray, handle=None):
         """Prefill one prompt into a row cache, reusing any cached
         prefix: gather the matched chain into the resident row buffers,
         chunk-prefill the suffix, donate the prompt's uncovered full
-        blocks, pin the chain.
+        blocks, pin the chain. ``handle`` is the admission's request
+        (tracing only — each dispatch lands on its span).
         Returns ``(row_cache, last_logits, pinned_node_or_None)``."""
         plen = prompt.size
         bs = self.prefix_block_size
+        tr = self._tracer
         if not self._prefix_on:
             padded = np.zeros((1, self.prefill_len), np.int32)
             padded[0, :plen] = prompt
             row, logits = self._device_call(
                 "prefill", self._prefill_p, self._params, padded, plen)
+            tr.on_prefill_chunk(handle, "prefill", 0, plen,
+                                self._last_wall_s)
             return row, logits, None
         # Degraded mode (post-OOM cool-down): the cache is neither
         # consulted nor grown — a pure cold chunked prefill, so serving
@@ -809,6 +879,7 @@ class ServeEngine:
             match = self._prefix.match(prompt,
                                        max_blocks=self._match_blocks(prompt))
             n_cached = match.n_blocks * bs
+            tr.on_prefix_match(handle, match.n_blocks, n_cached)
         else:
             match, n_cached = None, 0
         if n_cached > 0:
@@ -816,6 +887,8 @@ class ServeEngine:
             ids[:match.n_blocks] = match.block_ids
             row = self._device_call("gather", self._gather_p,
                                     self._pool, ids, self._row)
+            tr.on_prefill_chunk(handle, "gather", 0, n_cached,
+                                self._last_wall_s)
             self._row = row
         else:
             # Full miss: no gather dispatch — the chunks overwrite
@@ -848,6 +921,7 @@ class ServeEngine:
             row, logits = self._device_call(
                 site, prog, self._params, row, chunk_toks,
                 np.int32(w), np.int32(off))
+            tr.on_prefill_chunk(handle, site, off, w, self._last_wall_s)
             self._row = row
             off += w
         if not use_prefix:
@@ -905,6 +979,7 @@ class ServeEngine:
         def _queued_cancel(handle):
             handle.finish_s = self._clock()
             self.metrics.record_finish(FinishReason.CANCELLED.value)
+            self._tracer.on_finish(handle, FinishReason.CANCELLED.value)
 
         def _queued_expired(handle):
             # Died in the queue, shed by the scheduler at pop time:
@@ -914,6 +989,8 @@ class ServeEngine:
             # slot stays free for the next admission.
             handle.finish_s = self._clock()
             self.metrics.record_finish(FinishReason.DEADLINE.value)
+            self._tracer.on_deadline_shed(handle)
+            self._tracer.on_finish(handle, FinishReason.DEADLINE.value)
 
         # The suffix-priced cost_fn walks the radix tree per pop; only
         # pay that when a budget actually consumes the result.
@@ -959,8 +1036,9 @@ class ServeEngine:
         req = handle.request
         plen = len(req.prompt)
         replay = bool(handle.tokens)
+        self._tracer.on_admit(handle, sid, replay)
         row, logits, node = self._prefill_into_row(
-            np.asarray(req.prompt, np.int32))
+            np.asarray(req.prompt, np.int32), handle)
         t, k, p = req.sampling.as_arrays()
         try:
             self._cache = self._device_call(
@@ -984,6 +1062,7 @@ class ServeEngine:
             handle.ttft_s = now - handle.arrival_s
             self.metrics.record_first_token(handle.ttft_s)
             self.metrics.record_admission(now)
+            self._tracer.on_first_token(handle, handle.ttft_s)
         self._slots[sid] = handle
         self._positions[sid] = plen
         self._tokens[sid] = first
@@ -1018,9 +1097,17 @@ class ServeEngine:
             self.drain(self._drain_path)
         if self._drained:
             return 0
+        # The current step coordinate: the fault plan, the trace
+        # events, and the telemetry-ring record all stamp this value,
+        # so an injected fault and its observed recovery line up on
+        # identical (step, site) coordinates.
+        cur = self._step_idx
+        self._cur_step = cur
         if self._faults is not None:
-            self._faults.on_step(self._step_idx)
-        self._step_idx += 1
+            self._faults.on_step(cur)
+        self._step_idx = cur + 1
+        self._site_wall = {}
+        retries_before = self.metrics.retries
         t0 = self._clock()
         emitted_before = self.metrics.tokens_emitted
         self._maybe_rearm_degraded()
@@ -1054,6 +1141,7 @@ class ServeEngine:
                     new_tokens += 1
                     self._positions[sid] += 1
                     self._tokens[sid] = tok
+                    self._tracer.on_token(handle, cur)
                     if self.eos_token is not None and tok == self.eos_token:
                         self._evict(sid, RequestState.FINISHED,
                                     FinishReason.EOS)
@@ -1064,7 +1152,19 @@ class ServeEngine:
         self.metrics.record_tick(
             now, self.scheduler.depth, len(live), self.max_slots,
             new_tokens, now - t0)
-        return self.metrics.tokens_emitted - emitted_before
+        emitted = self.metrics.tokens_emitted - emitted_before
+        self.telemetry.append({
+            "step": cur, "t_s": now,
+            "queue_depth": self.scheduler.depth,
+            "live_slots": len(live), "tokens": emitted,
+            "tick_wall_s": now - t0,
+            "retries": self.metrics.retries - retries_before,
+            "degraded": self._degraded,
+            "site_wall_s": self._site_wall,
+        })
+        self._tracer.on_tick(cur, self.scheduler.depth, len(live),
+                             emitted, now - t0)
+        return emitted
 
     def run(self, max_steps: Optional[int] = None) -> None:
         """Drive ``step()`` until queue and slots drain (or the step
@@ -1125,7 +1225,12 @@ class ServeEngine:
             "version": drain_io.SNAPSHOT_VERSION,
             "drained_unix_s": time.time(),
             "requests": [drain_io.encode_handle(h, now) for h in handles],
+            # Last-moments telemetry (`obs/ring.py` summary): what the
+            # engine looked like going down — postmortem context the
+            # restore path ignores (`serve/drain.py`).
+            "telemetry": self.telemetry.summary(),
         }
+        self._tracer.on_drain(self._cur_step, len(handles))
         self._drained = True
         self._drain_flag = True
         if path is not None:

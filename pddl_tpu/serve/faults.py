@@ -194,6 +194,11 @@ class FaultPlan:
         self.step_idx = -1  # engine stamps this at the top of step()
         # Telemetry for tests/benches: injections per kind.
         self.injected: Dict[FaultKind, int] = {k: 0 for k in FaultKind}
+        # Injection observer (``fn(step, site, kind_value)``), wired by
+        # ``ServeEngine.set_tracer`` so every injection — LATENCY
+        # included, which raises nothing — lands in the trace with the
+        # exact (step, site) coordinate it fired at.
+        self.on_inject = None
 
     @property
     def total_injected(self) -> int:
@@ -238,6 +243,8 @@ class FaultPlan:
 
     def _fire(self, kind: FaultKind, site: str) -> None:
         self.injected[kind] += 1
+        if self.on_inject is not None:
+            self.on_inject(self.step_idx, site, kind.value)
         where = f"at step {self.step_idx}, site {site!r}"
         if kind is FaultKind.TRANSIENT:
             raise InjectedTransientError(

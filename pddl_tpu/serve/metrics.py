@@ -21,29 +21,83 @@ standard JSON-artifact shape.
 
 from __future__ import annotations
 
+import random
 from collections import deque
-from typing import Deque, Dict, List, Optional
+from typing import Deque, Dict, Iterable, List, Optional
 
 import numpy as np
 
 from pddl_tpu.utils.summary import format_table
 
 
-def _pct(values: List[float], q: float) -> Optional[float]:
-    if not values:
+def _pct(values, q: float) -> Optional[float]:
+    vals = list(values)
+    if not vals:
         return None
-    return float(np.percentile(np.asarray(values, np.float64), q))
+    return float(np.percentile(np.asarray(vals, np.float64), q))
+
+
+class Reservoir:
+    """Fixed-capacity uniform sample of an unbounded stream (Vitter's
+    algorithm R): after ``n`` observations every observation has
+    ``cap/n`` probability of being in the buffer, so percentiles and
+    means over the buffer estimate the WHOLE stream — which is what
+    keeps ``ServeMetrics.snapshot()`` stable while memory stays capped
+    under sustained load (the plain lists it replaces grew forever).
+
+    List-enough for the recording paths (``append``/``extend``/
+    ``len``/iteration/truthiness); seeded, so the same workload
+    snapshots the same numbers.
+    """
+
+    __slots__ = ("cap", "count", "_buf", "_rng")
+
+    def __init__(self, cap: int = 8192, seed: int = 0):
+        if cap < 1:
+            raise ValueError(f"reservoir cap must be >= 1, got {cap}")
+        self.cap = int(cap)
+        self.count = 0  # total observed (>= len once capped)
+        self._buf: List[float] = []
+        self._rng = random.Random(seed)
+
+    def append(self, value) -> None:
+        self.count += 1
+        if len(self._buf) < self.cap:
+            self._buf.append(value)
+        else:
+            j = self._rng.randrange(self.count)
+            if j < self.cap:
+                self._buf[j] = value
+
+    def extend(self, values: Iterable) -> None:
+        for v in values:
+            self.append(v)
+
+    def __len__(self) -> int:
+        return len(self._buf)
+
+    def __iter__(self):
+        return iter(self._buf)
+
+    def __bool__(self) -> bool:
+        return bool(self._buf)
 
 
 class ServeMetrics:
     """Accumulates engine telemetry; cheap enough to leave always-on
-    (a few floats per tick — never a device sync of its own)."""
+    (a few floats per tick — never a device sync of its own). The
+    per-sample series (TTFT, token latency, queue depth, occupancy)
+    live in capped :class:`Reservoir`\\ s — ``reservoir_cap`` samples
+    each, default ~8k — so a week of sustained load holds the same
+    memory as a minute while ``snapshot()`` percentiles keep estimating
+    the full stream."""
 
-    def __init__(self) -> None:
-        self.ttft_s: List[float] = []
-        self.token_latency_s: List[float] = []
-        self.queue_depth: List[int] = []
-        self.occupancy: List[float] = []
+    def __init__(self, reservoir_cap: int = 8192) -> None:
+        self.reservoir_cap = int(reservoir_cap)
+        self.ttft_s = Reservoir(self.reservoir_cap, seed=0)
+        self.token_latency_s = Reservoir(self.reservoir_cap, seed=1)
+        self.queue_depth = Reservoir(self.reservoir_cap, seed=2)
+        self.occupancy = Reservoir(self.reservoir_cap, seed=3)
         self.tokens_emitted = 0
         self.requests_finished = 0
         self.requests_rejected = 0
@@ -187,9 +241,9 @@ class ServeMetrics:
             "ttft_p99_s": _pct(self.ttft_s, 99),
             "token_latency_p50_s": _pct(self.token_latency_s, 50),
             "token_latency_p99_s": _pct(self.token_latency_s, 99),
-            "mean_queue_depth": (float(np.mean(self.queue_depth))
+            "mean_queue_depth": (float(np.mean(list(self.queue_depth)))
                                  if self.queue_depth else None),
-            "mean_slot_occupancy": (float(np.mean(self.occupancy))
+            "mean_slot_occupancy": (float(np.mean(list(self.occupancy)))
                                     if self.occupancy else None),
             "prefix_lookups": self.prefix_lookups,
             "prefix_hits": self.prefix_hits,

@@ -100,9 +100,14 @@ class Profiler(Callback):
 
 
 class StepTimer(Callback):
-    """Per-step wall-time stats (mean/p50/p90, compile step excluded) and
-    steady-state images/sec/chip — the per-chip number the strategies
-    multiply out (BASELINE.json metric)."""
+    """Per-step wall-time stats (mean/p50/p90/p99, compile step
+    excluded) and steady-state images/sec/chip — the per-chip number
+    the strategies multiply out (BASELINE.json metric).
+
+    :meth:`snapshot` emits the stats in the same flat-dict shape as
+    ``ServeMetrics.snapshot()`` (stable keys, ``None`` before data), so
+    the training step loop and the serving engine share one Prometheus
+    export path (`pddl_tpu/obs/export.py`)."""
 
     def __init__(self, global_batch_size: Optional[int] = None,
                  skip_steps: int = 1, verbose: int = 1):
@@ -135,6 +140,7 @@ class StepTimer(Callback):
             "step_time_mean_s": statistics.fmean(ts),
             "step_time_p50_s": ts[n // 2],
             "step_time_p90_s": ts[min(n - 1, int(0.9 * n))],
+            "step_time_p99_s": ts[min(n - 1, int(0.99 * n))],
             "steps_timed": float(n),
         }
         if self.global_batch_size:
@@ -142,6 +148,24 @@ class StepTimer(Callback):
             out["images_per_sec"] = per_sec
             out["images_per_sec_per_chip"] = per_sec / jax.device_count()
         return out
+
+    def snapshot(self) -> Dict[str, Optional[float]]:
+        """The export dict (`ServeMetrics.snapshot()` discipline):
+        every key always present, ``None`` where nothing was measured
+        yet — render with
+        ``obs.export.render_prometheus(timer.snapshot(),
+        prefix="pddl_train_step")`` or through
+        ``obs.export.serve_exposition(..., step_timer=timer)``."""
+        stats = self.stats
+        return {
+            "step_time_mean_s": stats.get("step_time_mean_s"),
+            "step_time_p50_s": stats.get("step_time_p50_s"),
+            "step_time_p90_s": stats.get("step_time_p90_s"),
+            "step_time_p99_s": stats.get("step_time_p99_s"),
+            "steps_timed": stats.get("steps_timed", 0.0),
+            "images_per_sec": stats.get("images_per_sec"),
+            "images_per_sec_per_chip": stats.get("images_per_sec_per_chip"),
+        }
 
     def on_train_end(self, state, logs):
         from pddl_tpu.core import dist

@@ -1,0 +1,444 @@
+"""Observability layer (`pddl_tpu/obs/`), CPU.
+
+The contracts under test:
+
+- **Zero-cost disabled**: with the default no-op tracer, a full engine
+  run allocates NOTHING attributable to `obs/trace.py` (tracemalloc
+  pin) — tracing off must be indistinguishable from the pre-obs
+  engine.
+- **Span timelines**: a traced request's span reconstructs the whole
+  lifecycle — queued → admitted (queue wait) → prefix match → prefill
+  chunks → first token → per-tick decode events → finish — with
+  monotone timestamps, and the JSONL sink round-trips it.
+- **Ring buffer**: capacity is respected under arbitrary load (oldest
+  overwritten, newest kept), records carry per-site dispatch wall
+  time, and the summary aggregates the window.
+- **Exporters**: the Prometheus text exposition round-trips through a
+  STRICT parser; every `ServeMetrics.snapshot()` key appears in both
+  the snapshot and the exposition (the drift guard — a new counter
+  cannot silently skip export); the stdlib `/metrics` endpoint serves
+  the same body over HTTP.
+- **Reservoirs**: `ServeMetrics` memory is bounded under sustained
+  load while snapshot percentiles stay stable (capped uniform
+  sampling), and zero-recompile holds with tracing enabled.
+"""
+
+import json
+import tracemalloc
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pddl_tpu.models.gpt import generate, tiny_gpt
+from pddl_tpu.obs import (
+    SERVE_COUNTER_KEYS,
+    JsonlEventLog,
+    MetricsHTTPServer,
+    NullTracer,
+    RequestTracer,
+    TelemetryRing,
+    engine_gauges,
+    parse_prometheus_text,
+    read_jsonl,
+    render_prometheus,
+    serve_exposition,
+)
+from pddl_tpu.serve import ServeEngine
+from pddl_tpu.serve.metrics import Reservoir, ServeMetrics
+from pddl_tpu.utils.profiling import StepTimer
+
+pytestmark = pytest.mark.obs
+
+
+@pytest.fixture(scope="module")
+def gpt_setup():
+    model = tiny_gpt(vocab_size=32, max_len=64)
+    prompt = jnp.ones((1, 8), jnp.int32)
+    params = model.init(jax.random.key(0), prompt, train=False)["params"]
+    return model, {"params": params}
+
+
+def _ref_greedy(model, variables, prompt, n_new):
+    out = generate(model, variables,
+                   jnp.asarray(prompt, jnp.int32)[None], n_new)
+    return np.asarray(out)[0, len(prompt):].tolist()
+
+
+# ---------------------------------------------------------------- tracer
+def test_disabled_tracer_allocates_nothing(gpt_setup):
+    """The zero-cost-when-disabled pin: run a real workload through an
+    engine with the default no-op tracer and assert tracemalloc saw
+    ZERO net allocations attributed to obs/trace.py."""
+    from pddl_tpu.obs import trace as trace_mod
+
+    model, variables = gpt_setup
+    eng = ServeEngine(model, variables, max_slots=2, prefill_len=16)
+    eng.warmup()
+    assert eng.tracer is trace_mod.NULL_TRACER
+    handles = [eng.submit((np.arange(5) + i) % 32, 4) for i in range(3)]
+    eng.run(max_steps=5)  # warm every code path before measuring
+    tracemalloc.start()
+    try:
+        snap_before = tracemalloc.take_snapshot()
+        eng.run(max_steps=200)
+        snap_after = tracemalloc.take_snapshot()
+    finally:
+        tracemalloc.stop()
+    assert all(h.done for h in handles)
+    trace_file = trace_mod.__file__
+    diff = snap_after.filter_traces(
+        [tracemalloc.Filter(True, trace_file)]).compare_to(
+        snap_before.filter_traces(
+            [tracemalloc.Filter(True, trace_file)]), "lineno")
+    grew = [d for d in diff if d.size_diff > 0]
+    assert not grew, f"disabled tracer allocated: {grew}"
+
+
+def test_span_timeline_reconstructs_request(gpt_setup, tmp_path,
+                                            pin_zero_recompiles):
+    """One traced request: the span carries the full queue → admission
+    → prefix match → prefill chunks → first token → decode → finish
+    timeline with monotone timestamps, and the JSONL sink holds the
+    identical record. Zero recompiles with tracing ON."""
+    model, variables = gpt_setup
+    path = str(tmp_path / "trace.jsonl")
+    log = JsonlEventLog(path)
+    tracer = RequestTracer(sink=log)
+    eng = pin_zero_recompiles(ServeEngine(
+        model, variables, max_slots=2, prefill_len=16, tracer=tracer))
+    p, n = (np.arange(10) * 3 + 1) % 32, 5
+    h = eng.submit(p, n)
+    eng.run(max_steps=50)
+    log.close()
+    assert h.tokens == _ref_greedy(model, variables, p, n)
+    assert tracer.spans_finished == 1
+    (record,) = list(tracer.finished)
+    assert record["kind"] == "span"
+    assert record["schema"] == 1
+    assert record["finish_reason"] == "length"
+    assert record["attrs"]["prompt_len"] == 10
+    assert record["attrs"]["tokens_emitted"] == n
+    assert record["attrs"]["ttft_s"] >= 0
+    names = [e["name"] for e in record["events"]]
+    assert names[0] == "queued"
+    assert "admitted" in names
+    assert "prefix_match" in names  # prefix cache is on by default
+    assert "prefill_chunk" in names
+    assert "first_token" in names
+    assert names.count("decode") == n - 1  # first token isn't a tick
+    ts = [e["t_s"] for e in record["events"]]
+    assert ts == sorted(ts), "span events out of order"
+    assert record["end_s"] >= record["start_s"]
+    admitted = next(e for e in record["events"] if e["name"] == "admitted")
+    assert admitted["queue_wait_s"] >= 0
+    chunks = [e for e in record["events"] if e["name"] == "prefill_chunk"]
+    assert all(c["wall_s"] > 0 for c in chunks)
+    # The sink's line is the same record, schema-stamped.
+    (from_disk,) = [r for r in read_jsonl(path) if r["kind"] == "span"]
+    assert from_disk == json.loads(json.dumps(record))
+
+
+def test_broken_sink_never_crashes_the_engine(gpt_setup, tmp_path):
+    """Observability must never be a fault source: a sink that closes
+    (or throws) mid-run degrades to counted no-export — the engine
+    keeps serving, drains cleanly, and the in-process deques still
+    hold the records."""
+    model, variables = gpt_setup
+    log = JsonlEventLog(str(tmp_path / "t.jsonl"))
+    tracer = RequestTracer(sink=log)
+    eng = ServeEngine(model, variables, max_slots=1, prefill_len=16,
+                      tracer=tracer)
+    h1 = eng.submit(np.arange(5) % 32, 3)
+    eng.run(max_steps=30)
+    assert h1.done
+    log.close()  # the sink dies under the engine
+    h2 = eng.submit((np.arange(6) + 1) % 32, 3)
+    eng.run(max_steps=30)
+    assert h2.done
+    assert eng.drain()["telemetry"]["ticks"] > 0  # drain event eats it
+    assert tracer.sink_errors > 0
+    assert tracer.spans_finished == 2  # records survive in-process
+
+
+def test_drain_flushes_inflight_spans(gpt_setup, tmp_path):
+    """SIGTERM-drain is exactly when a postmortem needs the spans:
+    every in-flight request's span must be flushed to the sink with
+    finish_reason 'drained' (the requests resume in a FRESH engine —
+    these records would otherwise never land)."""
+    model, variables = gpt_setup
+    path = str(tmp_path / "drain_trace.jsonl")
+    log = JsonlEventLog(path)
+    tracer = RequestTracer(sink=log)
+    eng = ServeEngine(model, variables, max_slots=1, prefill_len=16,
+                      tracer=tracer)
+    running = eng.submit(np.arange(5) % 32, 20)
+    queued = eng.submit((np.arange(6) + 1) % 32, 4)
+    for _ in range(3):
+        eng.step()
+    assert not running.done and not queued.done
+    eng.drain()
+    log.close()
+    assert not tracer.active
+    spans = [r for r in read_jsonl(path) if r["kind"] == "span"]
+    assert len(spans) == 2
+    assert all(s["finish_reason"] == "drained" for s in spans)
+    assert all(s["attrs"]["drained"] for s in spans)
+    # The running request's history survived into the flushed span.
+    by_id = {s["request_id"]: s for s in spans}
+    run_span = by_id[running.request.request_id]
+    names = [e["name"] for e in run_span["events"]]
+    assert "admitted" in names and "decode" in names
+
+
+def test_span_event_cap_drops_and_counts(gpt_setup):
+    model, variables = gpt_setup
+    tracer = RequestTracer(max_events_per_span=4)
+    eng = ServeEngine(model, variables, max_slots=1, prefill_len=16,
+                      tracer=tracer)
+    h = eng.submit(np.arange(6) % 32, 10)
+    eng.run(max_steps=50)
+    assert h.done
+    (record,) = list(tracer.finished)
+    assert len(record["events"]) == 4
+    assert record["events_dropped"] > 0
+
+
+def test_decode_events_have_their_own_budget(gpt_setup):
+    """A long stream must not crowd rare lifecycle events out of the
+    span: decode events stop at their own cap while later non-decode
+    events still land."""
+    model, variables = gpt_setup
+    tracer = RequestTracer(max_decode_events_per_span=2)
+    eng = ServeEngine(model, variables, max_slots=1, prefill_len=16,
+                      tracer=tracer)
+    h = eng.submit(np.arange(6) % 32, 10)
+    eng.run(max_steps=50)
+    assert h.done
+    (record,) = list(tracer.finished)
+    names = [e["name"] for e in record["events"]]
+    assert names.count("decode") == 2
+    assert record["events_dropped"] == 10 - 1 - 2  # the overflow
+    assert record["finish_reason"] == "length"  # finish still settled
+
+
+# ------------------------------------------------------------------ ring
+def test_ring_respects_capacity_and_order():
+    ring = TelemetryRing(capacity=4)
+    assert len(ring) == 0 and ring.last() is None
+    for i in range(11):
+        ring.append({"step": i, "tick_wall_s": 0.001 * (i + 1),
+                     "queue_depth": i, "live_slots": 1, "tokens": 2,
+                     "retries": 0, "degraded": False,
+                     "site_wall_s": {"tick": 0.001}})
+    assert len(ring) == 4
+    assert ring.total_appended == 11
+    steps = [r["step"] for r in ring.snapshot()]
+    assert steps == [7, 8, 9, 10]  # oldest evicted, order kept
+    assert ring.last()["step"] == 10
+    summary = ring.summary()
+    assert summary["ticks"] == 4
+    assert summary["tokens_emitted"] == 8
+    assert summary["site_wall_s"] == {"tick": 0.004}
+    with pytest.raises(ValueError, match="capacity"):
+        TelemetryRing(capacity=0)
+
+
+def test_engine_ring_records_per_site_wall(gpt_setup):
+    model, variables = gpt_setup
+    eng = ServeEngine(model, variables, max_slots=2, prefill_len=16,
+                      telemetry_capacity=8)
+    handles = [eng.submit((np.arange(6) + i) % 32, 3) for i in range(3)]
+    eng.run(max_steps=50)
+    assert all(h.done for h in handles)
+    assert len(eng.telemetry) <= 8
+    window = eng.telemetry.snapshot()
+    assert [r["step"] for r in window] == sorted(r["step"] for r in window)
+    # An admission step saw admission sites; every live step saw a tick.
+    sites = set()
+    for r in window:
+        sites.update(r["site_wall_s"])
+        assert r["tick_wall_s"] >= 0
+    assert "tick" in sites
+    total_tokens = sum(r["tokens"] for r in eng.telemetry.snapshot())
+    assert total_tokens <= 9  # window may have dropped early steps
+
+
+# ------------------------------------------------------------- exporters
+def test_jsonl_log_appends_whole_lines(tmp_path):
+    path = str(tmp_path / "events.jsonl")
+    with JsonlEventLog(path) as log:
+        log.write({"kind": "tick", "step": 0, "np": np.int32(3)})
+        log.write({"kind": "tick", "step": 1, "schema": 99})
+    # Reopening appends, never truncates.
+    with JsonlEventLog(path) as log:
+        log.write({"kind": "span", "step": 2})
+    records = read_jsonl(path)
+    assert [r["kind"] for r in records] == ["tick", "tick", "span"]
+    assert records[0]["schema"] == 1   # stamped
+    assert records[0]["np"] == 3       # numpy scalars serialize
+    assert records[1]["schema"] == 99  # caller's schema respected
+    with pytest.raises(ValueError, match="closed"):
+        log.write({"kind": "tick"})
+
+
+def test_prometheus_render_parses_strict():
+    snap = {"requests_finished": 3, "ttft_p50_s": 0.125,
+            "maybe_none": None, "flag": True,
+            "compile_counts": {"tick": 1, "insert": 1}}
+    text = render_prometheus(snap, prefix="pddl_serve",
+                             counters=frozenset({"requests_finished"}))
+    samples, types = parse_prometheus_text(text)
+    assert types["pddl_serve_requests_finished_total"] == "counter"
+    assert types["pddl_serve_ttft_p50_s"] == "gauge"
+    assert samples[("pddl_serve_requests_finished_total", ())] == 3.0
+    assert samples[("pddl_serve_ttft_p50_s", ())] == 0.125
+    assert np.isnan(samples[("pddl_serve_maybe_none", ())])
+    assert samples[("pddl_serve_flag", ())] == 1.0
+    assert samples[("pddl_serve_compile_counts",
+                    (("key", "tick"),))] == 1.0
+    # The parser is a real referee: malformed input is loud.
+    for bad in ("pddl metric 1", "name{unclosed 1", "name 1 2 3",
+                "# TYPE name bogus"):
+        with pytest.raises(ValueError):
+            parse_prometheus_text(bad)
+    with pytest.raises(ValueError, match="not exposition-legal"):
+        render_prometheus({"bad-key": 1})
+
+
+def test_snapshot_drift_guard_every_metric_exported(gpt_setup):
+    """THE drift guard: every counter/gauge in `ServeMetrics.snapshot()`
+    must appear in the Prometheus exposition (and every declared
+    counter key must still exist in the snapshot), so a new metric
+    cannot ship half-exported."""
+    model, variables = gpt_setup
+    eng = ServeEngine(model, variables, max_slots=2, prefill_len=16)
+    h = eng.submit(np.arange(6) % 32, 3)
+    eng.run(max_steps=30)
+    assert h.done
+    snap = eng.metrics.snapshot()
+    text = serve_exposition(eng.metrics, eng)
+    samples, types = parse_prometheus_text(text)
+    exported = {name for name, _ in samples}
+    for key in snap:
+        name = f"pddl_serve_{key}"
+        if key in SERVE_COUNTER_KEYS:
+            name += "_total"
+        assert name in exported, \
+            f"snapshot key {key!r} missing from the exposition"
+        expect = "counter" if key in SERVE_COUNTER_KEYS else "gauge"
+        assert types[name] == expect
+    # Stale declarations are drift too: every declared counter must
+    # still be a snapshot key.
+    assert SERVE_COUNTER_KEYS <= set(snap), \
+        "SERVE_COUNTER_KEYS declares a metric snapshot() no longer has"
+    # Engine gauges ride along (the ISSUE's dashboard set).
+    for gauge in ("pddl_serve_engine_live_slots",
+                  "pddl_serve_engine_degraded",
+                  "pddl_serve_engine_prefix_pool_nbytes",
+                  "pddl_serve_engine_compile_counts",
+                  "pddl_serve_ring_tick_wall_p50_s"):
+        assert any(name == gauge for name, _ in samples), gauge
+    for key in engine_gauges(eng):
+        assert f"pddl_serve_engine_{key}" in {n for n, _ in samples}
+
+
+def test_metrics_http_endpoint_scrapes(gpt_setup):
+    model, variables = gpt_setup
+    eng = ServeEngine(model, variables, max_slots=1, prefill_len=16)
+    h = eng.submit(np.arange(4) % 32, 2)
+    eng.run(max_steps=20)
+    assert h.done
+    with MetricsHTTPServer(lambda: serve_exposition(eng.metrics, eng)) \
+            as server:
+        with urllib.request.urlopen(server.url, timeout=10) as resp:
+            assert resp.status == 200
+            assert resp.headers["Content-Type"].startswith("text/plain")
+            body = resp.read().decode()
+        samples, _ = parse_prometheus_text(body)
+        assert samples[("pddl_serve_requests_finished_total", ())] == 1.0
+        # Anything but /metrics is a 404, and a scrape survives it.
+        bad = urllib.request.Request(
+            f"http://{server.host}:{server.port}/other")
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(bad, timeout=10)
+        assert exc.value.code == 404
+        with urllib.request.urlopen(server.url, timeout=10) as resp:
+            assert resp.status == 200
+
+
+def test_step_timer_routes_through_renderer():
+    """The training-side satellite: StepTimer emits the ServeMetrics
+    snapshot-dict shape (stable keys, None before data, p99 included)
+    and renders through the same Prometheus path."""
+    timer = StepTimer(global_batch_size=8, verbose=0)
+    cold = timer.snapshot()
+    assert cold["step_time_p99_s"] is None
+    assert cold["steps_timed"] == 0.0
+    timer.step_times = [0.01 * (i + 1) for i in range(100)]
+    snap = timer.snapshot()
+    assert snap["step_time_p99_s"] >= snap["step_time_p90_s"] \
+        >= snap["step_time_p50_s"]
+    assert snap["steps_timed"] == 100.0
+    assert snap["images_per_sec"] > 0
+    text = render_prometheus(snap, prefix="pddl_train_step")
+    samples, _ = parse_prometheus_text(text)
+    assert samples[("pddl_train_step_step_time_p99_s", ())] == \
+        pytest.approx(snap["step_time_p99_s"])
+    assert samples[("pddl_train_step_steps_timed", ())] == 100.0
+
+
+# ------------------------------------------------------------ reservoirs
+def test_reservoir_caps_memory_keeps_percentiles():
+    """The unbounded-growth fix: 200k samples through an 8k reservoir
+    hold 8k floats, and p50/p99 stay within a tight tolerance of the
+    true stream percentiles (uniform reservoir sampling)."""
+    rng = np.random.default_rng(0)
+    stream = rng.lognormal(mean=-3.0, sigma=0.5, size=200_000)
+    res = Reservoir(cap=8192, seed=1)
+    res.extend(stream.tolist())
+    assert len(res) == 8192
+    assert res.count == 200_000
+    sampled_p50 = np.percentile(list(res), 50)
+    sampled_p99 = np.percentile(list(res), 99)
+    true_p50 = np.percentile(stream, 50)
+    true_p99 = np.percentile(stream, 99)
+    assert abs(sampled_p50 - true_p50) / true_p50 < 0.05
+    assert abs(sampled_p99 - true_p99) / true_p99 < 0.05
+    with pytest.raises(ValueError, match="cap"):
+        Reservoir(cap=0)
+
+
+def test_serve_metrics_bounded_under_sustained_load():
+    """Drive ServeMetrics far past its cap straight through the real
+    recording paths: every reservoir stays at cap, counters stay exact,
+    and snapshot() still answers with sane percentiles."""
+    m = ServeMetrics(reservoir_cap=64)
+    for i in range(10_000):
+        m.record_tick(float(i), queue_depth=i % 7, live_slots=i % 4,
+                      total_slots=4, new_tokens=2, tick_seconds=0.001)
+        m.record_first_token(0.05)
+    assert len(m.ttft_s) == 64 and m.ttft_s.count == 10_000
+    assert len(m.token_latency_s) == 64
+    assert len(m.queue_depth) == 64
+    assert len(m.occupancy) == 64
+    snap = m.snapshot()
+    assert snap["tokens_emitted"] == 30_000  # counters stay exact
+    assert snap["ttft_p50_s"] == pytest.approx(0.05)
+    assert snap["token_latency_p99_s"] == pytest.approx(0.001)
+    assert 0.0 <= snap["mean_slot_occupancy"] <= 1.0
+
+
+def test_tracer_hook_surface_matches_null():
+    """RequestTracer must override only methods NullTracer declares —
+    the engine calls exactly the NullTracer surface, so a hook added on
+    the real tracer alone would never fire."""
+    null_hooks = {n for n in vars(NullTracer)
+                  if n.startswith("on_")}
+    real_hooks = {n for n in vars(RequestTracer)
+                  if n.startswith("on_")}
+    assert real_hooks <= null_hooks, \
+        f"RequestTracer hooks unknown to the engine: " \
+        f"{real_hooks - null_hooks}"

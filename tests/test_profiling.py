@@ -57,7 +57,11 @@ def test_step_timer_stats():
     stats = timer.stats
     assert stats["steps_timed"] == 5  # compile step skipped
     assert stats["step_time_mean_s"] > 0
+    assert stats["step_time_p99_s"] >= stats["step_time_p50_s"]
     assert stats["images_per_sec"] > 0
+    # The serving-schema snapshot carries the same numbers (the shared
+    # Prometheus export path is pinned in tests/test_obs.py).
+    assert timer.snapshot()["step_time_p99_s"] == stats["step_time_p99_s"]
     # per-chip normalization divides by the 8 fake devices
     np.testing.assert_allclose(
         stats["images_per_sec_per_chip"] * 8, stats["images_per_sec"]

@@ -35,6 +35,7 @@ import numpy as np
 import pytest
 
 from pddl_tpu.models.gpt import generate, tiny_gpt
+from pddl_tpu.obs import RequestTracer
 from pddl_tpu.serve import (
     FaultKind,
     FaultPlan,
@@ -110,14 +111,18 @@ _PROFILES = {
 def test_chaos_matrix(gpt_setup, workload_refs, pin_zero_recompiles,
                       seed, profile):
     """Seeded chaos: no crash, every request terminal, survivors
-    token-identical to the fault-free run, zero recompiles throughout.
-    (The seed-0 column doubles as the tier-1 smoke; the whole matrix is
-    fast enough to stay un-`slow`.)"""
+    token-identical to the fault-free run, zero recompiles throughout —
+    with per-request tracing ON across the whole matrix, and every
+    injected fault (LATENCY included, which raises nothing) surfacing
+    as a trace event whose (step, site) coordinates the retry events
+    then match. (The seed-0 column doubles as the tier-1 smoke; the
+    whole matrix is fast enough to stay un-`slow`.)"""
     model, variables = gpt_setup
     plan = FaultPlan(seed=seed, sleep_fn=_no_sleep, **_PROFILES[profile])
+    tracer = RequestTracer()
     eng = pin_zero_recompiles(ServeEngine(
         model, variables, max_slots=2, prefill_len=16,
-        fault_plan=plan, backoff_sleep=_no_sleep))
+        fault_plan=plan, backoff_sleep=_no_sleep, tracer=tracer))
     handles = [eng.submit(p, n) for p, n in _WORKLOAD]
     eng.run(max_steps=600)
     assert not eng.has_work, "engine failed to drain under chaos"
@@ -126,6 +131,27 @@ def test_chaos_matrix(gpt_setup, workload_refs, pin_zero_recompiles,
         if h.state == RequestState.FINISHED:
             assert h.tokens == ref, \
                 f"surviving stream diverged under {profile}/seed {seed}"
+    # Observability contract under chaos: injections and recoveries
+    # land in the trace with coordinates that line up.
+    injected_evs = tracer.events_named("fault_injected")
+    assert len(injected_evs) == plan.total_injected
+    by_kind = {}
+    for ev in injected_evs:
+        by_kind[ev["kind"]] = by_kind.get(ev["kind"], 0) + 1
+    assert by_kind == {k.value: v for k, v in plan.injected.items() if v}
+    injected_coords = {(e["step"], e["site"]) for e in injected_evs}
+    retry_evs = tracer.events_named("retry")
+    assert len(retry_evs) == eng.metrics.retries
+    for ev in retry_evs:
+        assert (ev["step"], ev["site"]) in injected_coords, \
+            f"retry at uninjected coordinate {(ev['step'], ev['site'])}"
+    assert len(tracer.events_named("replay")) \
+        == eng.metrics.replays + eng.metrics.requests_failed
+    assert len(tracer.events_named("degraded_entry")) \
+        == eng.metrics.degraded_entries
+    # Every request's span settled with its terminal reason.
+    assert tracer.spans_finished >= len(handles)
+    assert not tracer.active
     # The engine is still serviceable after the storm (plan exhausted
     # its injection cap, so this completes clean).
     p, n = _WORKLOAD[0]
@@ -157,6 +183,39 @@ def test_transient_tick_retry_recovers_in_place(gpt_setup,
     assert eng.metrics.replays == 0
 
 
+def test_scheduled_fault_surfaces_in_trace_at_exact_coordinates(
+        gpt_setup):
+    """A surgical FaultSpec at (step=2, site="tick", count=2): the
+    trace must carry exactly two fault_injected and two retry events at
+    that coordinate — the span-event/(step, site) contract the runbook's
+    replay-storm diagnosis relies on — and the recovering request's
+    span must record its replay-free finish."""
+    model, variables = gpt_setup
+    p, n = (np.arange(7) * 4 + 3) % 32, 6
+    plan = FaultPlan(scheduled=[FaultSpec(step=2, site="tick",
+                                          kind=FaultKind.TRANSIENT,
+                                          count=2)])
+    tracer = RequestTracer()
+    eng = ServeEngine(model, variables, max_slots=2, prefill_len=16,
+                      fault_plan=plan, max_retries=3,
+                      backoff_sleep=_no_sleep, tracer=tracer)
+    h = eng.submit(p, n)
+    eng.run(max_steps=100)
+    assert h.state == RequestState.FINISHED
+    injected = tracer.events_named("fault_injected")
+    assert [(e["step"], e["site"], e["kind"]) for e in injected] \
+        == [(2, "tick", "transient")] * 2
+    retries = tracer.events_named("retry")
+    assert [(e["step"], e["site"]) for e in retries] == [(2, "tick")] * 2
+    assert [e["attempt"] for e in retries] == [1, 2]
+    (span,) = list(tracer.finished)
+    assert span["finish_reason"] == "length"
+    assert span["attrs"]["replays"] == 0
+    # The ring saw the same step's retries (telemetry agreement).
+    rec = next(r for r in eng.telemetry.snapshot() if r["step"] == 2)
+    assert rec["retries"] == 2
+
+
 def test_tick_retries_exhausted_replays_token_exact(gpt_setup,
                                                     pin_zero_recompiles):
     """Past the retry budget the live slots' KV is declared lost: both
@@ -179,6 +238,37 @@ def test_tick_retries_exhausted_replays_token_exact(gpt_setup,
         assert h.replays == 1
     assert eng.metrics.replays == 2
     assert eng.metrics.retries == 2  # the budget's two actual retries
+
+
+def test_replay_admission_queue_wait_counts_from_requeue(gpt_setup):
+    """The replay 'admitted' event's queue_wait_s measures time since
+    the REQUEUE, not since the original submit — otherwise the first
+    service attempt reads as scheduler backlog in the timeline."""
+    model, variables = gpt_setup
+    clock = _FakeClock()
+    plan = FaultPlan(scheduled=[FaultSpec(step=3, site="tick",
+                                          kind=FaultKind.TRANSIENT,
+                                          count=8)])
+    tracer = RequestTracer(clock=clock)
+    eng = ServeEngine(model, variables, max_slots=1, prefill_len=16,
+                      clock=clock, fault_plan=plan, max_retries=2,
+                      backoff_sleep=_no_sleep, tracer=tracer)
+    h = eng.submit((np.arange(8) * 3 + 1) % 32, 7)
+    for _ in range(100):
+        if h.done:
+            break
+        eng.step()
+        clock.now += 1.0
+    assert h.state == RequestState.FINISHED
+    assert h.replays == 1
+    (span,) = list(tracer.finished)
+    admits = [e for e in span["events"] if e["name"] == "admitted"]
+    assert [a["replay"] for a in admits] == [False, True]
+    # One fake-clock second passed between the requeue (mid-step 3)
+    # and the replay admission (step 4); the original admission was
+    # four seconds before that.
+    assert admits[1]["queue_wait_s"] == 1.0
+    assert span["duration_s"] > admits[1]["queue_wait_s"]
 
 
 def test_replay_budget_exhausted_fails_request_not_engine(gpt_setup):
