@@ -39,6 +39,104 @@ from pddl_tpu.train.state import TrainState
 V5E_BF16_PEAK_FLOPS = 197e12
 
 
+def _write_record(path: str, record: dict) -> None:
+    """The one artifact-writing convention (both legs use it)."""
+    if not path:
+        return
+    out_dir = os.path.dirname(path)
+    if out_dir:
+        os.makedirs(out_dir, exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(record, f, indent=1)
+
+
+def _checkpoint_overhead_leg(args, state, jstep, tokens, targets) -> None:
+    """Paired leg: the same N-step loop with verified step-granular
+    checkpointing on vs off (`utils/bench_artifact.py` discipline:
+    >=3 repeats, median + spread, provenance). The checkpointed leg
+    pays what `CheckpointEveryN` pays in training: a host fetch of the
+    state for per-leaf checksums at each save, the (async) Orbax write
+    overlapping subsequent steps, and one wait at the end — against
+    compute that keeps running between saves. Writes ONE JSON record
+    (the `--out` artifact: `artifacts/gpt_bench/r10_train_faults.json`).
+    """
+    import shutil
+    import tempfile
+
+    from pddl_tpu.ckpt.checkpoint import Checkpointer
+    from pddl_tpu.utils.bench_artifact import provenance, timed_stats
+
+    ckpt_dir = args.ckpt_dir or tempfile.mkdtemp(prefix="pddl_ckpt_bench_")
+    holder = {"state": state}
+
+    def run_clean():
+        for _ in range(args.steps):
+            holder["state"], loss = jstep(holder["state"], tokens, targets)
+        return loss
+
+    saves_per_repeat = args.steps // args.ckpt_every
+    # ONE manager across repeats, warmed with a throwaway save: the
+    # first Orbax save pays directory/manager setup that a long-running
+    # training job amortizes to nothing — timing it would charge the
+    # steady-state cadence for a one-time cost.
+    ckpt = Checkpointer(ckpt_dir, max_to_keep=2, async_save=True)
+    ckpt.save(holder["state"], force=True, checksum=True)
+    ckpt.wait()
+
+    def run_ckpt():
+        for i in range(args.steps):
+            holder["state"], loss = jstep(holder["state"], tokens,
+                                          targets)
+            if (i + 1) % args.ckpt_every == 0:
+                ckpt.save(holder["state"], force=True, checksum=True)
+        ckpt.wait()
+        return loss
+
+    sync = lambda loss: float(loss)  # noqa: E731 - scalar fetch = sync
+    clean = timed_stats(run_clean, sync, n_repeats=args.repeats)
+    ckpt_on = timed_stats(run_ckpt, sync, n_repeats=args.repeats)
+    ckpt.close()
+    if not args.ckpt_dir:
+        shutil.rmtree(ckpt_dir, ignore_errors=True)
+
+    B, S = args.batch, args.seq
+    toks_clean = B * S * args.steps / clean["median_s"]
+    toks_ckpt = B * S * args.steps / ckpt_on["median_s"]
+    ratio = toks_ckpt / toks_clean
+    n_params = sum(x.size for x in jax.tree.leaves(holder["state"].params))
+    per_save_ms = ((ckpt_on["median_s"] - clean["median_s"])
+                   / max(saves_per_repeat, 1) * 1e3)
+    print(f"checkpoint-overhead ({n_params / 1e6:.0f}M params, "
+          f"every {args.ckpt_every} of {args.steps} steps, "
+          f"{args.repeats} repeats):", file=sys.stderr)
+    print(f"  off: {toks_clean:,.0f} tok/s  on: {toks_ckpt:,.0f} tok/s "
+          f"-> {ratio:.3f}x retained "
+          f"(~{per_save_ms:.1f} ms amortized per verified save)",
+          file=sys.stderr)
+    record = {
+        "metric": "train_checkpoint_throughput_retained",
+        "value": round(ratio, 4),
+        "unit": "ratio (checkpoint-every-N on / off, tokens/sec)",
+        "clean_tokens_per_sec": round(toks_clean, 1),
+        "checkpointed_tokens_per_sec": round(toks_ckpt, 1),
+        "amortized_ms_per_save": round(per_save_ms, 2),
+        "clean": clean,
+        "checkpointed": ckpt_on,
+        "config": {"family": args.family, "batch": B, "seq": S,
+                   "depth": args.depth, "width": args.width,
+                   "heads": args.heads, "vocab": args.vocab,
+                   "params_m": round(n_params / 1e6, 1),
+                   "attention": args.attention,
+                   "steps": args.steps, "ckpt_every": args.ckpt_every,
+                   "saves_per_repeat": saves_per_repeat,
+                   "checksums": True, "async_save": True},
+        "device": jax.devices()[0].device_kind,
+        "provenance": provenance(args.repeats),
+    }
+    print(json.dumps(record))
+    _write_record(args.out, record)
+
+
 def main() -> None:
     p = argparse.ArgumentParser()
     p.add_argument("--family", default="gpt", choices=["gpt", "llama"],
@@ -84,6 +182,26 @@ def main() -> None:
     p.add_argument("--fused-ce", type=int, default=1,
                    help="1 (default): fused head+CE via fused_lm_loss; "
                         "0: materialized logits + sparse CE")
+    p.add_argument("--attention", default="flash",
+                   choices=["flash", "reference"],
+                   help="training attention path (reference lets the "
+                        "bench run on hosts whose jax lacks the Mosaic "
+                        "kernel prerequisites, e.g. CPU CI)")
+    p.add_argument("--checkpoint-overhead", action="store_true",
+                   help="paired leg: the SAME step loop with verified "
+                        "step-granular checkpointing (Checkpointer.save "
+                        "with per-leaf checksums, CheckpointEveryN "
+                        "cadence) on vs off, >=3 timed repeats each — "
+                        "the cost of the crash-resilience layer "
+                        "(docs/OPERATIONS.md 'Failure modes & recovery "
+                        "(training)')")
+    p.add_argument("--ckpt-every", type=int, default=5,
+                   help="save cadence in steps for --checkpoint-overhead")
+    p.add_argument("--repeats", type=int, default=5,
+                   help="timed repeats per leg for --checkpoint-overhead")
+    p.add_argument("--ckpt-dir", default="",
+                   help="checkpoint directory for --checkpoint-overhead "
+                        "(default: a temp dir)")
     p.add_argument("--out", default="",
                    help="also write the JSON record to this path")
     args = p.parse_args()
@@ -100,7 +218,7 @@ def main() -> None:
     if args.family == "gpt":
         model = GPT(vocab_size=args.vocab, max_len=args.seq,
                     embed_dim=args.width, depth=args.depth,
-                    num_heads=args.heads, attention="flash",
+                    num_heads=args.heads, attention=args.attention,
                     remat=args.remat, dtype=jnp.bfloat16,
                     param_dtype=param_dtype)
     else:
@@ -110,7 +228,7 @@ def main() -> None:
                       embed_dim=args.width, depth=args.depth,
                       num_heads=args.heads, num_kv_heads=args.kv_heads,
                       intermediate_dim=args.intermediate,
-                      attention="flash", remat=args.remat,
+                      attention=args.attention, remat=args.remat,
                       moe_experts=args.experts, moe_top_k=args.moe_top_k,
                       moe_capacity_factor=args.moe_capacity,
                       dtype=jnp.bfloat16, param_dtype=param_dtype)
@@ -152,6 +270,9 @@ def main() -> None:
     jstep = jax.jit(step, donate_argnums=(0,))
     state, loss = jstep(state, tokens, targets)
     float(loss)  # scalar fetch = real sync under tunneled transports
+    if args.checkpoint_overhead:
+        _checkpoint_overhead_leg(args, state, jstep, tokens, targets)
+        return
     t0 = time.perf_counter()
     for _ in range(args.steps):
         state, loss = jstep(state, tokens, targets)
@@ -223,12 +344,7 @@ def main() -> None:
             if args.intermediate is not None
             else -(-(8 * args.width // 3) // 128) * 128)
     print(json.dumps(record))
-    if args.out:
-        out_dir = os.path.dirname(args.out)
-        if out_dir:
-            os.makedirs(out_dir, exist_ok=True)
-        with open(args.out, "w") as f:
-            json.dump(record, f, indent=1)
+    _write_record(args.out, record)
 
 
 if __name__ == "__main__":

@@ -23,9 +23,12 @@ package provides that and the mid-training story the reference lacks
 
 from pddl_tpu.ckpt.checkpoint import (
     BackupAndRestore,
+    CheckpointCorruptError,
+    CheckpointEveryN,
     Checkpointer,
     ModelCheckpoint,
     latest_epoch,
+    tree_checksums,
 )
 from pddl_tpu.ckpt.fetch import fetch_keras_resnet50_weights
 from pddl_tpu.ckpt.hf_import import load_hf_gpt2
@@ -33,9 +36,12 @@ from pddl_tpu.ckpt.keras_import import load_keras_resnet50_h5
 
 __all__ = [
     "Checkpointer",
+    "CheckpointCorruptError",
+    "CheckpointEveryN",
     "ModelCheckpoint",
     "BackupAndRestore",
     "latest_epoch",
+    "tree_checksums",
     "fetch_keras_resnet50_weights",
     "load_hf_gpt2",
     "load_keras_resnet50_h5",

@@ -112,6 +112,10 @@ class ExperimentConfig:
     # persistence
     checkpoint_dir: Optional[str] = None
     resume: bool = False
+    # Step-granular verified checkpointing cadence (CheckpointEveryN):
+    # None keeps epoch-granular saves only. With --resume, a killed run
+    # restarts MID-epoch from the newest verified save.
+    checkpoint_every_steps: Optional[int] = None
     save_path: Optional[str] = None  # final export (model.save analogue :69-72)
     # observability
     profile_dir: Optional[str] = None  # jax.profiler traces (utils/profiling)

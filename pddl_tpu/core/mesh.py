@@ -38,6 +38,25 @@ import jax
 import numpy as np
 from jax.sharding import Mesh
 
+
+def mesh_context(mesh: Mesh):
+    """Ambient-mesh context manager across jax versions.
+
+    Newer jax spells it ``jax.set_mesh`` (with ``jax.sharding.use_mesh``
+    as the intermediate name); older releases use the ``Mesh`` object's
+    own context manager. The shardings this repo passes to ``jit`` are
+    explicit ``NamedSharding``s that carry their mesh, so the ambient
+    context is belt-and-braces — but version-gating it here keeps the
+    Trainer importable and RUNNABLE on every jax the container ships
+    instead of failing at the first ``init_state``.
+    """
+    if hasattr(jax, "set_mesh"):
+        return jax.set_mesh(mesh)
+    use_mesh = getattr(jax.sharding, "use_mesh", None)
+    if use_mesh is not None:
+        return use_mesh(mesh)
+    return mesh  # old-style: `with mesh:` sets the ambient mesh
+
 # Canonical axis names, in canonical order.
 DATA_AXIS = "data"
 MODEL_AXIS = "model"

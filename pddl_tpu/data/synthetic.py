@@ -66,6 +66,14 @@ class SyntheticImageClassification:
         hi = lo + self.local_batch_size
         return {"image": images[lo:hi], "label": labels[lo:hi].astype(np.int32)}
 
+    def with_offset(self, n: int) -> "SyntheticImageClassification":
+        """The same stream positioned ``n`` batches ahead — the
+        resumable-loader protocol ``Trainer.fit(resume=...)`` uses to
+        reposition the pipeline for free (any dataset exposing
+        ``with_offset`` gets exact resume without host-side skipping)."""
+        return dataclasses.replace(
+            self, index_offset=self.index_offset + int(n))
+
     def __iter__(self) -> Iterator[Dict[str, np.ndarray]]:
         index = 0
         while True:
@@ -117,6 +125,11 @@ class SyntheticLanguageModeling:
         hi = lo + self.local_batch_size
         return {"tokens": seqs[lo:hi, :-1].astype(np.int32),
                 "targets": seqs[lo:hi, 1:].astype(np.int32)}
+
+    def with_offset(self, n: int) -> "SyntheticLanguageModeling":
+        """See :meth:`SyntheticImageClassification.with_offset`."""
+        return dataclasses.replace(
+            self, index_offset=self.index_offset + int(n))
 
     def __iter__(self) -> Iterator[Dict[str, np.ndarray]]:
         index = 0

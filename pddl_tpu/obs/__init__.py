@@ -12,6 +12,7 @@ HTTP endpoint. See docs/OPERATIONS.md § "Observability (serving)".
 
 from pddl_tpu.obs.export import (
     SERVE_COUNTER_KEYS,
+    TRAIN_COUNTER_KEYS,
     JsonlEventLog,
     MetricsHTTPServer,
     device_memory_gauges,
@@ -20,6 +21,7 @@ from pddl_tpu.obs.export import (
     read_jsonl,
     render_prometheus,
     serve_exposition,
+    train_exposition,
 )
 from pddl_tpu.obs.ring import TelemetryRing
 from pddl_tpu.obs.trace import (
@@ -44,4 +46,6 @@ __all__ = [
     "read_jsonl",
     "render_prometheus",
     "serve_exposition",
+    "train_exposition",
+    "TRAIN_COUNTER_KEYS",
 ]

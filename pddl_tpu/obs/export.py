@@ -268,6 +268,38 @@ def device_memory_gauges() -> Dict[str, object]:
     return out
 
 
+TRAIN_COUNTER_KEYS = frozenset({
+    # Trainer.fault_snapshot() keys that are monotonic counters; the
+    # rest render as gauges. The drift guard in tests/test_train_faults
+    # asserts every snapshot key exports either way.
+    "retries", "recoveries", "replayed_steps", "checkpoints_saved",
+    "checkpoint_wall_s",
+})
+
+
+def train_exposition(trainer, *, step_timer=None,
+                     device_memory: bool = False) -> str:
+    """The training scrape body: the Trainer's fault/recovery snapshot
+    (retries, in-process recoveries, replayed steps, checkpoint count
+    and wall time, per-kind injections, per-site dispatch wall,
+    compile counts — any ``compile_counts`` value above 1 on a scrape
+    is a recompile, the zero-recompile contract as a dashboard line),
+    optionally the `StepTimer` percentiles and per-device memory —
+    the SAME renderer and text format the serving engine exports
+    through, so one Prometheus config scrapes both."""
+    parts = [render_prometheus(trainer.fault_snapshot(),
+                               prefix="pddl_train",
+                               counters=TRAIN_COUNTER_KEYS)]
+    if step_timer is not None:
+        parts.append(render_prometheus(
+            step_timer.snapshot(), prefix="pddl_train_step",
+            counters=frozenset({"steps_timed"})))
+    if device_memory:
+        parts.append(render_prometheus(device_memory_gauges(),
+                                       prefix="pddl_device_memory"))
+    return "".join(parts)
+
+
 def serve_exposition(metrics, engine=None, *,
                      step_timer=None,
                      device_memory: bool = False) -> str:

@@ -176,6 +176,27 @@ class NullTracer:
     def on_drain(self, step: int, n_requests: int) -> None:
         """Engine drained (snapshot taken)."""
 
+    # ------------------------------------------------- training hooks
+    # The Trainer's guarded boundary (`train/loop.py`) emits through
+    # the SAME tracer surface the serving engine uses — `on_retry` and
+    # `on_fault_injected` above are shared verbatim (the (step, site)
+    # coordinate is the optimizer step and compiled-program name);
+    # these three cover what only training has: checkpoints and the
+    # restore+replay recovery.
+
+    def on_checkpoint_saved(self, step: int, wall_s: float) -> None:
+        """A step-granular verified checkpoint finished dispatching."""
+
+    def on_restore(self, step: int, restored_step: int,
+                   site: str) -> None:
+        """Training state lost at ``(step, site)``; rolled back to the
+        verified checkpoint at ``restored_step``."""
+
+    def on_recovery(self, step: int, restored_step: int,
+                    replayed: int) -> None:
+        """In-process recovery completed: ``replayed`` steps re-run
+        from the replay buffer, training resumes at ``step``."""
+
 
 NULL_TRACER = NullTracer()
 
@@ -352,6 +373,20 @@ class RequestTracer(NullTracer):
     def on_degraded_exit(self, step: int, duration_s: float) -> None:
         self._engine_event("degraded_exit", step=step,
                            duration_s=duration_s)
+
+    # ------------------------------------------------- training hooks
+    def on_checkpoint_saved(self, step: int, wall_s: float) -> None:
+        self._engine_event("checkpoint_saved", step=step, wall_s=wall_s)
+
+    def on_restore(self, step: int, restored_step: int,
+                   site: str) -> None:
+        self._engine_event("restore", step=step,
+                           restored_step=restored_step, site=site)
+
+    def on_recovery(self, step: int, restored_step: int,
+                    replayed: int) -> None:
+        self._engine_event("recovery", step=step,
+                           restored_step=restored_step, replayed=replayed)
 
     def on_drain(self, step: int, n_requests: int) -> None:
         self._engine_event("drain", step=step, n_requests=n_requests)

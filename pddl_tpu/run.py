@@ -150,22 +150,34 @@ def build_trainer(cfg: ExperimentConfig, strategy=None):
 
         callbacks.append(Profiler(cfg.profile_dir))
     if cfg.checkpoint_dir:
-        if cfg.resume:
-            # Restores newest checkpoint at train start + rolls a backup
-            # every epoch; initial_epoch advances in run_experiment to match.
-            from pddl_tpu.ckpt import BackupAndRestore
-
-            callbacks.append(BackupAndRestore(cfg.checkpoint_dir))
-        else:
-            # Fresh run: only write checkpoints, never restore old state.
-            from pddl_tpu.ckpt import ModelCheckpoint
-
-            callbacks.append(ModelCheckpoint(cfg.checkpoint_dir, max_to_keep=1))
-        # Cloud-TPU preemption (SIGTERM) -> consistent save + clean stop;
-        # the next --resume run continues from it.
+        # Writers only — restore is fit(resume=...)'s job (wired in
+        # run_experiment), which restores the newest VERIFIED save and
+        # repositions the data stream mid-epoch; a second restoring
+        # callback could resurrect a corrupt latest save the resume
+        # path deliberately skipped. Keep >= 2 saves so the torn-latest
+        # fallback always has somewhere to land.
+        # Cloud-TPU preemption (SIGTERM) -> consistent save + clean
+        # stop; the next --resume run continues from it.
         from pddl_tpu.utils.preemption import PreemptionCheckpoint
 
-        callbacks.append(PreemptionCheckpoint(cfg.checkpoint_dir))
+        if cfg.checkpoint_every_steps:
+            # Step-granular verified saves subsume the epoch backup —
+            # two managers retaining different step lists on one
+            # directory would race each other's GC — and the grace
+            # save DELEGATES to the same manager for the same reason.
+            from pddl_tpu.ckpt import CheckpointEveryN
+
+            cen = CheckpointEveryN(
+                cfg.checkpoint_dir,
+                every_n_steps=cfg.checkpoint_every_steps)
+            callbacks.append(cen)
+            callbacks.append(PreemptionCheckpoint(delegate=cen))
+        else:
+            from pddl_tpu.ckpt import ModelCheckpoint
+
+            mc = ModelCheckpoint(cfg.checkpoint_dir, max_to_keep=2)
+            callbacks.append(mc)
+            callbacks.append(PreemptionCheckpoint(delegate=mc))
     return trainer, callbacks
 
 
@@ -308,13 +320,13 @@ def run_experiment(cfg: ExperimentConfig, steps_per_epoch: Optional[int] = None,
     if h5_path:
         _load_pretrained(trainer, cfg, train, h5_path)
 
-    initial_epoch = 0
-    if cfg.resume and cfg.checkpoint_dir:
-        from pddl_tpu.ckpt import latest_epoch
-
-        last = latest_epoch(cfg.checkpoint_dir)
-        if last is not None:
-            initial_epoch = last + 1
+    # Crash-resume is fit(resume=...): restores the newest VERIFIED
+    # checkpoint (torn/corrupt latest skipped), repositions the data
+    # stream from the saved loader metadata, and continues MID-epoch.
+    # An empty checkpoint directory starts fresh, so the same --resume
+    # command line serves the first launch and every restart.
+    resume = cfg.checkpoint_dir if (cfg.resume and cfg.checkpoint_dir) \
+        else None
 
     spe = steps_per_epoch or cfg.steps_per_epoch
     if cfg.data_dir is None and spe is None:
@@ -330,7 +342,7 @@ def run_experiment(cfg: ExperimentConfig, steps_per_epoch: Optional[int] = None,
         validation_steps=validation_steps or (spe and max(1, spe // 4)),
         callbacks=callbacks,
         verbose=cfg.verbose,
-        initial_epoch=initial_epoch,
+        resume=resume,
     )
 
     if cfg.save_path and strategy.is_coordinator:
@@ -524,6 +536,11 @@ def main(argv=None) -> int:
                         "(ckpt/fetch.py; off by default — TPU hosts may "
                         "have no egress)")
     p.add_argument("--checkpoint-dir", default=None)
+    p.add_argument("--checkpoint-every-steps", type=int, default=None,
+                   help="step-granular verified checkpoint cadence "
+                        "(CheckpointEveryN); a --resume restart then "
+                        "continues MID-epoch from the newest verified "
+                        "save instead of replaying the epoch")
     p.add_argument("--resume", action="store_true")
     p.add_argument("--save", dest="save_path", default=None)
     p.add_argument("--profile-dir", default=None,
@@ -548,6 +565,7 @@ def main(argv=None) -> int:
         "model": args.model, "strategy": args.strategy,
         "pretrained_h5": args.pretrained_h5,
         "checkpoint_dir": args.checkpoint_dir,
+        "checkpoint_every_steps": args.checkpoint_every_steps,
         "save_path": args.save_path, "seed": args.seed,
         "verbose": args.verbose, "profile_dir": args.profile_dir,
         "lr_schedule": args.lr_schedule, "ema_decay": args.ema_decay,

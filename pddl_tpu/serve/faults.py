@@ -1,257 +1,46 @@
 """Deterministic fault injection for the serving engine.
 
-The ROADMAP north star is heavy traffic from millions of users; at that
-scale device faults are ROUTINE, not exceptional — a transient
-``XlaRuntimeError`` from a flaky interconnect, a ``RESOURCE_EXHAUSTED``
-under HBM pressure, a latency spike from a neighbor, a SIGKILL from the
-scheduler. The engines in this lineage (Orca's iteration-level
-scheduling, vLLM's paged KV management) treat all of these as events to
-recover from; the reference repo's only failure handling is a
-``GRPC_FAIL_FAST`` toggle (SURVEY.md §5).
-
-You cannot trust a recovery path you cannot exercise, so this module
-makes faults INJECTABLE and SEEDED: a :class:`FaultPlan` hooks every
-device-call boundary of :class:`~pddl_tpu.serve.engine.ServeEngine`
-(the sites are exactly the engine's ``compile_counts()`` keys) and
-fires transient errors, allocation failures, latency spikes, or hard
-kill-points at chosen or randomly drawn ``(step, site)`` coordinates.
-Reproducible by construction: the same seed against the same workload
-injects the same faults, so every recovery path is testable in tier-1
-on CPU (``tests/test_serve_faults.py``) and measurable in
+The machinery (seeded schedule + rate draws, the fault taxonomy, the
+injection-before-dispatch discipline) lives in
+:mod:`pddl_tpu.utils.faults` and is shared with the training loop's
+:mod:`pddl_tpu.train.faults`; this module pins the SERVING site
+vocabulary: a :class:`FaultPlan` hooks every device-call boundary of
+:class:`~pddl_tpu.serve.engine.ServeEngine` (the sites are exactly the
+engine's ``compile_counts()`` keys) and fires transient errors,
+allocation failures, latency spikes, or hard kill-points at chosen or
+randomly drawn ``(step, site)`` coordinates — reproducible by
+construction, so every recovery path is testable in tier-1 on CPU
+(``tests/test_serve_faults.py``) and measurable in
 ``benchmarks/serve_bench.py``'s fault leg.
 
-Fault taxonomy and the engine's contract for each:
+The engine's contract per fault kind (details in ``engine._device_call``
+and docs/OPERATIONS.md § "Failure modes & recovery (serving)"):
 
-- **TRANSIENT** (raises :class:`InjectedTransientError`, the stand-in
-  for an ``INTERNAL``/``UNAVAILABLE`` ``XlaRuntimeError``): the call is
-  retried with bounded exponential backoff; past ``max_retries`` the
-  affected slot state is declared lost and the request(s) REPLAY.
-- **OOM** (raises :class:`InjectedResourceExhausted`, the stand-in for
-  ``RESOURCE_EXHAUSTED``): never blind-retried — the engine flips into
-  DEGRADED mode (prefix-cache donations off, unpinned pool blocks
-  flushed), the failed work replays, and the cache re-arms after a
-  cool-down.
-- **LATENCY**: the call is delayed (``sleep_fn``), nothing raises — the
-  tail-latency fault; deadlines and the drain path must keep working
-  under it.
-- **KILL** (raises :class:`KillPoint`, a ``BaseException``): simulates
-  abrupt termination mid-step. The engine never catches it — it unwinds
-  through ``step()`` like a real crash, and the test then exercises
-  drain/restore on the survivor state.
-
-Injection happens BEFORE the wrapped program dispatches, so device
-buffers (including donated ones) are never left half-consumed by an
-injected fault — which is what makes retry sound. Real device errors
-from a donated program are escalated straight to the rebuild path
-instead (see ``engine._device_call``).
+- **TRANSIENT**: bounded-backoff retry; past ``max_retries`` the slot
+  KV is declared lost and the request(s) REPLAY token-exactly.
+- **OOM**: never blind-retried — DEGRADED mode (prefix-cache donations
+  off, unpinned pool blocks flushed), re-arm after a cool-down.
+- **LATENCY**: the call is delayed; deadlines and drain keep working.
+- **KILL**: unwinds through ``step()`` like a real crash; the test then
+  exercises drain/restore on the survivor state.
 """
 
 from __future__ import annotations
 
-import dataclasses
-import enum
-import time
-from typing import Dict, List, Optional, Sequence, Tuple
-
-import numpy as np
-
-
-class FaultKind(enum.Enum):
-    TRANSIENT = "transient"  # retryable device error
-    OOM = "oom"              # RESOURCE_EXHAUSTED: degrade, don't retry
-    LATENCY = "latency"      # slow call, nothing raised
-    KILL = "kill"            # hard termination mid-step (BaseException)
+from pddl_tpu.utils.faults import (  # noqa: F401 - the serve-layer surface
+    FaultKind,
+    FaultSpec,
+    InjectedResourceExhausted,
+    InjectedTransientError,
+    KillPoint,
+    classify,
+)
+from pddl_tpu.utils.faults import FaultPlan as _BaseFaultPlan
 
 
-class InjectedTransientError(RuntimeError):
-    """Stand-in for a retryable ``XlaRuntimeError`` (INTERNAL /
-    UNAVAILABLE / ABORTED): the device call failed but nothing about
-    the engine's resident state is invalidated."""
-
-
-class InjectedResourceExhausted(RuntimeError):
-    """Stand-in for ``RESOURCE_EXHAUSTED``: an allocation failed —
-    retrying the same call without shedding memory is pointless."""
-
-
-class KillPoint(BaseException):
-    """Simulated hard kill at a (step, site) coordinate. A
-    ``BaseException`` so no retry/except-Exception path can swallow it:
-    it unwinds through ``ServeEngine.step()`` exactly like a real
-    SIGKILL would end the process mid-dispatch."""
-
-    def __init__(self, site: str, step: int):
-        self.site = site
-        self.step = step
-        super().__init__(f"injected kill-point at step {step}, site {site!r}")
-
-
-# What a fault-aware caller may see from jax itself. Classification is
-# by status-code marker in the message (jaxlib's XlaRuntimeError carries
-# the absl status string); anything unrecognized is NOT swallowed.
-_TRANSIENT_MARKERS = ("INTERNAL", "UNAVAILABLE", "ABORTED", "DATA_LOSS",
-                      "DEADLINE_EXCEEDED")
-
-
-def classify(err: BaseException) -> Optional[str]:
-    """``"transient"`` / ``"oom"`` / ``None`` (not a device fault — let
-    it propagate: a shape error or a bug must stay loud)."""
-    if isinstance(err, InjectedResourceExhausted):
-        return "oom"
-    if isinstance(err, InjectedTransientError):
-        return "transient"
-    if type(err).__name__ == "XlaRuntimeError":
-        msg = str(err)
-        if "RESOURCE_EXHAUSTED" in msg:
-            return "oom"
-        if any(m in msg for m in _TRANSIENT_MARKERS):
-            return "transient"
-    return None
-
-
-@dataclasses.dataclass(frozen=True)
-class FaultSpec:
-    """One scheduled fault: fire ``kind`` on the next ``count``
-    invocations of ``site`` during engine step ``step``. ``count``
-    matters for TRANSIENT — ``count <= max_retries`` recovers inside
-    the retry loop, ``count > max_retries`` forces the replay path."""
-
-    step: int
-    site: str
-    kind: FaultKind
-    count: int = 1
-
-
-class FaultPlan:
-    """Seeded fault schedule over the engine's device-call sites.
-
-    Two layers, both deterministic:
-
-    - ``scheduled``: explicit :class:`FaultSpec` coordinates — the
-      surgical tool (kill exactly at step 3's tick; fail the donate of
-      step 1 twice).
-    - rates: per-check Bernoulli draws from one ``np.random.default_rng
-      (seed)`` stream — the chaos tool. Given the same workload the
-      call sequence is identical, so the same seed injects the same
-      faults at the same coordinates.
-
-    Args:
-      seed: the PRNG seed (reproducibility handle).
-      transient_rate / oom_rate / latency_rate: per-call probabilities
-        (must sum to <= 1).
-      latency_s: injected delay per LATENCY fault.
-      sites: optional allowlist — random faults only fire at these
-        sites (scheduled specs are never filtered).
-      scheduled: :class:`FaultSpec` sequence.
-      max_random_injections: cap on rate-drawn faults (keeps a chaos
-        run terminating even at silly rates); ``None`` = unbounded.
-      sleep_fn: how LATENCY waits (tests pass a fake-clock advancer).
-    """
+class FaultPlan(_BaseFaultPlan):
+    """Seeded fault schedule over the engine's device-call sites
+    (== ``ServeEngine.compile_counts()`` keys)."""
 
     SITES = ("prefill", "gather", "chunk_prefill", "chunk_prefill_wide",
              "donate", "insert", "tick", "sample_first")
-
-    def __init__(self, seed: int = 0, *, transient_rate: float = 0.0,
-                 oom_rate: float = 0.0, latency_rate: float = 0.0,
-                 latency_s: float = 0.005,
-                 sites: Optional[Sequence[str]] = None,
-                 scheduled: Sequence[FaultSpec] = (),
-                 max_random_injections: Optional[int] = None,
-                 sleep_fn=time.sleep):
-        for name, rate in (("transient_rate", transient_rate),
-                           ("oom_rate", oom_rate),
-                           ("latency_rate", latency_rate)):
-            if not 0.0 <= rate <= 1.0:
-                raise ValueError(f"{name} must be in [0, 1], got {rate}")
-        if transient_rate + oom_rate + latency_rate > 1.0:
-            raise ValueError("fault rates must sum to <= 1")
-        if sites is not None:
-            unknown = set(sites) - set(self.SITES)
-            if unknown:
-                raise ValueError(
-                    f"unknown fault site(s) {sorted(unknown)}; valid "
-                    f"sites are {self.SITES}")
-        for spec in scheduled:
-            if spec.site not in self.SITES:
-                raise ValueError(
-                    f"unknown scheduled site {spec.site!r}; valid sites "
-                    f"are {self.SITES}")
-            if spec.count < 1:
-                raise ValueError(f"FaultSpec.count must be >= 1: {spec}")
-        self.seed = int(seed)
-        self._rng = np.random.default_rng(seed)
-        self._rates = (float(transient_rate), float(oom_rate),
-                       float(latency_rate))
-        self.latency_s = float(latency_s)
-        self._sites = frozenset(sites) if sites is not None else None
-        self._sched: Dict[Tuple[int, str], List[FaultKind]] = {}
-        for spec in scheduled:
-            self._sched.setdefault((spec.step, spec.site), []).extend(
-                [spec.kind] * spec.count)
-        self._max_random = max_random_injections
-        self._random_fired = 0
-        self._sleep = sleep_fn
-        self.step_idx = -1  # engine stamps this at the top of step()
-        # Telemetry for tests/benches: injections per kind.
-        self.injected: Dict[FaultKind, int] = {k: 0 for k in FaultKind}
-        # Injection observer (``fn(step, site, kind_value)``), wired by
-        # ``ServeEngine.set_tracer`` so every injection — LATENCY
-        # included, which raises nothing — lands in the trace with the
-        # exact (step, site) coordinate it fired at.
-        self.on_inject = None
-
-    @property
-    def total_injected(self) -> int:
-        return sum(self.injected.values())
-
-    def on_step(self, step_idx: int) -> None:
-        """Engine hook: the current step coordinate for scheduled specs
-        (retries within a step re-check the same coordinate, which is
-        how ``FaultSpec.count`` consumes consecutive invocations)."""
-        self.step_idx = int(step_idx)
-
-    def check(self, site: str) -> None:
-        """Called by the engine immediately before dispatching ``site``.
-        Raises / sleeps per the schedule; returns normally otherwise."""
-        key = (self.step_idx, site)
-        pending = self._sched.get(key)
-        if pending:
-            kind = pending.pop(0)
-            if not pending:
-                del self._sched[key]
-            self._fire(kind, site)
-            return
-        t, o, lat = self._rates
-        if t + o + lat <= 0.0:
-            return
-        if self._sites is not None and site not in self._sites:
-            return
-        if (self._max_random is not None
-                and self._random_fired >= self._max_random):
-            return
-        u = self._rng.random()
-        if u < t:
-            kind = FaultKind.TRANSIENT
-        elif u < t + o:
-            kind = FaultKind.OOM
-        elif u < t + o + lat:
-            kind = FaultKind.LATENCY
-        else:
-            return
-        self._random_fired += 1
-        self._fire(kind, site)
-
-    def _fire(self, kind: FaultKind, site: str) -> None:
-        self.injected[kind] += 1
-        if self.on_inject is not None:
-            self.on_inject(self.step_idx, site, kind.value)
-        where = f"at step {self.step_idx}, site {site!r}"
-        if kind is FaultKind.TRANSIENT:
-            raise InjectedTransientError(
-                f"INTERNAL: injected transient device error {where}")
-        if kind is FaultKind.OOM:
-            raise InjectedResourceExhausted(
-                f"RESOURCE_EXHAUSTED: injected allocation failure {where}")
-        if kind is FaultKind.KILL:
-            raise KillPoint(site, self.step_idx)
-        self._sleep(self.latency_s)  # LATENCY: slow, not broken

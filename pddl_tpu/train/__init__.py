@@ -17,6 +17,12 @@ from pddl_tpu.train.loop import Trainer
 from pddl_tpu.train.history import History
 from pddl_tpu.train import callbacks
 from pddl_tpu.train import metrics
+from pddl_tpu.train.faults import (
+    FaultKind,
+    FaultSpec,
+    TrainFaultPlan,
+    TrainStateLost,
+)
 
 __all__ = [
     "TrainState",
@@ -24,6 +30,10 @@ __all__ = [
     "History",
     "callbacks",
     "metrics",
+    "FaultKind",
+    "FaultSpec",
+    "TrainFaultPlan",
+    "TrainStateLost",
     "make_optimizer",
     "make_schedule",
     "get_learning_rate",

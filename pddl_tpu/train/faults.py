@@ -1,0 +1,72 @@
+"""Deterministic fault injection for the training loop.
+
+The paper's whole subject is distributed *training*, yet its only
+failure handling is a ``GRPC_FAIL_FAST`` toggle and a Horovod
+re-broadcast comment (SURVEY.md §5). The serving engine grew the full
+recovery story first (`pddl_tpu/serve/faults.py` + one guarded
+device-call boundary + token-exact replay); this module ports that
+design to the Trainer, following CheckFreq (Mohan et al., FAST '21)
+for low-overhead step-granular checkpointing and Gemini (Wang et al.,
+SOSP '23) for checkpoint-validity / fast in-memory recovery
+discipline.
+
+The machinery is :mod:`pddl_tpu.utils.faults`, unchanged; this module
+pins the TRAINING site vocabulary — the Trainer's compiled program
+names (== ``Trainer.compile_counts()`` keys):
+
+- ``train_step``: the jitted donated SPMD update. The fault contract
+  (``Trainer._device_call``): TRANSIENT retries with bounded
+  exponential backoff; exhausted retries — or any OOM, or a REAL error
+  from the donated program (whose input buffers may already be
+  consumed) — restore the last VERIFIED checkpoint **in-process** and
+  replay forward to the failed step from the Trainer's bounded batch
+  replay buffer (`ckpt/checkpoint.py` ``CheckpointEveryN`` supplies
+  both the saves and the buffer depth). Replay is bit-exact: the step
+  is a pure function of (state, batch) and the per-step PRNG folds in
+  ``state.step``.
+- ``eval_step``: pure read-only evaluation — TRANSIENT retries in
+  place; exhausted retries re-raise (no state was mutated, nothing to
+  restore).
+
+KILL unwinds through ``fit()`` like a real SIGKILL; the recovery story
+for it is process restart + ``Trainer.fit(resume=...)`` (exact resume
+from the newest verified step-granular checkpoint, loader position
+included), exercised by the ``chaos``-marked matrix in
+``tests/test_train_faults.py`` and documented in docs/OPERATIONS.md
+§ "Failure modes & recovery (training)".
+"""
+
+from __future__ import annotations
+
+from pddl_tpu.utils.faults import (  # noqa: F401 - the train-layer surface
+    FaultKind,
+    FaultSpec,
+    InjectedResourceExhausted,
+    InjectedTransientError,
+    KillPoint,
+    classify,
+)
+from pddl_tpu.utils.faults import FaultPlan as _BaseFaultPlan
+
+
+class TrainFaultPlan(_BaseFaultPlan):
+    """Seeded fault schedule over the Trainer's device-call sites
+    (== ``Trainer.compile_counts()`` keys). The step coordinate is the
+    GLOBAL optimizer step (``int(state.step)`` at dispatch time), so a
+    scheduled fault stays pinned to the same update across resumes."""
+
+    SITES = ("train_step", "eval_step")
+
+
+class TrainStateLost(RuntimeError):
+    """Internal escalation from the Trainer's guarded boundary: the
+    device call could not complete within the retry budget (or the
+    donated state may have been consumed by a real error) — the live
+    TrainState is no longer trustworthy and must be restored from the
+    last verified checkpoint. Carries the failing site and the
+    original error as ``__cause__``."""
+
+    def __init__(self, site: str, err: BaseException):
+        self.site = site
+        self.err = err
+        super().__init__(f"training state lost at site {site!r}: {err}")

@@ -25,16 +25,25 @@ from __future__ import annotations
 import logging
 import sys
 import time
+from collections import deque
 from typing import Any, Callable, Dict, Iterable, Iterator, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from pddl_tpu.core.mesh import mesh_context
+from pddl_tpu.obs.trace import NULL_TRACER
 from pddl_tpu.parallel.base import Strategy
 from pddl_tpu.parallel.single import SingleDeviceStrategy
 from pddl_tpu.train import metrics as metrics_lib
 from pddl_tpu.train.callbacks import Callback
+from pddl_tpu.train.faults import (
+    InjectedResourceExhausted,
+    InjectedTransientError,
+    TrainStateLost,
+    classify,
+)
 from pddl_tpu.train.history import History
 from pddl_tpu.train.state import TrainState, make_optimizer
 
@@ -86,6 +95,24 @@ class Trainer:
         # "plain" | "stochastic_round" | "f32_master"
         # (train/mixed_precision.py). No-op for f32 params.
         param_update: str = "plain",
+        # -- crash resilience (train/faults.py, docs/OPERATIONS.md
+        # § "Failure modes & recovery (training)") --------------------
+        # Seeded deterministic fault injection over the compiled-program
+        # sites ("train_step"/"eval_step") — the chaos handle.
+        fault_plan=None,
+        # Transient-device-error retry budget per dispatch; past it the
+        # state is declared lost and the in-process restore+replay path
+        # runs (needs a CheckpointEveryN callback attached).
+        max_retries: int = 3,
+        retry_backoff_s: float = 0.02,
+        # Restore+replay attempts per failed step before giving up (a
+        # persistently failing site must surface, not crash-loop).
+        max_recoveries: int = 8,
+        # Training fault/recovery/checkpoint events flow through the
+        # same tracer surface the serving engine uses (obs/trace.py).
+        tracer=None,
+        # How retry backoff waits (tests pass a no-op).
+        retry_sleep=time.sleep,
     ):
         self.model = model
         self.input_key = input_key
@@ -113,6 +140,38 @@ class Trainer:
         self._train_step = None
         self._eval_step = None
         self._state_shardings = None
+
+        # -- crash-resilience state ------------------------------------
+        self._faults = fault_plan
+        self.max_retries = int(max_retries)
+        self.retry_backoff_s = float(retry_backoff_s)
+        self.max_recoveries = int(max_recoveries)
+        self._tracer = tracer if tracer is not None else NULL_TRACER
+        self._retry_sleep = retry_sleep
+        if self._faults is not None and self._faults.on_inject is None:
+            # Every injection — LATENCY included, which raises nothing —
+            # lands in the trace at its exact (step, site) coordinate.
+            self._faults.on_inject = self._tracer.on_fault_injected
+        # Host-side dispatch wall time per site (obs exposition).
+        self._site_wall: Dict[str, float] = {}
+        # Lifetime fault/recovery counters (obs/export.train_exposition
+        # renders every key — keep in sync with TRAIN_COUNTER_KEYS).
+        self.fault_stats: Dict[str, float] = {
+            "retries": 0, "recoveries": 0, "replayed_steps": 0,
+            "checkpoints_saved": 0, "checkpoint_wall_s": 0.0,
+        }
+        # In-process recovery plumbing: the CheckpointEveryN callback
+        # registers itself here (attach_recovery) and the bounded batch
+        # replay buffer covers the gap back to its last verified save.
+        self._recovery_cb = None
+        self._replay_buffer: Optional[deque] = None
+        # Python mirror of state.step (no per-step device sync) — the
+        # (step, site) fault coordinate and the replay-buffer key.
+        self._opt_step = 0
+        # Data-pipeline position, refreshed after every step; saved into
+        # checkpoint metadata so a restart resumes MID-epoch, bit-exact.
+        self._loader_state: Optional[Dict[str, int]] = None
+        self._batches_consumed = 0
 
     # ------------------------------------------------------------------ init
     def init_state(self, sample_batch: Dict[str, np.ndarray]) -> TrainState:
@@ -142,7 +201,7 @@ class Trainer:
 
         abstract = jax.eval_shape(_init, rng)
         self._state_shardings = self.strategy.state_sharding(abstract)
-        with jax.set_mesh(mesh):
+        with mesh_context(mesh):
             self.state = jax.jit(_init, out_shardings=self._state_shardings)(rng)
         self._build_steps()
         return self.state
@@ -269,6 +328,176 @@ class Trainer:
             out_shardings=None,
         )
 
+    # -------------------------------------------------- fault handling
+    def compile_counts(self) -> Dict[str, int]:
+        """Compiled-executable count per resident program — the
+        training analogue of ``ServeEngine.compile_counts()`` (and the
+        vocabulary of :class:`~pddl_tpu.train.faults.TrainFaultPlan`
+        sites). Any value above 1 is a recompile; the chaos suite pins
+        exactly 1 across every recovery transition."""
+        counts: Dict[str, int] = {}
+        for name, fn in (("train_step", self._train_step),
+                         ("eval_step", self._eval_step)):
+            if fn is not None:
+                n = fn._cache_size()
+                if n:
+                    counts[name] = n
+        return counts
+
+    def attach_recovery(self, checkpoint_cb) -> None:
+        """Wire a ``CheckpointEveryN`` callback as the in-process
+        restore source (called automatically by its ``set_trainer``).
+        The batch replay buffer is sized to TWO save intervals: the
+        newest save can be torn/corrupt, and recovery must still reach
+        back to the previous verified one."""
+        self._recovery_cb = checkpoint_cb
+        self._replay_buffer = deque(
+            maxlen=2 * int(checkpoint_cb.every_n_steps))
+
+    def on_checkpoint_saved(self, step: int, wall_s: float) -> None:
+        """``CheckpointEveryN`` save hook: telemetry only."""
+        self.fault_stats["checkpoints_saved"] += 1
+        self.fault_stats["checkpoint_wall_s"] += wall_s
+        self._tracer.on_checkpoint_saved(step, wall_s)
+
+    def loader_state(self) -> Optional[Dict[str, int]]:
+        """Data-pipeline position after the latest completed step:
+        ``{"epoch", "step_in_epoch", "batches_consumed"}`` — what a
+        step-granular save embeds so ``fit(resume=...)`` repositions
+        the stream exactly. ``None`` before the first step."""
+        return dict(self._loader_state) if self._loader_state else None
+
+    def fault_snapshot(self) -> Dict[str, object]:
+        """Flat export dict (``ServeMetrics.snapshot()`` discipline:
+        every key always present) for the Prometheus exposition —
+        rendered whole by ``obs.export.train_exposition``."""
+        injected = ({k.value: v for k, v in self._faults.injected.items()}
+                    if self._faults is not None else {})
+        return {
+            **{k: self.fault_stats[k] for k in sorted(self.fault_stats)},
+            "faults_injected": injected,
+            "site_wall_s": {k: round(v, 6)
+                            for k, v in sorted(self._site_wall.items())},
+            "compile_counts": self.compile_counts(),
+            "opt_step": self._opt_step,
+        }
+
+    def _device_call(self, site: str, fn, *args):
+        """The ONE guarded device-dispatch boundary (the serving
+        engine's ``_device_call`` ported to training): consult the
+        fault plan, classify failures, retry transients with bounded
+        exponential backoff, and escalate to
+        :class:`~pddl_tpu.train.faults.TrainStateLost` when the budget
+        runs out. ``KillPoint`` is a BaseException — it passes through
+        everything here, like the SIGKILL it stands for. Injected
+        faults fire BEFORE ``fn`` runs, so retrying never touches a
+        half-consumed donated buffer; a REAL error from the donated
+        train step is never re-dispatched (its donated state may
+        already be deleted) — it escalates immediately, as does any
+        OOM (an allocation that just failed won't pass until the
+        restore path rebuilds the state)."""
+        attempt = 0
+        while True:
+            try:
+                if self._faults is not None:
+                    self._faults.check(site)
+                t0 = time.perf_counter()
+                out = fn(*args)
+                self._site_wall[site] = (self._site_wall.get(site, 0.0)
+                                         + time.perf_counter() - t0)
+                return out
+            except Exception as e:
+                kind = classify(e)
+                if kind is None:
+                    raise  # not a device fault: bugs stay loud
+                injected = isinstance(e, (InjectedTransientError,
+                                          InjectedResourceExhausted))
+                consumed = (not injected and site == "train_step"
+                            and self.donate_state)
+                if kind == "oom" or consumed:
+                    raise TrainStateLost(site, e) from e
+                attempt += 1
+                if attempt > self.max_retries:
+                    raise TrainStateLost(site, e) from e
+                self.fault_stats["retries"] += 1
+                self._tracer.on_retry(self._opt_step, site, attempt)
+                self._retry_sleep(self.retry_backoff_s * (2 ** (attempt - 1)))
+
+    def _guarded_train_step(self, batch) -> Dict[str, jnp.ndarray]:
+        """One optimizer step through the guarded boundary. On
+        escalation, restore the last verified checkpoint IN-PROCESS,
+        replay forward from the batch buffer to the failed step, then
+        retry the failed step itself — CheckFreq-style recovery without
+        a process restart. Bit-exact: the step is a pure function of
+        (state, batch) and the per-step PRNG folds in ``state.step``."""
+        while True:
+            try:
+                if self._faults is not None:
+                    self._faults.on_step(self._opt_step)
+                out = self._device_call("train_step", self._train_step,
+                                        self.state, batch)
+                break
+            except TrainStateLost as lost:
+                self._restore_and_replay(lost)
+        self.state, logs = out
+        if self._replay_buffer is not None:
+            self._replay_buffer.append((self._opt_step, batch))
+        self._opt_step += 1
+        return logs
+
+    def _restore_and_replay(self, lost: TrainStateLost) -> None:
+        """Roll the live state back to the newest VERIFIED checkpoint
+        and replay buffered batches forward to the step that failed.
+        Leaves ``self.state`` at exactly ``self._opt_step`` (the failed
+        step re-dispatches in the caller's loop)."""
+        cb = self._recovery_cb
+        if cb is None or cb.ckpt is None:
+            raise lost
+        target = self._opt_step
+        for _ in range(self.max_recoveries):
+            self.fault_stats["recoveries"] += 1
+            cb.ckpt.wait()  # an in-flight async save may be the newest good
+            restored = cb.ckpt.restore(self.state)
+            restored_step = int(jax.device_get(restored.step))
+            if restored_step > target:
+                raise RuntimeError(
+                    f"newest checkpoint (step {restored_step}) is AHEAD "
+                    f"of the failed step {target}; cannot replay "
+                    "backwards — is another run writing this directory?"
+                ) from lost
+            buffered = dict(self._replay_buffer or ())
+            missing = [s for s in range(restored_step, target)
+                       if s not in buffered]
+            if missing:
+                raise RuntimeError(
+                    f"replay buffer does not cover steps {missing} "
+                    f"between the restored checkpoint ({restored_step}) "
+                    f"and the failed step ({target}) — checkpoint "
+                    "cadence outran the buffer") from lost
+            self._tracer.on_restore(target, restored_step, lost.site)
+            self.state = restored
+            try:
+                for s in range(restored_step, target):
+                    if self._faults is not None:
+                        self._faults.on_step(s)
+                    self.state, _ = self._device_call(
+                        "train_step", self._train_step, self.state,
+                        buffered[s])
+                    self.fault_stats["replayed_steps"] += 1
+            except TrainStateLost as again:
+                lost = again
+                continue
+            self._tracer.on_recovery(target, restored_step,
+                                     target - restored_step)
+            log.warning(
+                "recovered in-process from %s at step %d: restored "
+                "step %d and replayed %d step(s)", lost.site, target,
+                restored_step, target - restored_step)
+            return
+        raise RuntimeError(
+            f"recovery budget exhausted ({self.max_recoveries} "
+            f"restore+replay attempts) at step {target}") from lost
+
     # --------------------------------------------------------------- prefetch
     def _prefetch_distributed(self, it: Iterator, depth: int) -> Iterator:
         """Yield already-distributed global batches, ``depth`` ahead.
@@ -308,6 +537,16 @@ class Trainer:
         verbose: int = 2,  # reference uses verbose=2 (imagenet-resnet50.py:67)
         initial_epoch: int = 0,
         prefetch: int = 2,  # device-feed lookahead; 0/1 disables
+        # Crash-resume: a checkpoint directory (or Checkpointer). The
+        # newest VERIFIED save restores (a torn/corrupt latest falls
+        # back to the previous good step), the data stream repositions
+        # from the saved loader state, and training continues MID-epoch
+        # — bit-exact with an uninterrupted run. Overrides
+        # ``initial_epoch``. An empty directory starts fresh (so the
+        # same command line works for the first launch and every
+        # restart). See docs/OPERATIONS.md § "Failure modes & recovery
+        # (training)".
+        resume=None,
     ) -> History:
         if validation_data is not None and isinstance(validation_data, Iterator):
             raise ValueError(
@@ -318,12 +557,29 @@ class Trainer:
         history = History()
         self.stop_training = False
         self.global_step = 0
+        self._batches_consumed = 0
+        self._loader_state = None
+        if self._replay_buffer is not None:
+            # Stale batches from a previous fit would alias step indices.
+            self._replay_buffer.clear()
+
+        resume_offset = 0  # steps already done inside the resumed epoch
+        host_skip = 0      # batches to drop from the fresh iterator
+        if resume is not None:
+            prepared = self._prepare_resume(resume, train_data,
+                                            steps_per_epoch)
+            if prepared is not None:
+                train_data, initial_epoch, resume_offset, host_skip = prepared
 
         train_iter = self._ensure_iterator(train_data)
         if self.state is None:
             first = next(train_iter)
             self.init_state(first)
             train_iter = _chain_first(first, train_iter)
+        self._opt_step = int(jax.device_get(self.state.step))
+        if host_skip:
+            train_iter = self._skip_consumed(train_iter, host_skip,
+                                             train_data, steps_per_epoch)
 
         for cb in callbacks:
             cb.set_trainer(self)
@@ -346,6 +602,9 @@ class Trainer:
                 step_logs = []
                 steps = 0
                 samples = 0
+                # Mid-epoch resume: the restored epoch already ran this
+                # many steps before the crash — run only the remainder.
+                offset = resume_offset if epoch == initial_epoch else 0
                 def make_feed(it):
                     if prefetch and prefetch > 1:
                         return self._prefetch_distributed(it, prefetch)
@@ -401,19 +660,33 @@ class Trainer:
                             "is None; pass a re-iterable dataset or set steps_per_epoch"
                         )
                     feed = make_feed(iter(train_data))
-                while steps_per_epoch is None or steps < steps_per_epoch:
+                while steps_per_epoch is None or offset + steps < steps_per_epoch:
                     try:
                         global_batch = next(feed)
                     except StopIteration:
                         break
                     # Global batch size (leading dim of the global array).
                     samples += int(global_batch[self.target_key].shape[0])
-                    self.state, logs = self._train_step(self.state, global_batch)
+                    logs = self._guarded_train_step(global_batch)
                     step_logs.append(logs)
+                    steps += 1
+                    # Loader position settles BEFORE batch-end hooks run,
+                    # so a step-granular save records exactly this step's
+                    # stream position (normalized to the next epoch's
+                    # start at the boundary).
+                    self._batches_consumed += 1
+                    in_ep = offset + steps
+                    if steps_per_epoch is not None and in_ep >= steps_per_epoch:
+                        self._loader_state = {
+                            "epoch": epoch + 1, "step_in_epoch": 0,
+                            "batches_consumed": self._batches_consumed}
+                    else:
+                        self._loader_state = {
+                            "epoch": epoch, "step_in_epoch": in_ep,
+                            "batches_consumed": self._batches_consumed}
                     self._run_hooks(
                         callbacks, "on_train_batch_end", self.global_step, logs=logs
                     )
-                    steps += 1
                     self.global_step += 1
                     if self.stop_training:
                         # Honored mid-epoch (Keras semantics) — e.g. preemption
@@ -421,6 +694,15 @@ class Trainer:
                         stopped_mid_epoch = True
                         break
                 if steps == 0:
+                    if offset:
+                        # The resumed epoch was already fully trained
+                        # before the crash (the save landed on its last
+                        # batch): nothing to re-run HERE, but the later
+                        # epochs still must run — fall through to them.
+                        # (Only the first resumed epoch can carry an
+                        # offset, so a genuinely empty dataset still
+                        # raises on the next iteration.)
+                        continue
                     raise ValueError("empty training dataset/epoch")
                 if stopped_mid_epoch:
                     # A mid-epoch stop means "exit NOW" (preemption grace
@@ -429,6 +711,11 @@ class Trainer:
                     # save), no partial-epoch History entry that would mislead
                     # plateau/early-stop logic on resume.
                     break
+                # Epoch boundary reached (finite stream drained): saves
+                # from here resume at the NEXT epoch's start.
+                self._loader_state = {
+                    "epoch": epoch + 1, "step_in_epoch": 0,
+                    "batches_consumed": self._batches_consumed}
 
                 # Training throughput: window closes before validation runs.
                 dt = time.perf_counter() - t0
@@ -456,6 +743,98 @@ class Trainer:
         self.history = history
         return history
 
+    # --------------------------------------------------------------- resume
+    @staticmethod
+    def _skip_consumed(it, n: int, data, steps_per_epoch) -> Iterator:
+        """Drain ``n`` already-consumed batches from the stream. With
+        ``steps_per_epoch`` set, a finite re-iterable that drains is
+        RE-ITERATED — exactly the ``_repeating`` wrap-around the
+        original run's continuous feed applied — so the skip follows
+        the same batch sequence the crashed run consumed. Without it,
+        the skip stays within the resumed epoch's single pass."""
+        skipped = 0
+        while skipped < n:
+            advanced = False
+            for _ in it:
+                advanced = True
+                skipped += 1
+                if skipped == n:
+                    return it
+            if (steps_per_epoch is None or isinstance(data, Iterator)
+                    or not advanced):
+                raise ValueError(
+                    f"resume: dataset ended after {skipped} of {n} "
+                    "already-consumed batches — the stream is shorter "
+                    "than it was before the crash")
+            it = iter(data)
+        return it
+
+    def _prepare_resume(self, resume, train_data, steps_per_epoch):
+        """Restore the newest verified checkpoint and work out where the
+        data stream must restart. Returns ``(train_data, initial_epoch,
+        step_offset, host_skip)`` or ``None`` when the directory holds
+        no checkpoint yet (fresh start — same CLI for launch and
+        restart).
+
+        Stream repositioning, in preference order: a dataset exposing
+        ``with_offset(n)`` (the synthetic families) is shifted by the
+        saved ``batches_consumed`` — free; otherwise ``host_skip``
+        batches are drained from the fresh iterator before training
+        (exact for any deterministic re-iterable). Without
+        ``steps_per_epoch`` the feed is rebuilt per epoch, so only the
+        resumed epoch's ``step_in_epoch`` batches are skipped. Legacy
+        saves (no loader metadata) keep the old semantics: restart at
+        the epoch after the recorded one, stream from the top.
+        """
+        if isinstance(train_data, Iterator):
+            raise ValueError(
+                "fit(resume=...) needs a re-iterable dataset — a one-shot "
+                "iterator cannot be repositioned to the saved offset"
+            )
+        from pddl_tpu.ckpt.checkpoint import Checkpointer
+
+        own = isinstance(resume, str)
+        ckpt = Checkpointer(resume, async_save=False) if own else resume
+        try:
+            if ckpt.latest_step() is None:
+                log.info("resume: no checkpoint under %s yet — fresh run",
+                         getattr(ckpt, "directory", resume))
+                return None
+            if self.state is None:
+                self.init_state(next(iter(train_data)))
+            self.state = ckpt.restore(self.state)
+            step = int(jax.device_get(self.state.step))
+            try:
+                meta = ckpt.metadata(step)
+            except Exception:  # noqa: BLE001 - meta is advisory here
+                meta = {}
+        finally:
+            if own:
+                ckpt.close()
+        loader = meta.get("loader") or None
+        if loader:
+            initial_epoch = int(loader.get("epoch", 0))
+            offset = int(loader.get("step_in_epoch", 0))
+            consumed = int(loader.get("batches_consumed", 0))
+        else:
+            saved = meta.get("epoch")
+            initial_epoch = int(saved) + 1 if saved is not None else 0
+            offset = consumed = 0
+        self._batches_consumed = consumed
+        skip = consumed if steps_per_epoch is not None else offset
+        host_skip = 0
+        if skip:
+            if (steps_per_epoch is not None
+                    and hasattr(train_data, "with_offset")):
+                train_data = train_data.with_offset(skip)
+            else:
+                host_skip = skip
+        log.info(
+            "resume: restored verified step %d (epoch %d, step_in_epoch "
+            "%d, %d batches consumed)", step, initial_epoch, offset,
+            consumed)
+        return train_data, initial_epoch, offset, host_skip
+
     # -------------------------------------------------------------- evaluate
     def evaluate(
         self,
@@ -475,7 +854,15 @@ class Trainer:
             except StopIteration:
                 break
             global_batch = self.strategy.distribute_batch(batch)
-            logs_list.append(self._eval_step(self.state, global_batch))
+            try:
+                if self._faults is not None:
+                    self._faults.on_step(self._opt_step)
+                logs_list.append(self._device_call(
+                    "eval_step", self._eval_step, self.state, global_batch))
+            except TrainStateLost as lost:
+                # Eval mutates nothing — there is no state to restore;
+                # an exhausted retry budget surfaces the device error.
+                raise lost.err
             n += 1
         if not logs_list:
             raise ValueError("empty evaluation dataset")
@@ -505,12 +892,30 @@ class Trainer:
         return iter(data)
 
     def _run_hooks(self, callbacks, hook: str, *args, logs=None) -> None:
+        # on_train_end is CLEANUP: every callback must get its turn
+        # (checkpoint flush, signal-handler restore) even when an
+        # earlier one raises — e.g. HeartbeatCallback re-raising
+        # WorkerLost for the supervisor. The first error re-raises
+        # after the sweep, so it still reaches the caller.
+        deferred: Optional[Exception] = None
         for cb in callbacks:
             fn = getattr(cb, hook)
             if hook in ("on_train_begin",):
                 result = fn(self.state)
             elif hook in ("on_train_end",):
-                result = fn(self.state, logs or {})
+                try:
+                    result = fn(self.state, logs or {})
+                except Exception as e:  # noqa: BLE001 - swept, re-raised
+                    if deferred is None:
+                        deferred = e
+                    else:
+                        # Only the first propagates; later failures must
+                        # not vanish without a trace.
+                        log.error(
+                            "on_train_end of %s also failed (suppressed "
+                            "in favor of the first error): %s",
+                            type(cb).__name__, e)
+                    continue
             elif hook == "on_epoch_begin":
                 result = fn(args[0], self.state)
             elif hook == "on_epoch_end":
@@ -521,6 +926,8 @@ class Trainer:
                 raise ValueError(hook)
             if result is not None:
                 self.state = result
+        if deferred is not None:
+            raise deferred
 
 
 def _mean_logs(logs_list) -> Dict[str, float]:

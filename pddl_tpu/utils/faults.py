@@ -1,0 +1,267 @@
+"""Seeded deterministic fault injection — the shared core.
+
+The ROADMAP north star is a system that "handles as many scenarios as
+you can imagine"; at production scale device faults are ROUTINE, not
+exceptional — a transient ``XlaRuntimeError`` from a flaky
+interconnect, a ``RESOURCE_EXHAUSTED`` under HBM pressure, a latency
+spike from a neighbor, a SIGKILL from the scheduler. You cannot trust
+a recovery path you cannot exercise, so faults here are INJECTABLE and
+SEEDED: a :class:`FaultPlan` hooks every guarded device-call boundary
+of a host loop (the serving engine's ``_device_call``, the Trainer's
+``_device_call``) and fires transient errors, allocation failures,
+latency spikes, or hard kill-points at chosen or randomly drawn
+``(step, site)`` coordinates. Reproducible by construction: the same
+seed against the same workload injects the same faults, so every
+recovery path is testable in tier-1 on CPU.
+
+This module is the machinery only — the SITE VOCABULARY is owned by
+each subsystem: :class:`pddl_tpu.serve.faults.FaultPlan` pins the
+serving engine's ``compile_counts()`` keys,
+:class:`pddl_tpu.train.faults.TrainFaultPlan` the Trainer's compiled
+program names. Both are thin subclasses overriding :attr:`FaultPlan.
+SITES`; everything else (scheduling, rate draws, classification, the
+injection-before-dispatch discipline) is identical, which is the point:
+one fault taxonomy, one recovery contract, serving AND training.
+
+Fault taxonomy and the caller's contract for each:
+
+- **TRANSIENT** (raises :class:`InjectedTransientError`, the stand-in
+  for an ``INTERNAL``/``UNAVAILABLE`` ``XlaRuntimeError``): the call is
+  retried with bounded exponential backoff; past ``max_retries`` the
+  affected device state is declared lost and the subsystem's replay
+  path runs (serving: token-exact request replay; training: restore
+  the last verified checkpoint and replay forward).
+- **OOM** (raises :class:`InjectedResourceExhausted`, the stand-in for
+  ``RESOURCE_EXHAUSTED``): never blind-retried — memory must be shed
+  (serving: degraded mode) or the state rebuilt (training: restore)
+  before the allocation can pass.
+- **LATENCY**: the call is delayed (``sleep_fn``), nothing raises — the
+  tail-latency fault; deadlines, drains, and checkpoints must keep
+  working under it.
+- **KILL** (raises :class:`KillPoint`, a ``BaseException``): simulates
+  abrupt termination mid-step. Nothing catches it — it unwinds like a
+  real SIGKILL, and the test then exercises restart/restore on what
+  the process left on disk.
+
+Injection happens BEFORE the wrapped program dispatches, so device
+buffers (including donated ones) are never left half-consumed by an
+injected fault — which is what makes retry sound. Real device errors
+from a donated program must escalate straight to the rebuild path
+instead (see ``serve/engine._device_call``, ``train/loop.Trainer``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+class FaultKind(enum.Enum):
+    TRANSIENT = "transient"  # retryable device error
+    OOM = "oom"              # RESOURCE_EXHAUSTED: shed/rebuild, don't retry
+    LATENCY = "latency"      # slow call, nothing raised
+    KILL = "kill"            # hard termination mid-step (BaseException)
+
+
+class InjectedTransientError(RuntimeError):
+    """Stand-in for a retryable ``XlaRuntimeError`` (INTERNAL /
+    UNAVAILABLE / ABORTED): the device call failed but nothing about
+    the caller's resident state is invalidated."""
+
+
+class InjectedResourceExhausted(RuntimeError):
+    """Stand-in for ``RESOURCE_EXHAUSTED``: an allocation failed —
+    retrying the same call without shedding memory is pointless."""
+
+
+class KillPoint(BaseException):
+    """Simulated hard kill at a (step, site) coordinate. A
+    ``BaseException`` so no retry/except-Exception path can swallow it:
+    it unwinds through the host loop exactly like a real SIGKILL would
+    end the process mid-dispatch."""
+
+    def __init__(self, site: str, step: int):
+        self.site = site
+        self.step = step
+        super().__init__(f"injected kill-point at step {step}, site {site!r}")
+
+
+# What a fault-aware caller may see from jax itself. Classification is
+# by status-code marker in the message (jaxlib's XlaRuntimeError carries
+# the absl status string); anything unrecognized is NOT swallowed.
+_TRANSIENT_MARKERS = ("INTERNAL", "UNAVAILABLE", "ABORTED", "DATA_LOSS",
+                      "DEADLINE_EXCEEDED")
+
+
+def classify(err: BaseException) -> Optional[str]:
+    """``"transient"`` / ``"oom"`` / ``None`` (not a device fault — let
+    it propagate: a shape error or a bug must stay loud)."""
+    if isinstance(err, InjectedResourceExhausted):
+        return "oom"
+    if isinstance(err, InjectedTransientError):
+        return "transient"
+    if type(err).__name__ == "XlaRuntimeError":
+        msg = str(err)
+        if "RESOURCE_EXHAUSTED" in msg:
+            return "oom"
+        if any(m in msg for m in _TRANSIENT_MARKERS):
+            return "transient"
+    return None
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultSpec:
+    """One scheduled fault: fire ``kind`` on the next ``count``
+    invocations of ``site`` during host-loop step ``step``. ``count``
+    matters for TRANSIENT — ``count <= max_retries`` recovers inside
+    the retry loop, ``count > max_retries`` forces the replay path."""
+
+    step: int
+    site: str
+    kind: FaultKind
+    count: int = 1
+
+
+class FaultPlan:
+    """Seeded fault schedule over a host loop's device-call sites.
+
+    Two layers, both deterministic:
+
+    - ``scheduled``: explicit :class:`FaultSpec` coordinates — the
+      surgical tool (kill exactly at step 3's tick; fail the donate of
+      step 1 twice).
+    - rates: per-check Bernoulli draws from one ``np.random.default_rng
+      (seed)`` stream — the chaos tool. Given the same workload the
+      call sequence is identical, so the same seed injects the same
+      faults at the same coordinates.
+
+    Subclasses pin :attr:`SITES` to their subsystem's site vocabulary
+    (serving: the engine's ``compile_counts()`` keys; training: the
+    Trainer's compiled program names); construction validates every
+    site against it so a typo'd coordinate cannot silently never fire.
+
+    Args:
+      seed: the PRNG seed (reproducibility handle).
+      transient_rate / oom_rate / latency_rate: per-call probabilities
+        (must sum to <= 1).
+      latency_s: injected delay per LATENCY fault.
+      sites: optional allowlist — random faults only fire at these
+        sites (scheduled specs are never filtered).
+      scheduled: :class:`FaultSpec` sequence.
+      max_random_injections: cap on rate-drawn faults (keeps a chaos
+        run terminating even at silly rates); ``None`` = unbounded.
+      sleep_fn: how LATENCY waits (tests pass a fake-clock advancer).
+    """
+
+    SITES: Tuple[str, ...] = ()
+
+    def __init__(self, seed: int = 0, *, transient_rate: float = 0.0,
+                 oom_rate: float = 0.0, latency_rate: float = 0.0,
+                 latency_s: float = 0.005,
+                 sites: Optional[Sequence[str]] = None,
+                 scheduled: Sequence[FaultSpec] = (),
+                 max_random_injections: Optional[int] = None,
+                 sleep_fn=time.sleep):
+        for name, rate in (("transient_rate", transient_rate),
+                           ("oom_rate", oom_rate),
+                           ("latency_rate", latency_rate)):
+            if not 0.0 <= rate <= 1.0:
+                raise ValueError(f"{name} must be in [0, 1], got {rate}")
+        if transient_rate + oom_rate + latency_rate > 1.0:
+            raise ValueError("fault rates must sum to <= 1")
+        if sites is not None:
+            unknown = set(sites) - set(self.SITES)
+            if unknown:
+                raise ValueError(
+                    f"unknown fault site(s) {sorted(unknown)}; valid "
+                    f"sites are {self.SITES}")
+        for spec in scheduled:
+            if spec.site not in self.SITES:
+                raise ValueError(
+                    f"unknown scheduled site {spec.site!r}; valid sites "
+                    f"are {self.SITES}")
+            if spec.count < 1:
+                raise ValueError(f"FaultSpec.count must be >= 1: {spec}")
+        self.seed = int(seed)
+        self._rng = np.random.default_rng(seed)
+        self._rates = (float(transient_rate), float(oom_rate),
+                       float(latency_rate))
+        self.latency_s = float(latency_s)
+        self._sites = frozenset(sites) if sites is not None else None
+        self._sched: Dict[Tuple[int, str], List[FaultKind]] = {}
+        for spec in scheduled:
+            self._sched.setdefault((spec.step, spec.site), []).extend(
+                [spec.kind] * spec.count)
+        self._max_random = max_random_injections
+        self._random_fired = 0
+        self._sleep = sleep_fn
+        self.step_idx = -1  # the host loop stamps this at the top of a step
+        # Telemetry for tests/benches: injections per kind.
+        self.injected: Dict[FaultKind, int] = {k: 0 for k in FaultKind}
+        # Injection observer (``fn(step, site, kind_value)``), wired by
+        # the host loop's tracer plumbing so every injection — LATENCY
+        # included, which raises nothing — lands in the trace with the
+        # exact (step, site) coordinate it fired at.
+        self.on_inject = None
+
+    @property
+    def total_injected(self) -> int:
+        return sum(self.injected.values())
+
+    def on_step(self, step_idx: int) -> None:
+        """Host-loop hook: the current step coordinate for scheduled
+        specs (retries within a step re-check the same coordinate,
+        which is how ``FaultSpec.count`` consumes consecutive
+        invocations)."""
+        self.step_idx = int(step_idx)
+
+    def check(self, site: str) -> None:
+        """Called by the host loop immediately before dispatching
+        ``site``. Raises / sleeps per the schedule; returns normally
+        otherwise."""
+        key = (self.step_idx, site)
+        pending = self._sched.get(key)
+        if pending:
+            kind = pending.pop(0)
+            if not pending:
+                del self._sched[key]
+            self._fire(kind, site)
+            return
+        t, o, lat = self._rates
+        if t + o + lat <= 0.0:
+            return
+        if self._sites is not None and site not in self._sites:
+            return
+        if (self._max_random is not None
+                and self._random_fired >= self._max_random):
+            return
+        u = self._rng.random()
+        if u < t:
+            kind = FaultKind.TRANSIENT
+        elif u < t + o:
+            kind = FaultKind.OOM
+        elif u < t + o + lat:
+            kind = FaultKind.LATENCY
+        else:
+            return
+        self._random_fired += 1
+        self._fire(kind, site)
+
+    def _fire(self, kind: FaultKind, site: str) -> None:
+        self.injected[kind] += 1
+        if self.on_inject is not None:
+            self.on_inject(self.step_idx, site, kind.value)
+        where = f"at step {self.step_idx}, site {site!r}"
+        if kind is FaultKind.TRANSIENT:
+            raise InjectedTransientError(
+                f"INTERNAL: injected transient device error {where}")
+        if kind is FaultKind.OOM:
+            raise InjectedResourceExhausted(
+                f"RESOURCE_EXHAUSTED: injected allocation failure {where}")
+        if kind is FaultKind.KILL:
+            raise KillPoint(site, self.step_idx)
+        self._sleep(self.latency_s)  # LATENCY: slow, not broken

@@ -13,12 +13,20 @@ save + stop, pairing with :class:`pddl_tpu.ckpt.BackupAndRestore` /
 The handler only sets a flag (async-signal-safe); the actual save happens
 at the next batch boundary on the training thread, so the checkpoint is a
 consistent TrainState, not a torn mid-step capture.
+
+The grace-window save is STEP-granular: it records the Trainer's loader
+position (epoch, step offset within it, batches consumed) and per-leaf
+checksums alongside the state, so the restarted job resumes exactly the
+interrupted step via ``Trainer.fit(resume=...)`` / ``--resume`` — not a
+replay of the whole epoch — and a save torn by the eviction itself is
+detected and skipped on restore (`pddl_tpu/ckpt/checkpoint.py`).
 """
 
 from __future__ import annotations
 
 import logging
 import signal
+from typing import Optional
 
 from pddl_tpu.train.callbacks import Callback
 
@@ -29,16 +37,32 @@ class PreemptionCheckpoint(Callback):
     """Save a checkpoint and stop training cleanly when preempted.
 
     Args:
-      directory: checkpoint directory (shared with ``BackupAndRestore`` /
-        ``--resume`` so the restarted job continues from the save).
+      directory: checkpoint directory (shared with ``--resume`` /
+        ``Trainer.fit(resume=...)`` so the restarted job continues from
+        the save). Ignored when ``delegate`` is given.
       signals: which signals mean "about to be killed" (default SIGTERM —
         what Cloud TPU / GCE / Slurm send before eviction).
       restore_previous_handlers: put the old handlers back at train end.
+      delegate: an already-installed checkpoint callback exposing
+        ``save_now(state)`` + ``.ckpt`` (``CheckpointEveryN`` or
+        ``ModelCheckpoint``) to save through instead of opening a
+        second manager. Two WRITING
+        ``CheckpointManager``s on one directory race each other's
+        retention GC and can collide on the same step number (a SIGTERM
+        landing on a save-cadence batch would double-save) — delegating
+        keeps ONE writer per directory.
     """
 
-    def __init__(self, directory: str, signals=(signal.SIGTERM,),
-                 restore_previous_handlers: bool = True):
+    def __init__(self, directory: Optional[str] = None,
+                 signals=(signal.SIGTERM,),
+                 restore_previous_handlers: bool = True,
+                 delegate=None):
+        if (directory is None) == (delegate is None):
+            raise ValueError(
+                "pass exactly one of directory (own manager) or "
+                "delegate (a CheckpointEveryN to save through)")
         self.directory = directory
+        self.delegate = delegate
         self.signals = tuple(signals)
         self.restore_previous_handlers = restore_previous_handlers
         self.preempted = False
@@ -51,15 +75,16 @@ class PreemptionCheckpoint(Callback):
         self.preempted = True
 
     def on_train_begin(self, state):
-        from pddl_tpu.ckpt.checkpoint import Checkpointer
-
         # Fresh run: a reused callback instance (in-process resume/retry)
         # must not inherit the previous run's preempted flag.
         self.preempted = False
-        # Sync saves: during a grace window there may be no "later" to
-        # finish an async save in.
-        self._ckpt = Checkpointer(self.directory, max_to_keep=2,
-                                  async_save=False)
+        if self.delegate is None:
+            from pddl_tpu.ckpt.checkpoint import Checkpointer
+
+            # Sync saves: during a grace window there may be no "later"
+            # to finish an async save in.
+            self._ckpt = Checkpointer(self.directory, max_to_keep=2,
+                                      async_save=False)
         for sig in self.signals:
             self._previous[sig] = signal.signal(sig, self._on_signal)
         return None
@@ -73,12 +98,27 @@ class PreemptionCheckpoint(Callback):
         if not self.preempted or self.trainer.stop_training:
             return None
         log.warning("preemption signal received: checkpointing to %s and "
-                    "stopping", self.directory)
-        # epoch-1: the interrupted epoch is incomplete, so --resume's
-        # initial_epoch = saved+1 restarts exactly it.
-        self._ckpt.save(state, epoch=self._epoch - 1, metrics=None,
-                        force=True)
-        self._ckpt.wait()
+                    "stopping",
+                    self.directory if self.delegate is None
+                    else self.delegate.ckpt.directory)
+        if self.delegate is not None:
+            # One writer per directory: save through the step-granular
+            # callback's manager (loader metadata included by save_now)
+            # and make sure the write lands inside the grace window.
+            self.delegate.save_now(state)
+            self.delegate.ckpt.wait()
+        else:
+            # Step-granular grace save: loader position (epoch, step
+            # offset, batches consumed) rides in the metadata so
+            # fit(resume=...) continues MID-epoch instead of replaying
+            # the whole epoch. epoch-1 stays in the legacy field: the
+            # interrupted epoch is incomplete, so a legacy resume's
+            # initial_epoch = saved+1 restarts exactly it.
+            loader = self.trainer.loader_state()
+            epoch = loader["epoch"] - 1 if loader else self._epoch - 1
+            self._ckpt.save(state, epoch=epoch, metrics=None, force=True,
+                            loader=loader)
+            self._ckpt.wait()
         self.trainer.stop_training = True
         return None
 

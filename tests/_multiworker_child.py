@@ -106,6 +106,58 @@ def main() -> None:
     loss = hist.history["loss"][-1]
     assert np.isfinite(loss), loss
 
+    # Heartbeat failure detection over a REAL multi-process topology
+    # (shared-dir beats + coordinated restart marker), when the driver
+    # provides the shared directory.
+    hb_dir = os.environ.get("PDDL_HEARTBEAT_DIR")
+    if hb_dir:
+        import time
+
+        from pddl_tpu.parallel.multiworker import (
+            HeartbeatMonitor,
+            WorkerLost,
+        )
+
+        mon = HeartbeatMonitor(hb_dir, timeout_s=30.0)
+        mon.start()
+        # Every process beats; after a barrier-ish settle, nobody reads
+        # as failed (the live fleet is quiet).
+        deadline = time.time() + 20.0
+        while time.time() < deadline:
+            if all(s is not None for s in mon.last_seen().values()):
+                break
+            mon.beat()
+            time.sleep(0.05)
+        assert all(s is not None for s in mon.last_seen().values()), \
+            mon.last_seen()
+        assert mon.failed() == [], mon.failed()
+
+        # A worker that NEVER beat reads as lost once the timeout
+        # passes: watch one phantom extra worker on a fast fake clock.
+        # Advancing the fake clock also ages the REAL peers' wall-clock
+        # beats, so assert containment, not equality — the phantom must
+        # be among the lost, whatever the live workers read as.
+        fake_now = [time.time()]
+        ghost = HeartbeatMonitor(hb_dir, process_id=mon.process_id,
+                                 num_processes=n_procs + 1,
+                                 timeout_s=5.0, clock=lambda: fake_now[0])
+        ghost.start()
+        fake_now[0] += 6.0
+        try:
+            ghost.check()
+            raise AssertionError("phantom worker not detected")
+        except WorkerLost as e:
+            assert n_procs in e.lost, e.lost
+
+        # Coordinated restart: the LAST rank requests it; every process
+        # observes the shared marker.
+        if jax.process_index() == n_procs - 1:
+            mon.request_restart("elastic scale-down drill")
+        deadline = time.time() + 20.0
+        while time.time() < deadline and not mon.restart_requested():
+            time.sleep(0.05)
+        assert mon.restart_requested()
+
     print(f"child {jax.process_index()} OK loss={loss:.4f}", flush=True)
 
 

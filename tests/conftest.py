@@ -56,27 +56,32 @@ def native_build_error(tfrecord: bool = False) -> str:
 
 @pytest.fixture()
 def pin_zero_recompiles():
-    """THE serve-layer fixed-shape contract as a reusable fixture: every
-    resident compiled program of a registered engine has exactly ONE
-    executable right after warmup AND still exactly one when the test
-    ends — whatever mixed workload ran in between compiled nothing new.
+    """THE fixed-shape contract as a reusable fixture: every resident
+    compiled program of a registered object has exactly ONE executable
+    at registration AND still exactly one when the test ends — whatever
+    mixed workload (or fault-recovery path) ran in between compiled
+    nothing new.
 
-    Usage::
+    Works for anything exposing ``compile_counts()``: a ``ServeEngine``
+    (warmed first — it exposes ``warmup()``) or a ``Trainer`` (register
+    it after its first fit, when both programs exist)::
 
         eng = pin_zero_recompiles(ServeEngine(model, variables, ...))
+        trainer.fit(...); pin_zero_recompiles(trainer)
 
-    The fixture warms the engine, asserts the post-warmup counts, and
-    re-asserts at teardown, so every serve-layer test that builds an
-    engine through it gets the zero-recompile pin for free
-    (`test_serve_engine.py`, `test_prefix_cache.py`).
+    Every serve-layer test that builds an engine through it gets the
+    zero-recompile pin for free (`test_serve_engine.py`,
+    `test_prefix_cache.py`); the training chaos matrix pins recovery
+    transitions the same way (`test_train_faults.py`).
     """
     engines = []
 
     def register(engine):
-        engine.warmup()
+        if hasattr(engine, "warmup"):
+            engine.warmup()
         counts = engine.compile_counts()
-        assert all(v == 1 for v in counts.values()), \
-            f"program(s) compiled more than once at warmup: {counts}"
+        assert counts and all(v == 1 for v in counts.values()), \
+            f"program(s) compiled more than once at registration: {counts}"
         engines.append(engine)
         return engine
 

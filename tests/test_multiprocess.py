@@ -94,8 +94,13 @@ def _run_bootstrap_cluster(n_procs, **extra_env):
         assert f"child {pid} OK" in out, out
 
 
-def test_two_process_bootstrap_and_training():
-    _run_bootstrap_cluster(2)
+def test_two_process_bootstrap_and_training(tmp_path):
+    # PDDL_HEARTBEAT_DIR additionally exercises worker-failure
+    # detection over the real 2-process topology: every worker beats
+    # the shared directory, a never-beating phantom worker is detected
+    # as lost, and the coordinated-restart marker propagates from the
+    # last rank to every process (_multiworker_child.py).
+    _run_bootstrap_cluster(2, PDDL_HEARTBEAT_DIR=str(tmp_path / "hb"))
 
 
 def test_four_process_bootstrap_and_training():

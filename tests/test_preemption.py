@@ -56,9 +56,15 @@ def test_sigterm_checkpoints_and_stops(tmp_path):
     ckpt = Checkpointer(ckpt_dir, async_save=False)
     try:
         restored = ckpt.restore(tr2.state)
-        # The interrupted epoch (0) restarts on resume: saved epoch
-        # metadata is -1 so initial_epoch = saved+1 = 0.
-        assert ckpt.metadata().get("epoch") == -1
+        meta = ckpt.metadata()
+        # Legacy field: the interrupted epoch (0) restarts on a legacy
+        # resume (initial_epoch = saved+1 = 0)...
+        assert meta.get("epoch") == -1
+        # ...and the STEP-granular loader position rides alongside, so
+        # fit(resume=...) re-enters mid-epoch instead of replaying it.
+        assert meta["loader"] == {"epoch": 0, "step_in_epoch": 3,
+                                  "batches_consumed": 3}
+        assert meta["checksums"]  # grace save is verified too
     finally:
         ckpt.close()
     # Saved at the batch boundary right after the signal (step 3 = index 2
@@ -66,6 +72,37 @@ def test_sigterm_checkpoints_and_stops(tmp_path):
     assert int(jax.device_get(restored.step)) == saved_step == 3
     for a, b in zip(jax.tree.leaves(jax.device_get(tr.state.params)),
                     jax.tree.leaves(jax.device_get(restored.params))):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_preempted_run_resumes_mid_epoch_bit_exact(tmp_path):
+    """The full preemption story, step-granular: SIGTERM mid-epoch →
+    grace save with loader position → fit(resume=...) continues from
+    the INTERRUPTED step and the final params match an uninterrupted
+    run bit-exactly."""
+    ds = SyntheticImageClassification(batch_size=8, image_size=16,
+                                      num_classes=8, seed=0)
+
+    clean = Trainer(tiny_resnet(num_classes=8), learning_rate=1e-2,
+                    strategy=SingleDeviceStrategy(), seed=0)
+    clean.fit(ds, epochs=2, steps_per_epoch=5, verbose=0)
+
+    ckpt_dir = str(tmp_path / "pre")
+    tr = Trainer(tiny_resnet(num_classes=8), learning_rate=1e-2,
+                 strategy=SingleDeviceStrategy(), seed=0)
+    tr.fit(ds, epochs=2, steps_per_epoch=5, verbose=0,
+           callbacks=[_SendSigterm(at_step=6),
+                      PreemptionCheckpoint(ckpt_dir)])
+    assert int(jax.device_get(tr.state.step)) == 7  # stopped mid-epoch 1
+
+    tr2 = Trainer(tiny_resnet(num_classes=8), learning_rate=1e-2,
+                  strategy=SingleDeviceStrategy(), seed=0)
+    hist = tr2.fit(ds, epochs=2, steps_per_epoch=5, verbose=0,
+                   resume=ckpt_dir)
+    assert hist.epoch == [1]  # re-entered the interrupted epoch
+    assert int(jax.device_get(tr2.state.step)) == 10
+    for a, b in zip(jax.tree.leaves(jax.device_get(clean.state.params)),
+                    jax.tree.leaves(jax.device_get(tr2.state.params))):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 

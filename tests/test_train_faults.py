@@ -1,0 +1,675 @@
+"""Crash-resilient training (`pddl_tpu/train/faults.py`, the Trainer's
+guarded device-call boundary, verified step-granular checkpointing, and
+exact resume), CPU.
+
+The contracts under test — the training mirror of
+`tests/test_serve_faults.py`:
+
+- **Chaos matrix** (3 seeds x {transient storm, kill-at-step,
+  corrupt-latest-checkpoint}, ``@pytest.mark.chaos``): every run
+  terminates, resumes (in-process or via restart), and its final
+  parameters are BIT-IDENTICAL to the uninterrupted run, with zero
+  recompiles across every recovery transition.
+- **Retry**: a transient burst within the budget recovers in place —
+  no restore, same params, events traced at exact (step, site)
+  coordinates.
+- **Restore+replay**: a burst past the budget (or any OOM) restores
+  the last VERIFIED checkpoint in-process and replays forward from the
+  batch replay buffer — CheckFreq-style recovery, bit-exact.
+- **Verified checkpoints**: saves embed per-leaf checksums + loader
+  position; a corrupted latest save is detected (checksum or parse)
+  and restore falls back to the previous verified step.
+- **Exact restart**: a KILLed run restarted with ``fit(resume=...)``
+  continues MID-epoch from the saved loader position and ends
+  bit-exact with the clean run.
+- **Worker loss**: shared-dir heartbeats detect a silent worker,
+  propagate a coordinated-restart marker, and stop survivors at a
+  batch boundary (the cross-process leg rides
+  ``tests/test_multiprocess.py``).
+- **Exposition**: training fault/recovery counters render through the
+  same Prometheus path serving uses, drift-guarded both directions.
+"""
+
+import json
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from pddl_tpu.ckpt.checkpoint import (
+    CheckpointCorruptError,
+    CheckpointEveryN,
+    Checkpointer,
+)
+from pddl_tpu.data.synthetic import SyntheticImageClassification
+from pddl_tpu.models.resnet import tiny_resnet
+from pddl_tpu.obs import RequestTracer, parse_prometheus_text, train_exposition
+from pddl_tpu.parallel.single import SingleDeviceStrategy
+from pddl_tpu.train.faults import (
+    FaultKind,
+    FaultSpec,
+    KillPoint,
+    TrainFaultPlan,
+    TrainStateLost,
+)
+from pddl_tpu.train.loop import Trainer
+
+EPOCHS, SPE = 2, 5  # 10 optimizer steps end to end
+
+
+def _dataset():
+    return SyntheticImageClassification(batch_size=8, image_size=16,
+                                        num_classes=8, seed=3)
+
+
+def _trainer(**kw):
+    kw.setdefault("retry_sleep", lambda s: None)  # tests never wall-wait
+    return Trainer(tiny_resnet(num_classes=8), optimizer="adam",
+                   learning_rate=1e-2, strategy=SingleDeviceStrategy(),
+                   seed=0, **kw)
+
+
+def _params(tr):
+    return [np.asarray(x)
+            for x in jax.tree.leaves(jax.device_get(tr.state.params))]
+
+
+def _assert_bit_exact(a, b):
+    assert len(a) == len(b)
+    for x, y in zip(a, b):
+        np.testing.assert_array_equal(x, y)
+
+
+@pytest.fixture(scope="module")
+def clean_params():
+    tr = _trainer()
+    tr.fit(_dataset(), epochs=EPOCHS, steps_per_epoch=SPE, verbose=0)
+    return _params(tr)
+
+
+def _ckpt_cb(directory, every=2):
+    return CheckpointEveryN(str(directory), every_n_steps=every,
+                            async_save=False)
+
+
+def _corrupt_newest_step(directory):
+    """Flip bytes in every data file of the newest finalized step —
+    whether that breaks structural parsing or 'only' the bytes, restore
+    must detect it (parse failure or checksum mismatch) and fall back."""
+    steps = [int(n) for n in os.listdir(directory) if n.isdigit()]
+    newest = os.path.join(str(directory), str(max(steps)), "state")
+    flipped = 0
+    for root, _, files in os.walk(newest):
+        for name in files:
+            path = os.path.join(root, name)
+            size = os.path.getsize(path)
+            if size < 32:
+                continue
+            with open(path, "r+b") as f:
+                f.seek(size // 2)
+                b = f.read(1)
+                f.seek(size // 2)
+                f.write(bytes([b[0] ^ 0xFF]))
+            flipped += 1
+    assert flipped, f"nothing corruptible under {newest}"
+    return max(steps)
+
+
+# ------------------------------------------------------------ chaos matrix
+_PROFILES = ("transient_storm", "kill_at_step", "corrupt_latest")
+
+
+@pytest.mark.chaos
+@pytest.mark.parametrize("seed", [0, 1, 2])
+@pytest.mark.parametrize("profile", _PROFILES)
+def test_chaos_matrix(tmp_path, clean_params, pin_zero_recompiles, seed,
+                      profile):
+    """Seeded chaos over the training loop: every scenario terminates,
+    resumes (in-process restore+replay or kill+restart), matches the
+    clean run BIT-EXACTLY, and compiles nothing new across recovery."""
+    ckdir = str(tmp_path / "ck")
+    if profile == "transient_storm":
+        # Random transients, some bursts long enough to exhaust the
+        # retry budget and force restore+replay (count > max_retries
+        # scheduled on top of the rate draws so every seed exercises
+        # BOTH paths).
+        plan = TrainFaultPlan(
+            seed=seed, transient_rate=0.25, max_random_injections=6,
+            scheduled=[FaultSpec(4 + seed, "train_step",
+                                 FaultKind.TRANSIENT, count=10)])
+        tr = _trainer(fault_plan=plan)
+        tr.fit(_dataset(), epochs=EPOCHS, steps_per_epoch=SPE, verbose=0,
+               callbacks=[_ckpt_cb(ckdir)])
+        pin_zero_recompiles(tr)
+        assert plan.total_injected > 0
+        assert tr.fault_stats["recoveries"] >= 1
+        final = tr
+    elif profile == "kill_at_step":
+        # Adversarial coordinate: mid-epoch, off the checkpoint cadence.
+        kill_at = 5 + seed
+        plan = TrainFaultPlan(
+            seed=seed,
+            scheduled=[FaultSpec(kill_at, "train_step", FaultKind.KILL)])
+        tr = _trainer(fault_plan=plan)
+        with pytest.raises(KillPoint):
+            tr.fit(_dataset(), epochs=EPOCHS, steps_per_epoch=SPE,
+                   verbose=0, callbacks=[_ckpt_cb(ckdir)])
+        assert int(jax.device_get(tr.state.step)) == kill_at
+        # Restart: a FRESH process's trainer resumes mid-epoch.
+        final = _trainer()
+        final.fit(_dataset(), epochs=EPOCHS, steps_per_epoch=SPE,
+                  verbose=0, resume=ckdir, callbacks=[_ckpt_cb(ckdir)])
+        pin_zero_recompiles(final)
+    else:  # corrupt_latest
+        tr = _trainer()
+        tr.fit(_dataset(), epochs=1, steps_per_epoch=SPE, verbose=0,
+               callbacks=[_ckpt_cb(ckdir)])
+        corrupted = _corrupt_newest_step(ckdir)
+        final = _trainer()
+        final.fit(_dataset(), epochs=EPOCHS, steps_per_epoch=SPE,
+                  verbose=0, resume=ckdir, callbacks=[_ckpt_cb(ckdir)])
+        pin_zero_recompiles(final)
+        # The corrupted save was skipped: the resumed run restored an
+        # EARLIER step and recomputed forward.
+        assert corrupted > 0
+    assert int(jax.device_get(final.state.step)) == EPOCHS * SPE
+    _assert_bit_exact(_params(final), clean_params)
+
+
+# ------------------------------------------------------- targeted legs
+def test_transient_within_budget_retries_in_place(clean_params):
+    plan = TrainFaultPlan(scheduled=[
+        FaultSpec(3, "train_step", FaultKind.TRANSIENT, count=2)])
+    tracer = RequestTracer()
+    tr = _trainer(fault_plan=plan, tracer=tracer)
+    tr.fit(_dataset(), epochs=EPOCHS, steps_per_epoch=SPE, verbose=0)
+    _assert_bit_exact(_params(tr), clean_params)
+    assert tr.fault_stats["retries"] == 2
+    assert tr.fault_stats["recoveries"] == 0
+    # Injections and retries surface in the trace at the EXACT
+    # (step, site) coordinates the plan fired at.
+    inj = tracer.events_named("fault_injected")
+    assert [(e["step"], e["site"]) for e in inj] == [(3, "train_step")] * 2
+    ret = tracer.events_named("retry")
+    assert [(e["step"], e["site"], e["attempt"]) for e in ret] == \
+        [(3, "train_step", 1), (3, "train_step", 2)]
+
+
+def test_retries_exhausted_restores_and_replays(tmp_path, clean_params,
+                                                pin_zero_recompiles):
+    plan = TrainFaultPlan(scheduled=[
+        FaultSpec(7, "train_step", FaultKind.TRANSIENT, count=4)])
+    tracer = RequestTracer()
+    tr = _trainer(fault_plan=plan, tracer=tracer)
+    tr.fit(_dataset(), epochs=EPOCHS, steps_per_epoch=SPE, verbose=0,
+           callbacks=[_ckpt_cb(tmp_path / "ck")])
+    pin_zero_recompiles(tr)
+    _assert_bit_exact(_params(tr), clean_params)
+    assert tr.fault_stats["recoveries"] == 1
+    # Saved at step 6 (every 2), failed at 7: exactly one replayed step.
+    assert tr.fault_stats["replayed_steps"] == 1
+    restore, = tracer.events_named("restore")
+    assert (restore["step"], restore["restored_step"]) == (7, 6)
+    recovery, = tracer.events_named("recovery")
+    assert recovery["replayed"] == 1
+
+
+def test_oom_escalates_straight_to_restore(tmp_path, clean_params):
+    plan = TrainFaultPlan(scheduled=[
+        FaultSpec(5, "train_step", FaultKind.OOM)])
+    tr = _trainer(fault_plan=plan)
+    tr.fit(_dataset(), epochs=EPOCHS, steps_per_epoch=SPE, verbose=0,
+           callbacks=[_ckpt_cb(tmp_path / "ck")])
+    _assert_bit_exact(_params(tr), clean_params)
+    # No blind retry of a failed allocation: straight to restore.
+    assert tr.fault_stats["retries"] == 0
+    assert tr.fault_stats["recoveries"] == 1
+
+
+def test_exhausted_retries_without_recovery_source_raise():
+    plan = TrainFaultPlan(scheduled=[
+        FaultSpec(2, "train_step", FaultKind.TRANSIENT, count=10)])
+    tr = _trainer(fault_plan=plan)
+    with pytest.raises(TrainStateLost):
+        tr.fit(_dataset(), epochs=1, steps_per_epoch=SPE, verbose=0)
+
+
+def test_latency_fault_delays_but_completes(clean_params):
+    slept = []
+    plan = TrainFaultPlan(scheduled=[
+        FaultSpec(1, "train_step", FaultKind.LATENCY),
+        FaultSpec(6, "train_step", FaultKind.LATENCY)],
+        latency_s=0.001, sleep_fn=slept.append)
+    tr = _trainer(fault_plan=plan)
+    tr.fit(_dataset(), epochs=EPOCHS, steps_per_epoch=SPE, verbose=0)
+    _assert_bit_exact(_params(tr), clean_params)
+    assert slept == [0.001, 0.001]
+    assert tr.fault_stats["retries"] == 0
+
+
+def test_eval_transient_retries_in_place_and_exhaustion_raises():
+    # Within budget: evaluate() succeeds through retries.
+    plan = TrainFaultPlan(scheduled=[
+        FaultSpec(SPE, "eval_step", FaultKind.TRANSIENT, count=2)])
+    tr = _trainer(fault_plan=plan)
+    tr.fit(_dataset(), epochs=1, steps_per_epoch=SPE, verbose=0)
+    logs = tr.evaluate(_dataset(), steps=2)
+    assert np.isfinite(logs["loss"])
+    assert tr.fault_stats["retries"] == 2
+    # Past budget: eval mutates nothing — the device error surfaces
+    # as itself (no bogus restore of untouched state).
+    plan2 = TrainFaultPlan(scheduled=[
+        FaultSpec(SPE, "eval_step", FaultKind.TRANSIENT, count=10)])
+    tr2 = _trainer(fault_plan=plan2)
+    tr2.fit(_dataset(), epochs=1, steps_per_epoch=SPE, verbose=0)
+    from pddl_tpu.train.faults import InjectedTransientError
+
+    with pytest.raises(InjectedTransientError):
+        tr2.evaluate(_dataset(), steps=2)
+
+
+# ------------------------------------------------- exact resume details
+def test_kill_and_restart_resume_is_mid_epoch_and_bit_exact(
+        tmp_path, clean_params, pin_zero_recompiles):
+    """The acceptance pin, spelled out: kill at an adversarial step
+    (mid-epoch, off the save cadence), restart from the step-granular
+    checkpoint including loader state, end bit-exact — and the resumed
+    run's history shows it re-entered the INTERRUPTED epoch, not the
+    next one."""
+    ckdir = str(tmp_path / "ck")
+    plan = TrainFaultPlan(scheduled=[
+        FaultSpec(7, "train_step", FaultKind.KILL)])
+    tr = _trainer(fault_plan=plan)
+    with pytest.raises(KillPoint):
+        tr.fit(_dataset(), epochs=EPOCHS, steps_per_epoch=SPE, verbose=0,
+               callbacks=[_ckpt_cb(ckdir)])
+
+    # The newest save carries step-granular loader metadata.
+    ck = Checkpointer(ckdir, read_only=True)
+    try:
+        meta = ck.metadata()
+        assert meta["loader"] == {"epoch": 1, "step_in_epoch": 1,
+                                  "batches_consumed": 6}
+        assert meta["checksums"]  # verified save
+    finally:
+        ck.close()
+
+    tr2 = _trainer()
+    hist = tr2.fit(_dataset(), epochs=EPOCHS, steps_per_epoch=SPE,
+                   verbose=0, resume=ckdir)
+    pin_zero_recompiles(tr2)
+    # Only the interrupted epoch (index 1) completes after resume.
+    assert hist.epoch == [1]
+    assert int(jax.device_get(tr2.state.step)) == EPOCHS * SPE
+    _assert_bit_exact(_params(tr2), clean_params)
+
+
+def test_resume_empty_directory_starts_fresh(tmp_path, clean_params):
+    """The same command line serves first launch and restart: an empty
+    checkpoint directory is a fresh run, not an error."""
+    tr = _trainer()
+    tr.fit(_dataset(), epochs=EPOCHS, steps_per_epoch=SPE, verbose=0,
+           resume=str(tmp_path / "never_written"))
+    _assert_bit_exact(_params(tr), clean_params)
+
+
+def test_resume_without_steps_per_epoch_skips_within_epoch(tmp_path):
+    """Finite re-iterable data (no steps_per_epoch): the resumed epoch
+    skips exactly the batches it already consumed."""
+    class Finite:
+        def __init__(self, n=SPE):
+            self.n = n
+            self.ds = _dataset()
+
+        def __iter__(self):
+            return (self.ds.batch(i) for i in range(self.n))
+
+    clean = _trainer()
+    clean.fit(Finite(), epochs=2, verbose=0)
+
+    ckdir = str(tmp_path / "ck")
+    plan = TrainFaultPlan(scheduled=[
+        FaultSpec(7, "train_step", FaultKind.KILL)])
+    tr = _trainer(fault_plan=plan)
+    with pytest.raises(KillPoint):
+        tr.fit(Finite(), epochs=2, verbose=0, callbacks=[_ckpt_cb(ckdir)])
+    tr2 = _trainer()
+    tr2.fit(Finite(), epochs=2, verbose=0, resume=ckdir)
+    _assert_bit_exact(_params(tr2), _params(clean))
+
+
+def test_resume_skip_reiterates_finite_stream_with_steps_per_epoch(
+        tmp_path):
+    """steps_per_epoch over a FINITE re-iterable wraps around
+    (_repeating); the resume skip must follow the same wrap-around when
+    the consumed count exceeds one pass — not die at StopIteration."""
+    class Finite:
+        def __init__(self, n=6):
+            self.n = n
+            self.ds = _dataset()
+
+        def __iter__(self):
+            return (self.ds.batch(i) for i in range(self.n))
+
+    clean = _trainer()
+    clean.fit(Finite(), epochs=2, steps_per_epoch=5, verbose=0)
+
+    ckdir = str(tmp_path / "ck")
+    plan = TrainFaultPlan(scheduled=[
+        FaultSpec(8, "train_step", FaultKind.KILL)])  # 8 consumed > 6/pass
+    tr = _trainer(fault_plan=plan)
+    with pytest.raises(KillPoint):
+        tr.fit(Finite(), epochs=2, steps_per_epoch=5, verbose=0,
+               callbacks=[_ckpt_cb(ckdir)])
+    tr2 = _trainer()
+    tr2.fit(Finite(), epochs=2, steps_per_epoch=5, verbose=0, resume=ckdir)
+    _assert_bit_exact(_params(tr2), _params(clean))
+
+
+def test_preemption_delegates_grace_save_to_checkpoint_every_n(tmp_path):
+    """One writing manager per directory: PreemptionCheckpoint with a
+    delegate saves through CheckpointEveryN — idempotent when the
+    signal lands exactly on a save-cadence batch."""
+    import os as _os
+    import signal as _signal
+
+    from pddl_tpu.utils.preemption import PreemptionCheckpoint
+
+    class Sig:
+        def set_trainer(self, t):
+            self.trainer = t
+
+        def on_train_begin(self, state):
+            return None
+
+        def on_train_end(self, state, logs):
+            return None
+
+        def on_epoch_begin(self, epoch, state):
+            return None
+
+        def on_epoch_end(self, epoch, state, logs):
+            return None
+
+        def on_train_batch_end(self, step, state, logs):
+            if step == 3:  # lands ON the every-2 cadence (step 4 saved)
+                _os.kill(_os.getpid(), _signal.SIGTERM)
+            return None
+
+    ckdir = str(tmp_path / "ck")
+    cen = _ckpt_cb(ckdir, every=2)
+    tr = _trainer()
+    tr.fit(_dataset(), epochs=EPOCHS, steps_per_epoch=SPE, verbose=0,
+           callbacks=[Sig(), cen, PreemptionCheckpoint(delegate=cen)])
+    assert int(jax.device_get(tr.state.step)) == 4
+    ck = Checkpointer(ckdir, read_only=True)
+    try:
+        # The cadence saved step 4; the grace save was the idempotent
+        # no-op, not a second-manager collision.
+        assert ck.latest_step() == 4
+        assert ck.metadata(4)["loader"]["step_in_epoch"] == 4
+    finally:
+        ck.close()
+    with pytest.raises(ValueError, match="exactly one"):
+        PreemptionCheckpoint(ckdir, delegate=cen)
+
+
+def test_with_offset_repositions_synthetic_streams():
+    ds = _dataset()
+    shifted = ds.with_offset(3)
+    np.testing.assert_array_equal(shifted.batch(0)["image"],
+                                  ds.batch(3)["image"])
+    from pddl_tpu.data.synthetic import SyntheticLanguageModeling
+
+    lm = SyntheticLanguageModeling(batch_size=4, seq_len=8, seed=1)
+    np.testing.assert_array_equal(lm.with_offset(2).batch(1)["tokens"],
+                                  lm.batch(3)["tokens"])
+
+
+# --------------------------------------------- checkpoint verification
+def test_tampered_checksum_metadata_detected(tmp_path):
+    """A checksum mismatch (not just a torn file) is detected: restore
+    with an explicit step raises; restore without one falls back to the
+    previous verified save."""
+    ckdir = str(tmp_path / "ck")
+    tr = _trainer()
+    tr.fit(_dataset(), epochs=1, steps_per_epoch=SPE, verbose=0,
+           callbacks=[_ckpt_cb(ckdir)])
+    ck = Checkpointer(ckdir, async_save=False)
+    try:
+        newest = ck.latest_step()
+        meta_path = None
+        for root, _, files in os.walk(os.path.join(ckdir, str(newest))):
+            for name in files:
+                if name.endswith(".json") or "metadata" in name:
+                    p = os.path.join(root, name)
+                    try:
+                        doc = json.load(open(p))
+                    except Exception:  # noqa: BLE001
+                        continue
+                    if isinstance(doc, dict) and "checksums" in doc:
+                        meta_path = p
+                        first = next(iter(doc["checksums"]))
+                        doc["checksums"][first] = "deadbeef"
+                        json.dump(doc, open(p, "w"))
+        assert meta_path, "no checksum metadata found on disk"
+        with pytest.raises(CheckpointCorruptError):
+            ck.restore(tr.state, step=newest)
+        restored = ck.restore(tr.state)  # falls back
+        assert int(jax.device_get(restored.step)) < newest
+    finally:
+        ck.close()
+
+
+def test_torn_latest_save_falls_back(tmp_path):
+    """A torn save (files missing — crash mid-write after finalize
+    bookkeeping) restores the previous step instead of raising."""
+    ckdir = str(tmp_path / "ck")
+    tr = _trainer()
+    tr.fit(_dataset(), epochs=1, steps_per_epoch=SPE, verbose=0,
+           callbacks=[_ckpt_cb(ckdir)])
+    ck = Checkpointer(ckdir, async_save=False)
+    try:
+        newest = ck.latest_step()
+        state_dir = os.path.join(ckdir, str(newest), "state")
+        for root, _, files in os.walk(state_dir):
+            for name in files:
+                os.remove(os.path.join(root, name))
+        restored = ck.restore(tr.state)
+        assert int(jax.device_get(restored.step)) < newest
+    finally:
+        ck.close()
+
+
+def test_checkpoint_every_n_writes_verified_saves(tmp_path):
+    ckdir = str(tmp_path / "ck")
+    cb = _ckpt_cb(ckdir, every=2)
+    tr = _trainer()
+    tr.fit(_dataset(), epochs=1, steps_per_epoch=SPE, verbose=0,
+           callbacks=[cb])
+    assert cb.saves == 2  # steps 2 and 4
+    ck = Checkpointer(ckdir, read_only=True)
+    try:
+        assert ck.all_steps() == [2, 4]
+        meta = ck.metadata(4)
+        assert meta["loader"] == {"epoch": 0, "step_in_epoch": 4,
+                                  "batches_consumed": 4}
+        restored = ck.restore(tr.state, step=4)  # verifies checksums
+        assert ck.verify(restored, 4)
+    finally:
+        ck.close()
+    assert tr.fault_stats["checkpoints_saved"] == 2
+
+
+def test_checkpoint_every_n_rejects_unsafe_retention(tmp_path):
+    with pytest.raises(ValueError, match="max_to_keep"):
+        CheckpointEveryN(str(tmp_path), max_to_keep=1)
+    with pytest.raises(ValueError, match="every_n_steps"):
+        CheckpointEveryN(str(tmp_path), every_n_steps=0)
+
+
+# ------------------------------------------------------- worker loss
+def test_heartbeat_monitor_detects_silent_worker(tmp_path):
+    from pddl_tpu.parallel.multiworker import HeartbeatMonitor, WorkerLost
+
+    now = [1000.0]
+    clock = lambda: now[0]  # noqa: E731
+    a = HeartbeatMonitor(str(tmp_path), process_id=0, num_processes=2,
+                         timeout_s=5.0, clock=clock)
+    b = HeartbeatMonitor(str(tmp_path), process_id=1, num_processes=2,
+                         timeout_s=5.0, clock=clock)
+    a.start()
+    b.start()
+    a.check()  # both fresh
+    now[0] += 4.0
+    b.beat()
+    a.beat()
+    a.check()  # b beat recently
+    now[0] += 6.0
+    a.beat()   # a alive, b silent for 6s > 5s
+    with pytest.raises(WorkerLost) as e:
+        a.check()
+    assert e.value.lost == [1]
+    # b's view symmetrically blames a... after a's last beat goes stale.
+    now[0] += 6.0
+    b.beat()
+    assert b.failed() == [0]
+
+
+def test_heartbeat_restart_marker_roundtrip(tmp_path):
+    from pddl_tpu.parallel.multiworker import HeartbeatMonitor
+
+    a = HeartbeatMonitor(str(tmp_path), process_id=0, num_processes=2,
+                         timeout_s=5.0)
+    b = HeartbeatMonitor(str(tmp_path), process_id=1, num_processes=2,
+                         timeout_s=5.0)
+    assert not b.restart_requested()
+    a.request_restart("drill")
+    assert b.restart_requested()
+    b.clear_restart()
+    assert not a.restart_requested()
+
+
+def test_heartbeat_callback_stops_training_and_reports(tmp_path):
+    """A phantom worker that never beats: the callback detects it at a
+    batch boundary, requests the coordinated restart, stops training
+    cleanly (checkpoint callbacks still flush), and re-raises at train
+    end so the supervisor sees the failure."""
+    from pddl_tpu.parallel.multiworker import (
+        HeartbeatCallback,
+        HeartbeatMonitor,
+        WorkerLost,
+    )
+
+    now = [0.0]
+    mon = HeartbeatMonitor(str(tmp_path / "hb"), process_id=0,
+                           num_processes=2, timeout_s=0.5,
+                           clock=lambda: now[0])
+    cb = HeartbeatCallback(mon, check_every_steps=2)
+
+    class Tick:
+        def set_trainer(self, t):
+            self.trainer = t
+
+        def on_train_begin(self, state):
+            return None
+
+        def on_train_end(self, state, logs):
+            return None
+
+        def on_epoch_begin(self, epoch, state):
+            return None
+
+        def on_epoch_end(self, epoch, state, logs):
+            return None
+
+        def on_train_batch_end(self, step, state, logs):
+            now[0] += 0.3  # 2 steps outrun the 0.5s timeout
+            return None
+
+    tr = _trainer()
+    with pytest.raises(WorkerLost):
+        tr.fit(_dataset(), epochs=1, steps_per_epoch=SPE, verbose=0,
+               callbacks=[Tick(), cb])
+    assert mon.restart_requested()
+    assert int(jax.device_get(tr.state.step)) < SPE  # stopped early
+
+    # An OBSERVER (another worker's callback) sees a marker dropped
+    # MID-training and stops WITHOUT raising — only the detector
+    # reports. (A marker left over from a previous incarnation is
+    # cleared at train begin instead: relaunches must start clean.)
+    mon2 = HeartbeatMonitor(str(tmp_path / "hb"), process_id=1,
+                            num_processes=2, timeout_s=1e9)
+    # Marker polling rides the check cadence (shared-FS metadata cost);
+    # check every batch here so the observer reacts at the next boundary.
+    cb2 = HeartbeatCallback(mon2, check_every_steps=1)
+
+    class DropMarker:
+        def set_trainer(self, t):
+            self.trainer = t
+
+        def on_train_begin(self, state):
+            return None
+
+        def on_train_end(self, state, logs):
+            return None
+
+        def on_epoch_begin(self, epoch, state):
+            return None
+
+        def on_epoch_end(self, epoch, state, logs):
+            return None
+
+        def on_train_batch_end(self, step, state, logs):
+            if step == 1:  # another worker requests a restart
+                mon.request_restart("peer detection")
+            return None
+
+    tr2 = _trainer()
+    tr2.fit(_dataset(), epochs=1, steps_per_epoch=SPE, verbose=0,
+            callbacks=[DropMarker(), cb2])
+    assert cb2.lost is None  # observer, not detector
+    assert int(jax.device_get(tr2.state.step)) == 2  # stopped at marker
+
+
+# ------------------------------------------------------- exposition
+def test_train_exposition_renders_every_snapshot_key(tmp_path):
+    """Drift guard, both directions: every fault_snapshot key lands in
+    the exposition (flat or labeled), and the strict parser round-trips
+    the text — training rides the SAME export path as serving."""
+    plan = TrainFaultPlan(scheduled=[
+        FaultSpec(3, "train_step", FaultKind.TRANSIENT, count=4)])
+    tr = _trainer(fault_plan=plan)
+    tr.fit(_dataset(), epochs=1, steps_per_epoch=SPE, verbose=0,
+           callbacks=[_ckpt_cb(tmp_path / "ck")])
+    snap = tr.fault_snapshot()
+    assert snap["retries"] == 3
+    assert snap["recoveries"] == 1
+    assert snap["faults_injected"]["transient"] == 4
+    assert snap["compile_counts"] == {"train_step": 1}
+
+    text = train_exposition(tr)
+    samples, types = parse_prometheus_text(text)
+    names = {n for n, _ in samples}
+    for key in snap:
+        assert any(f"pddl_train_{key}" in n for n in names), \
+            f"snapshot key {key!r} missing from exposition"
+    assert types["pddl_train_retries_total"] == "counter"
+    assert samples[("pddl_train_retries_total", ())] == 3.0
+    assert samples[("pddl_train_compile_counts",
+                    (("key", "train_step"),))] == 1.0
+
+
+def test_train_fault_plan_validates_sites():
+    with pytest.raises(ValueError, match="unknown scheduled site"):
+        TrainFaultPlan(scheduled=[FaultSpec(0, "tick",
+                                            FaultKind.TRANSIENT)])
+    with pytest.raises(ValueError, match="unknown fault site"):
+        TrainFaultPlan(sites=["prefill"])
+    # The serving plan keeps its own vocabulary — shared machinery,
+    # separate site namespaces.
+    from pddl_tpu.serve.faults import FaultPlan
+
+    assert "tick" in FaultPlan.SITES
+    assert "train_step" in TrainFaultPlan.SITES
