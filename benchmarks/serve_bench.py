@@ -39,6 +39,17 @@ the ONLINE layer (`pddl_tpu/serve/`) the way a serving owner would:
    uninstrumented one. `--trace out.jsonl` additionally writes a full
    span/tick/metrics event log as a bench artifact.
 
+6. **Fleet leg** (`--fleet-only`, `--fleet-replicas 2,4,8`) — the
+   multi-replica tier (`pddl_tpu/serve/fleet/`): N real worker
+   processes behind the health-checked router, open-loop Poisson at
+   `--fleet-load` × N × the r08 single-engine clean baseline.
+   Aggregate tok/s + p99 TTFT per N (the scaling curve), plus the
+   failover leg at N ∈ {2, 4}: one replica SIGKILL'd mid-run (paired
+   clean/killed waves) — throughput retained vs the 0.9·(N−1)/N
+   floor, every request terminal, migrated survivor streams pinned
+   token-exact against an oracle engine, zero recompiles on
+   survivors.
+
 Every record embeds the engine's final `ServeMetrics.snapshot()`, so
 artifacts carry tail latencies (TTFT/token-latency p50/p99), not just
 throughput.
@@ -521,6 +532,236 @@ def _poisson_load(model, variables, offered_rps: float, n_requests: int,
     }
 
 
+def _fleet_worker_config(args) -> dict:
+    return dict(vocab=args.vocab, max_len=args.max_len,
+                embed_dim=args.embed_dim, depth=args.depth,
+                heads=args.heads, slots=args.slots,
+                prefill_len=args.prefill_len,
+                max_queue_depth=4 * args.slots, param_seed=0,
+                # Prefix reuse OFF: this leg's prompts share nothing
+                # (the pool would only add overhead) and the committed
+                # r11 artifact was measured on the 4-program engine —
+                # keep reruns comparable to it.
+                prefix_cache_blocks=0)
+
+
+def _fleet_spawn(n: int, cfg: dict):
+    import subprocess
+
+    from pddl_tpu.serve.fleet import FleetRouter, ProcessReplica
+
+    # Launch every worker first, then wait: the N warmup compiles run
+    # concurrently instead of paying N serial engine builds.
+    replicas = [ProcessReplica(i, {**cfg, "replica_id": i},
+                               stderr=subprocess.DEVNULL, wait_ready=False)
+                for i in range(n)]
+    for r in replicas:
+        r.wait_ready()
+    return FleetRouter(replicas, affinity_block_size=8,
+                       affinity_blocks=1, respawn=False)
+
+
+def _fleet_wave(fleet, prompts, new_tokens: int, offered_rps: float,
+                seed: int, kill_at_request: int = -1):
+    """One open-loop Poisson wave through the fleet (real time, so TTFT
+    includes genuine queue wait). ``kill_at_request >= 0`` SIGKILLs the
+    busiest replica once that many requests have been submitted — the
+    un-drainable mid-run death the failover leg measures."""
+    rng = np.random.default_rng(seed)
+    arrivals = np.cumsum(rng.exponential(1.0 / offered_rps, len(prompts)))
+    handles, rejected, killed_id = [], 0, None
+    # Hang protection: without a deadline the all_terminal field below
+    # would be a tautology — the loop could only ever exit with every
+    # handle done, and a regression stranding one request would spin
+    # the bench forever instead of failing its assert.
+    deadline = time.perf_counter() + max(
+        120.0, float(arrivals[-1]) + 2.0 * len(prompts))
+    t0 = time.perf_counter()
+    i = 0
+    while i < len(prompts) or any(not h.done for h in handles):
+        if time.perf_counter() > deadline:
+            break  # stranded request: report it, don't hang
+        now = time.perf_counter() - t0
+        while i < len(prompts) and arrivals[i] <= now:
+            try:
+                handles.append(fleet.submit(prompts[i], new_tokens))
+            except Exception:  # noqa: BLE001 - QueueFull / NoHealthy
+                rejected += 1
+            i += 1
+            if i == kill_at_request and killed_id is None:
+                victim = max((s for s in fleet.replicas
+                              if s.state.value == "up"),
+                             key=lambda s: s.load)
+                killed_id = victim.replica_id
+                victim.driver.kill()
+        if fleet.step() == 0:
+            time.sleep(0.001)
+    wall = time.perf_counter() - t0
+    delivered = sum(len(h.tokens) for h in handles)
+    ttfts = [h.ttft_s for h in handles if h.ttft_s is not None]
+    return {
+        "tokens_per_s": delivered / wall,
+        "ttft_p50_s": float(np.percentile(ttfts, 50)) if ttfts else None,
+        "ttft_p99_s": float(np.percentile(ttfts, 99)) if ttfts else None,
+        "rejected": rejected,
+        "all_terminal": all(h.done for h in handles),
+        "finished": sum(h.state.value == "finished" for h in handles),
+        "n_requests": len(handles),
+        "killed_replica": killed_id,
+        "handles": handles,
+    }
+
+
+def _fleet_leg(args, replica_counts, *, load_frac: float = 0.8,
+               kill_counts=(2, 4)):
+    """The r11 leg: aggregate tok/s + p99 TTFT at N replicas under
+    Poisson load (clean), and the failover leg — one replica
+    SIGKILL'd mid-run — at N in ``kill_counts``. Process replicas run
+    genuinely in parallel, so the scaling curve is real concurrency,
+    not slot arithmetic. Clean repeats reuse one fleet (spawn cost is
+    startup, not serving); every killed repeat gets a fresh fleet and
+    is PAIRED with a clean wave for the retained-throughput ratio.
+    Token-exactness after migration is pinned against an in-process
+    oracle engine built from the same param seed."""
+    from pddl_tpu.serve.fleet.worker import build_engine
+
+    cfg = _fleet_worker_config(args)
+    # The committed r08 single-engine clean baseline at this config —
+    # the acceptance comparison (N=4 must beat 2x this number).
+    r08_path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))),
+        "artifacts", "gpt_bench", "r08_serve_faults.json")
+    try:
+        with open(r08_path) as f:
+            baseline = json.load(f)["results"]["faults"][
+                "clean_tokens_per_s"]
+    except Exception:  # noqa: BLE001 - artifact absent: ratio omitted
+        baseline = None
+    cap_single = baseline or 1000.0
+    oracle = build_engine(cfg)
+    oracle_refs = {}
+
+    def ref_for(prompt):
+        key = tuple(prompt)
+        if key not in oracle_refs:
+            out = generate(oracle.model, {"params": oracle._params},
+                           jnp.asarray(prompt, jnp.int32)[None],
+                           args.new_tokens)
+            oracle_refs[key] = np.asarray(out)[0, len(prompt):].tolist()
+        return oracle_refs[key]
+
+    scaling = []
+    for n in replica_counts:
+        offered = load_frac * n * cap_single / args.new_tokens
+        n_requests = 48 * n  # long waves: the drain tail amortizes
+        fleet = _fleet_spawn(n, cfg)
+        try:
+            tps_all, p99_all, p50_all = [], [], []
+            last = None
+            for rep in range(args.repeats):
+                prompts = _make_requests(n_requests, args.prompt_len,
+                                         args.new_tokens, args.vocab,
+                                         seed=100 * n + rep)
+                last = _fleet_wave(fleet, prompts, args.new_tokens,
+                                   offered, seed=100 * n + rep)
+                assert last["all_terminal"]
+                tps_all.append(last["tokens_per_s"])
+                p99_all.append(last["ttft_p99_s"])
+                p50_all.append(last["ttft_p50_s"])
+            tps_med, tps_spread = median_spread(tps_all)
+            counts = fleet.compile_counts()
+            snap = fleet.metrics.snapshot()
+        finally:
+            fleet.close()
+        scaling.append({
+            "replicas": n,
+            "offered_fraction_of_nx_baseline": load_frac,
+            "offered_tokens_per_s": round(offered * args.new_tokens, 1),
+            "n_requests_per_wave": n_requests,
+            "tokens_per_s": round(tps_med, 1),
+            "tokens_per_s_spread_pct": round(tps_spread, 2),
+            "tokens_per_s_per_repeat": [round(t, 1) for t in tps_all],
+            "ttft_p50_s": round(median_spread(p50_all)[0], 4),
+            "ttft_p99_s": round(median_spread(p99_all)[0], 4),
+            "rejected_last_wave": last["rejected"],
+            "vs_r08_clean_x": (round(tps_med / baseline, 3)
+                               if baseline else None),
+            "zero_recompiles_all_replicas": bool(counts) and all(
+                v == 1 for v in counts.values()),
+            "fleet_metrics": snap,
+        })
+        _log(f"fleet N={n}: {tps_med:,.0f} tok/s (spread "
+             f"{tps_spread:.1f}%), p99 TTFT "
+             f"{scaling[-1]['ttft_p99_s']}s, vs r08 "
+             f"{scaling[-1]['vs_r08_clean_x']}x")
+
+    killed = []
+    for n in (k for k in kill_counts if k in replica_counts):
+        offered = load_frac * n * cap_single / args.new_tokens
+        n_requests = 48 * n
+        ratios, clean_all, killed_all = [], [], []
+        exact_all, migrated_total = True, 0
+        for rep in range(args.repeats):
+            prompts = _make_requests(n_requests, args.prompt_len,
+                                     args.new_tokens, args.vocab,
+                                     seed=500 * n + rep)
+            fleet = _fleet_spawn(n, cfg)
+            try:  # PAIRED: clean wave then killed wave, fresh fleets
+                clean = _fleet_wave(fleet, prompts, args.new_tokens,
+                                    offered, seed=500 * n + rep)
+                # A stranded clean-wave request would deflate the clean
+                # denominator and inflate the retained ratio meets_floor
+                # is judged on — fail the pair loudly instead.
+                assert clean["all_terminal"], \
+                    "a clean-wave request never settled"
+            finally:
+                fleet.close()
+            fleet = _fleet_spawn(n, cfg)
+            try:
+                kill = _fleet_wave(fleet, prompts, args.new_tokens,
+                                   offered, seed=500 * n + rep,
+                                   kill_at_request=n_requests // 2)
+                assert kill["all_terminal"], "a request never settled"
+                for h in kill["handles"]:
+                    if h.state.value == "finished" \
+                            and h.tokens != ref_for(h.request.prompt):
+                        exact_all = False
+                migrated_total += fleet.metrics.requests_migrated
+                counts = fleet.compile_counts()
+                surv_ok = bool(counts) and all(
+                    v == 1 for v in counts.values())
+            finally:
+                fleet.close()
+            clean_all.append(clean["tokens_per_s"])
+            killed_all.append(kill["tokens_per_s"])
+            ratios.append(kill["tokens_per_s"] / clean["tokens_per_s"])
+        ratio_med, ratio_spread = median_spread(ratios)
+        floor = 0.9 * (n - 1) / n
+        killed.append({
+            "replicas": n,
+            "kill": "SIGKILL busiest replica at half the request "
+                    "schedule (un-drainable: replay-mirror migration)",
+            "clean_tokens_per_s": round(median_spread(clean_all)[0], 1),
+            "killed_tokens_per_s": round(median_spread(killed_all)[0], 1),
+            "throughput_retained_x": round(ratio_med, 3),
+            "throughput_retained_per_pair": [round(r, 3) for r in ratios],
+            "throughput_retained_spread_pct": round(ratio_spread, 2),
+            "retained_floor_0p9_nm1_over_n": round(floor, 3),
+            "meets_floor": ratio_med >= floor,
+            "requests_migrated_total": migrated_total,
+            "survivor_streams_token_exact": exact_all,
+            "zero_recompiles_survivors_last_repeat": surv_ok,
+        })
+        _log(f"fleet kill N={n}: retained {ratio_med:.3f}x (floor "
+             f"{floor:.3f}, pairs {killed[-1]['throughput_retained_per_pair']}), "
+             f"migrated {migrated_total}, token-exact {exact_all}")
+    return {
+        "baseline_r08_clean_tokens_per_s": baseline,
+        "scaling": scaling,
+        "killed": killed,
+    }
+
+
 def main() -> None:
     p = argparse.ArgumentParser()
     p.add_argument("--vocab", type=int, default=256)
@@ -576,8 +817,53 @@ def main() -> None:
     p.add_argument("--repeats", type=int, default=3,
                    help="timed repetitions per headline number (median "
                         "+ spread recorded)")
+    p.add_argument("--fleet-only", action="store_true",
+                   help="run ONLY the multi-replica fleet leg (process "
+                        "replicas behind the router) and write a "
+                        "standalone artifact (r11_serve_fleet.json)")
+    p.add_argument("--fleet-replicas", default="2,4,8",
+                   help="comma-separated replica counts for the fleet "
+                        "scaling curve")
+    p.add_argument("--fleet-load", type=float, default=0.8,
+                   help="offered Poisson load as a fraction of "
+                        "N x the r08 single-engine clean baseline")
     p.add_argument("--out", default="")
     args = p.parse_args()
+
+    if args.fleet_only:
+        replica_counts = [int(n) for n in
+                          args.fleet_replicas.split(",") if n]
+        kill_ns = [k for k in (2, 4) if k in replica_counts]
+        _log(f"fleet leg only: N in {replica_counts}, "
+             f"{args.slots} slots/replica, Poisson at "
+             f"{args.fleet_load:.0%} of N x r08 baseline, kill leg at "
+             f"N in {kill_ns or '(none: no N in {2, 4} requested)'}")
+        fleet_results = _fleet_leg(args, replica_counts,
+                                   load_frac=args.fleet_load)
+        record = {
+            "metric": "fleet_serving_scaling_and_failover",
+            "unit": "tokens/sec aggregate (fleet, process replicas)",
+            "config": {
+                "model": (f"gpt {args.depth}x{args.embed_dim} "
+                          f"(vocab {args.vocab}, max_len "
+                          f"{args.max_len})"),
+                "slots_per_replica": args.slots,
+                "prefill_len": args.prefill_len,
+                "prompt_len": args.prompt_len,
+                "new_tokens": args.new_tokens,
+                "fleet_load_fraction": args.fleet_load,
+                "router": "prefix-affinity + rendezvous hash + sticky "
+                          "sessions; per-replica circuit breaker; "
+                          "drain-format live migration with "
+                          "replay-mirror fallback "
+                          "(pddl_tpu/serve/fleet/)",
+            },
+            "provenance": provenance(args.repeats),
+            "results": {"fleet": fleet_results},
+            "device": jax.devices()[0].device_kind,
+        }
+        _write_record(record, args.out)
+        return
 
     model = GPT(vocab_size=args.vocab, max_len=args.max_len,
                 embed_dim=args.embed_dim, depth=args.depth,

@@ -33,6 +33,7 @@ import json
 import os
 import shutil
 import sys
+import sysconfig
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -87,13 +88,17 @@ def build_digits_folder(root: str, image_size: int = 32,
 
 def build_python_corpus(root: str, max_bytes: int = 20 << 20,
                         val_fraction: float = 0.05,
-                        source_dir: str = "/usr/local/lib/python3.12") -> dict:
+                        source_dir: str | None = None) -> dict:
     """Concatenate CPython stdlib sources into train.txt/val.txt.
 
     A real, public text corpus that ships with every machine. Files are
     walked in sorted order (deterministic), capped at ``max_bytes``; the
-    tail ``val_fraction`` becomes the held-out split.
+    tail ``val_fraction`` becomes the held-out split. ``source_dir``
+    defaults to the RUNNING interpreter's stdlib (a hardcoded version
+    path silently yields an empty corpus on any other interpreter).
     """
+    if source_dir is None:
+        source_dir = sysconfig.get_paths()["stdlib"]
     chunks, total = [], 0
     for dirpath, dirnames, filenames in sorted(os.walk(source_dir)):
         dirnames.sort()

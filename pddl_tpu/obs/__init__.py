@@ -11,12 +11,14 @@ HTTP endpoint. See docs/OPERATIONS.md § "Observability (serving)".
 """
 
 from pddl_tpu.obs.export import (
+    FLEET_COUNTER_KEYS,
     SERVE_COUNTER_KEYS,
     TRAIN_COUNTER_KEYS,
     JsonlEventLog,
     MetricsHTTPServer,
     device_memory_gauges,
     engine_gauges,
+    fleet_exposition,
     parse_prometheus_text,
     read_jsonl,
     render_prometheus,
@@ -32,6 +34,7 @@ from pddl_tpu.obs.trace import (
 )
 
 __all__ = [
+    "FLEET_COUNTER_KEYS",
     "JsonlEventLog",
     "MetricsHTTPServer",
     "NULL_TRACER",
@@ -42,6 +45,7 @@ __all__ = [
     "TelemetryRing",
     "device_memory_gauges",
     "engine_gauges",
+    "fleet_exposition",
     "parse_prometheus_text",
     "read_jsonl",
     "render_prometheus",

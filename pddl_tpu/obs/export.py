@@ -300,6 +300,43 @@ def train_exposition(trainer, *, step_timer=None,
     return "".join(parts)
 
 
+# The canonical fleet-counter vocabulary: FleetMetrics.snapshot()
+# derives its keys from this set (and render's counter typing reads
+# it), so there is exactly one list to extend per new counter.
+FLEET_COUNTER_KEYS = frozenset({
+    "replica_up_events", "replica_down_events", "migrations",
+    "requests_migrated", "migrated_via_drain", "migrated_via_replay",
+    "requests_routed", "routed_sticky", "routed_affinity", "routed_hash",
+    "shed_rerouted", "shed_rejected", "requests_finished",
+    "requests_failed", "requests_orphaned", "heartbeat_failures",
+    "probes", "probe_failures", "tokens_streamed",
+})
+
+
+def fleet_exposition(router) -> str:
+    """The fleet-router scrape body: :class:`~pddl_tpu.serve.fleet.
+    FleetMetrics` counters (circuit transitions included as flattened
+    ``circuit_<from>_to_<to>`` counters) plus live per-replica gauges —
+    lifecycle, breaker state, and assigned load as labeled series keyed
+    by replica id. Same renderer/text format as serving and training,
+    so one Prometheus config scrapes all three tiers."""
+    snap = dict(router.metrics.snapshot())
+    counters = FLEET_COUNTER_KEYS | {
+        k for k in snap if k.startswith("circuit_")}
+    snap["replicas"] = len(router.replicas)
+    snap["replicas_healthy"] = router.healthy_replicas
+    snap["replica_state"] = {
+        f"r{s.replica_id}": 1 if s.state.value == "up" else 0
+        for s in router.replicas}
+    snap["replica_breaker_open"] = {
+        f"r{s.replica_id}": 0 if s.breaker.allows_traffic else 1
+        for s in router.replicas}
+    snap["replica_load"] = {
+        f"r{s.replica_id}": s.load for s in router.replicas}
+    return render_prometheus(snap, prefix="pddl_fleet",
+                             counters=frozenset(counters))
+
+
 def serve_exposition(metrics, engine=None, *,
                      step_timer=None,
                      device_memory: bool = False) -> str:

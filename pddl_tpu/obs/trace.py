@@ -176,6 +176,13 @@ class NullTracer:
     def on_drain(self, step: int, n_requests: int) -> None:
         """Engine drained (snapshot taken)."""
 
+    def on_fleet_event(self, name: str, **attrs) -> None:
+        """A fleet-router lifecycle event (`serve/fleet/router.py`):
+        replica_up/replica_down, circuit transitions, migration, shed,
+        heartbeat_missed, orphaned, probe_failed. One generic hook —
+        the event vocabulary belongs to the router, the transport (and
+        the no-op discipline) to the tracer."""
+
     # ------------------------------------------------- training hooks
     # The Trainer's guarded boundary (`train/loop.py`) emits through
     # the SAME tracer surface the serving engine uses — `on_retry` and
@@ -259,9 +266,10 @@ class RequestTracer(NullTracer):
             # the records) instead of crashing the serving engine.
             self.sink_errors += 1
 
-    def _engine_event(self, name: str, **attrs) -> None:
+    def _engine_event(self, name: str, kind: str = "engine_event",
+                      **attrs) -> None:
         ev: Dict[str, object] = {"schema": SCHEMA_VERSION,
-                                 "kind": "engine_event",
+                                 "kind": kind,
                                  "t_s": self._clock(), "name": name}
         ev.update(attrs)
         self.engine_events.append(ev)
@@ -373,6 +381,12 @@ class RequestTracer(NullTracer):
     def on_degraded_exit(self, step: int, duration_s: float) -> None:
         self._engine_event("degraded_exit", step=step,
                            duration_s=duration_s)
+
+    def on_fleet_event(self, name: str, **attrs) -> None:
+        # Rides the engine-event record stream (same deque, same sink)
+        # with kind="fleet_event", so events_named() and the JSONL log
+        # cover the fleet without a second pipeline.
+        self._engine_event(name, kind="fleet_event", **attrs)
 
     # ------------------------------------------------- training hooks
     def on_checkpoint_saved(self, step: int, wall_s: float) -> None:

@@ -125,8 +125,10 @@ def _sequential_grid():
     default dimension semantics."""
     from jax.experimental.pallas import tpu as pltpu  # noqa: PLC0415
 
-    return pltpu.CompilerParams(
-        dimension_semantics=("arbitrary", "arbitrary", "arbitrary"))
+    # Older jax spells it TPUCompilerParams; same fields either way.
+    cp = getattr(pltpu, "CompilerParams", None) \
+        or pltpu.TPUCompilerParams
+    return cp(dimension_semantics=("arbitrary", "arbitrary", "arbitrary"))
 
 
 def _masked_scores(q_ref, k_ref, qi, ki, *, scale, causal, block_q, block_k,
@@ -394,7 +396,10 @@ def _sds_like(ref_value):
     """ShapeDtypeStruct factory that propagates the varying-manual-axes set
     of ``ref_value`` — inside shard_map (GPipe stages, seq-sharded regions)
     pallas outputs must declare how they vary across mesh axes."""
-    vma = getattr(jax.typeof(ref_value), "vma", None)
+    typeof = getattr(jax, "typeof", None)
+    if typeof is None:  # pre-vma jax: nothing to propagate
+        return jax.ShapeDtypeStruct
+    vma = getattr(typeof(ref_value), "vma", None)
     if vma:
         return functools.partial(jax.ShapeDtypeStruct, vma=vma)
     return jax.ShapeDtypeStruct

@@ -32,7 +32,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
-from pddl_tpu.core.mesh import DATA_AXIS, STAGE_AXIS
+from pddl_tpu.core.collectives import pcast_varying
+from pddl_tpu.core.mesh import DATA_AXIS, STAGE_AXIS, shard_map
 
 PyTree = Any
 
@@ -125,8 +126,8 @@ def gpipe_apply(
         # The carries are logically per-device (stage-varying) even though
         # their initial values are constants — cast them to varying so the
         # scan carry type is stable (see also ring_attention).
-        buf_init = lax.pcast(zero, (stage_axis,), to="varying")
-        outs_init = lax.pcast(outs0, (data_axis, stage_axis), to="varying")
+        buf_init = pcast_varying(zero, (stage_axis,))
+        outs_init = pcast_varying(outs0, (data_axis, stage_axis))
         (_, outs), _ = lax.scan(
             tick, (buf_init, outs_init), jnp.arange(n_microbatches + n_stages - 1)
         )
@@ -138,7 +139,7 @@ def gpipe_apply(
     param_specs = jax.tree.map(
         lambda p: P(stage_axis, *([None] * (p.ndim - 1))), stage_params
     )
-    return jax.shard_map(
+    return shard_map(
         pipelined,
         mesh=mesh,
         in_specs=(param_specs, P(data_axis, *([None] * (x.ndim - 1)))),

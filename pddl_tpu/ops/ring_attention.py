@@ -34,6 +34,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from pddl_tpu.core.collectives import axis_size, pcast_varying
+from pddl_tpu.core.mesh import shard_map
 from pddl_tpu.ops.attention import NEG_INF
 
 
@@ -74,7 +76,7 @@ def ring_attention(
     # Shape-static, so the check is free — direct shard_map callers get
     # the descriptive error instead of an opaque reshape failure.
     rep = _gqa_rep(q, k)
-    n = lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     my = lax.axis_index(axis_name)
     scale_v = (1.0 / math.sqrt(d)) if scale is None else scale
     if window is not None and not causal:
@@ -114,7 +116,7 @@ def ring_attention(
     # (device-varying along the ring axis) even though their initial values
     # are constants.
     def _vary(x):
-        return lax.pcast(x, axis_name, to="varying")
+        return pcast_varying(x, axis_name)
 
     m0 = _vary(jnp.full((b, hkv, rep, s_local, 1), NEG_INF, jnp.float32))
     l0 = _vary(jnp.zeros((b, hkv, rep, s_local, 1), jnp.float32))
@@ -151,7 +153,7 @@ def ring_attention_flash(
     from pddl_tpu.ops.attention import flash_attention_lse
 
     b, h, s_local, d = q.shape
-    n = lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     my = lax.axis_index(axis_name)
     scale_v = (1.0 / math.sqrt(d)) if scale is None else scale
     if window is not None and not causal:
@@ -188,7 +190,7 @@ def ring_attention_flash(
         return m, s, acc, kc, vc
 
     def _vary(x):
-        return lax.pcast(x, axis_name, to="varying")
+        return pcast_varying(x, axis_name)
 
     # Rotation 0 always sees the device's own K/V shard (src == my). Under
     # causal that is the diagonal block, which needs row-level masking
@@ -260,7 +262,7 @@ def sequence_parallel_attention(
     # exists. tests/test_attention.py::test_flash_ring_check_vma_limitation
     # pins the exact failure so a jax upgrade that fixes it flips this
     # flag. The XLA ring path runs fully checked.
-    return jax.shard_map(
+    return shard_map(
         fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
         check_vma=not use_flash,
     )(q, k, v)

@@ -5,9 +5,11 @@
 shed + prefill budget), `request.py` the per-request lifecycle,
 `metrics.py` the telemetry, `kvcache/` the prefix-aware KV reuse layer
 (radix index + device block pool), `faults.py` seeded deterministic
-fault injection, `drain.py` the SIGTERM drain/restore snapshot. See
-`docs/SERVING.md` § "Online serving" and `docs/OPERATIONS.md`
-§ "Failure modes & recovery (serving)".
+fault injection, `drain.py` the SIGTERM drain/restore snapshot,
+`fleet/` the multi-replica tier (health-checked router, replica
+failover, live request migration). See `docs/SERVING.md` § "Online
+serving" and § "Serving fleet", and `docs/OPERATIONS.md` § "Failure
+modes & recovery (serving)" and § "Fleet runbook".
 """
 
 from pddl_tpu.serve.engine import ServeEngine

@@ -39,22 +39,34 @@ from pddl_tpu.serve.request import (
 SNAPSHOT_VERSION = 1
 
 
+def encode_sampling(sampling: SamplingParams) -> Dict[str, object]:
+    """The one wire shape for sampling params — shared by snapshot
+    entries here and the fleet's submit protocol
+    (`serve/fleet/replica.py`), so a new sampling field is added in
+    exactly one encode/decode pair."""
+    return {
+        "temperature": float(sampling.temperature),
+        "top_k": int(sampling.top_k) if sampling.top_k is not None else None,
+        "top_p": (float(sampling.top_p)
+                  if sampling.top_p is not None else None),
+    }
+
+
+def decode_sampling(d) -> SamplingParams:
+    d = d or {}
+    return SamplingParams(temperature=float(d.get("temperature", 0.0)),
+                          top_k=d.get("top_k"), top_p=d.get("top_p"))
+
+
 def encode_handle(handle: RequestHandle, now_s: float) -> Dict[str, object]:
     """One request's restorable host state. ``elapsed_s`` (age at drain
     time) rather than an absolute arrival lets the restoring engine —
     whose clock has a different epoch — keep deadline semantics: the
     wall budget already consumed stays consumed."""
-    sampling = handle.request.sampling
     return {
         "prompt": [int(t) for t in handle.request.prompt],
         "max_new_tokens": int(handle.request.max_new_tokens),
-        "sampling": {
-            "temperature": float(sampling.temperature),
-            "top_k": (int(sampling.top_k)
-                      if sampling.top_k is not None else None),
-            "top_p": (float(sampling.top_p)
-                      if sampling.top_p is not None else None),
-        },
+        "sampling": encode_sampling(handle.request.sampling),
         "deadline_s": (float(handle.request.deadline_s)
                        if handle.request.deadline_s is not None else None),
         "elapsed_s": max(0.0, float(now_s - handle.arrival_s)),
@@ -69,15 +81,10 @@ def decode_handle(entry: Dict[str, object], now_s: float) -> RequestHandle:
     ``tokens`` list marks it for the engine's replay admission (KV
     rebuilt from prompt + tokens, stream continued token-exactly); an
     empty one re-enters as a fresh request."""
-    s = entry.get("sampling") or {}
     req = Request(
         prompt=[int(t) for t in entry["prompt"]],
         max_new_tokens=int(entry["max_new_tokens"]),
-        sampling=SamplingParams(
-            temperature=float(s.get("temperature", 0.0)),
-            top_k=s.get("top_k"),
-            top_p=s.get("top_p"),
-        ),
+        sampling=decode_sampling(entry.get("sampling")),
         deadline_s=entry.get("deadline_s"),
     )
     handle = RequestHandle(

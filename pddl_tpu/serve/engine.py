@@ -1071,8 +1071,18 @@ class ServeEngine:
         self._top_ps[sid] = p
         if replay:
             # Finish conditions were already evaluated for every
-            # re-fed token before the fault; re-checking would double
-            # count. The stream resumes at its live edge.
+            # re-fed token before the fault — except possibly the LAST:
+            # a fleet-migrated mirror can carry a token its dying
+            # replica emitted without living to evict on, so re-check
+            # the live edge alone (an in-engine replay can never be
+            # complete — eviction beat it to the snapshot) or the first
+            # post-replay tick samples one token past the stream's end.
+            if (self.eos_token is not None
+                    and handle.tokens[-1] == self.eos_token):
+                self._evict(sid, RequestState.FINISHED, FinishReason.EOS)
+            elif len(handle.tokens) >= req.max_new_tokens:
+                self._evict(sid, RequestState.FINISHED,
+                            FinishReason.LENGTH)
             return
         # A one-token request (or an immediate eos) finishes at
         # admission without ever joining a tick.

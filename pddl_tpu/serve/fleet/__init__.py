@@ -1,0 +1,39 @@
+"""Multi-replica serving fleet: health-checked router, replica
+failover, live request migration.
+
+`router.py` is the front door (prefix-affinity + sticky-session +
+rendezvous routing, QueueFull shedding, failover with `serve/drain.py`
+as the migration wire format), `replica.py` the driver surface
+(:class:`LocalReplica` in-process for deterministic tier-1 chaos,
+:class:`ProcessReplica` over a stdio pipe for real multiprocess
+parallelism), `worker.py` the replica process entrypoint, `health.py`
+the per-replica circuit breaker. See `docs/OPERATIONS.md` § "Fleet
+runbook" and `docs/SERVING.md` § "Serving fleet".
+"""
+
+from pddl_tpu.serve.fleet.health import BreakerState, CircuitBreaker
+from pddl_tpu.serve.fleet.replica import (
+    LocalReplica,
+    ProcessReplica,
+    ReplicaDied,
+)
+from pddl_tpu.serve.fleet.router import (
+    FleetHandle,
+    FleetMetrics,
+    FleetRouter,
+    NoHealthyReplica,
+    ReplicaLifecycle,
+)
+
+__all__ = [
+    "BreakerState",
+    "CircuitBreaker",
+    "FleetHandle",
+    "FleetMetrics",
+    "FleetRouter",
+    "LocalReplica",
+    "NoHealthyReplica",
+    "ProcessReplica",
+    "ReplicaDied",
+    "ReplicaLifecycle",
+]

@@ -1,0 +1,127 @@
+"""Replica health: circuit breaker + heartbeat bookkeeping.
+
+A fleet's defining property is that any replica can die at any moment —
+and a router that keeps sending traffic at a dying replica converts one
+machine's failure into every caller's latency. The standard defense
+(Nygard's *Release It!*, the pattern every service mesh ships) is the
+CIRCUIT BREAKER, one per replica:
+
+- **CLOSED** — healthy: traffic flows; consecutive failures count up.
+- **OPEN** — tripped (``failure_threshold`` consecutive failures, or an
+  outright replica death): no traffic, no probes, until a bounded
+  exponential backoff expires (``backoff_base_s * 2**n``, capped at
+  ``backoff_max_s`` — each failed recovery attempt doubles the wait, so
+  a flapping replica cannot make the router spend its time probing).
+- **HALF_OPEN** — the backoff expired: exactly one probe is allowed (a
+  respawn attempt — fresh engine for an in-process replica, fresh
+  worker process for a process replica). Success closes the circuit,
+  failure re-opens it with the doubled backoff.
+
+The breaker is pure host-side state with an injectable clock, so every
+transition is unit-testable without sleeping. Heartbeats are the
+FAILURE DETECTOR feeding it: the shared-FS beat pattern of
+:class:`pddl_tpu.parallel.multiworker.HeartbeatMonitor` applied to the
+serving tier — a local replica "beats" by completing a step, a process
+replica by answering pipe pings — and a beat older than
+``heartbeat_timeout_s`` counts as a failure exactly like an explicit
+error does.
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Callable, Optional
+
+
+class BreakerState(enum.Enum):
+    CLOSED = "closed"
+    OPEN = "open"
+    HALF_OPEN = "half_open"
+
+
+class CircuitBreaker:
+    """Per-replica circuit breaker (CLOSED → OPEN → HALF_OPEN → ...).
+
+    Args:
+      failure_threshold: consecutive failures that trip CLOSED → OPEN.
+      backoff_base_s: first OPEN interval; doubles per re-open.
+      backoff_max_s: backoff cap (bounded exponential).
+      on_transition: optional ``fn(old: BreakerState, new: BreakerState)``
+        — the router wires this to its metrics/tracer so every
+        transition is observable.
+    """
+
+    def __init__(self, *, failure_threshold: int = 3,
+                 backoff_base_s: float = 0.5, backoff_max_s: float = 30.0,
+                 on_transition: Optional[Callable] = None):
+        if failure_threshold < 1:
+            raise ValueError(
+                f"failure_threshold must be >= 1, got {failure_threshold}")
+        if backoff_base_s <= 0 or backoff_max_s < backoff_base_s:
+            raise ValueError(
+                f"need 0 < backoff_base_s <= backoff_max_s, got "
+                f"{backoff_base_s}/{backoff_max_s}")
+        self.failure_threshold = int(failure_threshold)
+        self.backoff_base_s = float(backoff_base_s)
+        self.backoff_max_s = float(backoff_max_s)
+        self.on_transition = on_transition
+        self.state = BreakerState.CLOSED
+        self.consecutive_failures = 0
+        self.open_until_s = 0.0
+        self._backoff_s = self.backoff_base_s
+
+    def _to(self, new: BreakerState) -> None:
+        if new is self.state:
+            return
+        old, self.state = self.state, new
+        if self.on_transition is not None:
+            self.on_transition(old, new)
+
+    # ------------------------------------------------------------ queries
+    @property
+    def allows_traffic(self) -> bool:
+        """Route new requests here? Only a CLOSED circuit takes traffic
+        (HALF_OPEN carries exactly the probe, nothing else)."""
+        return self.state is BreakerState.CLOSED
+
+    def probe_due(self, now_s: float) -> bool:
+        """OPEN and past the backoff: one recovery probe may fire."""
+        return self.state is BreakerState.OPEN and now_s >= self.open_until_s
+
+    # ---------------------------------------------------------- recording
+    def begin_probe(self, now_s: float) -> None:
+        """OPEN → HALF_OPEN: the single allowed probe is in flight."""
+        if self.state is not BreakerState.OPEN:
+            raise RuntimeError(
+                f"begin_probe from {self.state.value} (must be open)")
+        self._to(BreakerState.HALF_OPEN)
+
+    def record_success(self, now_s: float) -> None:
+        """A successful call (or probe): close the circuit, reset the
+        failure count AND the backoff (a recovered replica earns a
+        fresh slate — the next incident starts at the base interval)."""
+        self.consecutive_failures = 0
+        self._backoff_s = self.backoff_base_s
+        self._to(BreakerState.CLOSED)
+
+    def record_failure(self, now_s: float) -> None:
+        """One failure/timeout. CLOSED trips at the threshold; a
+        HALF_OPEN probe failure re-opens immediately with the doubled
+        (capped) backoff."""
+        self.consecutive_failures += 1
+        if self.state is BreakerState.HALF_OPEN:
+            self._reopen(now_s)
+        elif (self.state is BreakerState.CLOSED
+                and self.consecutive_failures >= self.failure_threshold):
+            self._reopen(now_s)
+
+    def trip(self, now_s: float) -> None:
+        """Unconditional → OPEN (the router saw the replica die; no
+        threshold debate needed)."""
+        if self.state is not BreakerState.OPEN:
+            self._reopen(now_s)
+
+    def _reopen(self, now_s: float) -> None:
+        self.open_until_s = now_s + self._backoff_s
+        self._backoff_s = min(self._backoff_s * 2.0, self.backoff_max_s)
+        self._to(BreakerState.OPEN)

@@ -1,0 +1,536 @@
+"""Replica drivers: one engine behind a uniform router-facing surface.
+
+The router (`fleet/router.py`) speaks one small protocol no matter how
+a replica actually runs:
+
+- ``submit(rid, ...)`` / ``cancel(rid)`` — requests enter keyed by a
+  ROUTER-assigned id (engine request ids are per-process counters and
+  mean nothing across a fleet).
+- ``step() -> events`` — advance/pump the replica; returns the token
+  and finish events since the last call as plain dicts (the same
+  shapes the process worker writes over its pipe, so the router cannot
+  care which driver produced them).
+- ``drain_entries(now) -> [(rid, entry)]`` — the live-migration
+  capture: every in-flight request's host state in the
+  `serve/drain.py` wire format, rid-tagged. Raises when the replica is
+  beyond draining (hard-killed process) — the router then falls back
+  to its own prompt+emitted-token mirrors, which is exactly r08's
+  in-engine replay contract promoted to the fleet level.
+- ``restore(pairs)`` — live migration in: wire entries re-enter this
+  replica's engine through the normal drain-restore replay path, so a
+  migrated stream continues token-exactly.
+
+Two drivers:
+
+- :class:`LocalReplica` — in-process :class:`~pddl_tpu.serve.ServeEngine`
+  stepped by the router. Deterministic (injectable clocks/fault plans
+  reach the engine directly), so the tier-1 fleet chaos matrix runs on
+  it; a replica "dies" when :class:`~pddl_tpu.utils.faults.KillPoint`
+  (or a real error) unwinds out of ``step()``.
+- :class:`ProcessReplica` — a real OS process (`fleet/worker.py`)
+  driven over a stdio JSON-line pipe; pings are the heartbeat, EOF or
+  ``SIGKILL`` is death. This is the "multiprocess on CPU" deployment
+  the bench measures: N workers genuinely run in parallel.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import Dict, List, Optional, Tuple
+
+from pddl_tpu.serve import drain as drain_io
+from pddl_tpu.serve.request import QueueFull, RequestState, SamplingParams
+
+
+class ReplicaDied(RuntimeError):
+    """The replica is gone mid-operation (process exited, pipe EOF).
+    The router treats this exactly like a ``KillPoint`` unwinding out of
+    a local replica's step: replica down, migrate the in-flight work."""
+
+    def __init__(self, replica_id: int, why: str):
+        self.replica_id = replica_id
+        super().__init__(f"replica {replica_id} died: {why}")
+
+
+# The submit protocol's sampling wire shape IS the drain snapshot's —
+# one encode/decode pair (`serve/drain.py`) for both.
+sampling_to_wire = drain_io.encode_sampling
+sampling_from_wire = drain_io.decode_sampling
+
+
+def snapshot_from_pairs(pairs: List[Tuple[int, Dict]]) -> Dict[str, object]:
+    """rid-tagged wire entries → a `serve/drain.py` snapshot dict the
+    engine's ``restore()`` accepts. The one place the fleet assembles a
+    snapshot (both drivers and the worker's restore handler), so a
+    format/version change happens here, not in three copies."""
+    return {"version": drain_io.SNAPSHOT_VERSION,
+            "requests": [entry for _, entry in pairs]}
+
+
+class HandleLedger:
+    """rid → engine handle, plus the diff cursor that turns polled
+    handle state into incremental events. Shared by :class:`LocalReplica`
+    and the process worker so both emit identical event streams."""
+
+    def __init__(self):
+        self._handles: Dict[int, object] = {}
+        self._sent: Dict[int, int] = {}
+
+    def add(self, rid: int, handle) -> None:
+        self._handles[rid] = handle
+        # A restored/migrated handle arrives with its pre-migration
+        # tokens attached; those were already streamed to the caller.
+        self._sent[rid] = len(handle.tokens)
+
+    def get(self, rid: int):
+        return self._handles.get(rid)
+
+    def harvest(self) -> List[Dict[str, object]]:
+        """Events since the last harvest: one ``tokens`` event batching
+        every stream's new tokens, then a ``finish`` per settled
+        request (token order inside a tick does not matter — each
+        stream's own order is what token-exactness pins)."""
+        events: List[Dict[str, object]] = []
+        toks: List[Tuple[int, List[int]]] = []
+        done: List[int] = []
+        for rid, h in self._handles.items():
+            sent = self._sent[rid]
+            if len(h.tokens) > sent:
+                toks.append((rid, [int(t) for t in h.tokens[sent:]]))
+                self._sent[rid] = len(h.tokens)
+            if h.done:
+                done.append(rid)
+        if toks:
+            events.append({"ev": "tokens", "toks": toks})
+        for rid in done:
+            h = self._handles.pop(rid)
+            self._sent.pop(rid, None)
+            events.append({
+                "ev": "finish", "rid": rid, "state": h.state.value,
+                "reason": (h.finish_reason.value
+                           if h.finish_reason is not None else None),
+                "ttft_s": h.ttft_s, "n_tokens": len(h.tokens)})
+        return events
+
+    def drain_entries(self, now_s: float) -> List[Tuple[int, Dict]]:
+        """Every in-flight request as a rid-tagged drain wire entry,
+        running-first FCFS order (the drain discipline: restore owes
+        the oldest running stream the earliest re-admission)."""
+        live = [(rid, h) for rid, h in self._handles.items() if not h.done]
+        live.sort(key=lambda p: (p[1].state is not RequestState.RUNNING,
+                                 p[1].arrival_s))
+        return [(rid, drain_io.encode_handle(h, now_s)) for rid, h in live]
+
+    def __len__(self) -> int:
+        return len(self._handles)
+
+
+class LocalReplica:
+    """An in-process engine replica, stepped by the router.
+
+    ``engine_factory()`` builds (and rebuilds, after a death) the
+    :class:`~pddl_tpu.serve.ServeEngine`; keeping construction in a
+    factory is what makes the circuit breaker's HALF_OPEN probe a real
+    respawn instead of a pointless ping at a dead object.
+    """
+
+    can_respawn = True
+
+    def __init__(self, replica_id: int, engine_factory):
+        self.replica_id = int(replica_id)
+        self._factory = engine_factory
+        self.engine = engine_factory()
+        self._ledger = HandleLedger()
+
+    # ------------------------------------------------------------- intake
+    def submit(self, rid: int, prompt, max_new_tokens: int,
+               sampling: SamplingParams, deadline_s) -> None:
+        handle = self.engine.submit(prompt, max_new_tokens,
+                                    sampling=sampling, deadline_s=deadline_s)
+        self._ledger.add(rid, handle)
+
+    def cancel(self, rid: int) -> None:
+        h = self._ledger.get(rid)
+        if h is not None:
+            h.cancel()
+
+    # ------------------------------------------------------------ serving
+    def warmup(self) -> None:
+        self.engine.warmup()
+
+    def step(self) -> List[Dict[str, object]]:
+        self.engine.step()
+        return self._ledger.harvest()
+
+    @property
+    def queue_depth(self) -> int:
+        return self.engine.scheduler.depth
+
+    @property
+    def live_slots(self) -> int:
+        return self.engine.live_slots
+
+    def compile_counts(self) -> Dict[str, int]:
+        return self.engine.compile_counts()
+
+    # --------------------------------------------------------- resilience
+    def drain_entries(self, now_s: float) -> List[Tuple[int, Dict]]:
+        """Live-migration capture. The engine's own ``drain()`` is also
+        invoked (idempotent) so admission stops and in-flight tracer
+        spans flush; the rid-tagged entries come from the ledger —
+        identical wire format, but keyed for the router.
+
+        ``now_s`` (the ROUTER's clock) is ignored for encoding: each
+        handle's ``arrival_s`` was stamped on the ENGINE's clock, and
+        ``elapsed_s`` (the consumed deadline budget) only means
+        anything as a same-epoch difference — a router driving a fake
+        chaos clock over real-clock engines would otherwise snapshot a
+        garbage budget."""
+        del now_s
+        entries = self._ledger.drain_entries(self.engine._clock())
+        try:
+            self.engine.drain()
+        except Exception:  # noqa: BLE001 - the engine may be arbitrarily
+            pass           # wedged post-kill; the entries above suffice
+        return entries
+
+    def restore(self, pairs: List[Tuple[int, Dict]]) -> None:
+        """Migration in: wire entries join this engine's queue through
+        the standard restore path (depth limits bypassed — every one of
+        these was admitted by the fleet already)."""
+        handles = self.engine.restore(snapshot_from_pairs(pairs))
+        for (rid, _), handle in zip(pairs, handles):
+            self._ledger.add(rid, handle)
+
+    def take_pending(self) -> List[Dict[str, object]]:
+        """Unharvested ledger events — a request can finish inside the
+        very ``engine.step()`` a death unwound out of; harvesting here
+        lets the router settle it instead of migrating a done stream."""
+        return self._ledger.harvest()
+
+    def respawn(self) -> None:
+        self.engine = self._factory()
+        self._ledger = HandleLedger()
+
+    def close(self) -> None:
+        pass
+
+
+class ProcessReplica:
+    """A worker process replica (`fleet/worker.py`) over a stdio pipe.
+
+    The parent writes JSON-line commands to the child's stdin and reads
+    JSON-line events from its stdout (non-blocking, buffered); pings
+    answered with pongs are the heartbeat, and process exit / pipe EOF
+    surfaces as :class:`ReplicaDied` from whatever call noticed first.
+    ``kill()`` (SIGKILL) is the un-drainable hard death the chaos/bench
+    legs inject; ``terminate()`` (SIGTERM) lets the worker drain and
+    ship its snapshot back, which the router can migrate losslessly.
+    """
+
+    can_respawn = True
+
+    def __init__(self, replica_id: int, worker_config: Dict[str, object], *,
+                 python: str = sys.executable, ready_timeout_s: float = 300.0,
+                 ping_interval_s: float = 0.25, drain_timeout_s: float = 10.0,
+                 call_timeout_s: float = 30.0,
+                 clock=time.monotonic, stderr=None, wait_ready: bool = True):
+        self.replica_id = int(replica_id)
+        self._config = dict(worker_config)
+        self._python = python
+        self._ready_timeout_s = float(ready_timeout_s)
+        self._ping_interval_s = float(ping_interval_s)
+        self._drain_timeout_s = float(drain_timeout_s)
+        self._call_timeout_s = float(call_timeout_s)
+        self._clock = clock
+        self._stderr = stderr
+        self._spawn(wait_ready=wait_ready)
+
+    # ------------------------------------------------------- process mgmt
+    def _spawn(self, wait_ready: bool = True) -> None:
+        # The worker must import pddl_tpu from wherever THIS process
+        # found it — which may be a sys.path entry the child would not
+        # inherit (PYTHONPATH is appended to, never overwritten: other
+        # entries, e.g. platform-plugin site dirs, must survive).
+        import pddl_tpu  # noqa: PLC0415
+
+        pkg_root = os.path.dirname(os.path.dirname(
+            os.path.abspath(pddl_tpu.__file__)))
+        env = dict(os.environ)
+        parts = [p for p in env.get("PYTHONPATH", "").split(os.pathsep) if p]
+        if pkg_root not in parts:
+            env["PYTHONPATH"] = os.pathsep.join([pkg_root] + parts)
+        self._proc = subprocess.Popen(
+            [self._python, "-m", "pddl_tpu.serve.fleet.worker",
+             "--config-json", json.dumps(self._config)],
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+            stderr=self._stderr, text=False, env=env)
+        os.set_blocking(self._proc.stdout.fileno(), False)
+        self._buf = b""
+        self._pending: List[Dict[str, object]] = []
+        self._unanswered_ping_s: Optional[float] = None
+        self._last_ping_s = 0.0
+        self.ready_compile_counts: Optional[Dict[str, int]] = None
+        if wait_ready:
+            self.wait_ready()
+
+    def wait_ready(self) -> None:
+        """Block until the worker's ``ready`` ack (engine built and
+        warmed). Split from :meth:`_spawn` so a fleet can launch every
+        worker first (``wait_ready=False``) and pay the N warmup
+        compiles concurrently instead of serially."""
+        deadline = self._clock() + self._ready_timeout_s
+        while self.ready_compile_counts is None:
+            for ev in self._read_events(block_s=0.1):
+                if ev.get("ev") == "ready":
+                    self.ready_compile_counts = ev.get("compile_counts")
+                else:
+                    self._pending.append(ev)
+            if self._proc.poll() is not None:
+                raise ReplicaDied(self.replica_id,
+                                  f"worker exited rc={self._proc.returncode} "
+                                  "before ready")
+            if self._clock() > deadline:
+                self._proc.kill()
+                raise ReplicaDied(self.replica_id, "worker never became ready")
+
+    def _send(self, cmd: Dict[str, object]) -> None:
+        if self._proc.poll() is not None:
+            raise ReplicaDied(self.replica_id,
+                              f"worker exited rc={self._proc.returncode}")
+        try:
+            self._proc.stdin.write((json.dumps(cmd) + "\n").encode())
+            self._proc.stdin.flush()
+        except (BrokenPipeError, OSError) as e:
+            raise ReplicaDied(self.replica_id, f"pipe write failed: {e}") \
+                from e
+
+    def _read_events(self, block_s: float = 0.0) -> List[Dict[str, object]]:
+        """Drain available stdout lines (optionally waiting up to
+        ``block_s`` for the first byte). EOF raises ReplicaDied."""
+        out: List[Dict[str, object]] = []
+        deadline = self._clock() + block_s
+        while True:
+            try:
+                chunk = self._proc.stdout.read()
+            except (BlockingIOError, OSError):
+                chunk = None
+            if chunk:
+                self._buf += chunk
+                while b"\n" in self._buf:
+                    line, self._buf = self._buf.split(b"\n", 1)
+                    if line.strip():
+                        out.append(json.loads(line))
+                if out:
+                    # ANY event is a liveness proof — not just pongs —
+                    # so whatever ping was outstanding is answered.
+                    self._unanswered_ping_s = None
+                    return out
+            elif chunk == b"":  # EOF: the worker is gone
+                if self._proc.poll() is None:
+                    self._proc.wait(timeout=5)
+                raise ReplicaDied(
+                    self.replica_id,
+                    f"stdout EOF (rc={self._proc.returncode})")
+            if self._clock() >= deadline:
+                return out
+            time.sleep(0.002)
+
+    # ------------------------------------------------------------- intake
+    def submit(self, rid: int, prompt, max_new_tokens: int,
+               sampling: SamplingParams, deadline_s) -> None:
+        """Synchronous across the pipe: the worker acks admission or
+        reports its typed QueueFull (depth + retry_after hint), which
+        re-raises here so the router's shed logic is driver-agnostic."""
+        self._send({"cmd": "submit", "rid": int(rid),
+                    "prompt": [int(t) for t in prompt],
+                    "max_new_tokens": int(max_new_tokens),
+                    "sampling": sampling_to_wire(sampling),
+                    "deadline_s": deadline_s})
+        deadline = self._clock() + self._call_timeout_s
+        while True:
+            # Consume the WHOLE batch before acting on the ack: token
+            # events can share a read with it, and an early return would
+            # silently drop them (a lost token = a corrupted replay
+            # mirror = a non-token-exact migration later).
+            verdict = None
+            for ev in self._read_events(block_s=0.05):
+                kind = ev.get("ev")
+                if kind == "submit_ok" and ev.get("rid") == rid:
+                    verdict = "ok"
+                elif kind == "queue_full" and ev.get("rid") == rid:
+                    verdict = QueueFull(int(ev["queue_depth"]),
+                                        int(ev["max_queue_depth"]),
+                                        retry_after_s=ev.get("retry_after_s"))
+                elif kind == "error" and ev.get("rid") == rid:
+                    verdict = ValueError(str(ev.get("message")))
+                else:
+                    self._pending.append(ev)
+            if verdict == "ok":
+                return
+            if verdict is not None:
+                raise verdict
+            if self._clock() > deadline:
+                raise ReplicaDied(self.replica_id, "submit ack timed out")
+
+    def cancel(self, rid: int) -> None:
+        self._send({"cmd": "cancel", "rid": int(rid)})
+
+    # ------------------------------------------------------------ serving
+    def warmup(self) -> None:
+        pass  # ready implies warmed: the worker compiles before its ack
+
+    def step(self) -> List[Dict[str, object]]:
+        """Pump events; the worker self-drives its engine loop. Sends a
+        ping at ``ping_interval_s`` cadence — pongs are the heartbeat
+        the router's staleness check reads via :meth:`beat_age_s`."""
+        now = self._clock()
+        if now - self._last_ping_s >= self._ping_interval_s:
+            self._last_ping_s = now
+            self._send({"cmd": "ping"})
+            if self._unanswered_ping_s is None:
+                self._unanswered_ping_s = now
+        events, self._pending = self._pending, []
+        events.extend(self._read_events())
+        return [ev for ev in events if ev.get("ev") != "pong"]
+
+    def beat_age_s(self) -> float:
+        """Age of the OLDEST unanswered ping; 0.0 when none is
+        outstanding. Anchored to when a ping was actually SENT, never
+        to the last read — a router that idles between bursts must not
+        read its own quiet gap as replica silence and breaker-kill a
+        healthy worker on the first steps after waking. Buffered
+        events are drained (non-blocking) before judging: a pong that
+        arrived while the router was blocked elsewhere (e.g. a bounded
+        10 s drain capture of a wedged sibling) counts as answered."""
+        if self._unanswered_ping_s is not None:
+            try:
+                self._pending.extend(self._read_events())
+            except ReplicaDied:
+                pass  # a real death surfaces from the next step()/send
+        if self._unanswered_ping_s is None:
+            return 0.0
+        return self._clock() - self._unanswered_ping_s
+
+    def compile_counts(self) -> Dict[str, int]:
+        """Counts as of the last ``counts``/snapshot report (the ready
+        ack at minimum)."""
+        self._send({"cmd": "counts"})
+        deadline = self._clock() + self._call_timeout_s
+        while self._clock() < deadline:
+            counts = None  # consume the whole batch (see submit())
+            for ev in self._read_events(block_s=0.05):
+                if ev.get("ev") == "counts" and counts is None:
+                    counts = dict(ev["counts"])
+                else:
+                    self._pending.append(ev)
+            if counts is not None:
+                return counts
+        raise ReplicaDied(self.replica_id, "counts request timed out")
+
+    # --------------------------------------------------------- resilience
+    def drain_entries(self, now_s: float) -> List[Tuple[int, Dict]]:
+        """Graceful capture: SIGTERM the worker, read back its
+        rid-tagged snapshot (the worker's drain handler writes it as
+        its last event). A hard-killed worker raises instead — the
+        router falls back to its own mirrors. The wait is bounded by
+        ``drain_timeout_s``: the router's event loop blocks here, so a
+        WEDGED worker must degrade to the replay fallback quickly
+        rather than stall every surviving replica's stream for long."""
+        if self._proc.poll() is not None:
+            raise ReplicaDied(self.replica_id,
+                              f"worker already dead rc={self._proc.returncode}")
+        try:
+            self._proc.send_signal(signal.SIGTERM)
+        except OSError as e:
+            raise ReplicaDied(self.replica_id, f"SIGTERM failed: {e}") from e
+        deadline = self._clock() + self._drain_timeout_s
+        snapshot = None
+        while snapshot is None and self._clock() < deadline:
+            try:
+                events = self._read_events(block_s=0.1)
+            except ReplicaDied:
+                break  # EOF before the snapshot line made it out
+            for ev in events:
+                if ev.get("ev") == "snapshot":
+                    snapshot = ev
+                else:
+                    # Backlog sharing the pipe with the snapshot —
+                    # finish/token events for requests that settled just
+                    # before the SIGTERM. Dropping them would leave their
+                    # fleet handles unsettled forever; the router applies
+                    # them via take_pending() after the capture.
+                    self._pending.append(ev)
+        if snapshot is None:
+            if self._proc.poll() is None:  # wedged past the bound: put
+                self._proc.kill()          # it down, replay-migrate
+            raise ReplicaDied(self.replica_id,
+                              "no drain snapshot before EOF")
+        try:
+            self._proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            self._proc.kill()
+        return [(int(rid), entry) for rid, entry in snapshot["requests"]]
+
+    def take_pending(self) -> List[Dict[str, object]]:
+        """Hand any buffered backlog events to the caller (the router
+        applies these after a drain capture so same-pipe finish/token
+        events are not lost with the replica). Drains the OS pipe
+        buffer first, best-effort: a SIGKILL'd worker's stdout stays
+        readable until EOF, and finish/token events it wrote before
+        dying must settle their handles rather than force a pointless
+        replay-migration of an already-complete stream."""
+        try:
+            while True:
+                got = self._read_events()
+                if not got:
+                    break
+                self._pending.extend(got)
+        except ReplicaDied:
+            pass  # EOF: everything readable was parsed above
+        events, self._pending = self._pending, []
+        return events
+
+    _RESTORE_CHUNK = 8  # entries per restore command
+
+    def restore(self, pairs: List[Tuple[int, Dict]]) -> None:
+        """Migration in, chunked: one huge restore line can exceed the
+        stdin pipe capacity while the worker is itself blocked writing
+        token events nobody is reading — a mutual stall. Small commands
+        with a non-blocking stdout drain between them keep both pipe
+        directions moving; the worker treats each chunk as an
+        independent restore."""
+        for i in range(0, len(pairs), self._RESTORE_CHUNK):
+            chunk = pairs[i:i + self._RESTORE_CHUNK]
+            self._send({"cmd": "restore",
+                        "requests": [[int(rid), entry]
+                                     for rid, entry in chunk]})
+            self._pending.extend(self._read_events())
+
+    def respawn(self) -> None:
+        if self._proc.poll() is None:
+            self._proc.kill()
+            self._proc.wait()
+        self._spawn()
+
+    # ------------------------------------------------------- fault inject
+    def kill(self) -> None:
+        """SIGKILL — the un-drainable death (bench/chaos legs)."""
+        self._proc.kill()
+
+    def terminate(self) -> None:
+        self._proc.send_signal(signal.SIGTERM)
+
+    def close(self) -> None:
+        if self._proc.poll() is None:
+            try:
+                self._send({"cmd": "shutdown"})
+                self._proc.wait(timeout=10)
+            except Exception:  # noqa: BLE001 - best-effort shutdown
+                self._proc.kill()
+                self._proc.wait()

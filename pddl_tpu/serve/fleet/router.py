@@ -1,0 +1,829 @@
+"""The fleet router: N replicas behind one health-checked front door.
+
+One :class:`~pddl_tpu.serve.ServeEngine` multiplexes one chip; the
+ROADMAP's "millions of users" need a fleet — and a fleet's defining
+property is that any replica can die at any moment. DistServe (Zhong et
+al., 2024) and Splitwise (Patel et al., 2024) draw the architectural
+conclusion this module implements: replicas are disposable ROLES behind
+a router, never pets. Three router duties:
+
+**Routing.** Prefix-affinity first: the router keeps a host-side SHADOW
+of each replica's radix cache (the same
+:class:`~pddl_tpu.serve.kvcache.RadixPrefixCache` match machinery,
+holding token chains but no device blocks) and sends a prompt to the
+healthy replica whose cache already holds its longest leading-block
+chain — shared system prompts land where their KV lives, which is what
+makes per-replica prefix caches pay at fleet scale. Sticky sessions
+(``session=``) keep multi-turn conversations on one replica for the
+same reason. Cold prompts route by RENDEZVOUS HASH of the leading
+blocks over the healthy set, so one replica's death remaps only its own
+keys. A full replica (typed
+:class:`~pddl_tpu.serve.request.QueueFull`) sheds to the least-loaded
+healthy replica, carrying the ``retry_after_s`` hint forward; only a
+fleet-wide full queue rejects the caller.
+
+**Health.** Per-replica circuit breaker (`fleet/health.py`):
+consecutive failures or heartbeat silence trip CLOSED→OPEN, a bounded
+exponential backoff gates HALF_OPEN probes, and a successful probe (a
+respawn — fresh engine / fresh worker process) closes the circuit and
+returns the replica to rotation.
+
+**Failover with live migration.** When a replica dies, the router
+captures its drain snapshot — `serve/drain.py` is already the wire
+format — and ``restore()``s the in-flight streams on survivors, where
+the engine's replay admission rebuilds each KV token-exactly: a request
+that STARTED on the dead replica FINISHES with the identical token
+sequence. An un-drainable hard kill (SIGKILL, no snapshot possible)
+falls back to the router's own prompt+emitted-token mirrors — exactly
+r08's in-engine replay contract, held at fleet level. Requests with no
+surviving replica park as orphans and re-enter when a probe brings a
+replica back; they fail terminally only when recovery is impossible.
+
+Every fleet event (replica_up/down, circuit transitions, migrations,
+sheds) flows through the `obs/` tracer (``on_fleet_event``) and the
+Prometheus exporter (:func:`pddl_tpu.obs.export.fleet_exposition`).
+"""
+
+from __future__ import annotations
+
+import collections
+import enum
+import hashlib
+import itertools
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from pddl_tpu.obs.trace import NULL_TRACER
+from pddl_tpu.serve import drain as drain_io
+from pddl_tpu.serve.fleet.health import BreakerState, CircuitBreaker
+from pddl_tpu.serve.fleet.replica import ReplicaDied
+from pddl_tpu.serve.kvcache import RadixPrefixCache
+from pddl_tpu.serve.request import (
+    FinishReason,
+    QueueFull,
+    Request,
+    RequestState,
+    SamplingParams,
+)
+from pddl_tpu.utils.faults import KillPoint
+
+
+class NoHealthyReplica(RuntimeError):
+    """Every replica's circuit is open (or dead): the fleet cannot take
+    this request right now. The HTTP-503 analogue — distinct from
+    :class:`~pddl_tpu.serve.request.QueueFull` (healthy but saturated)
+    so upstream can tell "back off briefly" from "page someone"."""
+
+
+class ReplicaLifecycle(enum.Enum):
+    UP = "up"
+    DEAD = "dead"
+
+
+class FleetHandle:
+    """The caller's stream handle at fleet level.
+
+    Mirrors the :class:`~pddl_tpu.serve.request.RequestHandle` surface
+    (``tokens``/``state``/``finish_reason``/``done``/``ttft_s``/
+    ``cancel()``) but is owned by the ROUTER: ``tokens`` is the
+    canonical append-only stream the caller reads, fed from whichever
+    replica currently runs the request — across any number of
+    migrations, which ``migrations`` counts. It doubles as the replay
+    mirror: ``prompt + tokens`` is sufficient to rebuild the stream on
+    a survivor when a replica hard-dies, so it duck-types the
+    `serve/drain.py` encoder's handle surface."""
+
+    def __init__(self, request: Request, arrival_s: float,
+                 session: Optional[str] = None):
+        self.request = request
+        self.arrival_s = arrival_s
+        self.session = session
+        self.tokens: List[int] = []
+        self.state = RequestState.QUEUED
+        self.finish_reason: Optional[FinishReason] = None
+        self.ttft_s: Optional[float] = None
+        self.finish_s: Optional[float] = None
+        self.replica_id: Optional[int] = None
+        self.migrations = 0
+        self._cancel = False
+        self._orphan_counted = False
+
+    def cancel(self) -> None:
+        self._cancel = True
+
+    @property
+    def cancelled(self) -> bool:
+        return self._cancel
+
+    @property
+    def done(self) -> bool:
+        return self.state in (RequestState.FINISHED, RequestState.CANCELLED,
+                              RequestState.TIMED_OUT, RequestState.FAILED)
+
+    def __repr__(self) -> str:  # debugging aid, not an API
+        return (f"FleetHandle(id={self.request.request_id}, "
+                f"replica={self.replica_id}, state={self.state.value}, "
+                f"tokens={len(self.tokens)}, migrations={self.migrations})")
+
+
+class FleetMetrics:
+    """Fleet-level counters (replica lifecycle, routing decisions,
+    migrations, shedding); per-request latency stays in each engine's
+    own :class:`~pddl_tpu.serve.ServeMetrics`."""
+
+    def __init__(self):
+        self.replica_up_events = 0       # respawns that closed a circuit
+        self.replica_down_events = 0
+        self.migrations = 0              # death → redistribution passes
+        self.requests_migrated = 0
+        self.migrated_via_drain = 0      # live migration (snapshot)
+        self.migrated_via_replay = 0     # hard kill (router mirrors)
+        self.requests_routed = 0
+        self.routed_sticky = 0
+        self.routed_affinity = 0
+        self.routed_hash = 0
+        self.shed_rerouted = 0           # QueueFull → another replica took it
+        self.shed_rejected = 0           # fleet-wide full: caller rejected
+        self.requests_finished = 0
+        self.requests_failed = 0
+        self.requests_orphaned = 0
+        self.heartbeat_failures = 0
+        self.probes = 0
+        self.probe_failures = 0
+        self.tokens_streamed = 0
+        self.circuit_transitions: Dict[str, int] = {}
+
+    def snapshot(self) -> Dict[str, object]:
+        # Derived from the exporter's canonical key set so the two
+        # cannot drift: a counter added above but missing from
+        # FLEET_COUNTER_KEYS never reaches the snapshot, and one listed
+        # there but not defined here raises loudly right away.
+        from pddl_tpu.obs.export import FLEET_COUNTER_KEYS  # noqa: PLC0415
+
+        out = {k: getattr(self, k) for k in sorted(FLEET_COUNTER_KEYS)}
+        for key, n in sorted(self.circuit_transitions.items()):
+            out["circuit_" + key.replace("->", "_to_")] = n
+        return out
+
+
+class _ShadowIndex:
+    """Host-only shadow of one replica's radix cache: the SAME match
+    machinery (`serve/kvcache/radix.py`), but its "block ids" are
+    placeholders — no device pool exists here. Optimistic by design
+    (the replica's real cache may have evicted a chain the shadow still
+    holds); a stale hit costs one suboptimal route, never correctness."""
+
+    def __init__(self, block_size: int, capacity_blocks: int):
+        self._bs = int(block_size)
+        self._idx = RadixPrefixCache(self._bs, capacity_blocks + 1)
+
+    def match_blocks(self, prompt, max_blocks: int) -> int:
+        return self._idx.match(prompt, max_blocks=max_blocks).n_blocks
+
+    def observe(self, prompt, max_blocks: int) -> None:
+        """Record that this replica now holds the prompt's leading
+        blocks (mirror of the engine's donate-side dedup walk)."""
+        match = self._idx.match(prompt, max_blocks=max_blocks)
+        node, stored = self._idx.descend(match.node, prompt, match.n_blocks)
+        want = min(len(prompt) // self._bs, max_blocks) - stored
+        if want <= 0:
+            return
+        ids = self._idx.allocate(want)
+        if ids:
+            self._idx.extend(
+                node,
+                prompt[stored * self._bs:(stored + len(ids)) * self._bs],
+                ids)
+
+
+class _ReplicaSlot:
+    """One replica's router-side state: driver + breaker + shadow index
+    + the fleet handles currently assigned to it."""
+
+    def __init__(self, driver, breaker: CircuitBreaker,
+                 shadow_block_size: int, shadow_capacity: int):
+        self.driver = driver
+        self.replica_id = driver.replica_id
+        self.breaker = breaker
+        self.state = ReplicaLifecycle.UP
+        self.assigned: Dict[int, FleetHandle] = {}
+        self._shadow_cfg = (shadow_block_size, shadow_capacity)
+        self.shadow = _ShadowIndex(shadow_block_size, shadow_capacity)
+
+    def reset_shadow(self) -> None:
+        self.shadow = _ShadowIndex(*self._shadow_cfg)
+
+    @property
+    def load(self) -> int:
+        return len(self.assigned)
+
+    @property
+    def available(self) -> bool:
+        return (self.state is ReplicaLifecycle.UP
+                and self.breaker.allows_traffic)
+
+
+class FleetRouter:
+    """Health-checked router over N replica drivers.
+
+    Args:
+      replicas: driver sequence (:class:`~.replica.LocalReplica` /
+        :class:`~.replica.ProcessReplica`), ids unique.
+      affinity_block_size: token granularity of the routing shadow —
+        match the replicas' ``prefix_block_size`` so shadow hits
+        predict real radix hits.
+      affinity_blocks: leading blocks consulted for affinity AND fed to
+        the rendezvous hash (the "prompt head").
+      shadow_capacity_blocks: per-replica shadow index size (host RAM
+        only; LRU beyond it).
+      breaker: kwargs for each replica's :class:`CircuitBreaker`.
+      heartbeat_timeout_s: a driver exposing ``beat_age_s`` (process
+        replicas) older than this counts a breaker failure per step.
+      respawn: allow HALF_OPEN probes to rebuild dead replicas (fresh
+        engine / fresh worker process). With it off, a dead replica
+        stays dead and its circuit never half-opens.
+      tracer: `obs/` tracer; fleet events emit via ``on_fleet_event``.
+      clock: injectable monotonic clock (chaos tests drive backoff and
+        heartbeat timeouts with a fake one).
+    """
+
+    def __init__(self, replicas: Sequence[object], *,
+                 affinity_block_size: int = 8, affinity_blocks: int = 8,
+                 shadow_capacity_blocks: int = 4096,
+                 breaker: Optional[Dict[str, object]] = None,
+                 heartbeat_timeout_s: float = 5.0,
+                 respawn: bool = True, tracer=None,
+                 max_sessions: int = 65536,
+                 clock=time.monotonic):
+        if not replicas:
+            raise ValueError("a fleet needs at least one replica")
+        ids = [r.replica_id for r in replicas]
+        if len(set(ids)) != len(ids):
+            raise ValueError(f"replica ids must be unique, got {ids}")
+        self._clock = clock
+        self._tracer = NULL_TRACER if tracer is None else tracer
+        self._heartbeat_timeout_s = float(heartbeat_timeout_s)
+        self._respawn = bool(respawn)
+        self._affinity_blocks = int(affinity_blocks)
+        self._block_size = int(affinity_block_size)
+        self.metrics = FleetMetrics()
+        breaker = dict(breaker or {})
+        self._slots: List[_ReplicaSlot] = []
+        for driver in replicas:
+            slot = _ReplicaSlot(
+                driver,
+                CircuitBreaker(**breaker),
+                affinity_block_size, int(shadow_capacity_blocks))
+            slot.breaker.on_transition = self._circuit_observer(slot)
+            self._slots.append(slot)
+        self._by_rid: Dict[int, FleetHandle] = {}
+        self._rids = itertools.count()
+        # Sticky-session map, LRU-bounded: sessions outlive their
+        # requests by design (that is the stickiness), so without a cap
+        # a long-lived router grows one entry per distinct session
+        # forever. Least-recently-routed sessions fall off first; an
+        # evicted session that returns simply re-routes by affinity.
+        self._max_sessions = int(max_sessions)
+        self._sessions: "collections.OrderedDict[str, _ReplicaSlot]" = \
+            collections.OrderedDict()
+        # (rid, FleetHandle) pairs with no surviving replica, waiting
+        # for a probe to bring one back.
+        self._orphans: List[Tuple[int, FleetHandle]] = []
+        self._closed = False
+
+    # ------------------------------------------------------ observability
+    def _circuit_observer(self, slot: _ReplicaSlot):
+        def observe(old: BreakerState, new: BreakerState) -> None:
+            key = f"{old.value}->{new.value}"
+            self.metrics.circuit_transitions[key] = \
+                self.metrics.circuit_transitions.get(key, 0) + 1
+            self._tracer.on_fleet_event(
+                "circuit", replica=slot.replica_id, transition=key)
+        return observe
+
+    @property
+    def tracer(self):
+        return self._tracer
+
+    def set_tracer(self, tracer) -> None:
+        self._tracer = NULL_TRACER if tracer is None else tracer
+
+    # ----------------------------------------------------------- plumbing
+    @property
+    def replicas(self) -> List[_ReplicaSlot]:
+        return list(self._slots)
+
+    @property
+    def healthy_replicas(self) -> int:
+        return sum(s.available for s in self._slots)
+
+    @property
+    def has_work(self) -> bool:
+        return any(not fh.done for fh in self._by_rid.values()) \
+            or bool(self._orphans)
+
+    def warmup(self) -> None:
+        for slot in self._slots:
+            if slot.state is ReplicaLifecycle.UP:
+                slot.driver.warmup()
+
+    def compile_counts(self) -> Dict[str, int]:
+        """Aggregated per-replica compiled-program counts, keyed
+        ``r<id>/<site>`` — the zero-recompiles pin applied to every
+        queryable replica (a hard-killed worker is skipped: there is
+        nothing left to recompile OR to query)."""
+        counts: Dict[str, int] = {}
+        for slot in self._slots:
+            try:
+                for site, n in slot.driver.compile_counts().items():
+                    counts[f"r{slot.replica_id}/{site}"] = n
+            except ReplicaDied:
+                continue
+        return counts
+
+    # ------------------------------------------------------------ routing
+    def _prompt_head(self, prompt: List[int]) -> bytes:
+        head = prompt[:self._affinity_blocks * self._block_size]
+        return (",".join(str(t) for t in head)).encode()
+
+    def _rendezvous(self, prompt: List[int],
+                    candidates: List[_ReplicaSlot]) -> _ReplicaSlot:
+        head = self._prompt_head(prompt)
+
+        def score(slot: _ReplicaSlot) -> int:
+            h = hashlib.blake2b(head + b"|" + str(slot.replica_id).encode(),
+                                digest_size=8)
+            return int.from_bytes(h.digest(), "big")
+        return max(candidates, key=score)
+
+    def _session_pin(self, session: str, slot: _ReplicaSlot) -> None:
+        self._sessions[session] = slot
+        self._sessions.move_to_end(session)
+        while len(self._sessions) > self._max_sessions:
+            self._sessions.popitem(last=False)
+
+    def _route(self, prompt: List[int], session: Optional[str],
+               healthy: List[_ReplicaSlot]) -> Tuple[_ReplicaSlot, str]:
+        if session is not None:
+            stuck = self._sessions.get(session)
+            if stuck is not None:
+                self._sessions.move_to_end(session)  # LRU touch
+                if stuck.available:
+                    return stuck, "sticky"
+        best, best_blocks = None, 0
+        for slot in healthy:
+            m = slot.shadow.match_blocks(prompt,
+                                         max_blocks=self._affinity_blocks)
+            if m > best_blocks or (m == best_blocks and m > 0
+                                   and best is not None
+                                   and slot.load < best.load):
+                best, best_blocks = slot, m
+        if best is not None and best_blocks > 0:
+            return best, "affinity"
+        return self._rendezvous(prompt, healthy), "hash"
+
+    def submit(self, prompt, max_new_tokens: int, *,
+               sampling: Optional[SamplingParams] = None,
+               deadline_s: Optional[float] = None,
+               session: Optional[str] = None) -> FleetHandle:
+        """Route one request; returns its fleet stream handle.
+
+        Raises :class:`NoHealthyReplica` when every circuit is open,
+        and :class:`~pddl_tpu.serve.request.QueueFull` (with the
+        smallest ``retry_after_s`` hint any replica offered) when every
+        healthy replica shed it."""
+        if self._closed:
+            raise RuntimeError("fleet router is closed")
+        prompt = [int(t) for t in prompt]
+        sampling = sampling or SamplingParams()
+        healthy = [s for s in self._slots if s.available]
+        if not healthy:
+            raise NoHealthyReplica(
+                f"no healthy replica among {len(self._slots)} "
+                "(all circuits open)")
+        chosen, how = self._route(prompt, session, healthy)
+        order = [chosen] + sorted((s for s in healthy if s is not chosen),
+                                  key=lambda s: s.load)
+        hints: List[float] = []
+        depth_sum = cap_sum = sheds_seen = 0
+        for slot in order:
+            rid = next(self._rids)
+            try:
+                slot.driver.submit(rid, prompt, max_new_tokens,
+                                   sampling, deadline_s)
+            except QueueFull as e:
+                sheds_seen += 1
+                if e.retry_after_s is not None:
+                    hints.append(e.retry_after_s)
+                depth_sum += e.queue_depth
+                cap_sum += e.max_queue_depth
+                continue
+            except ReplicaDied as e:
+                self._on_death(slot, e)
+                continue
+            fh = FleetHandle(
+                Request(prompt=prompt, max_new_tokens=int(max_new_tokens),
+                        sampling=sampling, deadline_s=deadline_s),
+                arrival_s=self._clock(), session=session)
+            fh.replica_id = slot.replica_id
+            fh.state = RequestState.QUEUED
+            self._by_rid[rid] = fh
+            slot.assigned[rid] = fh
+            slot.shadow.observe(prompt, max_blocks=self._affinity_blocks)
+            if session is not None:
+                self._session_pin(session, slot)
+            self.metrics.requests_routed += 1
+            # Only a reroute forced by an actual QueueFull is load
+            # shedding (the runbook reads shed_rerouted as
+            # backpressure); skipping past a replica that DIED during
+            # submit keeps the original routing label — the death
+            # already traced replica_down.
+            if sheds_seen:
+                how = "shed"
+                self.metrics.shed_rerouted += 1
+                self._tracer.on_fleet_event(
+                    "shed", request_id=fh.request.request_id,
+                    to_replica=slot.replica_id)
+            elif how == "sticky":
+                self.metrics.routed_sticky += 1
+            elif how == "affinity":
+                self.metrics.routed_affinity += 1
+            else:
+                self.metrics.routed_hash += 1
+            return fh
+        if cap_sum == 0 and not hints:
+            # Nothing actually reported a full queue — every attempt hit
+            # a dying replica. That is the 503 case, not backpressure.
+            raise NoHealthyReplica(
+                f"every healthy replica died during submit "
+                f"({len(order)} attempted)")
+        self.metrics.shed_rejected += 1
+        raise QueueFull(depth_sum, max(cap_sum, depth_sum),
+                        retry_after_s=min(hints) if hints else None)
+
+    # ------------------------------------------------------------ serving
+    def step(self) -> int:
+        """One router round: probe dead replicas whose backoff expired,
+        pump/step every live replica (catching deaths and migrating
+        their work), apply the resulting stream events. Returns tokens
+        streamed to fleet handles this round."""
+        now = self._clock()
+        tokens = 0
+        # Cancelled orphans settle HERE: no replica holds them, so the
+        # per-slot cancel forwarding never sees them, and without this
+        # an unbounded run() would spin on has_work through a total
+        # outage whose probes keep failing — cancel() must always lead
+        # to a terminal state.
+        if self._orphans:
+            kept = []
+            for rid, fh in self._orphans:
+                if fh.cancelled and not fh.done:
+                    fh.state = RequestState.CANCELLED
+                    fh.finish_reason = FinishReason.CANCELLED
+                    fh.finish_s = now
+                    self._by_rid.pop(rid, None)
+                elif not fh.done:
+                    kept.append((rid, fh))
+            self._orphans = kept
+        for slot in self._slots:
+            if slot.state is ReplicaLifecycle.DEAD:
+                self._maybe_probe(slot, now)
+                continue
+            beat_fn = getattr(slot.driver, "beat_age_s", None)
+            # One reading per round: each call drains the pipe, and the
+            # pre-step value is the conservative one to credit against.
+            beat_age = None if beat_fn is None else beat_fn()
+            if beat_age is not None \
+                    and beat_age > self._heartbeat_timeout_s:
+                self.metrics.heartbeat_failures += 1
+                slot.breaker.record_failure(now)
+                self._tracer.on_fleet_event(
+                    "heartbeat_missed", replica=slot.replica_id)
+                if slot.breaker.state is BreakerState.OPEN:
+                    self._on_death(
+                        slot, ReplicaDied(slot.replica_id,
+                                          "heartbeat timeout"))
+                    continue
+            try:
+                events = slot.driver.step()
+            except (KillPoint, ReplicaDied) as e:
+                self._on_death(slot, e)
+                continue
+            except Exception as e:  # noqa: BLE001 - replica failure, not ours
+                slot.breaker.record_failure(now)
+                self._tracer.on_fleet_event(
+                    "replica_error", replica=slot.replica_id,
+                    error=type(e).__name__)
+                if slot.breaker.state is BreakerState.OPEN:
+                    self._on_death(slot, e)
+                continue
+            # A successful pump only counts as breaker success when the
+            # heartbeat (if the driver has one) is actually fresh — a
+            # hung-but-alive worker keeps accepting pings into its pipe
+            # buffer, and crediting that would reset the silence count
+            # so the breaker could never reach OPEN.
+            if beat_age is None or beat_age <= self._heartbeat_timeout_s:
+                slot.breaker.record_success(now)
+            tokens += self._apply_events(slot, events)
+            self._forward_cancels(slot)
+        return tokens
+
+    def run(self, max_steps: Optional[int] = None,
+            idle_sleep_s: Optional[float] = None) -> None:
+        """Drive :meth:`step` until every fleet handle settles (or the
+        budget runs out). ``idle_sleep_s`` throttles the poll loop;
+        the default (``None``) auto-selects — 2 ms when any replica is
+        a self-driving process (a tight non-blocking pipe poll would
+        steal a whole core from the very workers it waits on), 0 for
+        purely in-process fleets, where stepping IS the work."""
+        if idle_sleep_s is None:
+            idle_sleep_s = (0.002 if any(
+                hasattr(s.driver, "beat_age_s") for s in self._slots)
+                else 0.0)
+        steps = 0
+        while self.has_work:
+            emitted = self.step()
+            steps += 1
+            if max_steps is not None and steps >= max_steps:
+                break
+            if emitted == 0 and idle_sleep_s > 0:
+                time.sleep(idle_sleep_s)
+
+    def _forward_cancels(self, slot: _ReplicaSlot) -> None:
+        for rid, fh in list(slot.assigned.items()):
+            if fh.cancelled and not fh.done:
+                try:
+                    slot.driver.cancel(rid)
+                except (ReplicaDied, OSError):
+                    pass  # death handling will settle it
+
+    def _apply_events(self, slot: _ReplicaSlot,
+                      events: List[Dict[str, object]]) -> int:
+        tokens = 0
+        now = self._clock()
+        for ev in events:
+            kind = ev.get("ev")
+            if kind == "tokens":
+                for rid, toks in ev["toks"]:
+                    fh = self._by_rid.get(rid)
+                    if fh is None:
+                        continue
+                    if fh.ttft_s is None and toks:
+                        fh.ttft_s = now - fh.arrival_s
+                    if fh.state is RequestState.QUEUED:
+                        fh.state = RequestState.RUNNING
+                    fh.tokens.extend(int(t) for t in toks)
+                    tokens += len(toks)
+            elif kind == "finish":
+                rid = ev["rid"]
+                fh = self._by_rid.pop(rid, None)
+                slot.assigned.pop(rid, None)
+                if fh is None:
+                    continue
+                fh.state = RequestState(ev["state"])
+                fh.finish_reason = (FinishReason(ev["reason"])
+                                    if ev.get("reason") else None)
+                fh.finish_s = now
+                if fh.state is RequestState.FINISHED:
+                    self.metrics.requests_finished += 1
+                elif fh.state is RequestState.FAILED:
+                    self.metrics.requests_failed += 1
+        self.metrics.tokens_streamed += tokens
+        return tokens
+
+    # --------------------------------------------------------- resilience
+    def _wire_entry(self, fh: FleetHandle) -> Dict[str, object]:
+        """A drain wire entry from the router's own mirror (the hard-
+        kill fallback: prompt + emitted tokens replay)."""
+        return drain_io.encode_handle(fh, self._clock())
+
+    def _on_death(self, slot: _ReplicaSlot, cause: BaseException) -> None:
+        if slot.state is ReplicaLifecycle.DEAD:
+            return
+        now = self._clock()
+        slot.state = ReplicaLifecycle.DEAD
+        slot.breaker.trip(now)
+        self.metrics.replica_down_events += 1
+        self._tracer.on_fleet_event(
+            "replica_down", replica=slot.replica_id,
+            cause=type(cause).__name__, in_flight=len(slot.assigned))
+        # Live migration: the replica's own drain snapshot when it can
+        # still produce one (`serve/drain.py` wire format, rid-tagged);
+        # otherwise rebuild from the router mirrors — same format, the
+        # prompt+emitted-token replay r08 pinned in-engine.
+        pairs = self._capture(slot, now)
+        via = "drain" if pairs is not None else "replay"
+        if pairs is None:
+            pairs = [(rid, self._wire_entry(fh))
+                     for rid, fh in slot.assigned.items() if not fh.done]
+        migrate: List[Tuple[int, Dict, FleetHandle]] = []
+        for rid, entry in pairs:
+            fh = self._by_rid.get(rid)
+            if fh is None or fh.done:
+                continue
+            etoks = [int(t) for t in entry.get("tokens", [])]
+            # The entry is authoritative: tokens the engine emitted in
+            # its dying step may not have streamed yet — adopt them so
+            # the restored stream and the caller's view agree exactly.
+            # A divergence means the snapshot and the caller's stream
+            # disagree: fail THAT request terminally rather than abort
+            # the whole death-handling pass mid-migration (and never
+            # restore a stream we know would not be token-exact).
+            if etoks[:len(fh.tokens)] != fh.tokens:
+                self._tracer.on_fleet_event(
+                    "migration_token_mismatch", request_id=rid)
+                self._fail_handle(fh, rid)
+                continue
+            if fh.ttft_s is None and len(etoks) > len(fh.tokens):
+                fh.ttft_s = now - fh.arrival_s
+            fh.tokens.extend(etoks[len(fh.tokens):])
+            migrate.append((rid, entry, fh))
+        leftovers = self._mirror_leftovers(slot, {rid for rid, _ in pairs})
+        slot.assigned.clear()
+        self._distribute(migrate, via)
+        if leftovers:
+            self._distribute(leftovers, "replay")
+
+    def _capture(self, slot: _ReplicaSlot,
+                 now: float) -> Optional[List[Tuple[int, Dict]]]:
+        """The capture discipline shared by death handling and graceful
+        drain: ask the driver for its snapshot (None = hard kill / no
+        snapshot possible), then fold whatever backlog the driver read
+        before or while capturing into the mirrors — finish events
+        settle their handles (so done streams are not migrated), token
+        events freshen the replay mirrors — BEFORE the caller judges
+        which entries still need moving."""
+        try:
+            pairs = slot.driver.drain_entries(now)
+        except Exception:  # noqa: BLE001 - incl. ReplicaDied: hard kill
+            pairs = None
+        take = getattr(slot.driver, "take_pending", None)
+        if take is not None:
+            try:
+                self._apply_events(slot, take())
+            except Exception:  # noqa: BLE001 - backlog is best-effort
+                pass
+        return pairs
+
+    def _mirror_leftovers(self, slot: _ReplicaSlot, in_snapshot) -> List[
+            Tuple[int, Dict, FleetHandle]]:
+        """Requests assigned to the replica but absent from its snapshot
+        — e.g. a migration restore the worker never read off its pipe —
+        must not be silently dropped: rebuild them from the router
+        mirrors (the replay wire entry), same rule for death and drain."""
+        return [(rid, self._wire_entry(fh), fh)
+                for rid, fh in slot.assigned.items()
+                if rid not in in_snapshot and not fh.done]
+
+    def _distribute(self, migrate: List[Tuple[int, Dict, FleetHandle]],
+                    via: str) -> None:
+        if not migrate:
+            return
+        survivors = [s for s in self._slots if s.available]
+        if not survivors:
+            if self._can_ever_recover():
+                self._orphans.extend((rid, fh) for rid, _, fh in migrate)
+                # Count each REQUEST once, ever: a flapping revive
+                # (probe succeeds, restore target dies, re-park) would
+                # otherwise inflate the counter the runbook keys manual
+                # intervention off to M*K for K real requests.
+                fresh = [fh for _, _, fh in migrate
+                         if not fh._orphan_counted]
+                for fh in fresh:
+                    fh._orphan_counted = True
+                self.metrics.requests_orphaned += len(fresh)
+                self._tracer.on_fleet_event("orphaned", n=len(migrate))
+            else:
+                for rid, _, fh in migrate:
+                    self._fail_handle(fh, rid)
+            return
+        self.metrics.migrations += 1
+        per_target: Dict[int, List[Tuple[int, Dict, FleetHandle]]] = {}
+        # Least-loaded-first round robin keeps the redistributed load
+        # balanced without a second routing pass per request.
+        ordered = sorted(survivors, key=lambda s: s.load)
+        for i, item in enumerate(migrate):
+            target = ordered[i % len(ordered)]
+            per_target.setdefault(target.replica_id, []).append(item)
+        by_id = {s.replica_id: s for s in self._slots}
+        for tid, items in per_target.items():
+            target = by_id[tid]
+            try:
+                target.driver.restore([(rid, entry)
+                                       for rid, entry, _ in items])
+            except (ReplicaDied, KillPoint) as e:
+                self._on_death(target, e)
+                # Re-distribute this shard over whoever remains — from
+                # FRESH mirror entries, not the originals: the target
+                # may have applied part of a chunked restore and
+                # streamed tokens past the old snapshot before dying
+                # (_on_death just folded that backlog into the
+                # mirrors), so restoring a stale entry would re-emit
+                # tokens the caller already holds.
+                retry = [(rid, self._wire_entry(fh), fh)
+                         for rid, _, fh in items if not fh.done]
+                self._distribute(retry, "replay")
+                continue
+            for rid, _, fh in items:
+                fh.replica_id = tid
+                fh.migrations += 1
+                target.assigned[rid] = fh
+                self._by_rid[rid] = fh
+                target.shadow.observe(
+                    list(fh.request.prompt),
+                    max_blocks=self._affinity_blocks)
+                if fh.session is not None:
+                    self._session_pin(fh.session, target)
+            self.metrics.requests_migrated += len(items)
+            if via == "drain":
+                self.metrics.migrated_via_drain += len(items)
+            else:
+                self.metrics.migrated_via_replay += len(items)
+            self._tracer.on_fleet_event(
+                "migration", to_replica=tid, n=len(items), via=via)
+
+    def _fail_handle(self, fh: FleetHandle,
+                     rid: Optional[int] = None) -> None:
+        fh.state = RequestState.FAILED
+        fh.finish_reason = FinishReason.ERROR
+        fh.finish_s = self._clock()
+        self.metrics.requests_failed += 1
+        # Drop the routing entry too: a terminally-failed handle left in
+        # `_by_rid` is scanned by every subsequent `has_work` forever —
+        # a slow leak across total-outage windows on a long-lived router.
+        if rid is not None:
+            self._by_rid.pop(rid, None)
+
+    def _can_ever_recover(self) -> bool:
+        return self._respawn and any(
+            getattr(s.driver, "can_respawn", False) for s in self._slots)
+
+    def _maybe_probe(self, slot: _ReplicaSlot, now: float) -> None:
+        if not (self._respawn and getattr(slot.driver, "can_respawn",
+                                          False)):
+            return
+        if not slot.breaker.probe_due(now):
+            return
+        slot.breaker.begin_probe(now)
+        self.metrics.probes += 1
+        try:
+            slot.driver.respawn()
+            slot.driver.warmup()
+        except Exception as e:  # noqa: BLE001 - probe failed, stay open
+            self.metrics.probe_failures += 1
+            slot.breaker.record_failure(self._clock())
+            self._tracer.on_fleet_event(
+                "probe_failed", replica=slot.replica_id,
+                error=type(e).__name__)
+            return
+        slot.breaker.record_success(self._clock())
+        slot.state = ReplicaLifecycle.UP
+        slot.reset_shadow()  # the fresh engine's radix cache is empty
+        self.metrics.replica_up_events += 1
+        self._tracer.on_fleet_event("replica_up", replica=slot.replica_id)
+        if self._orphans:
+            orphans, self._orphans = self._orphans, []
+            self._distribute(
+                [(rid, self._wire_entry(fh), fh) for rid, fh in orphans
+                 if not fh.done],
+                "replay")
+
+    # ------------------------------------------------------------ teardown
+    def drain(self) -> Dict[str, object]:
+        """Graceful fleet-wide drain: every live replica's in-flight
+        requests in one `serve/drain.py`-format snapshot (restorable
+        into a fresh engine or fleet). The router stops accepting."""
+        now = self._clock()
+        entries: List[Dict[str, object]] = []
+        for slot in self._slots:
+            if slot.state is not ReplicaLifecycle.UP:
+                continue
+            pairs = self._capture(slot, now)
+            if pairs is None:
+                entries.extend(self._wire_entry(fh)
+                               for fh in slot.assigned.values()
+                               if not fh.done)
+                continue
+            in_snapshot = set()
+            for rid, entry in pairs:
+                in_snapshot.add(rid)
+                fh = self._by_rid.get(rid)
+                if fh is not None and fh.done:
+                    continue  # settled by the backlog applied above
+                entries.append(entry)
+            entries.extend(
+                entry for _, entry, _ in
+                self._mirror_leftovers(slot, in_snapshot))
+        entries.extend(self._wire_entry(fh) for _, fh in self._orphans
+                       if not fh.done)
+        self._closed = True
+        return {"version": drain_io.SNAPSHOT_VERSION,
+                "drained_unix_s": time.time(), "requests": entries}
+
+    def close(self) -> None:
+        self._closed = True
+        for slot in self._slots:
+            try:
+                slot.driver.close()
+            except Exception:  # noqa: BLE001 - teardown is best-effort
+                pass

@@ -1,0 +1,206 @@
+"""Fleet worker: one engine replica as a real OS process.
+
+``python -m pddl_tpu.serve.fleet.worker --config-json '{...}'`` builds
+a GPT + :class:`~pddl_tpu.serve.ServeEngine` from the config, warms it,
+and then speaks the JSON-line protocol of
+:class:`~pddl_tpu.serve.fleet.replica.ProcessReplica` over stdio:
+commands (submit/cancel/ping/counts/restore/shutdown) arrive on stdin,
+events (ready/submit_ok/queue_full/tokens/finish/pong/counts/snapshot)
+leave on stdout. stdout is PROTOCOL-ONLY — anything chatty (jax logs)
+must go to stderr, which the parent leaves attached to its own.
+
+Determinism contract: every worker of a fleet (and the oracle engine a
+chaos test compares against) initializes parameters from the same
+``param_seed``, so greedy streams are token-exact across replicas —
+which is what makes live migration's "finish with the identical token
+sequence" promise testable.
+
+Death modes, matching r08's single-engine taxonomy:
+
+- **SIGTERM** → drain: stop admission, encode every in-flight request
+  (rid-tagged, `serve/drain.py` wire format), emit it as the final
+  ``snapshot`` event, exit 0. The router restores these on survivors —
+  live migration.
+- **SIGKILL / crash** → nothing is emitted; the parent sees EOF and
+  the router rebuilds the lost requests from its own prompt+token
+  mirrors (replay fallback).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import select
+import signal
+import sys
+from typing import Dict
+
+from pddl_tpu.serve.fleet.replica import HandleLedger, sampling_from_wire
+from pddl_tpu.serve.request import QueueFull
+
+
+def build_engine(config: Dict[str, object]):
+    """Engine from a flat config dict (the fleet's one model family for
+    now: GPT with ``attention="reference"`` — the CPU-safe path)."""
+    import jax
+    import jax.numpy as jnp
+
+    from pddl_tpu.models.gpt import GPT
+    from pddl_tpu.serve import ServeEngine
+
+    # Fleet determinism: every process deriving params from param_seed
+    # must draw the SAME bits. Newer jax defaults this True; older
+    # releases default False — pin it so a worker and the oracle
+    # comparing against it can never disagree on initialization.
+    try:
+        jax.config.update("jax_threefry_partitionable", True)
+    except Exception:  # noqa: BLE001 - flag gone once always-on
+        pass
+
+    model = GPT(vocab_size=int(config.get("vocab", 256)),
+                max_len=int(config.get("max_len", 512)),
+                embed_dim=int(config.get("embed_dim", 256)),
+                depth=int(config.get("depth", 4)),
+                num_heads=int(config.get("heads", 4)),
+                attention="reference")
+    dummy = jnp.ones((1, 8), jnp.int32)
+    params = model.init(jax.random.key(int(config.get("param_seed", 0))),
+                        dummy, train=False)["params"]
+    return ServeEngine(
+        model, {"params": params},
+        max_slots=int(config.get("slots", 8)),
+        prefill_len=int(config.get("prefill_len", 64)),
+        max_queue_depth=int(config.get("max_queue_depth", 64)),
+        # Engine-parity default: absent means the auto-sized prefix
+        # pool, NOT off — the router's affinity shadow must point at
+        # caches that exist. Pass 0 explicitly to disable.
+        prefix_cache_blocks=config.get("prefix_cache_blocks"),
+        rng=jax.random.key(int(config.get("engine_seed", 0))))
+
+
+def _emit(record: Dict[str, object]) -> None:
+    sys.stdout.write(json.dumps(record) + "\n")
+    sys.stdout.flush()
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--config-json", required=True)
+    args = p.parse_args(argv)
+    config = json.loads(args.config_json)
+
+    engine = build_engine(config)
+    engine.warmup()
+    ledger = HandleLedger()
+
+    flags = {"drain": False, "shutdown": False}
+
+    def _on_sigterm(signum, frame):  # flag only: async-signal-safe
+        flags["drain"] = True
+
+    signal.signal(signal.SIGTERM, _on_sigterm)
+    _emit({"ev": "ready", "replica": config.get("replica_id"),
+           "compile_counts": engine.compile_counts()})
+
+    import time
+
+    def handle_cmd(cmd: Dict[str, object]) -> None:
+        kind = cmd.get("cmd")
+        if kind == "submit":
+            rid = int(cmd["rid"])
+            try:
+                handle = engine.submit(
+                    cmd["prompt"], int(cmd["max_new_tokens"]),
+                    sampling=sampling_from_wire(cmd.get("sampling")),
+                    deadline_s=cmd.get("deadline_s"))
+            except QueueFull as e:
+                _emit({"ev": "queue_full", "rid": rid,
+                       "queue_depth": e.queue_depth,
+                       "max_queue_depth": e.max_queue_depth,
+                       "retry_after_s": e.retry_after_s})
+                return
+            except ValueError as e:  # bad request (too long, etc.):
+                _emit({"ev": "error", "rid": rid,  # reject it, not the
+                       "message": str(e)})         # whole worker
+                return
+            ledger.add(rid, handle)
+            _emit({"ev": "submit_ok", "rid": rid})
+        elif kind == "cancel":
+            h = ledger.get(int(cmd["rid"]))
+            if h is not None:
+                h.cancel()
+        elif kind == "ping":
+            _emit({"ev": "pong", "queue_depth": engine.scheduler.depth,
+                   "live_slots": engine.live_slots})
+        elif kind == "counts":
+            _emit({"ev": "counts", "counts": engine.compile_counts()})
+        elif kind == "restore":
+            from pddl_tpu.serve.fleet.replica import snapshot_from_pairs
+            from pddl_tpu.serve.request import FinishReason, RequestState
+
+            # Entry-at-a-time with per-entry isolation (the submit
+            # handler's discipline): one bad migrated entry — a
+            # corrupted mirror, a prompt beyond THIS replica's max_len —
+            # must fail that request terminally, not crash a healthy
+            # survivor mid-failover and cascade the outage.
+            for rid, entry in cmd["requests"]:
+                rid = int(rid)
+                try:
+                    (h,) = engine.restore(snapshot_from_pairs(
+                        [(rid, entry)]))
+                except Exception as e:  # noqa: BLE001 - reject the entry
+                    print(f"restore of rid={rid} rejected: {e}",
+                          file=sys.stderr)
+                    _emit({"ev": "finish", "rid": rid,
+                           "state": RequestState.FAILED.value,
+                           "reason": FinishReason.ERROR.value,
+                           "ttft_s": (entry.get("ttft_s")
+                                      if isinstance(entry, dict) else None),
+                           "n_tokens": 0})
+                    continue
+                ledger.add(rid, h)
+        elif kind == "drain":
+            flags["drain"] = True
+        elif kind == "shutdown":
+            flags["shutdown"] = True
+
+    stdin_fd = sys.stdin.fileno()
+    buf = b""
+    while not flags["shutdown"]:
+        # Commands first (non-blocking; idle workers block briefly so a
+        # quiet fleet costs ~no CPU), then one engine step if live.
+        timeout = 0.0 if engine.has_work else 0.02
+        ready, _, _ = select.select([stdin_fd], [], [], timeout)
+        if ready:
+            try:
+                chunk = sys.stdin.buffer.raw.read(65536)
+            except (BlockingIOError, OSError):
+                chunk = None
+            if chunk == b"":  # parent closed stdin: orphaned, exit
+                break
+            if chunk:
+                buf += chunk
+                while b"\n" in buf:
+                    line, buf = buf.split(b"\n", 1)
+                    if line.strip():
+                        handle_cmd(json.loads(line))
+        if flags["drain"]:
+            now = time.monotonic()
+            entries = ledger.drain_entries(now)
+            try:
+                engine.drain()
+            except Exception:  # noqa: BLE001 - snapshot already captured
+                pass
+            _emit({"ev": "snapshot",
+                   "requests": [[rid, entry] for rid, entry in entries],
+                   "compile_counts": engine.compile_counts()})
+            return 0
+        if engine.has_work:
+            engine.step()
+            for ev in ledger.harvest():
+                _emit(ev)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,44 @@
+"""Child for the cross-process drain→restore round-trip test.
+
+Builds the deterministic fleet-worker engine
+(`pddl_tpu.serve.fleet.worker.build_engine`, seeded params), submits a
+fixed workload, runs a few steps so some streams are mid-flight, then
+drains to ``<out_dir>/snapshot.json`` via the engine's own SIGTERM-path
+``drain()`` and writes a sidecar ``state.json`` with each request's
+partial stream at drain time — everything the PARENT test (a different
+interpreter) needs to pin the restore token-exact.
+
+Usage: ``python tests/_serve_drain_child.py <out_dir> <config-json>``
+"""
+
+import json
+import os
+import sys
+
+
+def main() -> int:
+    out_dir, config_json = sys.argv[1], sys.argv[2]
+    config = json.loads(config_json)
+    os.makedirs(out_dir, exist_ok=True)
+
+    from pddl_tpu.serve.fleet.worker import build_engine
+
+    engine = build_engine(config)
+    engine.warmup()
+    handles = [engine.submit(req["prompt"], req["max_new_tokens"])
+               for req in config["workload"]]
+    for _ in range(int(config.get("steps_before_drain", 3))):
+        engine.step()
+    partial = [list(h.tokens) for h in handles]
+    engine.drain(os.path.join(out_dir, "snapshot.json"))
+    with open(os.path.join(out_dir, "state.json"), "w") as f:
+        json.dump({
+            "partial_tokens": partial,
+            "states": [h.state.value for h in handles],
+            "pid": os.getpid(),
+        }, f)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -21,6 +21,14 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+# Newer jax defaults this True (random bits independent of how the key
+# computation is partitioned); older releases default False, which makes
+# sharded-vs-single-device runs draw DIFFERENT dropout masks and fail the
+# SPMD-identity pins. Align old jax with the modern semantics.
+try:
+    jax.config.update("jax_threefry_partitionable", True)
+except Exception:  # noqa: BLE001 - flag removed once it's the only behavior
+    pass
 
 # The suite's wall-clock is dominated by XLA:CPU compiles of the sharded
 # train steps. Persist them (shared with the driver's multichip gate):
@@ -54,6 +62,32 @@ def native_build_error(tfrecord: bool = False) -> str:
         return str(e)
 
 
+def ref_greedy(model, variables, prompt, n_new):
+    """The serving test suite's oracle: one-shot batch-1 ``generate()``
+    over the same params. Every engine/fleet path (cold admit, prefix
+    hit, replay, migration) is pinned token-exact against THIS — one
+    copy, so every serving test file pins the same reference."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from pddl_tpu.models.gpt import generate
+
+    out = generate(model, variables,
+                   jnp.asarray(prompt, jnp.int32)[None], n_new)
+    return np.asarray(out)[0, len(prompt):].tolist()
+
+
+class FakeClock:
+    """Deterministic ``clock=`` stand-in: time advances only when a
+    test sets ``.now`` (deadlines, backoff, breaker windows)."""
+
+    def __init__(self, now=0.0):
+        self.now = now
+
+    def __call__(self):
+        return self.now
+
+
 @pytest.fixture()
 def pin_zero_recompiles():
     """THE fixed-shape contract as a reusable fixture: every resident
@@ -63,16 +97,22 @@ def pin_zero_recompiles():
     nothing new.
 
     Works for anything exposing ``compile_counts()``: a ``ServeEngine``
-    (warmed first — it exposes ``warmup()``) or a ``Trainer`` (register
-    it after its first fit, when both programs exist)::
+    (warmed first — it exposes ``warmup()``), a ``Trainer`` (register
+    it after its first fit, when both programs exist), or a
+    ``FleetRouter``, whose aggregated counts are keyed
+    ``r<replica>/<site>`` — registering a fleet pins zero recompiles
+    PER REPLICA, which is how the fleet chaos matrix asserts that no
+    surviving replica recompiled anything across a migration::
 
         eng = pin_zero_recompiles(ServeEngine(model, variables, ...))
         trainer.fit(...); pin_zero_recompiles(trainer)
+        fleet = pin_zero_recompiles(FleetRouter([...]))
 
     Every serve-layer test that builds an engine through it gets the
     zero-recompile pin for free (`test_serve_engine.py`,
     `test_prefix_cache.py`); the training chaos matrix pins recovery
-    transitions the same way (`test_train_faults.py`).
+    transitions the same way (`test_train_faults.py`), the fleet
+    matrix per surviving replica (`test_serve_fleet.py`).
     """
     engines = []
 

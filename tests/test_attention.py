@@ -6,6 +6,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+from pddl_tpu.core.mesh import has_vma_checking, shard_map
 from pddl_tpu.ops.attention import attention_reference, flash_attention
 from pddl_tpu.ops.ring_attention import (
     ring_attention,
@@ -162,7 +163,7 @@ def test_ring_attention_single_shard_degenerates_to_full():
     mesh = build_mesh(MeshConfig(data=8, seq=1))
     q, k, v = _qkv(b=1, h=1, s=32, d=8)
     spec = P(None, None, "seq", None)
-    out = jax.shard_map(
+    out = shard_map(
         lambda q, k, v: ring_attention(q, k, v, axis_name="seq"),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
     )(q, k, v)
@@ -229,8 +230,10 @@ def test_remat_policies_numerics_and_grads():
                                        rtol=1e-5)
             for a, b in zip(jax.tree.leaves(grad),
                             jax.tree.leaves(ref_grad)):
+                # atol covers XLA-version rematerialization reassociation
+                # (older CPU backends land ~1e-5 off on isolated elements).
                 np.testing.assert_allclose(np.asarray(a), np.asarray(b),
-                                           rtol=1e-4, atol=1e-5)
+                                           rtol=1e-4, atol=5e-5)
 
     check(lambda r: ViT(patch_size=4, embed_dim=32, depth=2, num_heads=4,
                         num_classes=8, attention="reference", remat=r),
@@ -276,6 +279,7 @@ def test_flash_attention_lse_matches_reference():
                                    atol=3e-5, rtol=3e-5)
 
 
+@pytest.mark.slow  # multi-hop pallas-interpret loop: tier-2 wall-clock
 def test_flash_ring_matches_reference_and_xla_ring(mesh8):
     """Flash-per-rotation ring == XLA-einsum ring == full attention,
     forward AND gradients, causal and not."""
@@ -313,6 +317,11 @@ def test_flash_ring_matches_reference_and_xla_ring(mesh8):
                                        atol=3e-4, rtol=3e-4)
 
 
+@pytest.mark.skipif(not has_vma_checking(),
+                    reason="pre-vma jax: the legacy check_rep "
+                           "checker is disabled by the compat "
+                           "shard_map, so there is no checker "
+                           "behaviour to pin")
 def test_flash_ring_check_vma_limitation():
     """Pin WHY the flash ring runs with check_vma=False (VERDICT r1 weak #5).
 
@@ -336,7 +345,7 @@ def test_flash_ring_check_vma_limitation():
     q, k, v = (jax.random.normal(jax.random.key(20 + i), (B, H, S, D))
                for i in range(3))
     spec = P(None, None, "seq", None)
-    checked = jax.shard_map(
+    checked = shard_map(
         functools.partial(ring_attention_flash, axis_name="seq", causal=True),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
         check_vma=True,
@@ -505,6 +514,7 @@ def test_flash_lse_gqa_matches_reference():
                                    atol=2e-5, rtol=2e-5)
 
 
+@pytest.mark.slow  # multi-hop pallas-interpret loop: tier-2 wall-clock
 @pytest.mark.parametrize("use_flash", [False, True])
 def test_ring_gqa_rotates_unexpanded_kv(mesh8, use_flash):
     """Ring attention with kv-head-sized shards (the ppermute payload is
@@ -660,6 +670,7 @@ def test_decode_attention_prefix_bound_ignores_cache_garbage():
                                atol=2e-5, rtol=2e-5)
 
 
+@pytest.mark.slow  # multi-hop pallas-interpret loop: tier-2 wall-clock
 @pytest.mark.parametrize("use_flash", [False, True])
 def test_ring_swa_gqa_matches_windowed_reference(mesh8, use_flash):
     """Ring × SWA × GQA (VERDICT r3 task 4): the full composition —

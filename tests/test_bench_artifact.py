@@ -1,0 +1,130 @@
+"""The perf-trajectory gate (`utils/bench_artifact.py` compare/gate —
+ROADMAP item 5): >5% median regressions between committed artifacts at
+the same (metric, config) must fail LOUDLY, and the committed
+`artifacts/gpt_bench/r*.json` series must currently be regression-free.
+"""
+
+import copy
+import glob
+import os
+
+import pytest
+
+from pddl_tpu.utils.bench_artifact import (
+    artifact_key,
+    check_series,
+    compare,
+    load_artifact,
+    metric_direction,
+    _main,
+)
+
+pytestmark = pytest.mark.bench_gate
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_BENCH_DIR = os.path.join(_ROOT, "artifacts", "gpt_bench")
+
+
+def _record(**results):
+    return {"metric": "online_serving_tokens_per_sec",
+            "config": {"model": "gpt 4x256", "slots": 8},
+            "results": results}
+
+
+def test_metric_direction_vocabulary():
+    assert metric_direction("concurrent_engine_tokens_per_s") == 1
+    assert metric_direction("throughput_retained_x") == 1
+    assert metric_direction("ttft_p99_s") == -1
+    assert metric_direction("mean_ttft_prefix_on_s") == -1
+    # Ratio keys beat the latency substring: a bigger TTFT *reduction*
+    # is an improvement, not a regression.
+    assert metric_direction("ttft_reduction_x") == 1
+    # Noise keys are never compared.
+    assert metric_direction("spread_pct") == 0
+    assert metric_direction("ttft_inflation_per_pair") == 0
+    assert metric_direction("n_requests") == 0
+
+
+def test_compare_flags_directional_regressions_only():
+    old = _record(tokens_per_s=1000.0, ttft_p99_s=0.10, spread_pct=2.0,
+                  prefix_hit_rate=0.97)
+    # 4% throughput dip: inside the gate.
+    ok = compare(old, _record(tokens_per_s=960.0, ttft_p99_s=0.10,
+                              spread_pct=9.0, prefix_hit_rate=0.97))
+    assert ok == []
+    # 10% throughput drop: flagged, with the right direction label.
+    bad = compare(old, _record(tokens_per_s=900.0, ttft_p99_s=0.10,
+                               spread_pct=2.0, prefix_hit_rate=0.97))
+    assert [r["path"] for r in bad] == ["results.tokens_per_s"]
+    assert bad[0]["direction"] == "higher-better"
+    # TTFT rising 50%: flagged as a lower-better regression; TTFT
+    # FALLING 50% is an improvement and passes.
+    worse = compare(old, _record(tokens_per_s=1000.0, ttft_p99_s=0.15,
+                                 spread_pct=2.0, prefix_hit_rate=0.97))
+    assert [r["path"] for r in worse] == ["results.ttft_p99_s"]
+    assert worse[0]["direction"] == "lower-better"
+    assert compare(old, _record(tokens_per_s=1050.0, ttft_p99_s=0.05,
+                                spread_pct=2.0,
+                                prefix_hit_rate=0.99)) == []
+
+
+def test_compare_flags_vanished_directional_leaves():
+    """A renamed/dropped headline must not silently exit the gate: a
+    directional leaf present in old but absent in new is a loud
+    failure; noise leaves and NEW legs (absent in old) are not."""
+    old = _record(tokens_per_s=1000.0, ttft_p99_s=0.10, spread_pct=2.0)
+    gone = compare(old, _record(toks_per_s=1000.0, ttft_p99_s=0.10,
+                                spread_pct=2.0))
+    assert [r["path"] for r in gone] == ["results.tokens_per_s"]
+    assert gone[0]["direction"] == "missing-in-new"
+    assert gone[0]["new"] is None and gone[0]["change_pct"] is None
+    # Dropping a noise key, or growing a brand-new leg, stays green.
+    assert compare(old, _record(tokens_per_s=1000.0, ttft_p99_s=0.10,
+                                killed_tokens_per_s=900.0)) == []
+
+
+def test_compare_refuses_mismatched_configs():
+    old = _record(tokens_per_s=1000.0)
+    other = copy.deepcopy(old)
+    other["config"]["slots"] = 16  # a different experiment
+    with pytest.raises(ValueError, match="not comparable"):
+        compare(old, other)
+
+
+def test_committed_artifact_series_has_no_silent_regressions():
+    """THE gate: every consecutive same-(metric, config) pair in the
+    committed r*.json series is within 5% on every directional
+    headline. A failure here means a perf regression was committed —
+    fix the regression or consciously re-baseline the artifact, never
+    ignore this test."""
+    paths = sorted(glob.glob(os.path.join(_BENCH_DIR, "r*.json")))
+    assert paths, "committed bench artifacts are missing"
+    pairs, failures = check_series(paths, threshold_pct=5.0)
+    lines = []
+    for failure in failures:
+        for r in failure["regressions"]:
+            change = ("leaf vanished" if r["change_pct"] is None
+                      else f"{r['change_pct']:+.1f}%")
+            lines.append(
+                f"{failure['old_path']} -> {failure['new_path']}: "
+                f"{r['path']} {r['old']} -> {r['new']} "
+                f"({change}, {r['direction']})")
+    assert not failures, "committed perf regressions:\n" + "\n".join(lines)
+    # The loader really parsed the series (metric'd records exist, and
+    # the r11 fleet artifact participates in at least its own group).
+    keyed = [r for p in paths for r in load_artifact(p)
+             if artifact_key(r) is not None]
+    assert len(keyed) >= 8
+
+
+def test_cli_gate_exit_codes(tmp_path):
+    import json
+
+    old = _record(tokens_per_s=1000.0)
+    new = _record(tokens_per_s=850.0)
+    (tmp_path / "r01_x.json").write_text(json.dumps(old))
+    (tmp_path / "r02_x.json").write_text(json.dumps(new))
+    assert _main(["gate", str(tmp_path)]) == 1  # loud on regression
+    assert _main(["compare", str(tmp_path / "r01_x.json"),
+                  str(tmp_path / "r01_x.json")]) == 0
+    assert _main(["gate", _BENCH_DIR]) == 0  # the committed series

@@ -101,7 +101,11 @@ def test_warmup_and_plateau_compose():
     tr = _trainer(learning_rate=0.8)
     # min_delta so large nothing ever improves: plateau fires at the end of
     # EVERY epoch from epoch 1 on — including inside the warmup window.
-    plateau = ReduceLROnPlateau(patience=1, factor=0.1, min_delta=10.0,
+    # (1e30, not 10: at lr=0.8 the noise-fit loss explodes, and a small
+    # threshold lets a >min_delta swing register as improvement on some
+    # XLA:CPU runs, skipping one reduction. Finite, unlike inf, so the
+    # first epoch still sets the baseline: inf - inf is NaN.)
+    plateau = ReduceLROnPlateau(patience=1, factor=0.1, min_delta=1e30,
                                 min_lr=1e-6)
     warmup = LearningRateWarmup(warmup_epochs=3, verbose=0)
     lrs = []

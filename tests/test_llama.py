@@ -345,6 +345,7 @@ def test_generate_respects_sliding_window():
         np.asarray(jnp.argmax(full[:, 4:], axis=-1)))
 
 
+@pytest.mark.slow  # multi-hop pallas-interpret loop: tier-2 wall-clock
 def test_rolling_ring_cache_wraps_and_matches_full_forward():
     """Mistral's rolling KV cache: with window < max_len the decode cache
     is a ring of ~window slots (not max_len), and logits stay exact at
@@ -384,6 +385,7 @@ def test_rolling_ring_cache_wraps_and_matches_full_forward():
             atol=1e-5, rtol=1e-5, err_msg=f"position {t}")
 
 
+@pytest.mark.slow  # multi-hop pallas-interpret loop: tier-2 wall-clock
 def test_ring_prefill_longer_than_ring():
     """A prompt longer than the ring: prefill writes only the last
     `ring` keys; subsequent single-token steps stay exact."""
@@ -415,6 +417,7 @@ def test_ring_prefill_longer_than_ring():
             atol=1e-5, rtol=1e-5, err_msg=f"position {t}")
 
 
+@pytest.mark.slow  # multi-hop pallas-interpret loop: tier-2 wall-clock
 def test_ring_chunked_prefill_at_nonzero_index():
     """Chunked prefill on the SWA ring path: a SECOND multi-token call at
     i > 0 (after the ring has content, including post-wrap) must merge
@@ -719,6 +722,7 @@ def test_hf_biasless_checkpoint_into_biased_model_raises():
         load_hf_llama(hf, v, model=ours)
 
 
+@pytest.mark.slow  # multi-hop pallas-interpret loop: tier-2 wall-clock
 def test_ring_flash_gqa_matches_reference():
     """Sequence-parallel ring attention through the Llama family: GQA
     K/V expand before the ring, so the sharded result must equal the

@@ -7,7 +7,13 @@ import pytest
 from jax.sharding import PartitionSpec as P
 
 from pddl_tpu.core import collectives
-from pddl_tpu.core.mesh import MeshConfig, build_mesh, mesh_num_replicas, validate_divisible
+from pddl_tpu.core.mesh import (
+    MeshConfig,
+    build_mesh,
+    mesh_num_replicas,
+    shard_map,
+    validate_divisible,
+)
 
 
 def test_mesh_default_all_data(eight_devices):
@@ -39,7 +45,7 @@ def test_psum_pmean_over_mesh(mesh8):
     def f(x):
         return collectives.psum(x, "data"), collectives.pmean(x, "data")
 
-    g = jax.shard_map(f, mesh=mesh8, in_specs=P("data"), out_specs=P())
+    g = shard_map(f, mesh=mesh8, in_specs=P("data"), out_specs=P())
     s, m = g(jnp.arange(8.0))
     assert s[0] == 28.0
     assert m[0] == 3.5
@@ -49,7 +55,7 @@ def test_broadcast_from_root(mesh8):
     def f(x):
         return collectives.broadcast(x, "data", root=3)
 
-    g = jax.shard_map(f, mesh=mesh8, in_specs=P("data"), out_specs=P("data"))
+    g = shard_map(f, mesh=mesh8, in_specs=P("data"), out_specs=P("data"))
     out = g(jnp.arange(8.0))
     np.testing.assert_array_equal(np.asarray(out), np.full(8, 3.0))
 
@@ -58,7 +64,7 @@ def test_ppermute_ring(mesh8):
     def f(x):
         return collectives.ppermute_ring(x, "data", shift=1)
 
-    g = jax.shard_map(f, mesh=mesh8, in_specs=P("data"), out_specs=P("data"))
+    g = shard_map(f, mesh=mesh8, in_specs=P("data"), out_specs=P("data"))
     out = np.asarray(g(jnp.arange(8.0)))
     # member i sends to i+1: position j holds value j-1 (mod 8)
     np.testing.assert_array_equal(out, np.roll(np.arange(8.0), 1))
@@ -70,6 +76,6 @@ def test_reduce_scatter(mesh8):
 
     # Each member holds a length-8 vector of ones; psum_scatter sums across
     # members then scatters: each member ends with 8/8=1 element == 8.0.
-    g = jax.shard_map(f, mesh=mesh8, in_specs=P(None), out_specs=P("data"))
+    g = shard_map(f, mesh=mesh8, in_specs=P(None), out_specs=P("data"))
     out = np.asarray(g(jnp.ones(8)))
     np.testing.assert_array_equal(out, np.full(8, 8.0))

@@ -49,6 +49,7 @@ from pddl_tpu.obs import (
 from pddl_tpu.serve import ServeEngine
 from pddl_tpu.serve.metrics import Reservoir, ServeMetrics
 from pddl_tpu.utils.profiling import StepTimer
+from conftest import ref_greedy as _ref_greedy
 
 pytestmark = pytest.mark.obs
 
@@ -59,12 +60,6 @@ def gpt_setup():
     prompt = jnp.ones((1, 8), jnp.int32)
     params = model.init(jax.random.key(0), prompt, train=False)["params"]
     return model, {"params": params}
-
-
-def _ref_greedy(model, variables, prompt, n_new):
-    out = generate(model, variables,
-                   jnp.asarray(prompt, jnp.int32)[None], n_new)
-    return np.asarray(out)[0, len(prompt):].tolist()
 
 
 # ---------------------------------------------------------------- tracer

@@ -10,6 +10,7 @@ training on the fake 8-device mesh.
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 from jax.sharding import PartitionSpec as P
 
 from pddl_tpu.core.mesh import MeshConfig, STAGE_AXIS, build_mesh
@@ -69,7 +70,10 @@ def test_pipeline_strategy_shards_stages_and_trains():
     ds = SyntheticImageClassification(
         batch_size=8, image_size=32, num_classes=8, seed=0,
         signal_strength=3.0)
-    hist = tr.fit(ds, epochs=2, steps_per_epoch=4, verbose=0)
+    # 4 epochs: adamw needs a few warmup steps before the loss moves
+    # decisively on this tiny config (2 epochs is within seed-noise of
+    # flat).
+    hist = tr.fit(ds, epochs=4, steps_per_epoch=4, verbose=0)
     assert hist.history["loss"][-1] < hist.history["loss"][0]
 
     # One stage's weights per mesh position; embed/head replicated.
@@ -131,6 +135,7 @@ def test_3d_parallelism_dp_pp_tp():
     assert ln.sharding.spec == P(STAGE_AXIS)
 
 
+@pytest.mark.slow  # multi-hop pallas-interpret loop: tier-2 wall-clock
 def test_pipeline_bubble_arithmetic():
     """Every microbatch count yields the same math (bubble only wastes
     compute, never correctness)."""
@@ -146,6 +151,7 @@ def test_pipeline_bubble_arithmetic():
         np.testing.assert_allclose(o, outs[0], atol=1e-4, rtol=1e-4)
 
 
+@pytest.mark.slow  # multi-hop pallas-interpret loop: tier-2 wall-clock
 def test_remat_stages_changes_memory_never_numbers():
     """remat_stages (per-tick jax.checkpoint of the stage call — the
     GPipe activation-memory mitigation, benchmarks/gpipe_memory_bench.py)

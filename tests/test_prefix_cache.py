@@ -31,6 +31,7 @@ from pddl_tpu.models.llama import tiny_llama
 from pddl_tpu.ops.attention import cache_blocks_gather, cache_blocks_scatter
 from pddl_tpu.serve import RadixPrefixCache, ServeEngine
 from pddl_tpu.serve.kvcache.radix import SCRATCH_BLOCK
+from conftest import ref_greedy as _ref_greedy
 
 
 @pytest.fixture(scope="module")
@@ -47,12 +48,6 @@ def llama_setup():
     prompt = jnp.ones((1, 8), jnp.int32)
     params = model.init(jax.random.key(1), prompt, train=False)["params"]
     return model, {"params": params}
-
-
-def _ref_greedy(model, variables, prompt, n_new):
-    out = generate(model, variables,
-                   jnp.asarray(prompt, jnp.int32)[None], n_new)
-    return np.asarray(out)[0, len(prompt):].tolist()
 
 
 def _exactness_workload(model, variables, ref_variables=None, **engine_kw):

@@ -30,8 +30,10 @@ def test_roundtrip_error_bounded_per_channel():
     q = quantize_int8(tree, min_elems=1)
     back = dequantize(q)["dense"]["kernel"]
     # Symmetric 127-level: per-element error <= scale/2 = amax/254.
+    # The relative slack covers a w/scale landing exactly on a rounding
+    # tie (x.5), where the f32 error sits epsilon past the bound.
     bound = jnp.max(jnp.abs(w), axis=0) / 254.0
-    assert jnp.all(jnp.abs(back - w) <= bound + 1e-7)
+    assert jnp.all(jnp.abs(back - w) <= bound * (1 + 1e-5) + 1e-7)
     # Per-channel matters: the smallest channel's error obeys its OWN
     # amax bound, orders of magnitude below what the global (per-tensor)
     # amax would allow.

@@ -23,6 +23,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+from conftest import ref_greedy as _ref_greedy, FakeClock as _FakeClock
 from pddl_tpu.models.gpt import (
     batched_filtered_logits,
     filtered_logits,
@@ -45,20 +46,6 @@ def gpt_setup():
     prompt = jnp.ones((1, 8), jnp.int32)
     params = model.init(jax.random.key(0), prompt, train=False)["params"]
     return model, {"params": params}
-
-
-def _ref_greedy(model, variables, prompt, n_new):
-    out = generate(model, variables,
-                   jnp.asarray(prompt, jnp.int32)[None], n_new)
-    return np.asarray(out)[0, len(prompt):].tolist()
-
-
-class _FakeClock:
-    def __init__(self):
-        self.now = 0.0
-
-    def __call__(self):
-        return self.now
 
 
 def test_admit_evict_slot_reuse_matches_generate(gpt_setup):
@@ -284,13 +271,16 @@ def test_eos_finishes_early(gpt_setup):
     model, variables = gpt_setup
     p = np.arange(6) % 32
     ref = _ref_greedy(model, variables, p, 3)
+    eos = ref[1]
     eng = ServeEngine(model, variables, max_slots=1, prefill_len=16,
-                      eos_token=ref[1])
+                      eos_token=eos)
     h = eng.submit(p, 20)
     eng.run(max_steps=50)
     assert h.state == RequestState.FINISHED
     assert h.finish_reason == FinishReason.EOS
-    assert h.tokens == ref[:2]
+    # The stream stops at the FIRST occurrence of the eos token (which
+    # may be earlier than index 1 if greedy repeats it), eos included.
+    assert h.tokens == ref[:ref.index(eos) + 1]
 
 
 def test_submit_validation_and_ring_refusal(gpt_setup):

@@ -28,6 +28,7 @@ The contracts under test:
 """
 
 import signal
+import sys
 
 import jax
 import jax.numpy as jnp
@@ -48,6 +49,7 @@ from pddl_tpu.serve import (
 )
 from pddl_tpu.serve.scheduler import FCFSScheduler
 from pddl_tpu.serve.request import Request, RequestHandle
+from conftest import ref_greedy as _ref_greedy, FakeClock as _FakeClock
 
 
 @pytest.fixture(scope="module")
@@ -56,20 +58,6 @@ def gpt_setup():
     prompt = jnp.ones((1, 8), jnp.int32)
     params = model.init(jax.random.key(0), prompt, train=False)["params"]
     return model, {"params": params}
-
-
-def _ref_greedy(model, variables, prompt, n_new):
-    out = generate(model, variables,
-                   jnp.asarray(prompt, jnp.int32)[None], n_new)
-    return np.asarray(out)[0, len(prompt):].tolist()
-
-
-class _FakeClock:
-    def __init__(self):
-        self.now = 0.0
-
-    def __call__(self):
-        return self.now
 
 
 def _no_sleep(_):
@@ -484,7 +472,134 @@ def test_drain_preserves_remaining_deadline_budget(gpt_setup):
     assert restored.state == RequestState.TIMED_OUT
 
 
+def test_cross_process_drain_restore_roundtrip(tmp_path):
+    """The snapshot is a real WIRE format, not an in-process artifact:
+    written by one interpreter (`tests/_serve_drain_child.py` — builds
+    the deterministic fleet-worker engine, serves, drains on disk),
+    restored token-exactly in THIS interpreter. Pins what the in-process
+    round-trip cannot: JSON serialization fidelity, version checking,
+    and param-derivation determinism across processes (the fleet's
+    migration path crosses exactly this boundary)."""
+    import json
+    import os
+    import subprocess
+
+    from pddl_tpu.serve.fleet.worker import build_engine
+
+    workload = [
+        {"prompt": ((np.arange(10) * 5 + 1) % 64).tolist(),
+         "max_new_tokens": 8},
+        {"prompt": ((np.arange(13) * 3 + 7) % 64).tolist(),
+         "max_new_tokens": 7},
+        {"prompt": ((np.arange(7) + 17) % 64).tolist(),
+         "max_new_tokens": 6},
+        {"prompt": ((np.arange(11) * 7 + 2) % 64).tolist(),
+         "max_new_tokens": 5},
+    ]
+    cfg = dict(vocab=64, max_len=128, embed_dim=64, depth=2, heads=2,
+               slots=2, prefill_len=32, max_queue_depth=64, param_seed=3,
+               steps_before_drain=3, workload=workload)
+    child = os.path.join(os.path.dirname(__file__), "_serve_drain_child.py")
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = root + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run(
+        [sys.executable, child, str(tmp_path), json.dumps(cfg)],
+        env=env, capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, f"drain child failed:\n{proc.stderr[-3000:]}"
+    with open(tmp_path / "state.json") as f:
+        child_state = json.load(f)
+    assert any(child_state["partial_tokens"]), "child drained nothing live"
+    assert "running" in child_state["states"]
+
+    engine = build_engine(cfg)  # fresh engine, THIS interpreter
+    restored = engine.restore(str(tmp_path / "snapshot.json"))
+    assert len(restored) == len(workload)
+    engine.run(max_steps=500)
+    refs = [_ref_greedy(engine.model, {"params": engine._params},
+                        req["prompt"], req["max_new_tokens"])
+            for req in workload]
+    by_prompt = {tuple(h.request.prompt): h for h in restored}
+    for req, ref, part in zip(workload, refs,
+                              child_state["partial_tokens"]):
+        h = by_prompt[tuple(req["prompt"])]
+        assert h.state == RequestState.FINISHED
+        assert h.tokens == ref                 # full stream, token-exact
+        assert h.tokens[:len(part)] == part    # resumed, not re-sampled
+
+
 # ---------------------------------------------------- backpressure hints
+def test_retry_after_hint_monotone_nonnegative():
+    """Property (seeded sweep): whatever admission history the engine
+    has seen, ``estimate_retry_after_s`` is non-negative and monotone
+    non-decreasing in queue depth — a deeper queue never promises a
+    SHORTER wait (that inversion is what turns polite backoff into a
+    retry storm)."""
+    from pddl_tpu.serve.metrics import ServeMetrics
+
+    rng = np.random.default_rng(0)
+    warm_trials = 0
+    for _ in range(25):
+        m = ServeMetrics()
+        t = 0.0
+        for _ in range(int(rng.integers(0, 40))):
+            t += float(rng.exponential(rng.uniform(0.01, 2.0)))
+            m.record_admission(t)
+        depths = sorted(int(rng.integers(0, 64)) for _ in range(10))
+        hints = [m.estimate_retry_after_s(d) for d in depths]
+        if m.recent_admission_interval_s() is None:
+            assert all(h is None for h in hints)  # honest cold answer
+            continue
+        warm_trials += 1
+        assert all(h is not None and h >= 0.0 for h in hints)
+        assert all(a <= b for a, b in zip(hints, hints[1:])), \
+            f"hint not monotone over depths {depths}: {hints}"
+    assert warm_trials >= 10  # the sweep exercised the warm estimator
+
+
+def test_polite_client_never_sees_consecutive_queue_fulls(gpt_setup):
+    """Property (seeded runs): a client that HONORS ``retry_after_s``
+    (waits the hinted interval while the engine keeps draining) never
+    gets rejected twice in a row — the hint really does estimate when
+    a queue slot frees. Un-hinted rejections (cold estimator) are
+    exempt: there was nothing to honor."""
+    model, variables = gpt_setup
+    for seed in (0, 1, 2):
+        rng = np.random.default_rng(seed)
+        clock = _FakeClock()
+        eng = ServeEngine(model, variables, max_slots=1, prefill_len=16,
+                          max_queue_depth=3, clock=clock)
+
+        def pump(dt, *, eng=eng, clock=clock):
+            # The draining engine: steps keep happening as time passes.
+            for _ in range(max(1, int(dt / 0.25))):
+                eng.step()
+                clock.now += 0.25
+
+        submitted, last_full_hinted = 0, False
+        while submitted < 25:
+            prompt = (np.arange(int(rng.integers(4, 10)))
+                      + submitted) % 32
+            try:
+                eng.submit(prompt, int(rng.integers(2, 5)))
+                submitted += 1
+                last_full_hinted = False
+            except QueueFull as e:
+                if e.retry_after_s is not None:
+                    assert not last_full_hinted, \
+                        (f"seed {seed}: consecutive QueueFulls for a "
+                         f"client honoring retry_after_s")
+                    assert e.retry_after_s >= 0.0
+                    last_full_hinted = True
+                    pump(e.retry_after_s + 0.25)
+                else:
+                    last_full_hinted = False
+                    pump(0.25)
+            if rng.random() < 0.5:
+                pump(0.25)
+        eng.run(max_steps=1000)
+
+
 def test_queue_full_carries_retry_after_hint(gpt_setup):
     model, variables = gpt_setup
     clock = _FakeClock()
