@@ -110,7 +110,7 @@ SERVE_COUNTER_KEYS = frozenset({
     "requests_cancelled", "requests_failed", "requests_deadline_shed",
     "tokens_emitted", "prefix_lookups", "prefix_hits",
     "prefill_tokens_saved", "prefix_evictions", "retries", "replays",
-    "degraded_entries", "degraded_time_s",
+    "preemptions", "degraded_entries", "degraded_time_s",
 })
 
 _NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
@@ -310,6 +310,13 @@ FLEET_COUNTER_KEYS = frozenset({
     "shed_rerouted", "shed_rejected", "requests_finished",
     "requests_failed", "requests_orphaned", "heartbeat_failures",
     "probes", "probe_failures", "tokens_streamed",
+    # Admission control / brownout (`serve/fleet/admission.py`): the
+    # front-door rejections and ladder movement. Per-class splits
+    # flatten to admission_rejected_<class>, typed counters below like
+    # the circuit_* transitions.
+    "admission_rate_limited", "brownout_shed_best_effort",
+    "brownout_rejected_cold", "brownout_capped_output",
+    "brownout_escalations", "brownout_deescalations",
 })
 
 
@@ -322,9 +329,14 @@ def fleet_exposition(router) -> str:
     so one Prometheus config scrapes all three tiers."""
     snap = dict(router.metrics.snapshot())
     counters = FLEET_COUNTER_KEYS | {
-        k for k in snap if k.startswith("circuit_")}
+        k for k in snap
+        if k.startswith(("circuit_", "admission_rejected_"))}
     snap["replicas"] = len(router.replicas)
     snap["replicas_healthy"] = router.healthy_replicas
+    if router.admission is not None:
+        # The ladder rung as a gauge: 0 NORMAL … 3 REJECT_COLD. The
+        # runbook's first stop during an overload page.
+        snap["brownout_rung"] = int(router.admission.rung)
     snap["replica_state"] = {
         f"r{s.replica_id}": 1 if s.state.value == "up" else 0
         for s in router.replicas}

@@ -170,6 +170,10 @@ class NullTracer:
     def on_deadline_shed(self, handle) -> None:
         """Queued request shed at pop time (deadline expired)."""
 
+    def on_preempt(self, handle, step: int) -> None:
+        """Running best_effort slot parked for queued interactive
+        work; the stream resumes later via replay admission."""
+
     def on_finish(self, handle, reason: str) -> None:
         """Request reached a terminal state."""
 
@@ -346,6 +350,16 @@ class RequestTracer(NullTracer):
         span = self._span(handle)
         if span is not None:
             span.event(self._clock(), "deadline_shed")
+
+    def on_preempt(self, handle, step: int) -> None:
+        span = self._span(handle)
+        if span is not None:
+            now = self._clock()
+            span.last_requeue_s = now  # replay admission waits from HERE
+            span.event(now, "preempted", step=step,
+                       priority=handle.request.priority.value)
+        self._engine_event("preempted", step=step,
+                           request_id=handle.request.request_id)
 
     def on_replay(self, handle, step: int, requeued: bool) -> None:
         span = self._span(handle)

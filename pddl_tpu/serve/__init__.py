@@ -1,8 +1,9 @@
 """Online serving: continuous batching over the compiled decode path.
 
-`engine.py` is the step loop (slot pool, fused per-slot decode tick),
-`scheduler.py` the admission policy (FCFS + load shedding + deadline
-shed + prefill budget), `request.py` the per-request lifecycle,
+`engine.py` is the step loop (slot pool, fused per-slot decode tick,
+chunked-prefill time slicing), `scheduler.py` the admission policy
+(priority classes + EDF + anti-starvation aging, load shedding,
+deadline shed, prefill budget), `request.py` the per-request lifecycle,
 `metrics.py` the telemetry, `kvcache/` the prefix-aware KV reuse layer
 (radix index + device block pool), `faults.py` seeded deterministic
 fault injection, `drain.py` the SIGTERM drain/restore snapshot,
@@ -24,17 +25,22 @@ from pddl_tpu.serve.faults import (
 from pddl_tpu.serve.kvcache import RadixPrefixCache
 from pddl_tpu.serve.metrics import ServeMetrics
 from pddl_tpu.serve.request import (
+    AdmissionRejected,
     FinishReason,
+    Priority,
     QueueFull,
     Request,
     RequestHandle,
     RequestState,
     SamplingParams,
 )
-from pddl_tpu.serve.scheduler import FCFSScheduler
+from pddl_tpu.serve.scheduler import FCFSScheduler, SLOScheduler
 
 __all__ = [
+    "AdmissionRejected",
     "FCFSScheduler",
+    "Priority",
+    "SLOScheduler",
     "FaultKind",
     "FaultPlan",
     "FaultSpec",

@@ -30,13 +30,19 @@ import os
 from typing import Dict, List
 
 from pddl_tpu.serve.request import (
+    Priority,
     Request,
     RequestHandle,
     RequestState,
     SamplingParams,
 )
 
-SNAPSHOT_VERSION = 1
+# Version 2 added the per-request ``priority`` field (ISSUE 7's SLO
+# classes). Version-1 snapshots — taken by a pre-priority engine —
+# still restore: an absent priority defaults to ``interactive``, the
+# class every pre-SLO request implicitly was.
+SNAPSHOT_VERSION = 2
+_READABLE_VERSIONS = frozenset({1, 2})
 
 
 def encode_sampling(sampling: SamplingParams) -> Dict[str, object]:
@@ -69,6 +75,7 @@ def encode_handle(handle: RequestHandle, now_s: float) -> Dict[str, object]:
         "sampling": encode_sampling(handle.request.sampling),
         "deadline_s": (float(handle.request.deadline_s)
                        if handle.request.deadline_s is not None else None),
+        "priority": handle.request.priority.value,
         "elapsed_s": max(0.0, float(now_s - handle.arrival_s)),
         "tokens": [int(t) for t in handle.tokens],
         "ttft_s": (float(handle.ttft_s)
@@ -86,6 +93,11 @@ def decode_handle(entry: Dict[str, object], now_s: float) -> RequestHandle:
         max_new_tokens=int(entry["max_new_tokens"]),
         sampling=decode_sampling(entry.get("sampling")),
         deadline_s=entry.get("deadline_s"),
+        # Version-1 entries predate priority classes: default to
+        # interactive (what every pre-SLO request implicitly was)
+        # instead of raising on the missing key.
+        priority=Priority(entry.get("priority",
+                                    Priority.INTERACTIVE.value)),
     )
     handle = RequestHandle(
         req, arrival_s=float(now_s) - float(entry.get("elapsed_s", 0.0)))
@@ -112,10 +124,11 @@ def load_snapshot(path: str) -> Dict[str, object]:
     with open(path) as f:
         snapshot = json.load(f)
     version = snapshot.get("version")
-    if version != SNAPSHOT_VERSION:
+    if version not in _READABLE_VERSIONS:
         raise ValueError(
             f"serve drain snapshot version {version!r} unsupported "
-            f"(this build reads version {SNAPSHOT_VERSION})")
+            f"(this build reads versions "
+            f"{sorted(_READABLE_VERSIONS)})")
     return snapshot
 
 

@@ -124,13 +124,14 @@ from pddl_tpu.serve.kvcache import (
 from pddl_tpu.serve.metrics import ServeMetrics
 from pddl_tpu.serve.request import (
     FinishReason,
+    Priority,
     QueueFull,
     Request,
     RequestHandle,
     RequestState,
     SamplingParams,
 )
-from pddl_tpu.serve.scheduler import FCFSScheduler
+from pddl_tpu.serve.scheduler import SLOScheduler
 
 
 class _SlotStateLost(RuntimeError):
@@ -175,8 +176,19 @@ class ServeEngine:
       prefill_len: the fixed padded prompt width (every prompt must fit;
         one compiled prefill serves all lengths). Defaults to
         ``model.max_len // 2``.
-      max_queue_depth / prefill_token_budget: admission knobs, see
-        `scheduler.py`.
+      max_queue_depth / prefill_token_budget / aging_s: admission
+        knobs, see `scheduler.py` — the scheduler pops priority-first
+        (interactive > batch > best_effort), EDF within a class, with
+        ``aging_s`` of queue wait promoting a request one class (the
+        anti-starvation bound).
+      prefill_slice_tokens: chunked-prefill FAIRNESS — when set, an
+        admission prefills at most this many prompt tokens per
+        ``step()`` (narrow chunks only; the wide program is skipped)
+        and the fused decode tick runs between slices, so one 32k cold
+        prompt is time-sliced against the running streams instead of
+        stalling every next token behind its whole prefill. Requires
+        the prefix-cache engine (the chunk programs ARE the slicing
+        mechanism); ``None`` (default) keeps whole-prompt admission.
       eos_token: optional stop token (included in the stream when hit).
       param_transform: the ``generate()`` int8 hook — applied INSIDE the
         compiled programs (:mod:`pddl_tpu.ops.quant`).
@@ -218,6 +230,11 @@ class ServeEngine:
       degraded_cooldown_s: how long an OOM keeps the prefix cache
         degraded (donations off) before re-arming; a repeat OOM inside
         the window pushes the re-arm out again.
+      preempt_cap: times one BEST_EFFORT stream may be parked (slot
+        evicted, requeued, later resumed token-exactly via replay
+        admission) to free a slot for queued ``interactive`` work;
+        ``0`` disables preemption. The cap is what keeps a paused
+        stream from thrashing forever under sustained pressure.
       tracer: optional per-request tracer
         (:class:`~pddl_tpu.obs.trace.RequestTracer`); ``None`` installs
         the no-op :data:`~pddl_tpu.obs.trace.NULL_TRACER` — tracing
@@ -235,6 +252,8 @@ class ServeEngine:
                  prefill_len: Optional[int] = None,
                  max_queue_depth: int = 64,
                  prefill_token_budget: Optional[int] = None,
+                 aging_s: Optional[float] = 30.0,
+                 prefill_slice_tokens: Optional[int] = None,
                  eos_token: Optional[int] = None,
                  param_transform=None, rng=None,
                  clock=time.monotonic,
@@ -246,6 +265,7 @@ class ServeEngine:
                  backoff_sleep=time.sleep,
                  max_replays: int = 3,
                  degraded_cooldown_s: float = 5.0,
+                 preempt_cap: int = 2,
                  tracer=None, telemetry_capacity: int = 512):
         if max_slots < 1:
             raise ValueError(f"max_slots must be >= 1, got {max_slots}")
@@ -267,9 +287,10 @@ class ServeEngine:
         self._params = variables["params"]
         self._dec = model.clone(decode=True)
         self._rng = rng if rng is not None else jax.random.key(0)
-        self.scheduler = FCFSScheduler(
+        self.scheduler = SLOScheduler(
             max_queue_depth=max_queue_depth,
-            prefill_token_budget=prefill_token_budget)
+            prefill_token_budget=prefill_token_budget,
+            aging_s=aging_s)
         self.metrics = ServeMetrics()
 
         # Observability (`pddl_tpu/obs/`): the tracer defaults to the
@@ -346,6 +367,30 @@ class ServeEngine:
                     f"reserved scratch sink), got {pool_blocks}")
         self.prefix_block_size = bs
         self._chunk = chunk
+
+        # Chunked-prefill fairness: at most `prefill_slice_tokens` of
+        # prompt prefill per step(), the decode tick interleaved
+        # between slices. One slice in flight at a time (the resident
+        # row cache is the single admission pipeline); `_slice` holds
+        # its resumable state across steps.
+        if prefill_slice_tokens is not None:
+            if not self._prefix_on:
+                raise ValueError(
+                    "prefill_slice_tokens requires the prefix-cache "
+                    "engine (its chunk programs are the slicing "
+                    "mechanism); leave prefix_cache_blocks enabled or "
+                    "unset prefill_slice_tokens")
+            if prefill_slice_tokens < 1:
+                raise ValueError(
+                    f"prefill_slice_tokens must be >= 1, got "
+                    f"{prefill_slice_tokens}")
+        self._slice_tokens = (int(prefill_slice_tokens)
+                              if prefill_slice_tokens is not None else None)
+        self._slice: Optional[Dict[str, object]] = None
+        self._slice_budget_left = 0
+        if preempt_cap < 0:
+            raise ValueError(f"preempt_cap must be >= 0, got {preempt_cap}")
+        self._preempt_cap = int(preempt_cap)
 
         # One handle per occupied slot; all other per-slot state lives
         # in the arrays below (positions) or is derivable from the
@@ -502,20 +547,25 @@ class ServeEngine:
     # -------------------------------------------------------- submission
     def submit(self, prompt: Sequence[int], max_new_tokens: int, *,
                sampling: Optional[SamplingParams] = None,
-               deadline_s: Optional[float] = None) -> RequestHandle:
+               deadline_s: Optional[float] = None,
+               priority: Priority = Priority.INTERACTIVE) -> RequestHandle:
         """Queue one request; returns its streaming handle.
 
         Raises :class:`~pddl_tpu.serve.request.QueueFull` when the
         admission-control queue is at depth (the metrics count the
         rejection either way); the raised instance carries a
-        ``retry_after_s`` hint — queue depth x the recent
+        ``retry_after_s`` hint — the queue this PRIORITY would wait
+        behind (its own and every more urgent class) x the recent
         per-admission interval — once the engine has admitted enough
-        traffic to estimate one. After :meth:`drain` the engine
-        accepts nothing (the process is on its way out)."""
+        traffic to estimate one, so a ``best_effort`` reject honestly
+        hints a longer wait than an ``interactive`` one. After
+        :meth:`drain` the engine accepts nothing (the process is on
+        its way out)."""
         if self._drained:
             raise RuntimeError(
                 "engine is drained (snapshot taken, admission stopped); "
                 "restore the snapshot into a fresh engine")
+        priority = Priority(priority)
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.size < 1:
             raise ValueError("prompt must have at least one token")
@@ -533,20 +583,24 @@ class ServeEngine:
         req = Request(prompt=prompt.tolist(),
                       max_new_tokens=int(max_new_tokens),
                       sampling=sampling or SamplingParams(),
-                      deadline_s=deadline_s)
+                      deadline_s=deadline_s, priority=priority)
         handle = RequestHandle(req, arrival_s=self._clock())
         try:
             self.scheduler.submit(handle)
         except QueueFull as e:
-            self.metrics.record_rejected()
+            self.metrics.record_rejected(priority.value)
             # Re-raise with the polite-backpressure hint the scheduler
-            # cannot compute (it has no latency telemetry).
+            # cannot compute (it has no latency telemetry). The depth
+            # priced in is what THIS class waits behind — its own and
+            # every more urgent class — so lower classes get longer,
+            # honest hints.
             raise QueueFull(
                 e.queue_depth, e.max_queue_depth,
                 retry_after_s=self.metrics.estimate_retry_after_s(
-                    e.queue_depth)) from None
+                    self.scheduler.depth_at_or_above(priority)),
+                priority=priority) from None
         except Exception:
-            self.metrics.record_rejected()
+            self.metrics.record_rejected(priority.value)
             raise
         self._tracer.on_submit(handle, self.scheduler.depth)
         return handle
@@ -647,7 +701,8 @@ class ServeEngine:
     def has_work(self) -> bool:
         if self._drained:
             return False
-        return self.live_slots > 0 or self.scheduler.depth > 0
+        return (self.live_slots > 0 or self.scheduler.depth > 0
+                or bool(self._admitting))
 
     def _free_slot_ids(self) -> List[int]:
         return [i for i, s in enumerate(self._slots) if s is None]
@@ -659,7 +714,8 @@ class ServeEngine:
         handle.state = state
         handle.finish_reason = reason
         handle.finish_s = self._clock()
-        self.metrics.record_finish(reason.value)
+        self.metrics.record_finish(reason.value,
+                                   handle.request.priority.value)
         self._tracer.on_finish(handle, reason.value)
         self._park_slot(slot_id)
 
@@ -791,7 +847,8 @@ class ServeEngine:
             handle.state = RequestState.FAILED
             handle.finish_reason = FinishReason.ERROR
             handle.finish_s = self._clock()
-            self.metrics.record_finish(FinishReason.ERROR.value)
+            self.metrics.record_finish(FinishReason.ERROR.value,
+                                       handle.request.priority.value)
             self._tracer.on_replay(handle, self._cur_step, False)
             self._tracer.on_finish(handle, FinishReason.ERROR.value)
             return False
@@ -926,17 +983,31 @@ class ServeEngine:
             off += w
         if not use_prefix:
             return row, logits, None
-        # Donate the prompt's uncovered FULL blocks. First descend any
-        # chain ALREADY stored past the (capped) gather match — those
-        # chunks must not have fresh blocks allocated, or a full pool
-        # would evict useful blocks to supply ids the index hands
-        # straight back. Pin before allocating so this admission's own
-        # eviction pass can never free the blocks just gathered from.
-        # Donation order is write-then-index: the pool scatter runs
-        # BEFORE `extend` attaches the ids, so a fault mid-donation can
-        # never leave the index pointing at blocks that hold junk — the
-        # unwind releases the unattached ids and the pin, restoring the
-        # pre-admission refcount baseline exactly.
+        node = self._donate_tail(prompt, row, match, n_cached)
+        # Adopt the row buffers for the next admission (the slot insert
+        # COPIES the row, so reuse is safe and saves a fresh full-length
+        # cache allocation per admission).
+        self._row = row
+        return row, logits, node
+
+    def _donate_tail(self, prompt: np.ndarray, row, match,
+                     n_cached: int):
+        """Donate the prompt's uncovered FULL blocks and pin the chain;
+        ``match`` must be CURRENT (the sliced path re-matches at finish
+        time — ticks ran between its slices and an OOM flush could have
+        detached a start-time node). First descend any chain ALREADY
+        stored past the (capped) gather match — those chunks must not
+        have fresh blocks allocated, or a full pool would evict useful
+        blocks to supply ids the index hands straight back. Pin before
+        allocating so this admission's own eviction pass can never free
+        the blocks just gathered from. Donation order is
+        write-then-index: the pool scatter runs BEFORE ``extend``
+        attaches the ids, so a fault mid-donation can never leave the
+        index pointing at blocks that hold junk — the unwind releases
+        the unattached ids and the pin, restoring the pre-admission
+        refcount baseline exactly. Returns the pinned node."""
+        bs = self.prefix_block_size
+        plen = len(prompt)
         node, stored_blocks = self._prefix.descend(
             match.node, prompt, match.n_blocks)
         self._prefix.pin(node)
@@ -965,20 +1036,30 @@ class ServeEngine:
         self.metrics.record_prefix_lookup(
             n_cached, blocks_live=self._prefix.blocks_live,
             evictions=self._prefix.evictions)
-        # Adopt the row buffers for the next admission (the slot insert
-        # COPIES the row, so reuse is safe and saves a fresh full-length
-        # cache allocation per admission).
-        self._row = row
-        return row, logits, node
+        return node
 
     def _admit(self) -> None:
+        if self._slice_tokens is not None:
+            # The per-STEP prefill allowance: every chunk dispatched on
+            # behalf of admissions this step draws from it, so the
+            # decode tick below is never more than one allowance away.
+            self._slice_budget_left = self._slice_tokens
+        if self._slice is not None:
+            # A prefill is mid-flight from an earlier step: the resident
+            # row is ITS pipeline — advance it first; only if it
+            # finishes (or settles) may new admissions start.
+            if not self._continue_slice():
+                return
         free = self._free_slot_ids()
         if not free:
-            return
+            free = self._preempt_for_interactive()
+            if not free:
+                return
 
         def _queued_cancel(handle):
             handle.finish_s = self._clock()
-            self.metrics.record_finish(FinishReason.CANCELLED.value)
+            self.metrics.record_finish(FinishReason.CANCELLED.value,
+                                       handle.request.priority.value)
             self._tracer.on_finish(handle, FinishReason.CANCELLED.value)
 
         def _queued_expired(handle):
@@ -988,7 +1069,8 @@ class ServeEngine:
             # this is exactly where deadlines earn their keep. The
             # slot stays free for the next admission.
             handle.finish_s = self._clock()
-            self.metrics.record_finish(FinishReason.DEADLINE.value)
+            self.metrics.record_finish(FinishReason.DEADLINE.value,
+                                       handle.request.priority.value)
             self._tracer.on_deadline_shed(handle)
             self._tracer.on_finish(handle, FinishReason.DEADLINE.value)
 
@@ -1004,41 +1086,200 @@ class ServeEngine:
             on_expired=_queued_expired, now_fn=self._clock,
             cost_fn=self._prefill_cost if use_cost else None))
         while self._admitting and free:
+            if (self._slice_tokens is not None
+                    and self._slice_budget_left <= 0):
+                break  # this step's prefill allowance is spent
             handle = self._admitting[0]
             sid = free.pop(0)
             try:
-                self._admit_one(sid, handle)
+                if self._slice_tokens is not None:
+                    if not self._start_slice(sid, handle):
+                        return  # pending: handle stays in _admitting
+                else:
+                    self._admit_one(sid, handle)
             except _SlotStateLost as lost:
-                # The per-request unwind already released any pin; the
-                # slot never became live. Rebuild the resident row
-                # buffers defensively (a real device error may have
-                # consumed them via donation) — same shapes, nothing
-                # recompiles — rebuild anything else the failed dispatch
-                # consumed (slot pool → live-slot replay; block pool →
-                # fresh pool + index), and charge the request a replay.
                 free.insert(0, sid)
-                if self._prefix_on:
-                    self._row = jax.tree.map(
-                        lambda sd: jnp.zeros(sd.shape, sd.dtype),
-                        _decode_cache_shapes(self._dec, 1))
-                self._recover_consumed(lost)
-                if self._mark_replay(handle):
-                    self.scheduler.requeue_front([handle])
+                self._unwind_admission(lost, handle)
             self._admitting.popleft()
 
+    def _preempt_for_interactive(self) -> List[int]:
+        """Every slot is busy and ``interactive`` work is queued: park
+        running BEST_EFFORT streams (fewest tokens first — the
+        cheapest replay) and requeue them through the normal lane.
+        The paused stream resumes token-exactly later via the replay
+        admission (prompt re-prefilled, emitted tokens re-fed) — the
+        fault-recovery machinery doing scheduling duty. A handle is
+        preempted at most ``preempt_cap`` times, so a best_effort
+        stream can stall under pressure but never thrash forever; only
+        ACTUAL interactive submissions trigger this (aging promotions
+        and replay-lane entries don't), so preemption cannot cascade.
+        Returns the freed slot ids."""
+        if self._preempt_cap < 1:
+            return []
+        want = self.scheduler.queued_of_class(Priority.INTERACTIVE)
+        if want < 1:
+            return []
+        victims = sorted(
+            ((sid, h) for sid, h in enumerate(self._slots)
+             if h is not None
+             and h.request.priority is Priority.BEST_EFFORT
+             and h.preemptions < self._preempt_cap
+             and not h.replay_pending),
+            key=lambda p: len(p[1].tokens))
+        freed: List[int] = []
+        for sid, victim in victims[:want]:
+            victim.preemptions += 1
+            self.metrics.record_preemption()
+            self._tracer.on_preempt(victim, self._cur_step)
+            self._park_slot(sid)
+            self.scheduler.requeue(victim)
+            freed.append(sid)
+        return freed
+
+    def _unwind_admission(self, lost: _SlotStateLost,
+                          handle: RequestHandle) -> None:
+        """A dispatch died during this handle's admission. The
+        per-request unwind already released any pin; the slot never
+        became live. Rebuild the resident row buffers defensively (a
+        real device error may have consumed them via donation) — same
+        shapes, nothing recompiles — rebuild anything else the failed
+        dispatch consumed (slot pool → live-slot replay; block pool →
+        fresh pool + index), and charge the request a replay."""
+        self._slice = None
+        if self._prefix_on:
+            self._row = jax.tree.map(
+                lambda sd: jnp.zeros(sd.shape, sd.dtype),
+                _decode_cache_shapes(self._dec, 1))
+        self._recover_consumed(lost)
+        if self._mark_replay(handle):
+            self.scheduler.requeue_front([handle])
+
     def _admit_one(self, sid: int, handle: RequestHandle) -> None:
-        """Admit one popped handle into slot ``sid``. Two shapes: a
-        FRESH request samples its first token from the prefill logits
+        """Admit one popped handle into slot ``sid`` (the whole-prompt
+        path; the sliced path is :meth:`_start_slice`)."""
+        replay = bool(handle.tokens)
+        self._tracer.on_admit(handle, sid, replay)
+        row, logits, node = self._prefill_into_row(
+            np.asarray(handle.request.prompt, np.int32), handle)
+        self._install_slot(sid, handle, row, logits, node)
+
+    # ------------------------------------------------ sliced admission
+    def _start_slice(self, sid: int, handle: RequestHandle) -> bool:
+        """Begin a time-sliced admission: match + gather now (cheap,
+        and the gathered KV copy is private — later evictions cannot
+        reach it), then chunk-prefill under the per-step allowance.
+        Returns True when the admission completed within this step's
+        budget; False parks it in ``self._slice`` to resume next step
+        — the decode tick runs in between, which is the whole point."""
+        prompt = np.asarray(handle.request.prompt, np.int32)
+        replay = bool(handle.tokens)
+        self._tracer.on_admit(handle, sid, replay)
+        n_cached = 0
+        if not self._degraded:
+            match = self._prefix.match(
+                prompt, max_blocks=self._match_blocks(prompt))
+            n_cached = match.n_blocks * self.prefix_block_size
+            self._tracer.on_prefix_match(handle, match.n_blocks, n_cached)
+        if n_cached > 0:
+            ids = np.zeros(self._match_cap, np.int32)  # scratch-padded
+            ids[:match.n_blocks] = match.block_ids
+            self._row = self._device_call("gather", self._gather_p,
+                                          self._pool, ids, self._row)
+            self._tracer.on_prefill_chunk(handle, "gather", 0, n_cached,
+                                          self._last_wall_s)
+        self._slice = {"handle": handle, "sid": sid, "prompt": prompt,
+                       "off": n_cached, "n_cached": n_cached,
+                       "logits": None}
+        return self._advance_slice(self._slice)
+
+    def _continue_slice(self) -> bool:
+        """Resume the parked prefill. Returns True when ``self._slice``
+        settled (installed, expired, cancelled, or unwound) — admission
+        may continue — and False while it still has chunks to go."""
+        sl = self._slice
+        handle = sl["handle"]
+        now = self._clock()
+        if handle.cancelled or self._expired(handle, now):
+            # Not in a slot yet, so _reap cannot see it: settle here.
+            # The partially-prefilled row is abandoned junk the next
+            # admission overwrites (the padded-prefill invariant).
+            self._slice = None
+            if handle.cancelled:
+                handle.state = RequestState.CANCELLED
+                handle.finish_reason = FinishReason.CANCELLED
+            else:
+                handle.state = RequestState.TIMED_OUT
+                handle.finish_reason = FinishReason.TIMED_OUT
+            handle.finish_s = now
+            self.metrics.record_finish(handle.finish_reason.value,
+                                       handle.request.priority.value)
+            self._tracer.on_finish(handle, handle.finish_reason.value)
+            self._admitting.popleft()
+            return True
+        try:
+            done = self._advance_slice(sl)
+        except _SlotStateLost as lost:
+            self._unwind_admission(lost, handle)
+            self._admitting.popleft()
+            return True
+        if done:
+            self._admitting.popleft()
+        return done
+
+    def _advance_slice(self, sl: Dict[str, object]) -> bool:
+        """Dispatch narrow suffix chunks until the prompt is fully
+        prefilled or the step's allowance runs out (always at least one
+        chunk — progress is guaranteed). The wide program is never used
+        here: one huge dispatch is exactly the head-of-line block
+        slicing exists to break up."""
+        handle, prompt = sl["handle"], sl["prompt"]
+        plen = int(prompt.size)
+        spent = 0
+        while sl["off"] < plen:
+            if spent and self._slice_budget_left <= 0:
+                return False
+            off = int(sl["off"])
+            w = min(self._chunk, plen - off)
+            chunk_toks = np.zeros((1, self._chunk), np.int32)
+            chunk_toks[0, :w] = prompt[off:off + w]
+            self._row, sl["logits"] = self._device_call(
+                "chunk_prefill", self._chunk_p, self._params, self._row,
+                chunk_toks, np.int32(w), np.int32(off))
+            self._tracer.on_prefill_chunk(handle, "chunk_prefill", off, w,
+                                          self._last_wall_s)
+            sl["off"] = off + w
+            spent += w
+            self._slice_budget_left -= w
+        self._finish_slice(sl)
+        return True
+
+    def _finish_slice(self, sl: Dict[str, object]) -> None:
+        """The prompt is fully in the row cache: donate/pin (off a
+        FRESH match — decode ticks and possibly an OOM flush ran
+        between slices, so a start-time node may be detached), then
+        install the slot exactly like the whole-prompt path."""
+        handle, sid = sl["handle"], sl["sid"]
+        prompt = sl["prompt"]
+        node = None
+        if not self._degraded:
+            match = self._prefix.match(
+                prompt, max_blocks=self._match_blocks(prompt))
+            node = self._donate_tail(prompt, self._row, match,
+                                     int(sl["n_cached"]))
+        self._slice = None
+        self._install_slot(sid, handle, self._row, sl["logits"], node)
+
+    def _install_slot(self, sid: int, handle: RequestHandle, row, logits,
+                      node) -> None:
+        """Make a fully-prefilled row live in slot ``sid``. Two shapes:
+        a FRESH request samples its first token from the prefill logits
         (that's TTFT); a REPLAYED one (``handle.tokens`` non-empty —
-        fault recovery or drain/restore) rebuilds its KV from the
-        prompt here and re-feeds the emitted tokens through the coming
+        fault recovery or drain/restore) rebuilt its KV from the
+        prompt and re-feeds the emitted tokens through the coming
         ticks, so no token is ever re-sampled or double-streamed."""
         req = handle.request
         plen = len(req.prompt)
         replay = bool(handle.tokens)
-        self._tracer.on_admit(handle, sid, replay)
-        row, logits, node = self._prefill_into_row(
-            np.asarray(req.prompt, np.int32), handle)
         t, k, p = req.sampling.as_arrays()
         try:
             self._cache = self._device_call(
@@ -1060,7 +1301,8 @@ class ServeEngine:
             now = self._clock()
             handle.tokens.append(first)
             handle.ttft_s = now - handle.arrival_s
-            self.metrics.record_first_token(handle.ttft_s)
+            self.metrics.record_first_token(
+                handle.ttft_s, handle.request.priority.value)
             self.metrics.record_admission(now)
             self._tracer.on_first_token(handle, handle.ttft_s)
         self._slots[sid] = handle

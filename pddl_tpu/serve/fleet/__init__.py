@@ -7,10 +7,19 @@ as the migration wire format), `replica.py` the driver surface
 (:class:`LocalReplica` in-process for deterministic tier-1 chaos,
 :class:`ProcessReplica` over a stdio pipe for real multiprocess
 parallelism), `worker.py` the replica process entrypoint, `health.py`
-the per-replica circuit breaker. See `docs/OPERATIONS.md` § "Fleet
-runbook" and `docs/SERVING.md` § "Serving fleet".
+the per-replica circuit breaker, `admission.py` the overload front
+door (per-priority token buckets, overload detector, hysteretic
+brownout ladder). See `docs/OPERATIONS.md` § "Fleet runbook" and
+§ "Overload & brownout", and `docs/SERVING.md` § "Serving fleet".
 """
 
+from pddl_tpu.serve.fleet.admission import (
+    AdmissionControl,
+    BrownoutController,
+    BrownoutRung,
+    OverloadDetector,
+    TokenBucket,
+)
 from pddl_tpu.serve.fleet.health import BreakerState, CircuitBreaker
 from pddl_tpu.serve.fleet.replica import (
     LocalReplica,
@@ -26,14 +35,19 @@ from pddl_tpu.serve.fleet.router import (
 )
 
 __all__ = [
+    "AdmissionControl",
     "BreakerState",
+    "BrownoutController",
+    "BrownoutRung",
     "CircuitBreaker",
     "FleetHandle",
     "FleetMetrics",
     "FleetRouter",
     "LocalReplica",
     "NoHealthyReplica",
+    "OverloadDetector",
     "ProcessReplica",
     "ReplicaDied",
     "ReplicaLifecycle",
+    "TokenBucket",
 ]

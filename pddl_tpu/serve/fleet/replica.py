@@ -44,7 +44,12 @@ import time
 from typing import Dict, List, Optional, Tuple
 
 from pddl_tpu.serve import drain as drain_io
-from pddl_tpu.serve.request import QueueFull, RequestState, SamplingParams
+from pddl_tpu.serve.request import (
+    Priority,
+    QueueFull,
+    RequestState,
+    SamplingParams,
+)
 
 
 class ReplicaDied(RuntimeError):
@@ -149,9 +154,11 @@ class LocalReplica:
 
     # ------------------------------------------------------------- intake
     def submit(self, rid: int, prompt, max_new_tokens: int,
-               sampling: SamplingParams, deadline_s) -> None:
+               sampling: SamplingParams, deadline_s,
+               priority: Priority = Priority.INTERACTIVE) -> None:
         handle = self.engine.submit(prompt, max_new_tokens,
-                                    sampling=sampling, deadline_s=deadline_s)
+                                    sampling=sampling, deadline_s=deadline_s,
+                                    priority=priority)
         self._ledger.add(rid, handle)
 
     def cancel(self, rid: int) -> None:
@@ -174,6 +181,12 @@ class LocalReplica:
     @property
     def live_slots(self) -> int:
         return self.engine.live_slots
+
+    @property
+    def degraded(self) -> bool:
+        """The engine's r08 OOM-degraded flag — the router's overload
+        detector reads it as pressure (memory pressure IS overload)."""
+        return self.engine.degraded
 
     def compile_counts(self) -> Dict[str, int]:
         return self.engine.compile_counts()
@@ -275,6 +288,7 @@ class ProcessReplica:
         self._pending: List[Dict[str, object]] = []
         self._unanswered_ping_s: Optional[float] = None
         self._last_ping_s = 0.0
+        self._degraded = False
         self.ready_compile_counts: Optional[Dict[str, int]] = None
         if wait_ready:
             self.wait_ready()
@@ -343,7 +357,8 @@ class ProcessReplica:
 
     # ------------------------------------------------------------- intake
     def submit(self, rid: int, prompt, max_new_tokens: int,
-               sampling: SamplingParams, deadline_s) -> None:
+               sampling: SamplingParams, deadline_s,
+               priority: Priority = Priority.INTERACTIVE) -> None:
         """Synchronous across the pipe: the worker acks admission or
         reports its typed QueueFull (depth + retry_after hint), which
         re-raises here so the router's shed logic is driver-agnostic."""
@@ -351,7 +366,8 @@ class ProcessReplica:
                     "prompt": [int(t) for t in prompt],
                     "max_new_tokens": int(max_new_tokens),
                     "sampling": sampling_to_wire(sampling),
-                    "deadline_s": deadline_s})
+                    "deadline_s": deadline_s,
+                    "priority": Priority(priority).value})
         deadline = self._clock() + self._call_timeout_s
         while True:
             # Consume the WHOLE batch before acting on the ack: token
@@ -366,7 +382,8 @@ class ProcessReplica:
                 elif kind == "queue_full" and ev.get("rid") == rid:
                     verdict = QueueFull(int(ev["queue_depth"]),
                                         int(ev["max_queue_depth"]),
-                                        retry_after_s=ev.get("retry_after_s"))
+                                        retry_after_s=ev.get("retry_after_s"),
+                                        priority=Priority(priority))
                 elif kind == "error" and ev.get("rid") == rid:
                     verdict = ValueError(str(ev.get("message")))
                 else:
@@ -397,7 +414,20 @@ class ProcessReplica:
                 self._unanswered_ping_s = now
         events, self._pending = self._pending, []
         events.extend(self._read_events())
-        return [ev for ev in events if ev.get("ev") != "pong"]
+        out = []
+        for ev in events:
+            if ev.get("ev") == "pong":
+                # Pongs double as the degraded gauge's transport: the
+                # router's overload detector reads it off `degraded`.
+                self._degraded = bool(ev.get("degraded", False))
+            else:
+                out.append(ev)
+        return out
+
+    @property
+    def degraded(self) -> bool:
+        """Last pong's engine-degraded flag (r08 OOM machinery)."""
+        return self._degraded
 
     def beat_age_s(self) -> float:
         """Age of the OLDEST unanswered ping; 0.0 when none is

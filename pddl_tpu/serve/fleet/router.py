@@ -55,11 +55,14 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 from pddl_tpu.obs.trace import NULL_TRACER
 from pddl_tpu.serve import drain as drain_io
+from pddl_tpu.serve.fleet.admission import AdmissionControl
 from pddl_tpu.serve.fleet.health import BreakerState, CircuitBreaker
 from pddl_tpu.serve.fleet.replica import ReplicaDied
 from pddl_tpu.serve.kvcache import RadixPrefixCache
 from pddl_tpu.serve.request import (
+    AdmissionRejected,
     FinishReason,
+    Priority,
     QueueFull,
     Request,
     RequestState,
@@ -144,6 +147,18 @@ class FleetMetrics:
         self.routed_hash = 0
         self.shed_rerouted = 0           # QueueFull → another replica took it
         self.shed_rejected = 0           # fleet-wide full: caller rejected
+        # Admission control / brownout (`fleet/admission.py`): front-
+        # door rejections BEFORE any engine queue was consulted, plus
+        # the ladder's movement counters. Per-class rejection splits
+        # flatten into the snapshot as admission_rejected_<class>.
+        self.admission_rate_limited = 0
+        self.brownout_shed_best_effort = 0
+        self.brownout_rejected_cold = 0
+        self.brownout_capped_output = 0
+        self.brownout_escalations = 0
+        self.brownout_deescalations = 0
+        self.rejected_by_priority: Dict[str, int] = {
+            p.value: 0 for p in Priority}
         self.requests_finished = 0
         self.requests_failed = 0
         self.requests_orphaned = 0
@@ -163,6 +178,8 @@ class FleetMetrics:
         out = {k: getattr(self, k) for k in sorted(FLEET_COUNTER_KEYS)}
         for key, n in sorted(self.circuit_transitions.items()):
             out["circuit_" + key.replace("->", "_to_")] = n
+        for cls, n in sorted(self.rejected_by_priority.items()):
+            out["admission_rejected_" + cls] = n
         return out
 
 
@@ -245,6 +262,10 @@ class FleetRouter:
       tracer: `obs/` tracer; fleet events emit via ``on_fleet_event``.
       clock: injectable monotonic clock (chaos tests drive backoff and
         heartbeat timeouts with a fake one).
+      admission: optional :class:`~.admission.AdmissionControl` — the
+        overload front door (per-priority token buckets, overload
+        detector, brownout ladder). ``None`` (default) admits
+        everything the engines will take, exactly the r11 behavior.
     """
 
     def __init__(self, replicas: Sequence[object], *,
@@ -254,6 +275,7 @@ class FleetRouter:
                  heartbeat_timeout_s: float = 5.0,
                  respawn: bool = True, tracer=None,
                  max_sessions: int = 65536,
+                 admission: Optional[AdmissionControl] = None,
                  clock=time.monotonic):
         if not replicas:
             raise ValueError("a fleet needs at least one replica")
@@ -290,6 +312,35 @@ class FleetRouter:
         # for a probe to bring one back.
         self._orphans: List[Tuple[int, FleetHandle]] = []
         self._closed = False
+        self._admission = admission
+        if admission is not None:
+            admission.brownout.on_transition = self._brownout_observer(
+                admission.brownout.on_transition)
+
+    def _brownout_observer(self, chained):
+        def observe(old, new) -> None:
+            if new > old:
+                self.metrics.brownout_escalations += 1
+            else:
+                self.metrics.brownout_deescalations += 1
+            self._tracer.on_fleet_event(
+                "brownout", transition=f"{old.name}->{new.name}",
+                rung=int(new))
+            if chained is not None:  # the caller's own hook still fires
+                chained(old, new)
+        return observe
+
+    @property
+    def admission(self) -> Optional[AdmissionControl]:
+        return self._admission
+
+    def _degraded_replica_count(self) -> int:
+        """Replicas reporting DEGRADED (r08's OOM machinery) — fed to
+        the overload detector so memory pressure and load pressure
+        compose into one brownout signal."""
+        return sum(1 for s in self._slots
+                   if s.state is ReplicaLifecycle.UP
+                   and bool(getattr(s.driver, "degraded", False)))
 
     # ------------------------------------------------------ observability
     def _circuit_observer(self, slot: _ReplicaSlot):
@@ -385,15 +436,20 @@ class FleetRouter:
     def submit(self, prompt, max_new_tokens: int, *,
                sampling: Optional[SamplingParams] = None,
                deadline_s: Optional[float] = None,
-               session: Optional[str] = None) -> FleetHandle:
+               session: Optional[str] = None,
+               priority: Priority = Priority.INTERACTIVE) -> FleetHandle:
         """Route one request; returns its fleet stream handle.
 
         Raises :class:`NoHealthyReplica` when every circuit is open,
-        and :class:`~pddl_tpu.serve.request.QueueFull` (with the
-        smallest ``retry_after_s`` hint any replica offered) when every
-        healthy replica shed it."""
+        :class:`~pddl_tpu.serve.request.AdmissionRejected` when the
+        admission front door refused it (rate limit or brownout — the
+        hint covers the ladder's recovery horizon), and
+        :class:`~pddl_tpu.serve.request.QueueFull` (with the smallest
+        ``retry_after_s`` hint any replica offered) when every healthy
+        replica shed it."""
         if self._closed:
             raise RuntimeError("fleet router is closed")
+        priority = Priority(priority)
         prompt = [int(t) for t in prompt]
         sampling = sampling or SamplingParams()
         healthy = [s for s in self._slots if s.available]
@@ -402,6 +458,34 @@ class FleetRouter:
                 f"no healthy replica among {len(self._slots)} "
                 "(all circuits open)")
         chosen, how = self._route(prompt, session, healthy)
+        now = self._clock()
+        if self._admission is not None:
+            self._admission.update(now, self._degraded_replica_count())
+            # `cold` = neither sticky nor affinity matched: the
+            # admission the top brownout rung refuses to buy. The
+            # front door's own rejections are NOT fed to the overload
+            # detector — the ladder must unwind on engine-side calm,
+            # not sustain itself on the pressure of its own shedding.
+            ok, reason, hint = self._admission.admit(
+                now, priority, cold=(how == "hash"))
+            if not ok:
+                self.metrics.rejected_by_priority[priority.value] += 1
+                if reason == "rate_limit":
+                    self.metrics.admission_rate_limited += 1
+                elif reason == "brownout_shed":
+                    self.metrics.brownout_shed_best_effort += 1
+                else:
+                    self.metrics.brownout_rejected_cold += 1
+                self._tracer.on_fleet_event(
+                    "admission_rejected", reason=reason,
+                    priority=priority.value)
+                raise AdmissionRejected(reason, retry_after_s=hint,
+                                        priority=priority)
+            capped = self._admission.brownout.cap_new_tokens(
+                max_new_tokens)
+            if capped < int(max_new_tokens):
+                self.metrics.brownout_capped_output += 1
+                max_new_tokens = capped
         order = [chosen] + sorted((s for s in healthy if s is not chosen),
                                   key=lambda s: s.load)
         hints: List[float] = []
@@ -410,7 +494,7 @@ class FleetRouter:
             rid = next(self._rids)
             try:
                 slot.driver.submit(rid, prompt, max_new_tokens,
-                                   sampling, deadline_s)
+                                   sampling, deadline_s, priority)
             except QueueFull as e:
                 sheds_seen += 1
                 if e.retry_after_s is not None:
@@ -423,7 +507,8 @@ class FleetRouter:
                 continue
             fh = FleetHandle(
                 Request(prompt=prompt, max_new_tokens=int(max_new_tokens),
-                        sampling=sampling, deadline_s=deadline_s),
+                        sampling=sampling, deadline_s=deadline_s,
+                        priority=priority),
                 arrival_s=self._clock(), session=session)
             fh.replica_id = slot.replica_id
             fh.state = RequestState.QUEUED
@@ -450,6 +535,10 @@ class FleetRouter:
                 self.metrics.routed_affinity += 1
             else:
                 self.metrics.routed_hash += 1
+            if self._admission is not None:
+                # Engine-side signal: a reroute forced by QueueFull is
+                # pressure even though the request landed.
+                self._admission.observe(now, rejected=sheds_seen > 0)
             return fh
         if cap_sum == 0 and not hints:
             # Nothing actually reported a full queue — every attempt hit
@@ -458,8 +547,12 @@ class FleetRouter:
                 f"every healthy replica died during submit "
                 f"({len(order)} attempted)")
         self.metrics.shed_rejected += 1
+        self.metrics.rejected_by_priority[priority.value] += 1
+        if self._admission is not None:
+            self._admission.observe(now, rejected=True)
         raise QueueFull(depth_sum, max(cap_sum, depth_sum),
-                        retry_after_s=min(hints) if hints else None)
+                        retry_after_s=min(hints) if hints else None,
+                        priority=priority)
 
     # ------------------------------------------------------------ serving
     def step(self) -> int:
@@ -469,6 +562,11 @@ class FleetRouter:
         streamed to fleet handles this round."""
         now = self._clock()
         tokens = 0
+        if self._admission is not None:
+            # Ladder recovery must not depend on new submits arriving:
+            # a browned-out fleet that traffic abandoned entirely still
+            # unwinds to NORMAL on the step cadence.
+            self._admission.update(now, self._degraded_replica_count())
         # Cancelled orphans settle HERE: no replica holds them, so the
         # per-slot cancel forwarding never sees them, and without this
         # an unbounded run() would spin on has_work through a total
@@ -580,6 +678,16 @@ class FleetRouter:
                 slot.assigned.pop(rid, None)
                 if fh is None:
                     continue
+                # Adopt the ENGINE-measured TTFT when the driver
+                # reports one: the router-side stamp above measures
+                # first-token EVENT ARRIVAL, which under load includes
+                # however long the router spent between pipe pumps —
+                # loop latency, not scheduling quality. The engine's
+                # number (queue wait + prefill, on the replica's own
+                # clock) is what the SLO machinery actually controls
+                # and what the per-priority dashboards read.
+                if ev.get("ttft_s") is not None:
+                    fh.ttft_s = float(ev["ttft_s"])
                 fh.state = RequestState(ev["state"])
                 fh.finish_reason = (FinishReason(ev["reason"])
                                     if ev.get("reason") else None)

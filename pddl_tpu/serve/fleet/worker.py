@@ -36,7 +36,7 @@ import sys
 from typing import Dict
 
 from pddl_tpu.serve.fleet.replica import HandleLedger, sampling_from_wire
-from pddl_tpu.serve.request import QueueFull
+from pddl_tpu.serve.request import Priority, QueueFull
 
 
 def build_engine(config: Dict[str, object]):
@@ -66,11 +66,17 @@ def build_engine(config: Dict[str, object]):
     dummy = jnp.ones((1, 8), jnp.int32)
     params = model.init(jax.random.key(int(config.get("param_seed", 0))),
                         dummy, train=False)["params"]
+    aging = config.get("aging_s", 30.0)
     return ServeEngine(
         model, {"params": params},
         max_slots=int(config.get("slots", 8)),
         prefill_len=int(config.get("prefill_len", 64)),
         max_queue_depth=int(config.get("max_queue_depth", 64)),
+        # SLO knobs (ISSUE 7): scheduler aging and chunked-prefill
+        # slicing ride the same flat config.
+        prefill_token_budget=config.get("prefill_token_budget"),
+        aging_s=float(aging) if aging is not None else None,
+        prefill_slice_tokens=config.get("prefill_slice_tokens"),
         # Engine-parity default: absent means the auto-sized prefix
         # pool, NOT off — the router's affinity shadow must point at
         # caches that exist. Pass 0 explicitly to disable.
@@ -112,7 +118,9 @@ def main(argv=None) -> int:
                 handle = engine.submit(
                     cmd["prompt"], int(cmd["max_new_tokens"]),
                     sampling=sampling_from_wire(cmd.get("sampling")),
-                    deadline_s=cmd.get("deadline_s"))
+                    deadline_s=cmd.get("deadline_s"),
+                    priority=Priority(cmd.get(
+                        "priority", Priority.INTERACTIVE.value)))
             except QueueFull as e:
                 _emit({"ev": "queue_full", "rid": rid,
                        "queue_depth": e.queue_depth,
@@ -131,7 +139,8 @@ def main(argv=None) -> int:
                 h.cancel()
         elif kind == "ping":
             _emit({"ev": "pong", "queue_depth": engine.scheduler.depth,
-                   "live_slots": engine.live_slots})
+                   "live_slots": engine.live_slots,
+                   "degraded": engine.degraded})
         elif kind == "counts":
             _emit({"ev": "counts", "counts": engine.compile_counts()})
         elif kind == "restore":

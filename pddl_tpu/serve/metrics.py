@@ -27,7 +27,13 @@ from typing import Deque, Dict, Iterable, List, Optional
 
 import numpy as np
 
+from pddl_tpu.serve.request import Priority
 from pddl_tpu.utils.summary import format_table
+
+# Stable label vocabulary for the per-priority splits: every class is
+# always present (zeros included) so the Prometheus exposition's label
+# sets never appear/vanish with traffic.
+PRIORITY_CLASSES = tuple(p.value for p in Priority)
 
 
 def _pct(values, q: float) -> Optional[float]:
@@ -98,6 +104,19 @@ class ServeMetrics:
         self.token_latency_s = Reservoir(self.reservoir_cap, seed=1)
         self.queue_depth = Reservoir(self.reservoir_cap, seed=2)
         self.occupancy = Reservoir(self.reservoir_cap, seed=3)
+        # Per-priority splits (the SLO dashboard: is `interactive`
+        # actually protected, is `best_effort` actually absorbing the
+        # shedding?). TTFT reservoirs per class plus finish/shed/reject
+        # counters; exported as labeled Prometheus series.
+        self.ttft_by_priority: Dict[str, Reservoir] = {
+            cls: Reservoir(self.reservoir_cap, seed=10 + i)
+            for i, cls in enumerate(PRIORITY_CLASSES)}
+        self.finished_by_priority: Dict[str, int] = dict.fromkeys(
+            PRIORITY_CLASSES, 0)
+        self.deadline_shed_by_priority: Dict[str, int] = dict.fromkeys(
+            PRIORITY_CLASSES, 0)
+        self.rejected_by_priority: Dict[str, int] = dict.fromkeys(
+            PRIORITY_CLASSES, 0)
         self.tokens_emitted = 0
         self.requests_finished = 0
         self.requests_rejected = 0
@@ -117,6 +136,8 @@ class ServeMetrics:
         self.retries = 0             # failed device calls retried
         self.retry_sites: Dict[str, int] = {}
         self.replays = 0             # slot-state rebuilds (KV recomputed)
+        self.preemptions = 0         # best_effort slots parked for
+        #                              queued interactive work
         self.requests_failed = 0     # terminal FinishReason.ERROR
         self.requests_deadline_shed = 0  # FinishReason.DEADLINE at pop
         self.degraded_entries = 0    # times the engine flipped degraded
@@ -145,30 +166,42 @@ class ServeMetrics:
             self._first_activity_s = now_s
         self._last_activity_s = now_s
 
-    def record_first_token(self, ttft_s: float) -> None:
+    def record_first_token(self, ttft_s: float,
+                           priority: Optional[str] = None) -> None:
         self.ttft_s.append(ttft_s)
+        if priority in self.ttft_by_priority:
+            self.ttft_by_priority[priority].append(ttft_s)
         self.tokens_emitted += 1
 
-    def record_finish(self, reason_value: str) -> None:
+    def record_finish(self, reason_value: str,
+                      priority: Optional[str] = None) -> None:
         """One request departed. ``requests_finished`` counts ONLY
         successful completions (length/eos); cancellations, timeouts,
         pop-time deadline sheds, and fault failures each go to their
         own counter — all disjoint, so a success rate is finished /
         (finished + cancelled + timed_out + deadline_shed + failed +
-        rejected) with no hidden convention."""
+        rejected) with no hidden convention. ``priority`` (a
+        :class:`~pddl_tpu.serve.request.Priority` value string) feeds
+        the per-class finish/shed splits."""
         if reason_value == "timed_out":
             self.requests_timed_out += 1
         elif reason_value == "deadline":
             self.requests_deadline_shed += 1
+            if priority in self.deadline_shed_by_priority:
+                self.deadline_shed_by_priority[priority] += 1
         elif reason_value == "cancelled":
             self.requests_cancelled += 1
         elif reason_value == "error":
             self.requests_failed += 1
         else:
             self.requests_finished += 1
+            if priority in self.finished_by_priority:
+                self.finished_by_priority[priority] += 1
 
-    def record_rejected(self) -> None:
+    def record_rejected(self, priority: Optional[str] = None) -> None:
         self.requests_rejected += 1
+        if priority in self.rejected_by_priority:
+            self.rejected_by_priority[priority] += 1
 
     # ------------------------------------------------------- resilience
     def record_retry(self, site: str) -> None:
@@ -177,6 +210,9 @@ class ServeMetrics:
 
     def record_replay(self) -> None:
         self.replays += 1
+
+    def record_preemption(self) -> None:
+        self.preemptions += 1
 
     def record_degraded_entry(self) -> None:
         self.degraded_entries += 1
@@ -254,14 +290,38 @@ class ServeMetrics:
             "prefix_evictions": self.prefix_evictions,
             "retries": self.retries,
             "replays": self.replays,
+            "preemptions": self.preemptions,
             "requests_failed": self.requests_failed,
             "requests_deadline_shed": self.requests_deadline_shed,
             "degraded_entries": self.degraded_entries,
             "degraded_time_s": round(self.degraded_time_s, 6),
+            # Per-priority splits: mappings render as labeled series
+            # (one sample per class) through `obs/export.py`, so the
+            # SLO runbook reads shed/finish/TTFT per class off one
+            # scrape. Every class is always present — a silent zero is
+            # a zero, not a vanished label.
+            "requests_finished_by_priority": dict(
+                self.finished_by_priority),
+            "requests_deadline_shed_by_priority": dict(
+                self.deadline_shed_by_priority),
+            "requests_rejected_by_priority": dict(
+                self.rejected_by_priority),
+            "ttft_p50_s_by_priority": {
+                cls: _pct(r, 50)
+                for cls, r in self.ttft_by_priority.items()},
+            "ttft_p99_s_by_priority": {
+                cls: _pct(r, 99)
+                for cls, r in self.ttft_by_priority.items()},
         }
 
     def summary(self) -> str:
-        """Human-readable table via the shared summary plumbing."""
-        rows = {k: ("-" if v is None else v)
-                for k, v in self.snapshot().items()}
+        """Human-readable table via the shared summary plumbing (the
+        per-priority mappings flatten to one ``key[class]`` row each)."""
+        rows = {}
+        for k, v in self.snapshot().items():
+            if isinstance(v, dict):
+                for cls, cv in v.items():
+                    rows[f"{k}[{cls}]"] = "-" if cv is None else cv
+            else:
+                rows[k] = "-" if v is None else v
         return format_table("Serving metrics:", rows)

@@ -24,6 +24,30 @@ import itertools
 from typing import List, Optional, Sequence
 
 
+class Priority(enum.Enum):
+    """SLO class of a request — the scheduler's pop order and the
+    fleet's admission/brownout ladder both key off it.
+
+    - ``INTERACTIVE``: a human is waiting; protected under overload.
+    - ``BATCH``: latency-tolerant but must eventually run (the
+      scheduler's anti-starvation aging guarantees it).
+    - ``BEST_EFFORT``: sheddable; the first thing a brownout drops.
+    """
+
+    INTERACTIVE = "interactive"
+    BATCH = "batch"
+    BEST_EFFORT = "best_effort"
+
+    @property
+    def rank(self) -> int:
+        """0 = most urgent. The scheduler sorts ascending on this."""
+        return _PRIORITY_RANK[self]
+
+
+_PRIORITY_RANK = {Priority.INTERACTIVE: 0, Priority.BATCH: 1,
+                  Priority.BEST_EFFORT: 2}
+
+
 class QueueFull(RuntimeError):
     """Typed admission-control rejection: the engine's queue is at its
     ``max_queue_depth``. Carries the depth — and, when the engine has
@@ -32,18 +56,49 @@ class QueueFull(RuntimeError):
     ``ServeMetrics``) — so upstream backpressure can be polite
     (honor the hint) instead of blind hammering, without parsing
     strings. ``retry_after_s`` is ``None`` before the estimator warms
-    up (fewer than two admissions observed)."""
+    up (fewer than two admissions observed). The hint is
+    PRIORITY-AWARE: a lower class waits behind every queued request of
+    its own and all higher classes, so its hint counts that deeper
+    effective queue — longer, and honest."""
 
     def __init__(self, queue_depth: int, max_queue_depth: int,
-                 retry_after_s: Optional[float] = None):
+                 retry_after_s: Optional[float] = None,
+                 priority: Optional["Priority"] = None):
         self.queue_depth = queue_depth
         self.max_queue_depth = max_queue_depth
         self.retry_after_s = retry_after_s
+        self.priority = priority
         hint = (f"; retry after ~{retry_after_s:.3f}s"
                 if retry_after_s is not None else "")
         super().__init__(
             f"serving queue full ({queue_depth}/{max_queue_depth}); "
             f"shed load upstream or raise max_queue_depth{hint}")
+
+
+class AdmissionRejected(QueueFull):
+    """Router-level admission-control rejection (a :class:`QueueFull`
+    subclass so every existing backpressure path handles it): the fleet
+    refused the request BEFORE any engine queue was consulted — a
+    per-priority token bucket ran dry, or the brownout ladder is
+    shedding this class (``reason`` says which). Carries the same
+    honest ``retry_after_s`` contract; under brownout the hint covers
+    the hysteretic recovery horizon, so a ``best_effort`` reject waits
+    out the whole ladder unwind instead of hammering a browned-out
+    fleet."""
+
+    def __init__(self, reason: str, retry_after_s: Optional[float] = None,
+                 priority: Optional["Priority"] = None,
+                 queue_depth: int = 0, max_queue_depth: int = 0):
+        super().__init__(queue_depth, max_queue_depth,
+                         retry_after_s=retry_after_s, priority=priority)
+        self.reason = reason
+        hint = (f"; retry after ~{retry_after_s:.3f}s"
+                if retry_after_s is not None else "")
+        # Replace the queue-full message: no engine queue was involved.
+        self.args = (
+            f"fleet admission rejected ({reason}"
+            f"{', ' + priority.value if priority is not None else ''})"
+            f"{hint}",)
 
 
 class RequestState(enum.Enum):
@@ -108,6 +163,7 @@ class Request:
     max_new_tokens: int
     sampling: SamplingParams = SamplingParams()
     deadline_s: Optional[float] = None  # wall budget from submit()
+    priority: Priority = Priority.INTERACTIVE
     request_id: int = dataclasses.field(default_factory=lambda: next(_ids))
 
 
@@ -127,6 +183,11 @@ class RequestHandle:
     ``max_replays`` the request settles FAILED/ERROR instead of
     crash-looping. ``replay_pending`` is engine-internal: the
     already-emitted tokens still to re-feed during a replay.
+    ``preemptions`` counts slot evictions in favor of more urgent
+    queued work (the stream pauses and later resumes token-exactly
+    through the same replay machinery); the engine stops preempting a
+    handle past its preemption cap, so a stream can stall briefly but
+    never thrash forever.
     """
 
     def __init__(self, request: Request, arrival_s: float):
@@ -139,6 +200,7 @@ class RequestHandle:
         self.finish_s: Optional[float] = None
         self.replays = 0
         self.replay_pending: List[int] = []
+        self.preemptions = 0
         self._cancel = False
 
     def cancel(self) -> None:

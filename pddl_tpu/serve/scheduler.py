@@ -1,41 +1,76 @@
-"""Admission control for the serving engine: FCFS with two knobs.
+"""Admission control for the serving engine: SLO-aware pop order.
 
 Orca (OSDI '22) separates the SCHEDULING policy from the iteration-level
-execution engine; this module is the policy half, deliberately small:
+execution engine; this module is the policy half. It grew from pure
+FCFS to the overload-robust order DistServe's SLO-goodput framing asks
+for, while keeping the two original knobs:
 
 - **max_queue_depth** — the load-shedding knob. A full queue rejects at
   ``submit()`` with a typed :class:`~pddl_tpu.serve.request.QueueFull`
   so upstream can backpressure instead of building unbounded latency.
 - **prefill_token_budget** — the head-of-line-blocking knob. Admission
-  each tick is FCFS but stops once the admitted prompts' combined
-  length would exceed the budget: prefill work is O(prompt), and an
-  unbounded admission burst would stall every RUNNING request's next
-  token behind it. At least one request is always admitted when a slot
-  is free (a single over-budget prompt must not deadlock).
+  each tick stops once the admitted prompts' combined length would
+  exceed the budget: prefill work is O(prompt), and an unbounded
+  admission burst would stall every RUNNING request's next token behind
+  it. At least one request is always admitted when a slot is free (a
+  single over-budget prompt must not deadlock).
+
+Pop order (the SLO layer):
+
+- **Priority classes** (:class:`~pddl_tpu.serve.request.Priority`):
+  ``interactive`` pops before ``batch`` pops before ``best_effort`` —
+  under overload the queue wait lands on the work that can afford it.
+- **EDF within a class**: requests carrying a ``deadline_s`` pop
+  earliest-deadline-first (deadline shedding already kills expired
+  ones at pop time — EDF is what stops deadlines from dying in the
+  first place); deadline-less requests follow, FIFO.
+- **Anti-starvation aging**: a queued request's effective class rises
+  one rank per ``aging_s`` waited, so a sustained ``interactive``
+  flood cannot starve a ``batch`` request forever — after ``aging_s``
+  it competes at interactive rank and its older arrival wins the
+  tie-break. Plain EDF/priority without aging starves; the
+  ``overload`` test suite pins the bound discriminatively.
 
 The queue holds handles, not raw requests, so cancellation of a QUEUED
-request is just a skip at pop time.
+request is just a skip at pop time. Replayed/restored handles bypass
+the ordering entirely (a separate front lane): they were admitted once
+already and are owed the next free slots regardless of class.
 """
 
 from __future__ import annotations
 
+import math
 from collections import deque
-from typing import Deque, List, Optional
+from typing import Deque, List, Optional, Tuple
 
 from pddl_tpu.serve.request import (
     FinishReason,
+    Priority,
     QueueFull,
     RequestHandle,
     RequestState,
 )
 
 
-class FCFSScheduler:
-    """First-come-first-served admission with load shedding and a
-    per-tick prefill budget."""
+class SLOScheduler:
+    """Priority + EDF + aging admission with load shedding and a
+    per-tick prefill budget.
+
+    Args:
+      max_queue_depth: queue cap; beyond it ``submit()`` raises
+        :class:`~pddl_tpu.serve.request.QueueFull`.
+      prefill_token_budget: per-``admit()`` cap on the admitted
+        prompts' combined (cost_fn-priced) length.
+      aging_s: seconds of queue wait per effective-rank promotion
+        (the anti-starvation bound: a ``batch`` request waits at most
+        ``aging_s`` before competing at ``interactive`` rank, a
+        ``best_effort`` one at most ``2*aging_s``). ``None`` disables
+        aging — pure priority+EDF, which CAN starve; only tests use it.
+    """
 
     def __init__(self, *, max_queue_depth: int = 64,
-                 prefill_token_budget: Optional[int] = None):
+                 prefill_token_budget: Optional[int] = None,
+                 aging_s: Optional[float] = 30.0):
         if max_queue_depth < 1:
             raise ValueError(
                 f"max_queue_depth must be >= 1, got {max_queue_depth}")
@@ -43,27 +78,97 @@ class FCFSScheduler:
             raise ValueError(
                 f"prefill_token_budget must be >= 1, got "
                 f"{prefill_token_budget}")
+        if aging_s is not None and aging_s <= 0:
+            raise ValueError(f"aging_s must be > 0 or None, got {aging_s}")
         self.max_queue_depth = max_queue_depth
         self.prefill_token_budget = prefill_token_budget
-        self._queue: Deque[RequestHandle] = deque()
+        self.aging_s = float(aging_s) if aging_s is not None else None
+        # (seq, handle): seq is the FIFO tie-break inside an equal
+        # (effective rank, deadline) key — stable, so an all-default
+        # workload pops in exact submit order (the FCFS it grew from).
+        self._queue: List[Tuple[int, RequestHandle]] = []
+        self._seq = 0
+        # The bypass lane for replayed/restored handles: popped before
+        # any key is even computed (they were admitted once already).
+        self._front: Deque[RequestHandle] = deque()
 
     @property
     def depth(self) -> int:
-        return len(self._queue)
+        return len(self._queue) + len(self._front)
+
+    def depth_at_or_above(self, priority: Priority) -> int:
+        """Queued handles an arrival of ``priority`` would wait behind:
+        everything at its own or a more urgent class (the bypass lane
+        outranks every class). The PRIORITY-AWARE retry_after_s hint is
+        this depth times the recent admission interval — honest,
+        because a ``best_effort`` arrival really does queue behind all
+        interactive and batch work."""
+        rank = priority.rank
+        return len(self._front) + sum(
+            1 for _, h in self._queue if h.request.priority.rank <= rank)
 
     def submit(self, handle: RequestHandle) -> None:
         """Enqueue, or shed load with a typed rejection."""
-        if len(self._queue) >= self.max_queue_depth:
-            raise QueueFull(len(self._queue), self.max_queue_depth)
-        self._queue.append(handle)
+        if self.depth >= self.max_queue_depth:
+            raise QueueFull(self.depth, self.max_queue_depth,
+                            priority=handle.request.priority)
+        self._queue.append((self._seq, handle))
+        self._seq += 1
+
+    # ------------------------------------------------------- pop order
+    def _key(self, seq: int, handle: RequestHandle,
+             now: Optional[float]) -> Tuple[int, float, int]:
+        """(effective rank, absolute deadline, seq) — ascending pop.
+
+        Aging lowers the effective rank one class per ``aging_s``
+        waited (floored at the most urgent class), so the tie-break
+        seq — older first — then finishes the starvation argument.
+        A deadline-less request sorts on a SYNTHETIC horizon of
+        ``4*aging_s`` past its arrival (``inf`` with aging off): urgent
+        deadlines still jump it, but a stream of freshly-deadlined
+        arrivals cannot starve it inside its own class forever."""
+        req = handle.request
+        rank = req.priority.rank
+        if self.aging_s is not None and now is not None:
+            rank = max(0, rank - int((now - handle.arrival_s)
+                                     / self.aging_s))
+        if req.deadline_s is not None:
+            deadline = handle.arrival_s + req.deadline_s
+        elif self.aging_s is not None:
+            deadline = handle.arrival_s + 4.0 * self.aging_s
+        else:
+            deadline = math.inf
+        return rank, deadline, seq
+
+    def _peek_best(self, now: Optional[float]) -> Tuple[int, RequestHandle]:
+        """Index (into the main queue; -1 = front lane) and handle of
+        the next pop, WITHOUT removing it — the budget check must be
+        able to leave an over-budget head exactly where it is (popping
+        it into the bypass lane would promote it past every class next
+        tick, inverting the SLO order)."""
+        if self._front:
+            return -1, self._front[0]
+        best_i = 0
+        best_key = self._key(*self._queue[0], now)
+        for i in range(1, len(self._queue)):
+            key = self._key(*self._queue[i], now)
+            if key < best_key:
+                best_i, best_key = i, key
+        return best_i, self._queue[best_i][1]
+
+    def _pop_at(self, index: int) -> RequestHandle:
+        if index < 0:
+            return self._front.popleft()
+        return self._queue.pop(index)[1]
 
     def admit(self, free_slots: int,
               on_cancelled=None, on_expired=None, now_fn=None,
               cost_fn=None) -> List[RequestHandle]:
-        """Pop up to ``free_slots`` admissible handles FCFS, bounded by
-        the prefill token budget; cancelled queued handles are dropped
-        (marked CANCELLED) in passing — ``on_cancelled(handle)`` lets
-        the engine account them in its metrics.
+        """Pop up to ``free_slots`` admissible handles in SLO order,
+        bounded by the prefill token budget; cancelled queued handles
+        are dropped (marked CANCELLED) in passing —
+        ``on_cancelled(handle)`` lets the engine account them in its
+        metrics.
 
         Deadline-aware shedding: with ``now_fn`` supplied, a queued
         handle whose deadline already expired is skipped-and-failed at
@@ -72,6 +177,8 @@ class FCFSScheduler:
         sustained overload the queue wait is exactly where deadlines
         die, and paying a full prefill to emit zero useful tokens would
         steal the budget from requests that can still make theirs.
+        (EDF pop order makes the sweep cheap: expired deadlines are by
+        construction at the head of their class.)
 
         ``cost_fn(handle) -> int`` overrides the budget charge per
         request (default: full prompt length). The prefix-cache engine
@@ -87,19 +194,20 @@ class FCFSScheduler:
         admitted: List[RequestHandle] = []
         budget = self.prefill_token_budget
         spent = 0
-        while self._queue and len(admitted) < free_slots:
-            head = self._queue[0]
+        now = now_fn() if now_fn is not None else None
+        while self.depth and len(admitted) < free_slots:
+            idx, head = self._peek_best(now)
             if head.cancelled:
-                self._queue.popleft()
+                self._pop_at(idx)
                 head.state = RequestState.CANCELLED
                 head.finish_reason = FinishReason.CANCELLED
                 if on_cancelled is not None:
                     on_cancelled(head)
                 continue
-            if (now_fn is not None
+            if (now is not None
                     and head.request.deadline_s is not None
-                    and now_fn() - head.arrival_s > head.request.deadline_s):
-                self._queue.popleft()
+                    and now - head.arrival_s > head.request.deadline_s):
+                self._pop_at(idx)
                 head.state = RequestState.TIMED_OUT
                 head.finish_reason = FinishReason.DEADLINE
                 if on_expired is not None:
@@ -108,34 +216,66 @@ class FCFSScheduler:
             cost = (cost_fn(head) if cost_fn is not None
                     else len(head.request.prompt))
             if budget is not None and admitted and spent + cost > budget:
-                break  # FCFS: never skip the head for a cheaper request
-            self._queue.popleft()
+                # Never skip the chosen head for a cheaper lower-ranked
+                # request — that would invert the SLO order — but leave
+                # it IN PLACE: next tick re-ranks it against whatever
+                # arrived meanwhile.
+                break
+            self._pop_at(idx)
             head.state = RequestState.RUNNING
             admitted.append(head)
             spent += cost
         return admitted
 
+    def queued_of_class(self, priority: Priority) -> int:
+        """Main-queue handles whose ACTUAL class is ``priority`` (the
+        bypass lane and aging promotions excluded) — the engine's
+        preemption trigger reads this, so a replayed best_effort
+        handle in the bypass lane cannot preempt its own class."""
+        return sum(1 for _, h in self._queue
+                   if h.request.priority is priority)
+
+    def requeue(self, handle: RequestHandle) -> None:
+        """Re-enter a PREEMPTED running handle through the NORMAL
+        queue (not the bypass lane — a preempted ``best_effort``
+        stream must not outrank the interactive work it was parked
+        for). Depth limits do not apply: it was admitted once, and
+        shedding it now would turn a scheduling decision into a
+        visible failure."""
+        handle.state = RequestState.QUEUED
+        self._queue.append((self._seq, handle))
+        self._seq += 1
+
     # ------------------------------------------------- resilience hooks
     def requeue_front(self, handles: List[RequestHandle]) -> None:
-        """Put replayed handles back at the queue HEAD in the given
+        """Put replayed handles back in the bypass lane in the given
         order (they were admitted before anything currently queued, so
-        FCFS owes them the next free slots). Bypasses
-        ``max_queue_depth`` deliberately: these requests were already
-        accepted once — shedding them now would turn a transient device
-        fault into a visible rejection."""
+        the scheduler owes them the next free slots regardless of
+        class). Bypasses ``max_queue_depth`` deliberately: these
+        requests were already accepted once — shedding them now would
+        turn a transient device fault into a visible rejection."""
         for handle in reversed(handles):
             handle.state = RequestState.QUEUED
-            self._queue.appendleft(handle)
+            self._front.appendleft(handle)
 
     def drain(self) -> List[RequestHandle]:
-        """Pop every queued handle (FCFS order) for a drain snapshot;
-        the queue is left empty so a post-drain step admits nothing."""
-        out = list(self._queue)
+        """Pop every queued handle (bypass lane first, then submit
+        order) for a drain snapshot; the queue is left empty so a
+        post-drain step admits nothing."""
+        out = list(self._front)
+        out.extend(h for _, h in self._queue)
+        self._front.clear()
         self._queue.clear()
         return out
 
     def restore(self, handles: List[RequestHandle]) -> None:
-        """Re-enqueue restored handles in snapshot order. Like
-        :meth:`requeue_front`, depth limits do not apply — every one of
-        these was admitted by the drained engine."""
-        self._queue.extend(handles)
+        """Re-enqueue restored handles in snapshot order, ahead of any
+        new traffic (the bypass lane). Like :meth:`requeue_front`,
+        depth limits do not apply — every one of these was admitted by
+        the drained engine."""
+        self._front.extend(handles)
+
+
+# The name the engine (and older tests) grew up with: the SLO scheduler
+# with every request at the default class and no deadlines IS FCFS.
+FCFSScheduler = SLOScheduler

@@ -110,7 +110,7 @@ def timed_stats(fn: Callable, sync: Callable, *,
 # higher = better) beats the "ttft" latency rule.
 _HIGHER_BETTER = ("tokens_per_s", "tokens_per_sec", "speedup", "retained",
                   "reduction", "hit_rate", "accepted", "_per_tick",
-                  "throughput")
+                  "throughput", "goodput", "shed_absorbed")
 _LOWER_BETTER = ("ttft", "latency", "_ms", "_wall_s", "overhead",
                  "_seconds", "tick_s", "step_s")
 _NEVER = ("spread", "samples", "per_pair", "per_repeat", "n_requests",

@@ -39,6 +39,13 @@ def test_metric_direction_vocabulary():
     # Ratio keys beat the latency substring: a bigger TTFT *reduction*
     # is an improvement, not a regression.
     assert metric_direction("ttft_reduction_x") == 1
+    # The r12 SLO headlines are covered: goodput up is better, the
+    # best_effort shed-absorption fraction up is better, and the
+    # interactive TTFT inflation ratio down is better.
+    assert metric_direction("interactive_goodput_tokens_per_s") == 1
+    assert metric_direction("best_effort_shed_absorbed_frac") == 1
+    assert metric_direction(
+        "interactive_ttft_p99_overload_over_uncontended_x") == -1
     # Noise keys are never compared.
     assert metric_direction("spread_pct") == 0
     assert metric_direction("ttft_inflation_per_pair") == 0

@@ -195,6 +195,8 @@ def test_deadline_expired_in_queue_never_pays_prefill(gpt_setup):
     eng = ServeEngine(model, variables, max_slots=1, prefill_len=16,
                       clock=clock)
     running = eng.submit(np.arange(4) % 32, 30)
+    eng.step()  # admit `running` first: EDF would otherwise pop the
+    #             deadlined request ahead of the deadline-less one
     doomed = eng.submit(np.arange(5) % 32, 4, deadline_s=5.0)
     fine = eng.submit((np.arange(6) + 1) % 32, 3)
     for _ in range(3):

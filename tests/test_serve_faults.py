@@ -43,6 +43,7 @@ from pddl_tpu.serve import (
     FaultSpec,
     FinishReason,
     KillPoint,
+    Priority,
     QueueFull,
     RequestState,
     ServeEngine,
@@ -557,12 +558,16 @@ def test_retry_after_hint_monotone_nonnegative():
     assert warm_trials >= 10  # the sweep exercised the warm estimator
 
 
-def test_polite_client_never_sees_consecutive_queue_fulls(gpt_setup):
-    """Property (seeded runs): a client that HONORS ``retry_after_s``
-    (waits the hinted interval while the engine keeps draining) never
-    gets rejected twice in a row — the hint really does estimate when
-    a queue slot frees. Un-hinted rejections (cold estimator) are
-    exempt: there was nothing to honor."""
+@pytest.mark.parametrize("priority", list(Priority))
+def test_polite_client_never_sees_consecutive_queue_fulls(gpt_setup,
+                                                          priority):
+    """Property (seeded runs, ALL THREE priority classes): a client
+    that HONORS ``retry_after_s`` (waits the hinted interval while the
+    engine keeps draining) never gets rejected twice in a row — the
+    hint really does estimate when a queue slot frees, for
+    ``best_effort`` (whose hint prices the whole queue ahead of it)
+    just as for ``interactive``. Un-hinted rejections (cold estimator)
+    are exempt: there was nothing to honor."""
     model, variables = gpt_setup
     for seed in (0, 1, 2):
         rng = np.random.default_rng(seed)
@@ -581,7 +586,8 @@ def test_polite_client_never_sees_consecutive_queue_fulls(gpt_setup):
             prompt = (np.arange(int(rng.integers(4, 10)))
                       + submitted) % 32
             try:
-                eng.submit(prompt, int(rng.integers(2, 5)))
+                eng.submit(prompt, int(rng.integers(2, 5)),
+                           priority=priority)
                 submitted += 1
                 last_full_hinted = False
             except QueueFull as e:
@@ -654,6 +660,8 @@ def test_deadline_shed_at_pop_time(gpt_setup):
     eng = ServeEngine(model, variables, max_slots=1, prefill_len=16,
                       clock=clock)
     running = eng.submit(np.arange(4) % 32, 30)
+    eng.step()  # admit `running` before the deadlined request exists
+    #             (EDF pops real deadlines ahead of deadline-less work)
     dead = eng.submit(np.arange(5) % 32, 4, deadline_s=5.0)
     eng.step()
     clock.now = 6.0
