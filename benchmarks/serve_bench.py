@@ -29,7 +29,13 @@ the ONLINE layer (`pddl_tpu/serve/`) the way a serving owner would:
    fault-tolerant engine degrades gracefully (ratio near 1, every
    request terminal), a fail-stop one cliffs to zero. Retries, replays,
    degraded entries, and failed-request counts land in the artifact.
-5. **Observability leg** (`--obs-only` for a standalone artifact) —
+5. **Paged-attention leg** (`--paged-only`, standalone
+   r13 artifact) — paged vs resident-row engines, PAIRED: live-stream
+   KV bytes at matched total allocation (the duplicate-KV-elimination
+   ratio) and prefix-hit admission TTFT head-to-head with the
+   per-admission gather+insert copy wall (`admission_copy_us`) shown
+   going to zero.
+6. **Observability leg** (`--obs-only` for a standalone artifact) —
    the tracing tax (`pddl_tpu/obs/`): the same closed-loop workload
    with per-request tracing OFF (the default no-op tracer) vs ON
    (spans + JSONL sink). The paired ratio is the cost of turning the
@@ -39,7 +45,7 @@ the ONLINE layer (`pddl_tpu/serve/`) the way a serving owner would:
    uninstrumented one. `--trace out.jsonl` additionally writes a full
    span/tick/metrics event log as a bench artifact.
 
-6. **Fleet leg** (`--fleet-only`, `--fleet-replicas 2,4,8`) — the
+7. **Fleet leg** (`--fleet-only`, `--fleet-replicas 2,4,8`) — the
    multi-replica tier (`pddl_tpu/serve/fleet/`): N real worker
    processes behind the health-checked router, open-loop Poisson at
    `--fleet-load` × N × the r08 single-engine clean baseline.
@@ -50,7 +56,7 @@ the ONLINE layer (`pddl_tpu/serve/`) the way a serving owner would:
    token-exact against an oracle engine, zero recompiles on
    survivors.
 
-7. **SLO/overload leg** (`--slo-only`) — overload robustness
+8. **SLO/overload leg** (`--slo-only`) — overload robustness
    (ISSUE 7: priority/EDF/aging scheduler, chunked-prefill slicing,
    `serve/fleet/admission.py` brownout ladder): a trace-driven load —
    bursty multi-turn sessions over shared system prompts with
@@ -265,6 +271,140 @@ def _prefix_ttft_leg(model, variables, *, n_requests: int,
         "prefix_evictions": snap["prefix_evictions"],
         "engine_compile_counts_prefix_on": eng_on.compile_counts(),
         "engine_compile_counts_prefix_off": eng_off.compile_counts(),
+    }
+
+
+def _paged_leg(model, variables, *, prompt_len: int, shared_frac: float,
+               new_tokens: int, slots: int, prefill_len: int,
+               block_size: int, chunk: int, vocab: int, repeats: int,
+               seed: int = 17):
+    """True paged attention vs the resident-row prefix cache, PAIRED.
+
+    Two questions, both from the same warm shared-prefix workload with
+    every slot live at once:
+
+    1. **Capacity** — ``duplicate_kv_eliminated_x``: HBM holding the
+       live streams' KV, row / paged, from
+       ``ServeEngine.resident_kv_report()``. The row engine holds each
+       slot's 80%-shared prefix privately plus one pool copy; the
+       paged engine holds every DISTINCT block once, so the ratio is
+       the duplicate KV paging deletes (the effective-capacity
+       multiplier at this sharing level).
+    2. **Admission** — prefix-HIT mean TTFT, paged vs row, per-pair
+       ratio: the paged admission must not be slower than the gather
+       path even though it runs the same suffix chunks (it drops the
+       pool→row gather and the row→slot insert copy entirely);
+       ``admission_copy_us`` (per-admission gather+insert dispatch
+       wall from the telemetry ring) shows the copy cost that
+       disappeared.
+
+    The paged pool is sized to at most the row engine's TOTAL KV
+    allocation (slot cache + pool), so the capacity ratio is measured
+    at no-worse-than-identical pool bytes.
+    """
+    rng = np.random.default_rng(seed)
+    shared_len = int(prompt_len * shared_frac)
+    shared = rng.integers(0, vocab, size=shared_len).astype(np.int32)
+    prompts = [np.concatenate([
+        shared,
+        rng.integers(0, vocab, size=prompt_len - shared_len)
+        .astype(np.int32)]) for _ in range(slots)]
+    row_pool_blocks = (2 + prompt_len // block_size
+                      + slots * ((prompt_len - shared_len) // block_size
+                                 + 2))
+    max_len = model.max_len
+    table_width = -(-max_len // block_size)
+    paged_floor = slots * table_width + 1
+    # Identical-or-smaller footprint: the row engine's slot cache holds
+    # slots*max_len tokens and its pool row_pool_blocks*bs more; the
+    # paged pool gets at most that token budget (floor-checked).
+    paged_pool_blocks = max(
+        paged_floor,
+        (slots * max_len + row_pool_blocks * block_size) // block_size)
+
+    def run_once(paged: bool):
+        eng = ServeEngine(
+            model, variables, max_slots=slots, prefill_len=prefill_len,
+            max_queue_depth=2 * slots + 2,
+            prefix_cache_blocks=(paged_pool_blocks if paged
+                                 else row_pool_blocks),
+            prefix_block_size=block_size, prefix_chunk=chunk,
+            paged=paged)
+        eng.warmup()
+        # Wave 1 (cold): warms the cache; run to completion.
+        w1 = [eng.submit(p, 4) for p in prompts]
+        eng.run(max_steps=100000)
+        assert all(h.done for h in w1)
+        # Wave 2 (hit): every slot live on the warm prefix; snapshot
+        # residency mid-decode, then finish.
+        w2 = [eng.submit(p, new_tokens) for p in prompts]
+        while eng.live_slots < slots:
+            eng.step()
+        for _ in range(2):
+            eng.step()
+        report = eng.resident_kv_report()
+        report["blocks_shared"] = eng.blocks_shared
+        eng.run(max_steps=100000)
+        assert all(h.done for h in w2)
+        ttft = float(np.mean([h.ttft_s for h in w2]))
+        # Per-admission copy dispatch wall (gather + insert), from the
+        # ring: the cost line paging deletes (0 by construction there).
+        copy_s = sum(r["site_wall_s"].get("gather", 0.0)
+                     + r["site_wall_s"].get("insert", 0.0)
+                     for r in eng.telemetry.snapshot())
+        admissions = max(eng.metrics.prefix_lookups, 1)
+        return ttft, report, 1e6 * copy_s / admissions, eng
+
+    paged_ttfts, row_ttfts, ratios, cap_ratios = [], [], [], []
+    cap_paged = cap_row = None
+    eng_paged = eng_row = None
+    for _ in range(repeats):
+        t_row, cap_row, copy_row_us, eng_row = run_once(False)
+        t_paged, cap_paged, copy_paged_us, eng_paged = run_once(True)
+        row_ttfts.append(t_row)
+        paged_ttfts.append(t_paged)
+        ratios.append(t_row / t_paged)
+        cap_ratios.append(cap_row["kv_bytes_used"]
+                          / max(cap_paged["kv_bytes_used"], 1))
+    ttft_row_med, _ = median_spread(row_ttfts)
+    ttft_paged_med, _ = median_spread(paged_ttfts)
+    ratio_med, ratio_spread = median_spread(ratios)
+    cap_med, cap_spread = median_spread(cap_ratios)
+    snap = eng_paged.metrics.snapshot()
+    return {
+        "shared_frac": shared_frac,
+        "prompt_len": prompt_len,
+        "concurrent_streams": slots,
+        "prefix_block_size": block_size,
+        "paged_pool_blocks": paged_pool_blocks,
+        "row_pool_blocks": row_pool_blocks,
+        "kv_bytes_used_row": cap_row["kv_bytes_used"],
+        "kv_bytes_used_paged": cap_paged["kv_bytes_used"],
+        "kv_bytes_allocated_row": cap_row["kv_bytes_allocated"],
+        "kv_bytes_allocated_paged": cap_paged["kv_bytes_allocated"],
+        "tokens_resident": cap_paged["tokens_resident"],
+        "duplicate_kv_eliminated_x": round(cap_med, 3),
+        "duplicate_kv_eliminated_per_pair": [round(r, 3)
+                                             for r in cap_ratios],
+        "duplicate_kv_spread_pct": round(cap_spread, 2),
+        "effective_cached_tokens_per_byte_row": round(
+            cap_row["tokens_resident"]
+            / max(cap_row["kv_bytes_used"], 1), 9),
+        "effective_cached_tokens_per_byte_paged": round(
+            cap_paged["tokens_resident"]
+            / max(cap_paged["kv_bytes_used"], 1), 9),
+        "hit_admission_ttft_row_s": round(ttft_row_med, 5),
+        "hit_admission_ttft_paged_s": round(ttft_paged_med, 5),
+        "hit_admission_speedup_x": round(ratio_med, 3),
+        "hit_admission_speedup_per_pair": [round(r, 3) for r in ratios],
+        "spread_pct": round(ratio_spread, 2),
+        "admission_copy_us_row": round(copy_row_us, 1),
+        "admission_copy_us_paged": round(copy_paged_us, 1),
+        "blocks_shared_live": cap_paged["blocks_shared"],
+        "copy_bytes_avoided": snap["copy_bytes_avoided"],
+        "prefix_hit_rate": round(snap["prefix_hit_rate"], 3),
+        "engine_compile_counts_paged": eng_paged.compile_counts(),
+        "engine_compile_counts_row": eng_row.compile_counts(),
     }
 
 
@@ -1104,6 +1244,12 @@ def main() -> None:
     p.add_argument("--prefix-chunk", type=int, default=80,
                    help="narrow suffix-chunk width (~ the uncached "
                         "suffix at the default shared fraction)")
+    p.add_argument("--paged-only", action="store_true",
+                   help="run ONLY the paged-attention leg (paged vs "
+                        "resident-row engines, paired: duplicate-KV "
+                        "elimination at matched pool bytes + prefix-hit "
+                        "admission head-to-head) and write a standalone "
+                        "artifact (r13_serve_paged.json)")
     p.add_argument("--fault-rate", type=float, default=0.01,
                    help="injected fault probability per device dispatch "
                         "in the fault leg (transient; OOM rides at a "
@@ -1233,6 +1379,53 @@ def main() -> None:
     variables = {"params": params}
     model_desc = (f"gpt {args.depth}x{args.embed_dim} "
                   f"(vocab {args.vocab}, max_len {args.max_len})")
+
+    if args.paged_only:
+        _log(f"paged leg only: {args.slots} concurrent streams x "
+             f"{args.prefix_prompt_len}-token prompts at "
+             f"{args.prefix_shared_frac:.0%} shared, paged vs "
+             f"resident-row, {model_desc}")
+        paged = _paged_leg(
+            model, variables, prompt_len=args.prefix_prompt_len,
+            shared_frac=args.prefix_shared_frac,
+            new_tokens=args.prefix_new_tokens + 24,
+            slots=args.slots,
+            prefill_len=max(args.prefill_len, args.prefix_prompt_len),
+            block_size=args.prefix_block_size, chunk=args.prefix_chunk,
+            vocab=args.vocab, repeats=args.repeats)
+        record = {
+            "metric": "online_serving_paged_attention",
+            "unit": "ratio (row/paged KV bytes for the same live "
+                    "streams; row/paged prefix-hit admission TTFT)",
+            "config": {
+                "model": model_desc,
+                "slots": args.slots,
+                "prefill_len": args.prefill_len,
+                "prompt_len": args.prefix_prompt_len,
+                "shared_frac": args.prefix_shared_frac,
+                "prefix_block_size": args.prefix_block_size,
+                "paged": "per-slot block tables over the shared pool; "
+                         "pin-on-admit, in-place suffix append, "
+                         "bookkeeping-only donation "
+                         "(ops/attention.paged_decode_attention, "
+                         "serve/engine.py paged mode)",
+            },
+            "provenance": provenance(args.repeats),
+            "results": {"paged": paged},
+            "device": jax.devices()[0].device_kind,
+        }
+        _log(f"paged: duplicate KV eliminated "
+             f"{paged['duplicate_kv_eliminated_x']}x at matched pool "
+             f"bytes ({paged['kv_bytes_used_row']} -> "
+             f"{paged['kv_bytes_used_paged']} bytes for "
+             f"{paged['tokens_resident']} resident tokens); prefix-hit "
+             f"admission {paged['hit_admission_speedup_x']}x vs gather "
+             f"({paged['hit_admission_ttft_row_s']}s -> "
+             f"{paged['hit_admission_ttft_paged_s']}s; copy "
+             f"{paged['admission_copy_us_row']}us -> "
+             f"{paged['admission_copy_us_paged']}us per admission)")
+        _write_record(record, args.out)
+        return
 
     if args.obs_only:
         _log(f"observability leg only: {2 * args.concurrent} requests "

@@ -493,11 +493,25 @@ def _decode_fns(dec, temperature, top_k, top_p, max_new_tokens,
 # would silently capture any future non-position scalar cache state).
 CACHE_INDEX_KEYS = frozenset({"pos_index", "cache_index"})
 
+# The paged-serving block-table leaf name (`ops/attention.paged_*`,
+# `serve/kvcache/block_pool.paged_decode_cache`): its PRESENCE in a
+# cache collection is what flips the attention modules onto the paged
+# path, so the name is a registry constant like CACHE_INDEX_KEYS — the
+# modules, the engine's stamp helper below, and the pool builder all
+# match by it, never by shape duck typing.
+BLOCK_TABLE_KEY = "block_table"
+
 
 def is_cache_index_path(path) -> bool:
     """True when a cache-tree key path names a position counter leaf."""
     return bool(path) and (
         str(getattr(path[-1], "key", path[-1])) in CACHE_INDEX_KEYS)
+
+
+def is_block_table_path(path) -> bool:
+    """True when a cache-tree key path names a paged block-table leaf."""
+    return bool(path) and (
+        str(getattr(path[-1], "key", path[-1])) == BLOCK_TABLE_KEY)
 
 
 def slot_decode_cache(dec, slots: int):
@@ -523,6 +537,20 @@ def set_cache_positions(cache, positions):
     positions; the tick program stamps them in before each apply)."""
     return jax.tree_util.tree_map_with_path(
         lambda path, leaf: positions if is_cache_index_path(path) else leaf,
+        cache)
+
+
+def set_cache_block_tables(cache, tables):
+    """Overwrite every ``block_table`` leaf of a PAGED cache with
+    ``tables`` (``[slots, T]`` for the fused tick, ``[1, T]`` for a
+    batch-1 chunk prefill). The engine owns the authoritative host-side
+    tables exactly like the position counters — every paged program
+    stamps them in before the apply and re-stamps a canonical
+    placeholder on exit, so the resident donated tree keeps ONE
+    structure across the whole program set (shape-stable donation =
+    zero recompiles)."""
+    return jax.tree_util.tree_map_with_path(
+        lambda path, leaf: tables if is_block_table_path(path) else leaf,
         cache)
 
 

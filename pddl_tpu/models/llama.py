@@ -235,6 +235,37 @@ class LlamaAttention(nn.Module):
                              theta=self.rope_theta)
         k = k.astype(self.dtype)
         v = v.astype(self.dtype)
+        if initialized and self.has_variable("cache", "block_table"):
+            # PAGED serving (see the vit MHA twin): pool-shaped cache
+            # leaves + an engine-stamped per-slot block table replace
+            # the contiguous row cache. Post-RoPE keys are cached at
+            # their ABSOLUTE positions like the row path, so a shared
+            # pool block stays bit-valid for every referencing slot —
+            # the same contract the prefix cache's copies relied on,
+            # now without the copies. Rolling (ring) caches are never
+            # paged; the serving engine refuses ring models outright.
+            if ring is not None:
+                raise NotImplementedError(
+                    "paged attention requires a full-length cache; "
+                    "rolling sliding-window caches are not paged")
+            from pddl_tpu.ops.attention import (  # noqa: PLC0415
+                paged_cache_insert,
+                paged_decode_attention,
+            )
+
+            # Declared (not just read) so the mutated cache keeps the
+            # leaf and the donated tree's structure stays stable.
+            table = self.variable(
+                "cache", "block_table",
+                lambda: jnp.zeros((1, 1), jnp.int32)).value
+            cached_k.value = paged_cache_insert(cached_k.value, k, table, i)
+            cached_v.value = paged_cache_insert(cached_v.value, v, table, i)
+            index.value = i + s
+            o = paged_decode_attention(q, cached_k.value, cached_v.value,
+                                       table, i, window=self.sliding_window)
+            o = o.transpose(0, 2, 1, 3).reshape(
+                b, s, self.num_heads * head_dim)
+            return dense(features=self.num_heads * head_dim, name="out")(o)
         # Pre-write ring state: the multi-token ring path attends history
         # from here (the block's own writes below may overwrite in-window
         # history slots that this block's EARLY queries still need).

@@ -126,13 +126,42 @@ class MultiHeadAttention(nn.Module):
             "cache", "cache_index", lambda: jnp.zeros((), jnp.int32))
 
         i = index.value
+        if i.ndim and s != 1:
+            raise ValueError(
+                "per-row cache_index supports single-token steps "
+                f"only (got a {s}-token block); prefill requests "
+                "as batch-1 rows, then insert into their slot")
+        if initialized and self.has_variable("cache", "block_table"):
+            # PAGED serving: the cache leaves are the engine's shared
+            # block POOL ``[N, H, block_size, D]`` and the per-slot
+            # block table (engine-stamped, like the position counters)
+            # resolves every read/write — K/V of a shared prefix exists
+            # once regardless of how many slots reference it. Writes
+            # land in the slot's private tail block (or the scratch
+            # sink for parked slots / padding junk) by the engine's
+            # table discipline; reads sweep the table with the same
+            # masking as the row path below.
+            from pddl_tpu.ops.attention import (  # noqa: PLC0415
+                paged_cache_insert,
+                paged_decode_attention,
+            )
+
+            # Declared (not just read) so the mutated cache keeps the
+            # leaf and the donated tree's structure stays stable.
+            table = self.variable(
+                "cache", "block_table",
+                lambda: jnp.zeros((1, 1), jnp.int32)).value
+            cached_k.value = paged_cache_insert(
+                cached_k.value, k.astype(self.dtype), table, i)
+            cached_v.value = paged_cache_insert(
+                cached_v.value, v.astype(self.dtype), table, i)
+            index.value = i + s
+            o = paged_decode_attention(q, cached_k.value, cached_v.value,
+                                       table, i)
+            o = o.transpose(0, 2, 1, 3).reshape(b, s, h * head_dim)
+            return dense(features=h * head_dim, name="out")(o)
         if initialized:
             if i.ndim:
-                if s != 1:
-                    raise ValueError(
-                        "per-row cache_index supports single-token steps "
-                        f"only (got a {s}-token block); prefill requests "
-                        "as batch-1 rows, then insert into their slot")
                 rows = jnp.arange(b)
                 cached_k.value = cached_k.value.at[rows, :, i].set(
                     k[:, :, 0].astype(self.dtype))

@@ -111,6 +111,7 @@ SERVE_COUNTER_KEYS = frozenset({
     "tokens_emitted", "prefix_lookups", "prefix_hits",
     "prefill_tokens_saved", "prefix_evictions", "retries", "replays",
     "preemptions", "degraded_entries", "degraded_time_s",
+    "copy_bytes_avoided",
 })
 
 _NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
@@ -250,6 +251,12 @@ def engine_gauges(engine) -> Dict[str, object]:
         "degraded": engine.degraded,
         "drained": engine.drained,
         "prefix_pool_nbytes": engine.prefix_pool_nbytes,
+        # Paged-attention gauges (0 / 0.0 on a copy-mode engine): live
+        # cross-slot sharing and table occupancy, the dashboard's view
+        # of the in-place prefix sharing (`ServeEngine.paged`).
+        "paged": getattr(engine, "paged", False),
+        "blocks_shared": getattr(engine, "blocks_shared", 0),
+        "block_table_fill": getattr(engine, "block_table_fill", 0.0),
         "compile_counts": engine.compile_counts(),
     }
 
@@ -307,6 +314,7 @@ FLEET_COUNTER_KEYS = frozenset({
     "replica_up_events", "replica_down_events", "migrations",
     "requests_migrated", "migrated_via_drain", "migrated_via_replay",
     "requests_routed", "routed_sticky", "routed_affinity", "routed_hash",
+    "routed_load_balanced",
     "shed_rerouted", "shed_rejected", "requests_finished",
     "requests_failed", "requests_orphaned", "heartbeat_failures",
     "probes", "probe_failures", "tokens_streamed",

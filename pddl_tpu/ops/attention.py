@@ -1017,6 +1017,328 @@ def cache_blocks_scatter(pool: jnp.ndarray, row: jnp.ndarray, block_ids,
     return pool.at[block_ids].set(blocks.astype(pool.dtype))
 
 
+# ------------------------------------------------------ paged decode
+# True paged attention (vLLM PagedAttention, SOSP '23): decode reads
+# K/V straight out of the serving engine's block POOL through a
+# per-slot block-table indirection, so a shared prompt prefix exists
+# ONCE in HBM no matter how many live requests reference it and
+# admission never copies pool blocks into a resident row. Three ops:
+#
+# - :func:`paged_cache_insert` — write the current token(s) of every
+#   slot into its table-mapped pool block (the paged twin of the
+#   row-cache dynamic_update_slice writes in the decode modules).
+# - :func:`paged_decode_attention` — attention over the pool through
+#   the table. The jnp path (chunked gather + online softmax, HBM
+#   traffic bounded by the deepest live slot exactly like
+#   :func:`decode_attention`) is the CPU/tier-1 numerics ORACLE; the
+#   Pallas path (:func:`paged_decode_attention_kernel`) is the TPU
+#   hot-path kernel — the block table rides in SMEM via scalar
+#   prefetch and drives the K/V BlockSpec index maps, so each grid
+#   step DMAs exactly one pool block.
+#
+# Safety contract shared with `serve/kvcache/block_pool.py`: block 0
+# is the reserved scratch sink — parked slots' table rows are all
+# scratch, junk writes land there, and masked reads never reach past
+# a slot's position counter, so scratch content is junk by
+# construction and harmless by masking.
+
+
+def paged_cache_insert(pool: jnp.ndarray, kv: jnp.ndarray, block_table,
+                       index) -> jnp.ndarray:
+    """Write ``kv [B, H_kv, s, D]`` at global positions
+    ``index (+ arange(s))`` into pool blocks resolved through
+    ``block_table [B, T]`` (``pool [N, H_kv, block_size, D]``).
+
+    ``index`` is a scalar (batch-1 chunk prefill at a traced offset) or
+    a per-row ``[B]`` vector (the serving tick: every slot writes one
+    token at its own depth). Positions whose block index falls outside
+    the table are deflected to the scratch block — padded prefill junk
+    beyond a prompt's allocated blocks can never reach a real block.
+    Distinct valid positions map to distinct (block, offset) pairs, so
+    the scatter has no write conflicts except on scratch, whose content
+    is junk by contract.
+
+    The multi-token (batch-1 chunk prefill) path works at BLOCK
+    granularity: read the span's blocks, splice the chunk in
+    contiguously, scatter whole rows back. A per-token scatter of a
+    [C]-token chunk costs C strided row-strip writes (measured ~20x a
+    contiguous write on XLA CPU); a dozen whole-block copies cost
+    memcpy.
+    """
+    n, hkv, bs, d = pool.shape
+    b, _, s, _ = kv.shape
+    block_table = jnp.asarray(block_table, jnp.int32)
+    if block_table.ndim != 2 or block_table.shape[0] != b:
+        raise ValueError(
+            f"block_table must be [B={b}, T], got {block_table.shape}")
+    t = block_table.shape[1]
+    index = jnp.asarray(index, jnp.int32)
+    if s > 1 and b == 1:
+        # Block-granular read-modify-write over the chunk's span.
+        first = index // bs                       # traced span start block
+        n_span = -(-s // bs) + 1                  # static span width
+        span = first + jnp.arange(n_span)
+        ids = jnp.where(span < t,
+                        jnp.take(block_table[0], jnp.minimum(span, t - 1)),
+                        0)                        # off-table -> scratch
+        blocks = jnp.take(pool, ids, axis=0)      # [n_span, Hkv, bs, D]
+        flat = jnp.moveaxis(blocks, 0, 1).reshape(hkv, n_span * bs, d)
+        flat = jax.lax.dynamic_update_slice(
+            flat, kv[0].astype(pool.dtype), (0, index % bs, 0))
+        blocks = jnp.moveaxis(flat.reshape(hkv, n_span, bs, d), 1, 0)
+        return pool.at[ids].set(blocks)
+    pos = index[..., None] + jnp.arange(s, dtype=jnp.int32)   # [s] or [B, s]
+    pos = jnp.broadcast_to(pos, (b, s))
+    blk = pos // bs
+    off = pos % bs
+    bid = jnp.take_along_axis(block_table, jnp.minimum(blk, t - 1), axis=1)
+    bid = jnp.where(blk < t, bid, 0)  # out-of-table junk -> scratch
+    updates = jnp.moveaxis(kv, 2, 1).reshape(b * s, hkv, d)   # [B*s, Hkv, D]
+    return pool.at[bid.reshape(-1), :, off.reshape(-1)].set(
+        updates.astype(pool.dtype))
+
+
+def paged_decode_attention(
+    q: jnp.ndarray, k_pool: jnp.ndarray, v_pool: jnp.ndarray,
+    block_table, index, *, window: Optional[int] = None,
+    scale: Optional[float] = None, blocks_per_chunk: Optional[int] = None,
+    kernel: Optional[bool] = None, interpret: Optional[bool] = None,
+):
+    """Attention over a paged KV pool through a per-slot block table.
+
+    Semantically :func:`decode_attention` over the VIRTUAL cache
+    ``cache[b, :, j*bs + o] == pool[block_table[b, j], :, o]`` — same
+    masking, same online softmax, same prefix-bounded sweep — but the
+    per-request cache rows never exist contiguously: the pool IS the
+    storage and the table is the only per-slot state.
+
+    Args:
+      q: ``[B, H, s, D]`` post-RoPE queries (``s == 1`` on the decode
+        tick; ``s > 1`` for chunked prefill continuing at ``index``).
+      k_pool/v_pool: ``[N, H_kv, block_size, D]`` pool leaves; the
+        current tokens must already be written
+        (:func:`paged_cache_insert` runs first, like the row path).
+      block_table: ``[B, T]`` int32 pool block ids; entries beyond a
+        slot's depth are scratch (never read — masked).
+      index: tokens in the (virtual) cache before this call; scalar or
+        per-row ``[B]``.
+      window: sliding-window mask (non-rolling only — ring caches are
+        not paged).
+      blocks_per_chunk: table entries visited per sweep iteration on
+        the jnp path. Default (``None``): ~512 cache tokens per
+        iteration for single-token steps and ~256 for multi-token
+        chunks — the same sweep widths :func:`decode_attention` uses,
+        measured to amortize the gather/loop overhead on CPU without
+        blowing up the per-iteration score block.
+      kernel: ``True`` forces the Pallas kernel (decode steps only,
+        ``s == 1``), ``False`` the jnp reference, ``None`` (default)
+        picks the kernel on TPU and the reference elsewhere.
+      interpret: Pallas interpret mode (defaults to non-TPU backends).
+
+    Returns ``[B, H, s, D]`` in q's dtype.
+    """
+    b, h, s, d = q.shape
+    n, hkv, bs, _ = k_pool.shape
+    rep = _gqa_rep(q, k_pool)
+    block_table = jnp.asarray(block_table, jnp.int32)
+    if block_table.ndim != 2 or block_table.shape[0] != b:
+        raise ValueError(
+            f"block_table must be [B={b}, T], got {block_table.shape}")
+    t = block_table.shape[1]
+    index = jnp.asarray(index, jnp.int32)
+    if index.ndim > 1 or (index.ndim == 1 and index.shape[0] != b):
+        raise ValueError(
+            f"index must be a scalar or [B]={b} vector, got {index.shape}")
+    if window is not None and window < 1:
+        raise ValueError(f"window must be >= 1, got {window}")
+    scale_v = (1.0 / math.sqrt(d)) if scale is None else scale
+    if kernel is None:
+        kernel = jax.default_backend() == "tpu" and s == 1
+    if kernel:
+        if s != 1:
+            raise ValueError(
+                "the paged Pallas kernel serves single-token decode "
+                f"steps only (got a {s}-token block); multi-token "
+                "prefill takes the jnp path (kernel=False)")
+        return paged_decode_attention_kernel(
+            q, k_pool, v_pool, block_table, index, scale=scale_v,
+            window=window, interpret=interpret)
+
+    # ---- jnp reference path (the tier-1 oracle) ----
+    if blocks_per_chunk is None:
+        blocks_per_chunk = max(1, (512 if s == 1 else 256) // bs)
+    cb = min(int(blocks_per_chunk), t)
+    chunk = cb * bs
+    n_chunks = -(-t // cb)
+    qg = q.reshape(b, hkv, rep, s, d)
+    total = index + s
+    q_pos = index[..., None] + jnp.arange(s)
+
+    def _bcast(mask):
+        return mask if mask.ndim == 2 else mask[:, None, None]
+
+    def body(c, carry):
+        m, l, acc = carry
+        start_blk = jnp.minimum(c * cb, t - cb)       # clamped tail
+        ids = jax.lax.dynamic_slice(block_table, (0, start_blk),
+                                    (b, cb))          # [B, cb]
+        kc = jnp.take(k_pool, ids.reshape(-1), axis=0)
+        vc = jnp.take(v_pool, ids.reshape(-1), axis=0)
+        # [B*cb, Hkv, bs, D] -> [B, Hkv, cb*bs, D]
+        kc = jnp.moveaxis(kc.reshape(b, cb, hkv, bs, d), 1, 2) \
+            .reshape(b, hkv, chunk, d)
+        vc = jnp.moveaxis(vc.reshape(b, cb, hkv, bs, d), 1, 2) \
+            .reshape(b, hkv, chunk, d)
+        sb = jnp.einsum("bgrqd,bgkd->bgrqk", qg.astype(k_pool.dtype), kc,
+                        preferred_element_type=jnp.float32) * scale_v
+        pos = start_blk * bs + jnp.arange(chunk)
+        dedup = pos >= c * chunk  # drop the clamped tail's re-read overlap
+        mask = pos[..., None, :] <= q_pos[..., :, None]
+        if window is not None:
+            mask &= pos[..., None, :] > q_pos[..., :, None] - window
+        mask &= dedup[None, :]
+        sb = jnp.where(_bcast(mask), sb, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(sb, axis=-1, keepdims=True))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(sb - m_new)
+        l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc = acc * alpha + jnp.einsum(
+            "bgrqk,bgkd->bgrqd", p.astype(v_pool.dtype), vc,
+            preferred_element_type=jnp.float32)
+        return m_new, l, acc
+
+    live = jnp.minimum((jnp.max(total) + chunk - 1) // chunk, n_chunks)
+    m0 = jnp.full((b, hkv, rep, s, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, hkv, rep, s, 1), jnp.float32)
+    acc0 = jnp.zeros((b, hkv, rep, s, d), jnp.float32)
+    if n_chunks == 1:
+        m, l, acc = body(0, (m0, l0, acc0))
+    else:
+        m, l, acc = jax.lax.fori_loop(0, live, body, (m0, l0, acc0))
+    return (acc / jnp.maximum(l, 1e-30)).reshape(b, h, s, d).astype(q.dtype)
+
+
+def _paged_decode_kernel(table_ref, index_ref, q_ref, k_ref, v_ref, o_ref,
+                         m_ref, l_ref, acc_ref, *, scale: float, bs: int,
+                         num_t: int, hkv: int, rep: int,
+                         window: Optional[int]):
+    """One (slot, table-entry) grid step of paged decode attention.
+
+    ``table_ref``/``index_ref`` are scalar-prefetched (SMEM): the table
+    drove this step's K/V BlockSpec index maps (the DMA fetched pool
+    block ``table[b, j]``), and the per-slot depth gates the compute —
+    blocks past the slot's live prefix are skipped entirely, so the
+    sweep costs what the slot's depth costs, exactly like the chunked
+    jnp path. Running max / denominator / accumulator persist in VMEM
+    scratch across the (sequential, innermost) table sweep.
+    """
+    bq = pl.program_id(0)
+    j = pl.program_id(1)
+    depth = index_ref[bq]  # tokens in the virtual cache before this step
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    run = j * bs <= depth  # block intersects [0, depth] (current token incl.)
+    if window is not None:
+        run = jnp.logical_and(run, (j + 1) * bs - 1 > depth - window)
+
+    @pl.when(run)
+    def _compute():
+        # [Hkv, rep, D] x [Hkv, bs, D] -> [Hkv, rep, bs], batched on the
+        # kv-head dim, f32 accumulation on the MXU.
+        qg = q_ref[0].reshape(hkv, rep, q_ref.shape[-1])
+        sb = jax.lax.dot_general(
+            qg, k_ref[0], (((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32) * scale
+        pos = j * bs + jax.lax.broadcasted_iota(jnp.int32, sb.shape, 2)
+        mask = pos <= depth
+        if window is not None:
+            mask = jnp.logical_and(mask, pos > depth - window)
+        sb = jnp.where(mask, sb, NEG_INF)
+        sb = sb.reshape(hkv * rep, bs)
+        m_prev = m_ref[:, :1]
+        l_prev = l_ref[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(sb, axis=-1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(sb - m_new)
+        l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        pv = jax.lax.dot_general(  # [Hkv, rep, bs] x [Hkv, bs, D]
+            p.reshape(hkv, rep, bs).astype(v_ref.dtype), v_ref[0],
+            (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32).reshape(hkv * rep, -1)
+        acc_ref[:] = acc_ref[:] * alpha + pv
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(j == num_t - 1)
+    def _finalize():
+        l = jnp.maximum(l_ref[:, :1], 1e-30)
+        o_ref[0] = (acc_ref[:] / l).astype(o_ref.dtype)
+
+
+def paged_decode_attention_kernel(
+    q: jnp.ndarray, k_pool: jnp.ndarray, v_pool: jnp.ndarray,
+    block_table, index, *, scale: Optional[float] = None,
+    window: Optional[int] = None, interpret: Optional[bool] = None,
+) -> jnp.ndarray:
+    """The Pallas paged decode kernel (single-token steps).
+
+    Grid ``(B, T)`` with the table sweep innermost (sequential TPU grid
+    order, like the flash kernels): the scalar-prefetched block table
+    steers each step's K/V BlockSpec at pool block
+    ``block_table[b, j]`` — indirection happens in the DMA index map,
+    never as a gathered copy in HBM — and the per-slot depth (also
+    prefetched) skips dead blocks, so a parked slot costs one skipped
+    sweep and a live one exactly its prefix. Numerics match the jnp
+    reference path of :func:`paged_decode_attention` (same masking and
+    online softmax; pinned by `tests/test_paged_attention.py`).
+    """
+    b, h, s, d = q.shape
+    if s != 1:
+        raise ValueError(f"decode kernel takes single-token steps, got s={s}")
+    n, hkv, bs, _ = k_pool.shape
+    rep = _gqa_rep(q, k_pool)
+    t = jnp.asarray(block_table, jnp.int32).shape[1]
+    scale_v = (1.0 / math.sqrt(d)) if scale is None else scale
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    index = jnp.broadcast_to(jnp.asarray(index, jnp.int32), (b,))
+    from jax.experimental.pallas import tpu as pltpu  # noqa: PLC0415
+
+    qf = q.reshape(b, h, d)
+    cp = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, t),
+        in_specs=[
+            pl.BlockSpec((1, h, d), lambda bq, j, tbl, idx: (bq, 0, 0)),
+            pl.BlockSpec((1, hkv, bs, d),
+                         lambda bq, j, tbl, idx: (tbl[bq, j], 0, 0, 0)),
+            pl.BlockSpec((1, hkv, bs, d),
+                         lambda bq, j, tbl, idx: (tbl[bq, j], 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, h, d), lambda bq, j, tbl, idx: (bq, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((h, LANES), jnp.float32),  # running max
+            pltpu.VMEM((h, LANES), jnp.float32),  # running denom
+            pltpu.VMEM((h, d), jnp.float32),      # output accumulator
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(_paged_decode_kernel, scale=scale_v, bs=bs,
+                          num_t=t, hkv=hkv, rep=rep, window=window),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, h, d), q.dtype),
+        compiler_params=cp(dimension_semantics=("arbitrary", "arbitrary")),
+        interpret=bool(interpret),
+    )(jnp.asarray(block_table, jnp.int32), index, qf, k_pool, v_pool)
+    return out.reshape(b, h, 1, d)
+
+
 def decode_attention(
     q: jnp.ndarray, k_cache: jnp.ndarray, v_cache: jnp.ndarray,
     index, *, window: Optional[int] = None, rolling: bool = False,

@@ -41,8 +41,15 @@ from pddl_tpu.serve.request import (
 # classes). Version-1 snapshots — taken by a pre-priority engine —
 # still restore: an absent priority defaults to ``interactive``, the
 # class every pre-SLO request implicitly was.
-SNAPSHOT_VERSION = 2
-_READABLE_VERSIONS = frozenset({1, 2})
+# Version 3 (paged attention): the snapshot header carries ``paged``
+# and each RUNNING request its slot's block table — postmortem context
+# only (which pool blocks the stream occupied, how much was shared).
+# Restore NEVER reads the tables: pool storage dies with the process
+# and KV is a pure function of (params, tokens), so every version —
+# v2 copy-engine snapshots included — restores through the same
+# replay/prefill path, into either engine mode.
+SNAPSHOT_VERSION = 3
+_READABLE_VERSIONS = frozenset({1, 2, 3})
 
 
 def encode_sampling(sampling: SamplingParams) -> Dict[str, object]:
@@ -64,11 +71,21 @@ def decode_sampling(d) -> SamplingParams:
                           top_k=d.get("top_k"), top_p=d.get("top_p"))
 
 
-def encode_handle(handle: RequestHandle, now_s: float) -> Dict[str, object]:
+def encode_handle(handle: RequestHandle, now_s: float,
+                  block_table=None) -> Dict[str, object]:
     """One request's restorable host state. ``elapsed_s`` (age at drain
     time) rather than an absolute arrival lets the restoring engine —
     whose clock has a different epoch — keep deadline semantics: the
-    wall budget already consumed stays consumed."""
+    wall budget already consumed stays consumed. ``block_table`` (a
+    paged engine's per-slot pool block ids, running requests only) is
+    v3 postmortem context — see the version note above."""
+    entry = _encode_core(handle, now_s)
+    if block_table is not None:
+        entry["block_table"] = [int(b) for b in block_table]
+    return entry
+
+
+def _encode_core(handle: RequestHandle, now_s: float) -> Dict[str, object]:
     return {
         "prompt": [int(t) for t in handle.request.prompt],
         "max_new_tokens": int(handle.request.max_new_tokens),

@@ -103,6 +103,7 @@ from pddl_tpu.models.gpt import (
     prefill_row,
     prefill_row_from,
     sample_logits_batched,
+    set_cache_block_tables,
     set_cache_positions,
     slot_decode_cache,
 )
@@ -119,6 +120,7 @@ from pddl_tpu.serve.kvcache import (
     donate_prefix_blocks,
     gather_prefix_into_row,
     kv_block_pool,
+    paged_decode_cache,
     pool_nbytes,
 )
 from pddl_tpu.serve.metrics import ServeMetrics
@@ -160,6 +162,15 @@ _DONATED_BY_SITE = {
     "tick": "cache", "insert": "cache",
     "gather": "row", "chunk_prefill": "row", "chunk_prefill_wide": "row",
     "donate": "pool",
+}
+
+# The PAGED engine's site map: the pool IS the cache, and every paged
+# program (tick and both chunk widths) donates it — a real mid-dispatch
+# error from any of them may have consumed the one tree holding every
+# live stream's KV, so recovery is always the full pool rebuild + live
+# -slot replay.
+_PAGED_DONATED_BY_SITE = {
+    "tick": "pool", "chunk_prefill": "pool", "chunk_prefill_wide": "pool",
 }
 
 
@@ -215,6 +226,26 @@ class ServeEngine:
         admission prefills ``ceil(suffix/chunk)`` chunks, so prefill
         work scales with the UNCACHED suffix). Default
         ``max(prefix_block_size, prefill_len // 4)``.
+      paged: TRUE PAGED ATTENTION (vLLM PagedAttention / SGLang
+        RadixAttention composed): the resident slot cache disappears —
+        every stream's K/V lives in the block pool and decode reads it
+        through a per-slot ``[S, T]`` block table
+        (:func:`~pddl_tpu.ops.attention.paged_decode_attention`; the
+        Pallas kernel on TPU, the chunked jnp oracle elsewhere). A
+        prefix hit PINS the matched blocks in place instead of
+        copying them into a row (admission cost loses the pool→slot
+        gather and the insert copy), donation becomes a pure refcount
+        hand-off of blocks the prefill already wrote, and a shared
+        prefix's KV exists ONCE in HBM no matter how many live slots
+        reference it — which is what roughly doubles effective cache
+        capacity at high prefix sharing. Requires the prefix machinery
+        (``prefix_cache_blocks != 0``); with ``None`` the pool
+        auto-sizes to hold every slot at ``max_len`` plus shared
+        headroom, and an explicit size must cover
+        ``max_slots * ceil(max_len/block_size) + 1`` so a live stream
+        can never starve for a writable block. Token-exact against the
+        resident-row engine (the oracle) for every family/quant
+        config; same drain/replay/chaos contracts.
       fault_plan: optional :class:`~pddl_tpu.serve.faults.FaultPlan`
         consulted before every device dispatch (chaos tests, fault
         benches). ``None`` in production — real device errors take the
@@ -260,6 +291,7 @@ class ServeEngine:
                  prefix_cache_blocks: Optional[int] = None,
                  prefix_block_size: int = 8,
                  prefix_chunk: Optional[int] = None,
+                 paged: bool = False,
                  fault_plan=None, max_retries: int = 3,
                  retry_backoff_s: float = 0.02,
                  backoff_sleep=time.sleep,
@@ -341,12 +373,37 @@ class ServeEngine:
         self._donate_cap = self.prefill_len // bs
         chunk = (int(prefix_chunk) if prefix_chunk is not None
                  else max(bs, self.prefill_len // 4))
+        self._paged = bool(paged)
+        # Paged mode: T table entries cover every position a stream can
+        # reach; the pool must hold at least one writable block per
+        # live position-block plus the scratch sink, or a decode tick
+        # could starve mid-stream.
+        self._table_width = -(-model.max_len // bs)
+        paged_floor = self.max_slots * self._table_width + 1
         if prefix_cache_blocks is None:
-            pool_blocks = (2 * self.max_slots * max(self._donate_cap, 1)
-                           + 1) if self._match_cap >= 1 else 0
+            if self._paged:
+                # Live worst case + the same shared-cache headroom the
+                # copy engine's default bought (two prompts per slot).
+                pool_blocks = (paged_floor
+                               + 2 * self.max_slots * max(self._donate_cap,
+                                                          1))
+            else:
+                pool_blocks = (2 * self.max_slots * max(self._donate_cap, 1)
+                               + 1) if self._match_cap >= 1 else 0
         else:
             pool_blocks = int(prefix_cache_blocks)
         self._prefix_on = pool_blocks > 0
+        if self._paged:
+            if not self._prefix_on:
+                raise ValueError(
+                    "paged=True needs the block-pool machinery; "
+                    "prefix_cache_blocks=0 disables it")
+            if pool_blocks < paged_floor:
+                raise ValueError(
+                    f"paged=True needs prefix_cache_blocks >= "
+                    f"{paged_floor} (max_slots * ceil(max_len/"
+                    f"block_size) + scratch) so live streams can never "
+                    f"starve for a writable block; got {pool_blocks}")
         if self._prefix_on:
             if self._match_cap < 1:
                 raise ValueError(
@@ -469,17 +526,99 @@ class ServeEngine:
             # report other instances' pool shapes.
             return insert_cache_slot(cache, row_cache, slot, position)
 
+        # --- paged program bodies (see the `paged` arg docs) ---
+        # Every paged program stamps the engine-owned positions/tables
+        # on entry and restores CANONICAL placeholders (scalar counter,
+        # [1,1] table) on exit, so the donated resident tree keeps one
+        # structure across the fused tick and the batch-1 chunk widths
+        # — shape-stable donation is what keeps the set at zero
+        # recompiles.
+        def _canon_paged(cache):
+            cache = set_cache_positions(cache, jnp.zeros((), jnp.int32))
+            return set_cache_block_tables(cache,
+                                          jnp.zeros((1, 1), jnp.int32))
+
+        def _tick_paged(params, cache, positions, tables, tokens, temps,
+                        top_ks, top_ps, rng):
+            rng, sub = jax.random.split(rng)
+            cache = set_cache_positions(cache, positions)
+            cache = set_cache_block_tables(cache, tables)
+            logits, mutated = dec.apply(
+                {"params": (pt(params) if pt is not None else params),
+                 "cache": cache},
+                tokens[:, None], train=False, mutable=["cache"])
+            nxt = sample_logits_batched(
+                sub, logits[:, -1], temperature=temps, top_k=top_ks,
+                top_p=top_ps)
+            return _canon_paged(mutated["cache"]), nxt, rng
+
+        def _chunk_paged(params, cache, tokens, length, start, table):
+            cache = set_cache_block_tables(cache, table)
+            cache, logits = prefill_row_from(dec, params, tokens, length,
+                                             cache, start,
+                                             param_transform=pt)
+            return _canon_paged(cache), logits
+
+        def _chunk_paged_wide(params, cache, tokens, length, start, table):
+            # Distinct function object for a distinct compile_counts
+            # entry, like the row-mode wide chunk.
+            cache = set_cache_block_tables(cache, table)
+            cache, logits = prefill_row_from(dec, params, tokens, length,
+                                             cache, start,
+                                             param_transform=pt)
+            return _canon_paged(cache), logits
+
         # The resident programs (four without prefix caching; gather /
         # chunk-prefill / donate replace the one-shot prefill with it
-        # on). Donation discipline: the pooled slot cache is donated
-        # through insert and tick, the row cache through each suffix
-        # chunk, and the block pool through donate — the engine always
-        # adopts the returned trees, so the resident HBM buffers are
-        # reused in place and a stale reference can never be used by
-        # mistake.
+        # on; in PAGED mode the set shrinks to tick + chunk widths +
+        # sample_first — no gather, no insert, no donate scatter: the
+        # prefill writes K/V in place and sharing is pure host
+        # bookkeeping). Donation discipline: the pooled slot cache (or
+        # the paged pool tree) is donated through every program that
+        # touches it — the engine always adopts the returned trees, so
+        # the resident HBM buffers are reused in place and a stale
+        # reference can never be used by mistake.
+        self._donated_by_site = (_PAGED_DONATED_BY_SITE if self._paged
+                                 else _DONATED_BY_SITE)
+        self._sample_first_p = jax.jit(_sample_first)
+        if self._paged:
+            self._insert_p = None
+            self._tick_p = jax.jit(_tick_paged, donate_argnums=(1,))
+            self._gather_p = None
+            self._chunk_p = jax.jit(_chunk_paged, donate_argnums=(1,))
+            self._has_wide = (
+                self._chunk < self.prefill_len
+                and self.prefill_len + self.prefill_len // 4
+                <= model.max_len)
+            self._chunk_wide_p = (jax.jit(_chunk_paged_wide,
+                                          donate_argnums=(1,))
+                                  if self._has_wide else None)
+            self._donate_p = None
+            self._pool = None
+            self._prefix = RadixPrefixCache(bs, pool_blocks)
+            self._row = None
+            self._cache = paged_decode_cache(dec, pool_blocks, bs)
+            # Host-authoritative per-slot block tables (scratch-filled
+            # for parked slots) and the private (not-yet-shared) block
+            # ids each slot owns.
+            self._tables = np.zeros(
+                (self.max_slots, self._table_width), np.int32)
+            self._private: List[List[int]] = [
+                [] for _ in range(self.max_slots)]
+            # KV bytes one token occupies across every leaf — what one
+            # avoided gather copy is worth (`copy_bytes_avoided`).
+            kv_bytes = sum(
+                int(leaf.size) * leaf.dtype.itemsize
+                for path, leaf in jax.tree_util.tree_leaves_with_path(
+                    self._cache)
+                if leaf.ndim > 2)
+            self._kv_token_bytes = kv_bytes // (pool_blocks * bs)
+            self._warm = False
+            if tracer is not None:
+                self.set_tracer(tracer)
+            return
         self._insert_p = jax.jit(_insert, donate_argnums=(0,))
         self._tick_p = jax.jit(_tick, donate_argnums=(1,))
-        self._sample_first_p = jax.jit(_sample_first)
         if self._prefix_on:
             self._prefill_p = None
             self._gather_p = jax.jit(_gather, donate_argnums=(2,))
@@ -615,6 +754,30 @@ class ServeEngine:
         if not called."""
         if self._warm:
             return
+        if self._paged:
+            # All-scratch tables: every warmup write lands in the junk
+            # sink, the radix index stays empty, and every program
+            # traces once with its serving shapes.
+            t1 = np.zeros((1, self._table_width), np.int32)
+            self._cache, logits = self._chunk_p(
+                self._params, self._cache,
+                np.zeros((1, self._chunk), np.int32), np.int32(1),
+                np.int32(0), t1)
+            if self._has_wide:
+                self._cache, logits = self._chunk_wide_p(
+                    self._params, self._cache,
+                    np.zeros((1, self.prefill_len), np.int32), np.int32(1),
+                    np.int32(0), t1)
+            tok, self._rng = self._sample_first_p(
+                logits, np.float32(0.0), np.int32(0), np.float32(2.0),
+                self._rng)
+            self._cache, nxt, self._rng = self._tick_p(
+                self._params, self._cache, self._positions, self._tables,
+                self._tokens, self._temps, self._top_ks, self._top_ps,
+                self._rng)
+            jax.block_until_ready((tok, nxt))
+            self._warm = True
+            return
         if self._prefix_on:
             row = self._gather_p(
                 self._pool, np.zeros(self._match_cap, np.int32),
@@ -651,6 +814,16 @@ class ServeEngine:
         → donate instead of the one-shot prefill — chunk width, block-id
         vector lengths, and every offset/length are fixed shapes or
         runtime values, so the program set stays closed here too."""
+        if self._paged:
+            counts = {
+                "tick": self._tick_p._cache_size(),
+                "sample_first": self._sample_first_p._cache_size(),
+                "chunk_prefill": self._chunk_p._cache_size(),
+            }
+            if self._has_wide:
+                counts["chunk_prefill_wide"] = \
+                    self._chunk_wide_p._cache_size()
+            return counts
         counts = {
             "insert": self._insert_p._cache_size(),
             "tick": self._tick_p._cache_size(),
@@ -672,6 +845,83 @@ class ServeEngine:
         return self._prefix_on
 
     @property
+    def paged(self) -> bool:
+        """True when decode reads K/V straight from the block pool
+        through per-slot block tables (no resident slot cache)."""
+        return self._paged
+
+    @property
+    def blocks_shared(self) -> int:
+        """Pool blocks referenced by MORE THAN ONE live slot's block
+        table right now — each is one block of KV the copy engine
+        would have duplicated per referencing slot. 0 outside paged
+        mode."""
+        if not self._paged:
+            return 0
+        live = [sid for sid, h in enumerate(self._slots) if h is not None]
+        if len(live) < 2:
+            return 0
+        # One vectorized pass (this gauge is stamped every tick): count
+        # ids that appear in more than one row. Within a row ids are
+        # unique by construction (each table entry is a distinct block
+        # or scratch), so a >1 total count means >1 slot.
+        rows = self._tables[live]
+        ids, counts = np.unique(rows[rows != 0], return_counts=True)
+        return int((counts > 1).sum())
+
+    def resident_kv_report(self) -> Dict[str, int]:
+        """Live-stream KV accounting, comparable across engine modes
+        (the capacity half of `benchmarks/serve_bench.py --paged-only`):
+
+        - ``tokens_resident``: summed depth of every live stream — the
+          user-visible context currently held, identical for both
+          modes at the same workload snapshot.
+        - ``kv_bytes_used``: HBM actually holding that state. The
+          resident-row engine pays each live slot's depth PRIVATELY
+          plus one pool copy of every cached block; the paged engine
+          pays each DISTINCT referenced block once — shared prefixes
+          collapse, which is the whole point.
+        - ``kv_bytes_allocated``: the reserved footprint (slot cache +
+          pool, or the paged pool tree).
+        """
+        live = [sid for sid, h in enumerate(self._slots) if h is not None]
+        if self._paged:
+            tokens = int(sum(int(self._positions[sid]) for sid in live))
+            distinct = set()
+            for sid in live:
+                distinct.update(
+                    int(b) for b in self._tables[sid] if b != 0)
+            used = len(distinct) * self.prefix_block_size \
+                * self._kv_token_bytes
+            return {"tokens_resident": tokens, "kv_bytes_used": used,
+                    "kv_bytes_allocated": pool_nbytes(self._cache)}
+        cache_bytes = pool_nbytes(self._cache)
+        token_bytes = cache_bytes // (self.max_slots * self.model.max_len)
+        tokens = int(sum(int(self._positions[sid]) for sid in live))
+        used = tokens * token_bytes
+        allocated = cache_bytes
+        if self._prefix_on:
+            used += (self._prefix.blocks_live * self.prefix_block_size
+                     * token_bytes)
+            allocated += pool_nbytes(self._pool)
+        return {"tokens_resident": tokens, "kv_bytes_used": used,
+                "kv_bytes_allocated": allocated}
+
+    @property
+    def block_table_fill(self) -> float:
+        """Mean fraction of live slots' table entries pointing at real
+        (non-scratch) blocks — how much of the paged address space the
+        current streams occupy. 0.0 with no live slots or outside
+        paged mode."""
+        if not self._paged:
+            return 0.0
+        live = [sid for sid, h in enumerate(self._slots) if h is not None]
+        if not live:
+            return 0.0
+        rows = self._tables[live]
+        return float((rows != 0).mean())
+
+    @property
     def degraded(self) -> bool:
         """True while an OOM has the prefix cache shed and donations
         off (serving continues on the cold path); re-arms after
@@ -681,9 +931,12 @@ class ServeEngine:
     @property
     def prefix_pool_nbytes(self) -> int:
         """Device bytes the resident KV block pool holds (0 with the
-        cache off) — the HBM degraded mode can shed, the number to
-        weigh against OOM headroom when sizing ``prefix_cache_blocks``
-        (docs/OPERATIONS.md § "Failure modes & recovery")."""
+        cache off) — in the copy engine the HBM degraded mode can
+        shed; in PAGED mode the pool is the whole serving KV (live
+        streams included), so only its unpinned cached fraction is
+        sheddable (docs/OPERATIONS.md § "Failure modes & recovery")."""
+        if self._paged:
+            return pool_nbytes(self._cache)
         return pool_nbytes(self._pool) if self._prefix_on else 0
 
     @property
@@ -756,7 +1009,8 @@ class ServeEngine:
                     raise  # not a device fault: bugs stay loud
                 injected = isinstance(e, (InjectedTransientError,
                                           InjectedResourceExhausted))
-                consumed = None if injected else _DONATED_BY_SITE.get(site)
+                consumed = (None if injected
+                            else self._donated_by_site.get(site))
                 if kind == "oom":
                     self._enter_degraded()
                     raise _SlotStateLost(site, e, consumed) from e
@@ -809,21 +1063,52 @@ class ServeEngine:
                                         self._prefix.num_blocks)
         self._slot_nodes = [None] * self.max_slots
 
+    def _reset_paged_pool(self) -> None:
+        """Rebuild the paged world after its one donated tree may have
+        been consumed (or live KV presumed lost): fresh pool tree (same
+        shapes — nothing recompiles), fresh index (every stored chain
+        pointed into the dead storage), all tables back to scratch,
+        all private ownership dropped. Callers park/replay the live
+        slots FIRST — their KV lived here."""
+        self._cache = paged_decode_cache(self._dec, self._prefix.num_blocks,
+                                         self.prefix_block_size)
+        self._prefix = RadixPrefixCache(self.prefix_block_size,
+                                        self._prefix.num_blocks)
+        self._tables[:] = 0
+        self._private = [[] for _ in range(self.max_slots)]
+        self._slot_nodes = [None] * self.max_slots
+
     def _recover_consumed(self, lost: _SlotStateLost) -> None:
         """Rebuild whatever resident donated tree a real mid-dispatch
         error may have eaten (`_SlotStateLost.consumed`). The row cache
         is rebuilt unconditionally by the admission unwind; the slot
-        pool rebuild doubles as a full live-slot replay."""
+        pool rebuild doubles as a full live-slot replay. In PAGED mode
+        every consuming site donates the ONE pool tree holding all
+        live KV, so recovery is always the full live-slot replay
+        (`_lose_live_slots` parks, resets the paged world, and
+        requeues)."""
         if lost.consumed == "cache":
             self._lose_live_slots()
         elif lost.consumed == "pool":
-            self._reset_prefix_pool()
+            if self._paged:
+                self._lose_live_slots()
+            else:
+                self._reset_prefix_pool()
 
     def _park_slot(self, slot_id: int) -> None:
         """Park a vacated row: position 0, greedy params. Its future
         junk writes land at position 0 and the next admit overwrites
-        the whole cache row anyway."""
+        the whole cache row anyway (paged: the table row goes all-
+        scratch, so junk lands in the sink, and the slot's PRIVATE
+        blocks — tail + generated tokens, never shared — return to the
+        free list; donated prompt blocks stay cached under the radix
+        index, unpinned below)."""
         self._slots[slot_id] = None
+        if self._paged:
+            if self._private[slot_id]:
+                self._prefix.release(self._private[slot_id])
+                self._private[slot_id] = []
+            self._tables[slot_id, :] = 0
         if self._slot_nodes[slot_id] is not None:
             # Release the request's pin on its prefix chain: the blocks
             # stay cached (that's the point) but become LRU-evictable
@@ -865,12 +1150,26 @@ class ServeEngine:
         + emitted tokens at its re-admission."""
         lost = [(sid, h) for sid, h in enumerate(self._slots)
                 if h is not None]
-        self._cache = slot_decode_cache(self._dec, self.max_slots)
         requeue: List[RequestHandle] = []
         for sid, handle in lost:
-            self._park_slot(sid)
+            self._park_slot(sid)  # releases pins/private into the OLD index
             if self._mark_replay(handle):
                 requeue.append(handle)
+        if self._paged:
+            # A parked mid-prefill slice holds private ids and a pinned
+            # node of the index about to be retired: DROP it without
+            # releasing (the whole old index dies with the reset — a
+            # release would double-own the ids in the fresh free list).
+            # Its handle is still at the head of `_admitting`, so the
+            # next step re-admits it from scratch against the fresh
+            # pool, token-exactly.
+            self._slice = None
+            # The pool held every live stream's KV (and the cached
+            # chains): rebuild the whole paged world — same shapes,
+            # nothing recompiles.
+            self._reset_paged_pool()
+        else:
+            self._cache = slot_decode_cache(self._dec, self.max_slots)
         self.scheduler.requeue_front(requeue)
 
     def _expired(self, handle: RequestHandle, now: float) -> bool:
@@ -952,35 +1251,22 @@ class ServeEngine:
             # [0, plen) of the resident row and everything beyond parks
             # past the position counter the insert stamps.
             row = self._row
-        # Fixed-width chunks over the suffix — every (tokens, length,
-        # start) is a runtime value, so the program set stays closed.
-        # Width policy (coarse cost model — each apply pays a fixed
-        # dispatch/tick cost plus per-token compute): a long remainder
-        # (>= 3/4 of the wide width) takes the WIDE program in one
-        # apply, so a cold prompt costs what the one-shot prefill did;
-        # short suffixes — the prefix-hit case — take narrow chunks and
-        # pay only for the uncached tail. The resident row is adopted
-        # after EVERY dispatch (each chunk donates it), so a mid-chunk
-        # fault escalation never leaves `self._row` pointing at a
-        # consumed buffer.
-        off, logits = n_cached, None
-        while off < plen:
-            rem = plen - off
-            if self._has_wide and 4 * rem >= 3 * self.prefill_len:
-                width, prog = self.prefill_len, self._chunk_wide_p
-                site = "chunk_prefill_wide"
-            else:
-                width, prog = self._chunk, self._chunk_p
-                site = "chunk_prefill"
-            w = min(width, rem)
-            chunk_toks = np.zeros((1, width), np.int32)
-            chunk_toks[0, :w] = prompt[off:off + w]
-            row, logits = self._device_call(
-                site, prog, self._params, row, chunk_toks,
+        # Fixed-width chunks over the suffix (shared width policy —
+        # :meth:`_chunk_loop`). The resident row is adopted after EVERY
+        # dispatch (each chunk donates it), so a mid-chunk fault
+        # escalation never leaves `self._row` pointing at a consumed
+        # buffer.
+        row_box = [row]
+
+        def _dispatch(site, prog, chunk_toks, w, off):
+            row_box[0], lg = self._device_call(
+                site, prog, self._params, row_box[0], chunk_toks,
                 np.int32(w), np.int32(off))
-            tr.on_prefill_chunk(handle, site, off, w, self._last_wall_s)
-            self._row = row
-            off += w
+            self._row = row_box[0]
+            return lg
+
+        logits = self._chunk_loop(prompt, n_cached, handle, _dispatch)
+        row = row_box[0]
         if not use_prefix:
             return row, logits, None
         node = self._donate_tail(prompt, row, match, n_cached)
@@ -1036,6 +1322,166 @@ class ServeEngine:
         self.metrics.record_prefix_lookup(
             n_cached, blocks_live=self._prefix.blocks_live,
             evictions=self._prefix.evictions)
+        return node
+
+    def _chunk_loop(self, prompt: np.ndarray, off: int, handle,
+                    dispatch):
+        """The whole-prompt suffix chunk loop, ONE width policy for the
+        row and paged admissions (coarse cost model — each apply pays a
+        fixed dispatch cost plus per-token compute): a long remainder
+        (>= 3/4 of the wide width) takes the WIDE program in one apply,
+        so a cold prompt costs what the one-shot prefill did; short
+        suffixes — the prefix-hit case — take narrow chunks and pay
+        only for the uncached tail. ``dispatch(site, prog, chunk_toks,
+        w, off)`` runs the program, adopts whatever resident tree it
+        donated, and returns the logits."""
+        plen = int(prompt.size)
+        logits = None
+        while off < plen:
+            rem = plen - off
+            if self._has_wide and 4 * rem >= 3 * self.prefill_len:
+                width, prog = self.prefill_len, self._chunk_wide_p
+                site = "chunk_prefill_wide"
+            else:
+                width, prog = self._chunk, self._chunk_p
+                site = "chunk_prefill"
+            w = min(width, rem)
+            chunk_toks = np.zeros((1, width), np.int32)
+            chunk_toks[0, :w] = prompt[off:off + w]
+            logits = dispatch(site, prog, chunk_toks, w, off)
+            self._tracer.on_prefill_chunk(handle, site, off, w,
+                                          self._last_wall_s)
+            off += w
+        return logits
+
+    # ------------------------------------------------- paged admission
+    def _paged_match_and_allocate(self, prompt: np.ndarray, handle=None):
+        """The shared front half of every paged admission (whole-prompt
+        AND sliced): match → pin → allocate private suffix blocks →
+        stamp the table row. ONE definition because the ordering is
+        safety-critical — the pin must land BEFORE any allocation (with
+        no private copy, an eviction stealing a matched block
+        mid-admission would reach under this very request) and a
+        shortfall must unwind pin + ids exactly. Degraded mode skips
+        the index entirely (all blocks private). Returns
+        ``(pinned_node_or_None, n_matched_blocks, table_row [T],
+        private_ids)``; raises :class:`_SlotStateLost` unwound on
+        shortfall."""
+        plen = int(prompt.size)
+        bs = self.prefix_block_size
+        table_row = np.zeros(self._table_width, np.int32)
+        node, m = None, 0
+        if not self._degraded:
+            match = self._prefix.match(
+                prompt, max_blocks=self._match_blocks(prompt))
+            m = match.n_blocks
+            if m > 0:
+                node = match.node
+                self._prefix.pin(node)
+                table_row[:m] = match.block_ids
+            self._tracer.on_prefix_match(handle, m, m * bs)
+        need = -(-plen // bs) - m
+        private = list(self._prefix.allocate(need)) if need > 0 else []
+        if len(private) < need:
+            # Everything unpinned is already gone and it still doesn't
+            # fit — undo and escalate; the unwind charges a replay.
+            self._prefix.release(private)
+            if node is not None:
+                self._prefix.unpin(node)
+            raise _SlotStateLost(
+                "paged_alloc",
+                RuntimeError(
+                    f"block pool exhausted ({need} blocks needed, "
+                    f"{len(private)} free/evictable)"))
+        table_row[m:m + len(private)] = private
+        return node, m, table_row, private
+
+    def _prefill_paged(self, prompt: np.ndarray, handle=None):
+        """The paged twin of :meth:`_prefill_into_row`: a prefix hit
+        PINS the matched chain and points the slot's block table at it
+        in place (no gather copy), private blocks are allocated for the
+        suffix, and the chunk programs write K/V straight into those
+        pool blocks. Returns ``(last_logits, pinned_node_or_None,
+        table_row [T] np.int32, private_ids)``; raises
+        :class:`_SlotStateLost` with its own resources unwound."""
+        node, m, table_row, private = self._paged_match_and_allocate(
+            prompt, handle)
+        n_cached = m * self.prefix_block_size
+        use_prefix = not self._degraded
+        t1 = table_row[None]  # [1, T] — the chunk programs' view
+
+        def _dispatch(site, prog, chunk_toks, w, off):
+            self._cache, lg = self._device_call(
+                site, prog, self._params, self._cache, chunk_toks,
+                np.int32(w), np.int32(off), t1)
+            return lg
+
+        try:
+            logits = self._chunk_loop(prompt, n_cached, handle, _dispatch)
+        except _SlotStateLost:
+            # Injected faults consumed nothing: hand the resources
+            # back. A REAL consumed-pool error resets the whole paged
+            # world right after (the unwind's _recover_consumed), which
+            # retires this index anyway — releasing first is harmless.
+            self._prefix.release(private)
+            if node is not None:
+                self._prefix.unpin(node)
+            raise
+        if n_cached > 0:
+            self.metrics.record_copy_avoided(
+                n_cached * self._kv_token_bytes)
+        if use_prefix:
+            node = self._donate_tail_paged(prompt, node, table_row,
+                                           private, m)
+            self.metrics.record_prefix_lookup(
+                n_cached, blocks_live=self._prefix.blocks_live,
+                evictions=self._prefix.evictions)
+        return logits, node, table_row, private
+
+    def _donate_tail_paged(self, prompt: np.ndarray, node, table_row,
+                           private: List[int], m: int):
+        """Donation with ZERO copies: the prompt's full blocks are
+        already written in the pool — hand their ownership to the radix
+        index (they become the stored chain) and keep the slot's pin.
+        When a chain segment is ALREADY stored (the block-aligned-tail
+        case the copy engine deduped with `descend`), the slot's table
+        is SWAPPED onto the stored blocks — token-identity implies
+        bit-identical KV under the position-absolute cache contract —
+        and the duplicate private blocks go back to the free list, so a
+        repeat prompt holds the pool at its deduplicated size. Returns
+        the pinned chain tip (or ``node`` unchanged when the prompt has
+        no full blocks)."""
+        bs = self.prefix_block_size
+        plen = len(prompt)
+        full = plen // bs
+        anchor = node if node is not None else self._prefix.match(
+            prompt, max_blocks=0).node
+        deeper, stored = self._prefix.descend(anchor, prompt, m)
+        if stored > m:
+            chain = self._prefix.chain_ids(deeper)
+            for j in range(m, stored):
+                mine = int(table_row[j])
+                table_row[j] = chain[j]
+                private.remove(mine)
+                self._prefix.release([mine])
+        if deeper is not anchor or node is None:
+            if node is not None:
+                self._prefix.unpin(node)
+            self._prefix.pin(deeper)
+        node = deeper
+        if full > stored:
+            ids = [int(table_row[j]) for j in range(stored, full)]
+            tip = self._prefix.extend(
+                node, prompt[stored * bs:full * bs], ids)
+            chain = self._prefix.chain_ids(tip)
+            for j in range(stored, full):
+                # extend normally attaches our block; on a (defensive)
+                # dedup it freed ours — swap the table either way.
+                private.remove(int(table_row[j]))
+                table_row[j] = chain[j]
+            self._prefix.unpin(node)
+            self._prefix.pin(tip)
+            node = tip
         return node
 
     def _admit(self) -> None:
@@ -1102,6 +1548,31 @@ class ServeEngine:
                 self._unwind_admission(lost, handle)
             self._admitting.popleft()
 
+    def _paged_append_blocks(self) -> None:
+        """Before a paged tick: every live slot about to write at a
+        block boundary gets a fresh PRIVATE block appended to its
+        table (block-table growth is a runtime-array update — the
+        in-place append that replaces the copy engine's whole-row
+        insert). Allocation LRU-evicts unpinned cached chains under
+        pressure; with the pool at its validated floor it cannot fail
+        for a live stream, but if a mis-sized explicit pool ever does,
+        the slot is parked and REPLAYED rather than writing into a
+        shared block."""
+        for sid, handle in enumerate(self._slots):
+            if handle is None:
+                continue
+            blk = int(self._positions[sid]) // self.prefix_block_size
+            if blk >= self._table_width or self._tables[sid, blk] != 0:
+                continue
+            ids = self._prefix.allocate(1)
+            if not ids:
+                self._park_slot(sid)
+                if self._mark_replay(handle):
+                    self.scheduler.requeue_front([handle])
+                continue
+            self._tables[sid, blk] = ids[0]
+            self._private[sid].append(ids[0])
+
     def _preempt_for_interactive(self) -> List[int]:
         """Every slot is busy and ``interactive`` work is queued: park
         running BEST_EFFORT streams (fewest tokens first — the
@@ -1145,8 +1616,17 @@ class ServeEngine:
         shapes, nothing recompiles — rebuild anything else the failed
         dispatch consumed (slot pool → live-slot replay; block pool →
         fresh pool + index), and charge the request a replay."""
-        self._slice = None
-        if self._prefix_on:
+        sl, self._slice = self._slice, None
+        if self._paged:
+            # A parked slice still owns its pin + private blocks (the
+            # whole-prompt paged path releases its own before raising,
+            # and then self._slice was never set).
+            if sl is not None:
+                if sl.get("private"):
+                    self._prefix.release(sl["private"])
+                if sl.get("node") is not None:
+                    self._prefix.unpin(sl["node"])
+        elif self._prefix_on:
             self._row = jax.tree.map(
                 lambda sd: jnp.zeros(sd.shape, sd.dtype),
                 _decode_cache_shapes(self._dec, 1))
@@ -1159,8 +1639,14 @@ class ServeEngine:
         path; the sliced path is :meth:`_start_slice`)."""
         replay = bool(handle.tokens)
         self._tracer.on_admit(handle, sid, replay)
-        row, logits, node = self._prefill_into_row(
-            np.asarray(handle.request.prompt, np.int32), handle)
+        prompt = np.asarray(handle.request.prompt, np.int32)
+        if self._paged:
+            logits, node, table_row, private = self._prefill_paged(
+                prompt, handle)
+            self._install_slot(sid, handle, None, logits, node,
+                               table_row=table_row, private=private)
+            return
+        row, logits, node = self._prefill_into_row(prompt, handle)
         self._install_slot(sid, handle, row, logits, node)
 
     # ------------------------------------------------ sliced admission
@@ -1174,6 +1660,19 @@ class ServeEngine:
         prompt = np.asarray(handle.request.prompt, np.int32)
         replay = bool(handle.tokens)
         self._tracer.on_admit(handle, sid, replay)
+        if self._paged:
+            # Pin + allocate now (host-only, no gather dispatch — the
+            # matched blocks are referenced in place); the pin is what
+            # keeps the chain under this admission across the decode
+            # ticks that run between slices.
+            node, m, table_row, private = self._paged_match_and_allocate(
+                prompt, handle)
+            n_cached = m * self.prefix_block_size
+            self._slice = {"handle": handle, "sid": sid, "prompt": prompt,
+                           "off": n_cached, "n_cached": n_cached,
+                           "logits": None, "node": node,
+                           "table": table_row, "private": private}
+            return self._advance_slice(self._slice)
         n_cached = 0
         if not self._degraded:
             match = self._prefix.match(
@@ -1202,7 +1701,15 @@ class ServeEngine:
         if handle.cancelled or self._expired(handle, now):
             # Not in a slot yet, so _reap cannot see it: settle here.
             # The partially-prefilled row is abandoned junk the next
-            # admission overwrites (the padded-prefill invariant).
+            # admission overwrites (the padded-prefill invariant; in
+            # paged mode the private blocks return to the free list,
+            # where their junk is unreachable until reallocated and
+            # fully rewritten).
+            if self._paged:
+                if sl.get("private"):
+                    self._prefix.release(sl["private"])
+                if sl.get("node") is not None:
+                    self._prefix.unpin(sl["node"])
             self._slice = None
             if handle.cancelled:
                 handle.state = RequestState.CANCELLED
@@ -1242,9 +1749,15 @@ class ServeEngine:
             w = min(self._chunk, plen - off)
             chunk_toks = np.zeros((1, self._chunk), np.int32)
             chunk_toks[0, :w] = prompt[off:off + w]
-            self._row, sl["logits"] = self._device_call(
-                "chunk_prefill", self._chunk_p, self._params, self._row,
-                chunk_toks, np.int32(w), np.int32(off))
+            if self._paged:
+                self._cache, sl["logits"] = self._device_call(
+                    "chunk_prefill", self._chunk_p, self._params,
+                    self._cache, chunk_toks, np.int32(w), np.int32(off),
+                    sl["table"][None])
+            else:
+                self._row, sl["logits"] = self._device_call(
+                    "chunk_prefill", self._chunk_p, self._params,
+                    self._row, chunk_toks, np.int32(w), np.int32(off))
             self._tracer.on_prefill_chunk(handle, "chunk_prefill", off, w,
                                           self._last_wall_s)
             sl["off"] = off + w
@@ -1260,6 +1773,32 @@ class ServeEngine:
         install the slot exactly like the whole-prompt path."""
         handle, sid = sl["handle"], sl["sid"]
         prompt = sl["prompt"]
+        if self._paged:
+            node = sl["node"]
+            if int(sl["n_cached"]) > 0:
+                # Recorded at FINISH like the whole-prompt path, so a
+                # mid-slice unwind + replay can never double-count.
+                self.metrics.record_copy_avoided(
+                    int(sl["n_cached"]) * self._kv_token_bytes)
+            if not self._degraded:
+                # The start-time pin survived the interleaved ticks
+                # (flush_unpinned spares pinned chains), so donation
+                # descends from it directly. While degraded, the
+                # matched blocks stay pinned-but-undonated: the table
+                # references them in place, so the pin must outlive
+                # the slot either way.
+                node = self._donate_tail_paged(
+                    prompt, node, sl["table"], sl["private"],
+                    int(sl["n_cached"]) // self.prefix_block_size)
+                self.metrics.record_prefix_lookup(
+                    int(sl["n_cached"]),
+                    blocks_live=self._prefix.blocks_live,
+                    evictions=self._prefix.evictions)
+            self._slice = None
+            self._install_slot(sid, handle, None, sl["logits"], node,
+                               table_row=sl["table"],
+                               private=sl["private"])
+            return
         node = None
         if not self._degraded:
             match = self._prefix.match(
@@ -1270,20 +1809,26 @@ class ServeEngine:
         self._install_slot(sid, handle, self._row, sl["logits"], node)
 
     def _install_slot(self, sid: int, handle: RequestHandle, row, logits,
-                      node) -> None:
+                      node, table_row=None, private=None) -> None:
         """Make a fully-prefilled row live in slot ``sid``. Two shapes:
         a FRESH request samples its first token from the prefill logits
         (that's TTFT); a REPLAYED one (``handle.tokens`` non-empty —
         fault recovery or drain/restore) rebuilt its KV from the
         prompt and re-feeds the emitted tokens through the coming
-        ticks, so no token is ever re-sampled or double-streamed."""
+        ticks, so no token is ever re-sampled or double-streamed.
+
+        Paged mode passes ``table_row``/``private`` instead of ``row``:
+        the KV is already where it lives (the pool), so there is no
+        insert dispatch at all — installation is the host-side table
+        stamp."""
         req = handle.request
         plen = len(req.prompt)
         replay = bool(handle.tokens)
         t, k, p = req.sampling.as_arrays()
         try:
-            self._cache = self._device_call(
-                "insert", self._insert_p, self._cache, row, sid, plen)
+            if not self._paged:
+                self._cache = self._device_call(
+                    "insert", self._insert_p, self._cache, row, sid, plen)
             if replay:
                 first = handle.tokens[0]
                 handle.replay_pending = list(handle.tokens[1:])
@@ -1293,9 +1838,14 @@ class ServeEngine:
                     np.float32(t), np.int32(k), np.float32(p), self._rng)
                 first = int(tok[0])
         except _SlotStateLost:
+            if self._paged and private:
+                self._prefix.release(private)
             if node is not None:
                 self._prefix.unpin(node)
             raise
+        if self._paged:
+            self._tables[sid] = table_row
+            self._private[sid] = list(private)
         self._slot_nodes[sid] = node
         if not replay:
             now = self._clock()
@@ -1365,14 +1915,22 @@ class ServeEngine:
         self._maybe_rearm_degraded()
         self._reap()
         self._admit()
+        if self._paged:
+            self._paged_append_blocks()
         live = [i for i, s in enumerate(self._slots) if s is not None]
         new_tokens = 0
         if live:
             try:
-                self._cache, nxt, self._rng = self._device_call(
-                    "tick", self._tick_p, self._params, self._cache,
-                    self._positions, self._tokens, self._temps,
-                    self._top_ks, self._top_ps, self._rng)
+                if self._paged:
+                    self._cache, nxt, self._rng = self._device_call(
+                        "tick", self._tick_p, self._params, self._cache,
+                        self._positions, self._tables, self._tokens,
+                        self._temps, self._top_ks, self._top_ps, self._rng)
+                else:
+                    self._cache, nxt, self._rng = self._device_call(
+                        "tick", self._tick_p, self._params, self._cache,
+                        self._positions, self._tokens, self._temps,
+                        self._top_ks, self._top_ps, self._rng)
             except _SlotStateLost:
                 self._lose_live_slots()
                 nxt = None
@@ -1404,6 +1962,9 @@ class ServeEngine:
         self.metrics.record_tick(
             now, self.scheduler.depth, len(live), self.max_slots,
             new_tokens, now - t0)
+        if self._paged:
+            self.metrics.record_paged_gauges(self.blocks_shared,
+                                             self.block_table_fill)
         emitted = self.metrics.tokens_emitted - emitted_before
         self.telemetry.append({
             "step": cur, "t_s": now,
@@ -1473,10 +2034,24 @@ class ServeEngine:
                          key=lambda h: h.arrival_s)
         handles.extend(self._admitting)
         handles.extend(self.scheduler.drain())
+        # Paged engines record each running slot's block table in the
+        # v3 snapshot — postmortem context (which pool blocks the
+        # stream occupied, how much was shared), never a restore input:
+        # pool storage dies with the process and the restore path
+        # rebuilds KV via replay exactly like a v2 snapshot.
+        tables = {}
+        if self._paged:
+            for sid, h in enumerate(self._slots):
+                if h is not None:
+                    row = self._tables[sid]
+                    tables[id(h)] = [int(b) for b in row[row != 0]]
         self._snapshot = {
             "version": drain_io.SNAPSHOT_VERSION,
             "drained_unix_s": time.time(),
-            "requests": [drain_io.encode_handle(h, now) for h in handles],
+            "paged": self._paged,
+            "requests": [drain_io.encode_handle(h, now,
+                                                block_table=tables.get(id(h)))
+                         for h in handles],
             # Last-moments telemetry (`obs/ring.py` summary): what the
             # engine looked like going down — postmortem context the
             # restore path ignores (`serve/drain.py`).

@@ -145,6 +145,9 @@ class FleetMetrics:
         self.routed_sticky = 0
         self.routed_affinity = 0
         self.routed_hash = 0
+        self.routed_load_balanced = 0  # interactive shed off a hot
+        #                                affinity replica (pressure-
+        #                                aware routing)
         self.shed_rerouted = 0           # QueueFull → another replica took it
         self.shed_rejected = 0           # fleet-wide full: caller rejected
         # Admission control / brownout (`fleet/admission.py`): front-
@@ -266,6 +269,13 @@ class FleetRouter:
         overload front door (per-priority token buckets, overload
         detector, brownout ladder). ``None`` (default) admits
         everything the engines will take, exactly the r11 behavior.
+      interactive_reroute_load: priority-aware routing pressure
+        threshold — when the affinity-chosen replica's assigned load
+        reaches this many requests, INTERACTIVE submissions route to
+        the least-loaded healthy replica instead of the warm cache
+        (batch / best_effort keep pure prefix affinity: they can
+        afford the queue wait the warm cache buys back). ``None``
+        (default) keeps pure affinity for every class.
     """
 
     def __init__(self, replicas: Sequence[object], *,
@@ -276,6 +286,7 @@ class FleetRouter:
                  respawn: bool = True, tracer=None,
                  max_sessions: int = 65536,
                  admission: Optional[AdmissionControl] = None,
+                 interactive_reroute_load: Optional[int] = None,
                  clock=time.monotonic):
         if not replicas:
             raise ValueError("a fleet needs at least one replica")
@@ -288,6 +299,14 @@ class FleetRouter:
         self._respawn = bool(respawn)
         self._affinity_blocks = int(affinity_blocks)
         self._block_size = int(affinity_block_size)
+        self._interactive_reroute_load = (
+            int(interactive_reroute_load)
+            if interactive_reroute_load is not None else None)
+        if (self._interactive_reroute_load is not None
+                and self._interactive_reroute_load < 1):
+            raise ValueError(
+                f"interactive_reroute_load must be >= 1, got "
+                f"{interactive_reroute_load}")
         self.metrics = FleetMetrics()
         breaker = dict(breaker or {})
         self._slots: List[_ReplicaSlot] = []
@@ -414,7 +433,9 @@ class FleetRouter:
             self._sessions.popitem(last=False)
 
     def _route(self, prompt: List[int], session: Optional[str],
-               healthy: List[_ReplicaSlot]) -> Tuple[_ReplicaSlot, str]:
+               healthy: List[_ReplicaSlot],
+               priority: Priority = Priority.INTERACTIVE,
+               ) -> Tuple[_ReplicaSlot, str]:
         if session is not None:
             stuck = self._sessions.get(session)
             if stuck is not None:
@@ -430,6 +451,20 @@ class FleetRouter:
                                    and slot.load < best.load):
                 best, best_blocks = slot, m
         if best is not None and best_blocks > 0:
+            # Priority-aware load shedding of the affinity choice: a
+            # warm cache is worth a queue wait to a BATCH request, but
+            # an INTERACTIVE one under an SLO prefers a cold prefill
+            # on an idle replica over queueing behind a hot spot. When
+            # the affinity winner's load crosses the threshold and a
+            # meaningfully lighter healthy replica exists, interactive
+            # traffic takes it instead (labeled "load" — the runbook's
+            # signal that affinity is saturating).
+            if (self._interactive_reroute_load is not None
+                    and priority is Priority.INTERACTIVE
+                    and best.load >= self._interactive_reroute_load):
+                lightest = min(healthy, key=lambda s: s.load)
+                if lightest is not best and lightest.load < best.load:
+                    return lightest, "load"
             return best, "affinity"
         return self._rendezvous(prompt, healthy), "hash"
 
@@ -457,7 +492,7 @@ class FleetRouter:
             raise NoHealthyReplica(
                 f"no healthy replica among {len(self._slots)} "
                 "(all circuits open)")
-        chosen, how = self._route(prompt, session, healthy)
+        chosen, how = self._route(prompt, session, healthy, priority)
         now = self._clock()
         if self._admission is not None:
             self._admission.update(now, self._degraded_replica_count())
@@ -533,6 +568,8 @@ class FleetRouter:
                 self.metrics.routed_sticky += 1
             elif how == "affinity":
                 self.metrics.routed_affinity += 1
+            elif how == "load":
+                self.metrics.routed_load_balanced += 1
             else:
                 self.metrics.routed_hash += 1
             if self._admission is not None:

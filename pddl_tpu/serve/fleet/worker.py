@@ -81,6 +81,10 @@ def build_engine(config: Dict[str, object]):
         # pool, NOT off — the router's affinity shadow must point at
         # caches that exist. Pass 0 explicitly to disable.
         prefix_cache_blocks=config.get("prefix_cache_blocks"),
+        # Paged attention (ISSUE 8): decode straight from the block
+        # pool through per-slot block tables; absent keeps the copy
+        # engine so existing bench configs stay comparable.
+        paged=bool(config.get("paged", False)),
         rng=jax.random.key(int(config.get("engine_seed", 0))))
 
 

@@ -15,6 +15,7 @@ from pddl_tpu.serve.kvcache.block_pool import (
     donate_prefix_blocks,
     gather_prefix_into_row,
     kv_block_pool,
+    paged_decode_cache,
     pool_nbytes,
 )
 from pddl_tpu.serve.kvcache.radix import RadixPrefixCache
@@ -24,5 +25,6 @@ __all__ = [
     "donate_prefix_blocks",
     "gather_prefix_into_row",
     "kv_block_pool",
+    "paged_decode_cache",
     "pool_nbytes",
 ]

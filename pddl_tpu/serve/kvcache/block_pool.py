@@ -30,7 +30,12 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from pddl_tpu.models.gpt import _decode_cache_shapes, is_cache_index_path
+from pddl_tpu.models.gpt import (
+    BLOCK_TABLE_KEY,
+    CACHE_INDEX_KEYS,
+    _decode_cache_shapes,
+    is_cache_index_path,
+)
 from pddl_tpu.ops.attention import cache_blocks_gather, cache_blocks_scatter
 
 # The reserved write-sink block id (see module docstring).
@@ -62,6 +67,55 @@ def kv_block_pool(dec, num_blocks: int, block_size: int):
             sd.dtype)
 
     return jax.tree_util.tree_map_with_path(_leaf, row)
+
+
+def paged_decode_cache(dec, num_blocks: int, block_size: int):
+    """The PAGED serving cache tree: the pool IS the cache.
+
+    Where :func:`kv_block_pool` builds a pool that sits BESIDE the
+    engine's resident slot cache (the copy-in/copy-out prefix cache),
+    this builds the cache tree the paged engine hands straight to
+    ``dec.apply``: every K/V leaf is a block pool
+    ``[num_blocks, ..., block_size, D]``, position counters and
+    per-slot block tables are CANONICAL PLACEHOLDERS (scalar 0 /
+    ``[1, 1]``) that every paged program re-stamps from engine-owned
+    host state on entry and restores on exit — one tree structure
+    across the fused tick ([S] counters, [S, T] tables) and the
+    batch-1 chunk prefill (scalar counter, [1, T] table), which is
+    what keeps the donated resident buffers shape-stable and the
+    program set at zero recompiles.
+
+    Block 0 stays the reserved scratch sink: parked slots' table rows
+    are all scratch, so their fixed-shape tick writes land on junk the
+    radix index never references.
+    """
+    if num_blocks < 2:
+        raise ValueError(
+            f"num_blocks must be >= 2 (block 0 is the reserved scratch "
+            f"sink), got {num_blocks}")
+    if block_size < 1:
+        raise ValueError(f"block_size must be >= 1, got {block_size}")
+    row = _decode_cache_shapes(dec, 1)
+
+    def _build(tree):
+        out = {}
+        has_kv = False
+        for key, val in tree.items():
+            name = str(key)
+            if hasattr(val, "items"):
+                out[name] = _build(val)
+            elif name in CACHE_INDEX_KEYS:
+                out[name] = jnp.zeros((), jnp.int32)
+            else:
+                has_kv = True
+                out[name] = jnp.zeros(
+                    (num_blocks,) + val.shape[1:-2]
+                    + (block_size, val.shape[-1]), val.dtype)
+        if has_kv:
+            out[BLOCK_TABLE_KEY] = jnp.zeros((1, 1), jnp.int32)
+        return out
+
+    return _build(row)
 
 
 def pool_nbytes(pool) -> int:

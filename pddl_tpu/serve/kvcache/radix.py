@@ -149,6 +149,19 @@ class RadixPrefixCache:
             j += 1
         return node, j
 
+    def chain_ids(self, node: _Node) -> List[int]:
+        """Root-path block ids of ``node``, root-first — the stored
+        chain a paged slot's block table must point at after donation
+        (the paged engine swaps duplicate private blocks onto the
+        stored chain; token-identity implies bit-identical KV, so the
+        swap is token-exact by the position-absolute cache contract)."""
+        ids: List[int] = []
+        while node is not self._root:
+            ids.append(node.block_id)
+            node = node.parent
+        ids.reverse()
+        return ids
+
     # -------------------------------------------------------- refcounts
     def pin(self, node: _Node) -> None:
         """Protect ``node`` and its whole root path from eviction (one

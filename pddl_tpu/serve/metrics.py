@@ -131,6 +131,17 @@ class ServeMetrics:
         self.prefill_tokens_saved = 0
         self.prefix_evictions = 0
         self.prefix_blocks_live = 0  # gauge, engine-stamped per admission
+        # Paged-attention telemetry (all zero on a copy-mode engine):
+        # `copy_bytes_avoided` counts the pool->slot gather bytes a
+        # prefix hit did NOT copy (matched tokens x per-token KV
+        # bytes — the admission work paging deletes); `blocks_shared`
+        # is the live gauge of pool blocks referenced by >1 slot
+        # (each one a block the copy engine would hold once PER slot —
+        # the capacity-doubling number); `block_table_fill` is the
+        # mean occupied fraction of live slots' block tables.
+        self.copy_bytes_avoided = 0
+        self.blocks_shared = 0       # gauge, engine-stamped per tick
+        self.block_table_fill = 0.0  # gauge, engine-stamped per tick
         # Resilience telemetry (`serve/faults.py`, engine retry/replay/
         # degraded paths): all zero on a fault-free engine.
         self.retries = 0             # failed device calls retried
@@ -257,6 +268,17 @@ class ServeMetrics:
         self.prefix_blocks_live = int(blocks_live)
         self.prefix_evictions = int(evictions)
 
+    def record_copy_avoided(self, nbytes: int) -> None:
+        """One paged prefix hit referenced ``nbytes`` of matched KV in
+        place instead of gathering it into a slot row."""
+        self.copy_bytes_avoided += int(nbytes)
+
+    def record_paged_gauges(self, blocks_shared: int,
+                            block_table_fill: float) -> None:
+        """Per-tick paged sharing/occupancy gauges (engine-stamped)."""
+        self.blocks_shared = int(blocks_shared)
+        self.block_table_fill = float(block_table_fill)
+
     # ------------------------------------------------------ reporting
     def snapshot(self) -> Dict[str, object]:
         """The dashboard dict: counters plus latency percentiles (None
@@ -288,6 +310,9 @@ class ServeMetrics:
             "prefill_tokens_saved": self.prefill_tokens_saved,
             "prefix_blocks_live": self.prefix_blocks_live,
             "prefix_evictions": self.prefix_evictions,
+            "copy_bytes_avoided": self.copy_bytes_avoided,
+            "blocks_shared": self.blocks_shared,
+            "block_table_fill": round(self.block_table_fill, 6),
             "retries": self.retries,
             "replays": self.replays,
             "preemptions": self.preemptions,

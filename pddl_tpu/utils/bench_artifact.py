@@ -110,9 +110,10 @@ def timed_stats(fn: Callable, sync: Callable, *,
 # higher = better) beats the "ttft" latency rule.
 _HIGHER_BETTER = ("tokens_per_s", "tokens_per_sec", "speedup", "retained",
                   "reduction", "hit_rate", "accepted", "_per_tick",
-                  "throughput", "goodput", "shed_absorbed")
+                  "throughput", "goodput", "shed_absorbed",
+                  "eliminated", "tokens_per_byte")
 _LOWER_BETTER = ("ttft", "latency", "_ms", "_wall_s", "overhead",
-                 "_seconds", "tick_s", "step_s")
+                 "_seconds", "tick_s", "step_s", "copy_us")
 _NEVER = ("spread", "samples", "per_pair", "per_repeat", "n_requests",
           "count", "injected", "provenance", "seed", "offered")
 

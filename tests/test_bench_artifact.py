@@ -46,10 +46,41 @@ def test_metric_direction_vocabulary():
     assert metric_direction("best_effort_shed_absorbed_frac") == 1
     assert metric_direction(
         "interactive_ttft_p99_overload_over_uncontended_x") == -1
+    # The r13 paged-attention headlines: duplicate-KV elimination and
+    # cache density up is better, admission TTFT/copy time down is
+    # better, and the paged-vs-gather admission ratio is a speedup.
+    assert metric_direction("duplicate_kv_eliminated_x") == 1
+    assert metric_direction("effective_cached_tokens_per_byte_paged") == 1
+    assert metric_direction("hit_admission_ttft_paged_s") == -1
+    assert metric_direction("hit_admission_speedup_x") == 1
+    assert metric_direction("admission_copy_us_row") == -1
+    # Raw byte tallies are scale context, not headlines.
+    assert metric_direction("kv_bytes_used_row") == 0
     # Noise keys are never compared.
     assert metric_direction("spread_pct") == 0
     assert metric_direction("ttft_inflation_per_pair") == 0
     assert metric_direction("n_requests") == 0
+
+
+def test_r13_paged_artifact_is_gated():
+    """The paged-attention artifact participates in the series: it
+    loads, keys into a (metric, config) group, and its capacity and
+    admission headlines are DIRECTIONAL — a future r-record at the
+    same config that regresses them fails `check_series` loudly."""
+    path = os.path.join(_BENCH_DIR, "r13_serve_paged.json")
+    records = [r for r in load_artifact(path)
+               if artifact_key(r) is not None]
+    assert records, "r13_serve_paged.json has no keyed record"
+    paged = records[0]["results"]["paged"]
+    assert paged["duplicate_kv_eliminated_x"] >= 1.8
+    # "No slower than the gather path" (ISSUE 8 acceptance): the
+    # committed median must clear parity minus the observed noise
+    # floor.
+    assert paged["hit_admission_speedup_x"] >= 0.95
+    for key in ("duplicate_kv_eliminated_x",
+                "effective_cached_tokens_per_byte_paged",
+                "hit_admission_ttft_paged_s"):
+        assert metric_direction(key) != 0, key
 
 
 def test_compare_flags_directional_regressions_only():

@@ -43,7 +43,7 @@ from pddl_tpu.serve.fleet import (
     NoHealthyReplica,
     ReplicaDied,
 )
-from pddl_tpu.serve.request import RequestState
+from pddl_tpu.serve.request import Priority, RequestState
 from conftest import ref_greedy as _ref_greedy, FakeClock as _FakeClock
 
 pytestmark = pytest.mark.fleet
@@ -63,7 +63,7 @@ def _no_sleep(_):
 
 def _local_fleet(model, variables, n, *, with_plans=False, clock=None,
                  respawn=True, tracer=None, max_queue_depth=64,
-                 breaker=None):
+                 breaker=None, **router_kw):
     """N LocalReplica fleet over one shared tiny model; each replica
     gets its own (initially empty) fault plan so tests can schedule
     surgical kills after routing settles."""
@@ -87,7 +87,8 @@ def _local_fleet(model, variables, n, *, with_plans=False, clock=None,
     fleet = FleetRouter(replicas, affinity_block_size=8, affinity_blocks=1,
                         respawn=respawn, tracer=tracer,
                         breaker=breaker,
-                        clock=clock if clock is not None else time.monotonic)
+                        clock=clock if clock is not None else time.monotonic,
+                        **router_kw)
     return fleet, plans
 
 
@@ -237,6 +238,42 @@ def test_prefix_affinity_routes_to_cache_holder(gpt_setup):
     assert fleet.metrics.routed_affinity >= 1
     fleet.run(max_steps=100)
     assert h1.tokens == _ref_greedy(model, variables, tail, 3)
+
+
+def test_priority_aware_routing_sheds_interactive_off_hot_affinity(
+        gpt_setup):
+    """ROADMAP item 5's unclaimed follow-on, made discriminative: with
+    the affinity replica under load-pressure, an INTERACTIVE request
+    abandons the warm cache for the least-loaded healthy replica
+    (labeled ``load``), while a BATCH request with the SAME warm
+    prefix keeps pure affinity — the cache is worth a queue wait only
+    to traffic without an interactive SLO."""
+    model, variables = gpt_setup
+    fleet, _ = _local_fleet(model, variables, 2,
+                            interactive_reroute_load=2)
+    shared = ((np.arange(12) * 3 + 5) % 32).astype(np.int32)
+    h0 = fleet.submit(shared, 3)
+    hot = h0.replica_id
+    fleet.run(max_steps=100)
+
+    def _variant(t):
+        return np.concatenate([shared[:8], [t]]).astype(np.int32)
+
+    # Pile un-stepped load onto the warm replica (affinity routes the
+    # shared head straight back to it).
+    pressure = [fleet.submit(_variant(2 + i), 4) for i in range(2)]
+    assert all(h.replica_id == hot for h in pressure)
+    # Batch priority, same warm prefix, same pressure: stays put.
+    hb = fleet.submit(_variant(20), 3, priority=Priority.BATCH)
+    assert hb.replica_id == hot
+    assert fleet.metrics.routed_load_balanced == 0
+    # Interactive under the same pressure: least-loaded replica wins.
+    hi = fleet.submit(_variant(21), 3)
+    assert hi.replica_id != hot
+    assert fleet.metrics.routed_load_balanced == 1
+    fleet.run(max_steps=300)
+    for h, t in [(hb, 20), (hi, 21)]:
+        assert h.tokens == _ref_greedy(model, variables, _variant(t), 3)
 
 
 def test_sticky_sessions_and_rendezvous_determinism(gpt_setup):

@@ -1,0 +1,471 @@
+"""True paged attention (`ops/attention.paged_*` + ``ServeEngine(paged=True)``).
+
+The contracts under test:
+
+- **Op-level numerics**: `paged_decode_attention`'s jnp reference path
+  equals dense `decode_attention` over the equivalent contiguous cache
+  (GQA, per-row depths, sliding window, multi-token chunks), and the
+  Pallas kernel (interpret mode on CPU) equals the reference — the
+  tier-1 oracle chain the TPU hot path hangs off.
+- **Write discipline**: `paged_cache_insert` lands each token in its
+  table-mapped block; padding junk beyond the table deflects to the
+  scratch sink and can never corrupt a real block.
+- **Engine token-exactness**: the paged engine — no resident slot
+  cache, prefix hits PINNED in place, suffix blocks appended in place,
+  donation a pure refcount hand-off — emits exactly what the
+  resident-row engine and one-shot ``generate()`` emit, across
+  GPT/Llama/int8 and across cold, prefix-hit, preempted, and replayed
+  streams.
+- **Sharing with zero copies**: concurrent shared-prefix streams
+  reference the SAME pool blocks (``blocks_shared`` > 0), admission
+  records the gather bytes it no longer pays (``copy_bytes_avoided``),
+  and a block-aligned repeat dedups onto the stored chain instead of
+  growing the pool.
+- **Resilience parity**: the 3-seed chaos matrix, drain/restore (v3
+  snapshots carry block tables; v2 snapshots restore through the same
+  replay path), and the zero-recompile pin all hold in paged mode.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from conftest import ref_greedy as _ref_greedy
+from pddl_tpu.models.gpt import tiny_gpt
+from pddl_tpu.models.llama import tiny_llama
+from pddl_tpu.obs.export import parse_prometheus_text, serve_exposition
+from pddl_tpu.ops.attention import (
+    decode_attention,
+    paged_cache_insert,
+    paged_decode_attention,
+    paged_decode_attention_kernel,
+)
+from pddl_tpu.serve import ServeEngine
+from pddl_tpu.serve.faults import FaultPlan
+from pddl_tpu.serve.request import Priority, RequestState
+
+pytestmark = pytest.mark.paged
+
+_no_sleep = lambda s: None  # noqa: E731
+
+
+@pytest.fixture(scope="module")
+def gpt_setup():
+    model = tiny_gpt(vocab_size=32, max_len=64)
+    prompt = jnp.ones((1, 8), jnp.int32)
+    params = model.init(jax.random.key(0), prompt, train=False)["params"]
+    return model, {"params": params}
+
+
+@pytest.fixture(scope="module")
+def llama_setup():
+    model = tiny_llama(vocab_size=32, max_len=64)
+    prompt = jnp.ones((1, 8), jnp.int32)
+    params = model.init(jax.random.key(1), prompt, train=False)["params"]
+    return model, {"params": params}
+
+
+# ------------------------------------------------------------- op level
+def _random_paged(rng, b, hkv, bs, t, d):
+    """A pool + disjoint per-row linear tables + the DENSE cache they
+    spell (the oracle's view)."""
+    n = 1 + b * t
+    kp = jnp.asarray(rng.randn(n, hkv, bs, d), jnp.float32)
+    vp = jnp.asarray(rng.randn(n, hkv, bs, d), jnp.float32)
+    table = np.zeros((b, t), np.int32)
+    for i in range(b):
+        table[i] = 1 + i * t + np.arange(t)
+    kc = np.asarray(kp)[table].transpose(0, 2, 1, 3, 4).reshape(
+        b, hkv, t * bs, d)
+    vc = np.asarray(vp)[table].transpose(0, 2, 1, 3, 4).reshape(
+        b, hkv, t * bs, d)
+    return kp, vp, table, jnp.asarray(kc), jnp.asarray(vc)
+
+
+@pytest.mark.parametrize("hq,hkv", [(4, 4), (4, 2)])
+def test_paged_reference_matches_dense_decode(hq, hkv):
+    """Per-row depths (the serving tick's shape), MHA and GQA: the
+    paged jnp path == decode_attention over the equivalent contiguous
+    cache."""
+    rng = np.random.RandomState(0)
+    b, bs, t, d = 3, 4, 6, 8
+    kp, vp, table, kc, vc = _random_paged(rng, b, hkv, bs, t, d)
+    q = jnp.asarray(rng.randn(b, hq, 1, d), jnp.float32)
+    index = np.array([5, 17, 0], np.int32)
+    ref = decode_attention(q, kc, vc, index)
+    got = paged_decode_attention(q, kp, vp, table, index, kernel=False,
+                                 blocks_per_chunk=2)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_paged_reference_multi_token_and_window():
+    """The chunk-prefill shape (batch-1, s>1 at a scalar offset) and
+    sliding-window masking both match the dense oracle."""
+    rng = np.random.RandomState(1)
+    b, hkv, bs, t, d, s = 1, 2, 4, 6, 8, 5
+    kp, vp, table, kc, vc = _random_paged(rng, b, hkv, bs, t, d)
+    q = jnp.asarray(rng.randn(b, 4, s, d), jnp.float32)
+    ref = decode_attention(q, kc, vc, np.int32(7))
+    got = paged_decode_attention(q, kp, vp, table, np.int32(7),
+                                 kernel=False, blocks_per_chunk=3)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+    q1 = jnp.asarray(rng.randn(b, 4, 1, d), jnp.float32)
+    ref_w = decode_attention(q1, kc, vc, np.int32(13), window=6)
+    got_w = paged_decode_attention(q1, kp, vp, table, np.int32(13),
+                                   window=6, kernel=False)
+    np.testing.assert_allclose(np.asarray(got_w), np.asarray(ref_w),
+                               rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("hq,hkv", [(4, 4), (4, 2)])
+def test_paged_kernel_matches_reference(hq, hkv):
+    """The Pallas kernel (scalar-prefetched block table driving the
+    K/V index maps), interpret mode on CPU, == the jnp oracle — per-row
+    depths including a zero-depth (freshly admitted) row."""
+    rng = np.random.RandomState(2)
+    b, bs, t, d = 3, 4, 6, 8
+    kp, vp, table, kc, vc = _random_paged(rng, b, hkv, bs, t, d)
+    q = jnp.asarray(rng.randn(b, hq, 1, d), jnp.float32)
+    index = np.array([23, 0, 8], np.int32)
+    ref = paged_decode_attention(q, kp, vp, table, index, kernel=False)
+    got = paged_decode_attention_kernel(q, kp, vp, table, index,
+                                        interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_paged_cache_insert_and_scratch_deflection():
+    """Each slot's token lands at (table[pos//bs], pos%bs); positions
+    past the table land in the scratch sink, and no real block outside
+    the write set changes."""
+    rng = np.random.RandomState(3)
+    b, hkv, bs, t, d = 3, 2, 4, 6, 8
+    kp, vp, table, _, _ = _random_paged(rng, b, hkv, bs, t, d)
+    index = np.array([5, 17, 0], np.int32)
+    kv = jnp.asarray(rng.randn(b, hkv, 1, d), jnp.float32)
+    out = paged_cache_insert(kp, kv, table, index)
+    for i in range(b):
+        got = np.asarray(out[table[i, index[i] // bs], :, index[i] % bs])
+        np.testing.assert_array_equal(got, np.asarray(kv[i, :, 0]))
+    # Batch-1 multi-token chunk write (the block-granular RMW path):
+    # tokens land contiguously at their (block, offset) homes...
+    kv2 = jnp.asarray(rng.randn(1, hkv, 10, d), jnp.float32)
+    start = 9  # mid-block start, spans blocks 2..4
+    out2 = paged_cache_insert(kp, kv2, table[:1], np.int32(start))
+    for j in range(10):
+        pos = start + j
+        got = np.asarray(out2[table[0, pos // bs], :, pos % bs])
+        np.testing.assert_array_equal(got, np.asarray(kv2[0, :, j]))
+    # ...earlier tokens in the first span block survive the RMW...
+    np.testing.assert_array_equal(
+        np.asarray(out2[table[0, start // bs], :, : start % bs]),
+        np.asarray(kp[table[0, start // bs], :, : start % bs]))
+    # ...and a write running off the table's end deflects to scratch:
+    # no real block outside row 0's own table changes.
+    out3 = paged_cache_insert(kp, kv2, table[:1], np.int32(t * bs - 3))
+    np.testing.assert_array_equal(np.asarray(out3[1 + t:]),
+                                  np.asarray(kp[1 + t:]))
+    # The in-table tail tokens still landed.
+    for j in range(3):
+        pos = t * bs - 3 + j
+        got = np.asarray(out3[table[0, pos // bs], :, pos % bs])
+        np.testing.assert_array_equal(got, np.asarray(kv2[0, :, j]))
+
+
+# --------------------------------------------------------- engine level
+def _paged_engine(model, variables, **kw):
+    kw.setdefault("max_slots", 2)
+    kw.setdefault("prefill_len", 16)
+    return ServeEngine(model, variables, paged=True, **kw)
+
+
+def _exactness_workload(model, variables, ref_variables=None, **engine_kw):
+    """Cold admit, full-chain re-hit, partial hit — the paged twin of
+    `test_prefix_cache._exactness_workload`, pinned against the same
+    generate() oracle."""
+    ref_variables = ref_variables or variables
+    eng = _paged_engine(model, variables, **engine_kw)
+    base = (np.arange(12) * 5 + 1) % 32
+    sibling = np.concatenate([base[:8], (np.arange(6) + 17) % 32])
+    h_cold = eng.submit(base, 6)
+    eng.run(max_steps=100)
+    h_hit = eng.submit(base, 6)
+    h_part = eng.submit(sibling, 6)
+    eng.run(max_steps=100)
+    assert h_cold.tokens == _ref_greedy(model, ref_variables, base, 6)
+    assert h_hit.tokens == _ref_greedy(model, ref_variables, base, 6)
+    assert h_part.tokens == _ref_greedy(model, ref_variables, sibling, 6)
+    # Not vacuous: the hits referenced cached blocks in place.
+    assert eng.metrics.prefix_hits >= 2
+    assert eng.metrics.copy_bytes_avoided > 0
+    return eng
+
+
+@pytest.fixture(scope="module")
+def exact_gpt(gpt_setup):
+    """One warmed paged GPT engine, driven through the exactness
+    workload — shared by the pins that only READ its end state
+    (program set, metrics exposition), so the suite compiles one
+    engine for the three of them."""
+    model, variables = gpt_setup
+    return _exactness_workload(model, variables)
+
+
+def test_paged_token_exact_gpt(exact_gpt, pin_zero_recompiles):
+    eng = pin_zero_recompiles(exact_gpt)
+    assert eng.paged
+    # The paged program set: no gather, no insert, no donate scatter.
+    assert set(eng.compile_counts()) <= {
+        "tick", "sample_first", "chunk_prefill", "chunk_prefill_wide"}
+
+
+def test_paged_token_exact_llama(llama_setup):
+    """GQA + RoPE: post-RoPE keys are position-absolute, so a SHARED
+    pool block read through two different slots' tables is bit-valid
+    for both."""
+    model, variables = llama_setup
+    _exactness_workload(model, variables)
+
+
+@pytest.mark.parametrize("family", ["gpt", "llama"])
+def test_paged_int8_token_exact(family, gpt_setup, llama_setup):
+    """int8 param_transform composes: what the pool stores is K/V,
+    which int8 weight storage never touches; dequant runs inside the
+    paged chunk/tick programs."""
+    from pddl_tpu.ops.quant import dequantize, quantize_int8
+
+    model, variables = gpt_setup if family == "gpt" else llama_setup
+    qparams = quantize_int8(variables["params"], min_elems=128)
+    dense = {"params": dequantize(qparams)}
+    _exactness_workload(model, {"params": qparams}, ref_variables=dense,
+                        param_transform=dequantize)
+
+
+def test_paged_equals_resident_row_engine(gpt_setup):
+    """THE oracle pin the ISSUE names: the same mixed workload through
+    a paged and a resident-row engine, stream-for-stream identical."""
+    model, variables = gpt_setup
+    prompts = [((np.arange(9 + i) * 3 + 5 * i + 1) % 32) for i in range(5)]
+    prompts.append(prompts[0].copy())  # a full-chain re-hit
+    streams = {}
+    for mode in ("paged", "row"):
+        eng = ServeEngine(model, variables, max_slots=2, prefill_len=16,
+                          paged=(mode == "paged"))
+        hs = [eng.submit(p, 5) for p in prompts]
+        eng.run(max_steps=300)
+        streams[mode] = [h.tokens for h in hs]
+    assert streams["paged"] == streams["row"]
+
+
+def test_concurrent_shared_prefix_blocks_shared_in_place(gpt_setup):
+    """Many live slots on one warm prefix: the matched blocks exist
+    ONCE (blocks_shared counts them), table occupancy is reported, and
+    every stream is token-exact — the capacity story of paged mode as
+    an observable, not a slogan."""
+    model, variables = gpt_setup
+    eng = _paged_engine(model, variables, max_slots=4)
+    base = (np.arange(12) * 5 + 1) % 32
+    warm = eng.submit(base, 3)
+    eng.run(max_steps=60)
+    assert warm.tokens == _ref_greedy(model, variables, base, 3)
+    variants = [np.concatenate([base[:8], [(i * 7 + 3) % 32]])
+                for i in range(4)]
+    hs = [eng.submit(v, 6) for v in variants]
+    shared_seen, fill_seen = 0, 0.0
+    while eng.has_work:
+        eng.step()
+        shared_seen = max(shared_seen, eng.blocks_shared)
+        fill_seen = max(fill_seen, eng.block_table_fill)
+    for h, v in zip(hs, variants):
+        assert h.tokens == _ref_greedy(model, variables, v, 6)
+    assert shared_seen >= 1          # the warm block was referenced >1x
+    assert 0.0 < fill_seen <= 1.0
+    assert eng.metrics.blocks_shared >= 0  # gauge stamped per tick
+    assert eng.metrics.copy_bytes_avoided > 0
+
+
+def test_block_aligned_repeat_never_grows_a_paged_pool(gpt_setup):
+    """The paged twin of the donation-dedup pin: re-admitting a
+    block-aligned prompt swaps the slot's table onto the stored chain
+    and RELEASES the duplicate private blocks, so repeats hold the
+    pool at its deduplicated size (no eviction churn, live == 2)."""
+    model, variables = gpt_setup
+    eng = _paged_engine(model, variables, max_slots=1)
+    p = (np.arange(16) * 3 + 5) % 32  # 2 full blocks at bs=8
+    for _ in range(3):
+        h = eng.submit(p, 3)
+        eng.run(max_steps=50)
+        assert h.tokens == _ref_greedy(model, variables, p, 3)
+    assert eng.metrics.prefix_evictions == 0
+    assert eng.metrics.prefix_blocks_live == 2
+    assert eng.metrics.prefix_hits == 2
+
+
+def test_paged_preemption_resumes_token_exact(gpt_setup):
+    """A parked (preempted) best_effort stream resumes token-exactly
+    through replay admission — its freed private blocks went back to
+    the pool and were fully rewritten on re-admission."""
+    model, variables = gpt_setup
+    eng = _paged_engine(model, variables, max_slots=1)
+    pb = (np.arange(8) * 5 + 4) % 32
+    hbe = eng.submit(pb, 10, priority=Priority.BEST_EFFORT)
+    for _ in range(3):
+        eng.step()
+    pi = (np.arange(8) * 11 + 6) % 32
+    hint = eng.submit(pi, 4, priority=Priority.INTERACTIVE)
+    eng.run(max_steps=300)
+    assert eng.metrics.preemptions >= 1
+    assert hbe.tokens == _ref_greedy(model, variables, pb, 10)
+    assert hint.tokens == _ref_greedy(model, variables, pi, 4)
+
+
+def test_paged_sliced_admission_token_exact(gpt_setup, pin_zero_recompiles):
+    """Chunked-prefill fairness composes: slices write straight into
+    the slot's pool blocks across interleaved ticks, pin held from
+    slice start (flush spares pinned chains)."""
+    model, variables = gpt_setup
+    eng = pin_zero_recompiles(_paged_engine(
+        model, variables, prefill_slice_tokens=4, prefix_chunk=4))
+    p = (np.arange(15) * 3 + 1) % 32
+    ha = eng.submit(p, 6)
+    hb = eng.submit(p, 6)
+    eng.run(max_steps=300)
+    assert ha.tokens == _ref_greedy(model, variables, p, 6)
+    assert hb.tokens == _ref_greedy(model, variables, p, 6)
+
+
+def test_paged_pool_size_validation(gpt_setup):
+    """paged without the pool machinery, or with a pool the live
+    streams could starve, fails LOUDLY at construction."""
+    model, variables = gpt_setup
+    with pytest.raises(ValueError, match="paged=True needs"):
+        ServeEngine(model, variables, max_slots=2, prefill_len=16,
+                    paged=True, prefix_cache_blocks=0)
+    with pytest.raises(ValueError, match="starve"):
+        ServeEngine(model, variables, max_slots=2, prefill_len=16,
+                    paged=True, prefix_cache_blocks=4)
+
+
+# ----------------------------------------------------------- resilience
+@pytest.mark.chaos
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_paged_chaos_matrix(gpt_setup, pin_zero_recompiles, seed):
+    """The mixed chaos profile in paged mode: every request terminal,
+    survivors token-exact, zero recompiles across retry / replay /
+    degraded / pool-rebuild transitions."""
+    model, variables = gpt_setup
+    plan = FaultPlan(seed=seed, sleep_fn=_no_sleep, transient_rate=0.05,
+                     oom_rate=0.02, latency_rate=0.1, latency_s=1e-4,
+                     max_random_injections=20)
+    eng = pin_zero_recompiles(_paged_engine(
+        model, variables, fault_plan=plan, backoff_sleep=_no_sleep))
+    jobs = []
+    for i in range(5):
+        p = (np.arange(10) * 3 + i * 7 + 1) % 32
+        jobs.append((p, eng.submit(p, 5)))
+    eng.run(max_steps=600)
+    assert not eng.has_work, "engine failed to drain under chaos"
+    for p, h in jobs:
+        assert h.done, f"request {h} never reached a terminal state"
+        if h.state == RequestState.FINISHED:
+            assert h.tokens == _ref_greedy(model, variables, p, 5)
+
+
+def test_parked_slice_survives_paged_pool_reset(gpt_setup,
+                                                pin_zero_recompiles):
+    """A mid-prefill slice parked across steps must NOT leak its
+    (retired) block ids or radix node into the rebuilt paged world
+    when a tick fault forces the full pool reset: the slice is
+    dropped pre-reset and its handle re-admits from scratch against
+    the fresh pool — every stream still terminal and token-exact, no
+    double-owned blocks (the refcount invariants would trip on a
+    re-allocated duplicate)."""
+    from pddl_tpu.serve.faults import FaultKind
+
+    model, variables = gpt_setup
+    plan = FaultPlan(sleep_fn=_no_sleep)
+    eng = pin_zero_recompiles(_paged_engine(
+        model, variables, prefill_slice_tokens=4, prefix_chunk=4,
+        fault_plan=plan, backoff_sleep=_no_sleep, max_retries=0))
+    p_live = (np.arange(8) * 5 + 4) % 32
+    p_sliced = (np.arange(15) * 3 + 1) % 32
+    h_live = eng.submit(p_live, 8)
+    while eng.live_slots < 1:  # h_live fully admitted, now decoding
+        eng.step()
+    h_sliced = eng.submit(p_sliced, 4)
+    eng.step()
+    # White-box arm: the second admission must be PARKED mid-prefill
+    # (15 tokens at 4/step), holding private ids + a table row; now a
+    # single un-retryable transient at the NEXT tick forces the
+    # live-slot replay and the full paged-world rebuild underneath it.
+    assert eng._slice is not None
+    plan._sched[(eng._step_idx, "tick")] = [FaultKind.TRANSIENT]
+    eng.run(max_steps=400)
+    assert h_live.done and h_sliced.done
+    assert h_live.tokens == _ref_greedy(model, variables, p_live, 8)
+    assert h_sliced.tokens == _ref_greedy(model, variables, p_sliced, 4)
+    assert eng.metrics.replays >= 1  # the reset really happened
+
+
+def test_paged_drain_restore_round_trip(gpt_setup):
+    """v3 snapshot: carries ``paged`` + each running slot's block
+    table (postmortem context); restore into a fresh paged engine
+    resumes token-exactly via replay. A v2-shaped snapshot (no
+    tables — the copy engine's format) restores through the SAME
+    path."""
+    model, variables = gpt_setup
+    eng1 = _paged_engine(model, variables)
+    p1 = (np.arange(11) * 5 + 2) % 32
+    p2 = (np.arange(9) * 7 + 3) % 32
+    eng1.submit(p1, 8)
+    eng1.submit(p2, 8)
+    for _ in range(3):
+        eng1.step()
+    snap = eng1.drain()
+    assert snap["version"] == 3
+    assert snap["paged"] is True
+    running = [e for e in snap["requests"] if e.get("tokens")]
+    assert running and all("block_table" in e for e in running)
+    assert all(0 not in e["block_table"] for e in running)
+
+    eng2 = _paged_engine(model, variables)
+    rh = eng2.restore(snap)
+    eng2.run(max_steps=300)
+    assert rh[0].tokens == _ref_greedy(model, variables, p1, 8)
+    assert rh[1].tokens == _ref_greedy(model, variables, p2, 8)
+
+    # v2 copy-path snapshot into a paged engine: same replay restore.
+    snap_v2 = dict(snap)
+    snap_v2["version"] = 2
+    snap_v2.pop("paged")
+    snap_v2["requests"] = [
+        {k: v for k, v in e.items() if k != "block_table"}
+        for e in snap["requests"]]
+    eng3 = _paged_engine(model, variables)
+    rh3 = eng3.restore(snap_v2)
+    eng3.run(max_steps=300)
+    assert rh3[0].tokens == _ref_greedy(model, variables, p1, 8)
+    assert rh3[1].tokens == _ref_greedy(model, variables, p2, 8)
+
+
+# -------------------------------------------------------- observability
+def test_paged_metrics_reach_the_exposition(exact_gpt):
+    """blocks_shared / copy_bytes_avoided / block_table_fill flow
+    through ServeMetrics AND the engine gauges into the Prometheus
+    body, round-tripped through the strict referee parser (over the
+    shared exactness engine's end state — its workload recorded hits
+    and sharing)."""
+    eng = exact_gpt
+    text = serve_exposition(eng.metrics, eng)
+    samples, types = parse_prometheus_text(text)
+    flat = {name: v for (name, labels), v in samples.items() if not labels}
+    assert flat["pddl_serve_copy_bytes_avoided_total"] > 0
+    assert types["pddl_serve_copy_bytes_avoided_total"] == "counter"
+    assert "pddl_serve_blocks_shared" in flat
+    assert "pddl_serve_block_table_fill" in flat
+    assert flat["pddl_serve_engine_paged"] == 1
+    assert "pddl_serve_engine_blocks_shared" in flat
+    assert "pddl_serve_engine_block_table_fill" in flat

@@ -243,9 +243,10 @@ def test_queue_full_hint_is_rank_monotone(gpt_setup):
 
 # --------------------------------------------------- versioned snapshots
 def test_drain_snapshot_roundtrips_priority_and_deadline(gpt_setup):
-    """v2 wire format: priority + deadline fields survive the
-    drain→restore round trip (the fleet migration path inherits this
-    for free — `serve/drain.py` IS its wire format)."""
+    """Priority + deadline fields (the v2 additions) survive the
+    drain→restore round trip at the CURRENT snapshot version (v3 since
+    the paged engine — the fleet migration path inherits this for free:
+    `serve/drain.py` IS its wire format)."""
     model, variables = gpt_setup
     clock_a = _FakeClock()
     eng_a = ServeEngine(model, variables, max_slots=1, prefill_len=16,
@@ -257,7 +258,7 @@ def test_drain_snapshot_roundtrips_priority_and_deadline(gpt_setup):
     eng_a.step()
     clock_a.now = 4.0
     snapshot = eng_a.drain()
-    assert snapshot["version"] == drain_io.SNAPSHOT_VERSION == 2
+    assert snapshot["version"] == drain_io.SNAPSHOT_VERSION == 3
     by_len = {len(e["prompt"]): e for e in snapshot["requests"]}
     assert by_len[6]["priority"] == "batch"
     assert by_len[6]["deadline_s"] == 30.0
