@@ -633,6 +633,68 @@ def prefill_row_from(dec, params, prompt, length, row_cache, start, *,
     return mutated["cache"], last
 
 
+def lm_head_logits(model, params, feats):
+    """The LM head applied OUTSIDE the module: pre-head features
+    (``features_only=True`` apply output — post-final-norm, already in
+    the model's compute dtype) → vocab logits, mirroring
+    ``_GPTHead``/``_LlamaHead`` operation-for-operation (same
+    ``dot_general`` contraction, same bias/padding-slice/f32-cast
+    order), so the computed logits match the in-module head exactly.
+
+    This is the multi-tenant serving hook point (`serve/tenant/`): the
+    tenant engine's compiled programs run the model ``features_only``,
+    apply the head here, and then ADD per-slot LoRA deltas
+    (:func:`pddl_tpu.ops.lora.batched_lora_delta`) and grammar masks
+    before sampling — all runtime data, no program-shape variation.
+    ``params`` must already be transform-applied (the int8
+    ``param_transform`` runs BEFORE this, like everywhere else).
+    Bias-free heads (the Llama family) simply have no ``bias`` key.
+    """
+    head = params["lm_head"]
+    x = feats.astype(model.dtype)
+    kernel = head["kernel"].astype(model.dtype)
+    logits = jax.lax.dot_general(
+        x, kernel, (((x.ndim - 1,), (0,)), ((), ())))
+    if "bias" in head:
+        logits = logits + head["bias"].astype(model.dtype)
+    return logits[..., :model.vocab_size].astype(jnp.float32)
+
+
+def prefill_row_features(dec, params, prompt, length, row_cache, start, *,
+                         param_transform=None):
+    """The tenant twin of :func:`prefill_row`/:func:`prefill_row_from`:
+    one prefill chunk that ALSO returns the last position's pre-head
+    features, so the caller can compose LoRA deltas into the sampled
+    logits. ``row_cache=None`` starts a fresh batch-1 cache (the
+    whole-prompt ``prefill_row`` shape); otherwise the chunk continues
+    the given cache at global offset ``start`` (``prefill_row_from``
+    semantics, same clamping caveats).
+
+    Returns ``(row_cache, last_logits [1, V], last_feats [1, d])``.
+    The logits are computed through :func:`lm_head_logits` over the
+    full chunk and sliced at ``length - 1`` — the identical op shapes
+    the in-module head produces, so a no-adapter tenant admission is
+    bit-identical to the plain prefill path.
+    """
+    pt = param_transform or (lambda p: p)
+    p2 = pt(params)
+    if row_cache is None:
+        cache = jax.tree.map(lambda sd: jnp.zeros(sd.shape, sd.dtype),
+                             _decode_cache_shapes(dec, 1))
+    else:
+        cache = set_cache_positions(row_cache,
+                                    jnp.asarray(start, jnp.int32))
+    feats, mutated = dec.apply(
+        {"params": p2, "cache": cache}, prompt,
+        train=False, mutable=["cache"], features_only=True)
+    logits = lm_head_logits(dec, p2, feats)
+    last = jax.lax.dynamic_slice(
+        logits, (0, length - 1, 0), (1, 1, logits.shape[-1]))[:, 0]
+    last_feats = jax.lax.dynamic_slice(
+        feats, (0, length - 1, 0), (1, 1, feats.shape[-1]))[:, 0]
+    return mutated["cache"], last, last_feats
+
+
 @functools.lru_cache(maxsize=16)
 def _decode_cache_shapes(dec, batch: int):
     """KV-cache ShapeDtypeStructs for a decode module at a batch size.

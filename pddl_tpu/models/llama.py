@@ -406,6 +406,13 @@ class Llama(nn.Module):
     ``dtype`` attributes, ``features_only`` apply mode, ``lm_head``
     param naming — so :func:`pddl_tpu.models.gpt.generate` and
     :func:`pddl_tpu.models.gpt.fused_lm_loss` work on it unchanged.
+    The same contract is the MULTI-TENANT serving hook
+    (`serve/tenant/`): :func:`pddl_tpu.models.gpt.lm_head_logits` and
+    :func:`~pddl_tpu.models.gpt.prefill_row_features` reproduce
+    :class:`_LlamaHead` op-for-op from the ``features_only`` output
+    (bias-free ``lm_head``, padded-vocab slice, f32 cast — keep the
+    three in sync), which is what lets per-slot LoRA deltas and
+    grammar masks compose onto Llama logits token-exactly.
     """
 
     vocab_size: int

@@ -112,6 +112,11 @@ SERVE_COUNTER_KEYS = frozenset({
     "prefill_tokens_saved", "prefix_evictions", "retries", "replays",
     "preemptions", "degraded_entries", "degraded_time_s",
     "copy_bytes_avoided",
+    # Multi-tenant counters (`serve/tenant/`): adapter pool traffic and
+    # constrained-decoding volume. (adapter_hit_rate / the residency
+    # gauge / requests_by_adapter stay gauges.)
+    "adapter_hits", "adapter_loads", "adapter_evictions",
+    "constrained_requests", "requests_grammar_complete",
 })
 
 _NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
@@ -163,6 +168,15 @@ def render_prometheus(snapshot: Mapping[str, object], *,
             lines.append(f"# HELP {name} {help_text[key]}")
         if isinstance(value, Mapping):
             lines.append(f"# TYPE {name} gauge")
+            if not value:
+                # An OPEN label set with no members yet (e.g.
+                # requests_by_adapter before any tenant traffic) still
+                # exports its metric name — one NaN sample under an
+                # empty label, the same present-but-unobserved
+                # philosophy as None -> NaN — so the snapshot-drift
+                # guard (and a scrape differ) can tell "no labels yet"
+                # from "metric vanished".
+                lines.append(f'{name}{{key=""}} NaN')
             for label_val in sorted(value):
                 lines.append(
                     f'{name}{{key="{_escape_label(str(label_val))}"}} '
@@ -257,6 +271,12 @@ def engine_gauges(engine) -> Dict[str, object]:
         "paged": getattr(engine, "paged", False),
         "blocks_shared": getattr(engine, "blocks_shared", 0),
         "block_table_fill": getattr(engine, "block_table_fill", 0.0),
+        # Multi-tenant gauges (False/0 on a plain engine): whether the
+        # tenant path is compiled in, and how many adapters are
+        # device-resident right now (`serve/tenant/`).
+        "tenant": getattr(engine, "tenant_enabled", False),
+        "adapter_pool_resident": getattr(engine, "adapter_pool_resident",
+                                         0),
         "compile_counts": engine.compile_counts(),
     }
 
@@ -314,7 +334,7 @@ FLEET_COUNTER_KEYS = frozenset({
     "replica_up_events", "replica_down_events", "migrations",
     "requests_migrated", "migrated_via_drain", "migrated_via_replay",
     "requests_routed", "routed_sticky", "routed_affinity", "routed_hash",
-    "routed_load_balanced",
+    "routed_load_balanced", "routed_adapter",
     "shed_rerouted", "shed_rejected", "requests_finished",
     "requests_failed", "requests_orphaned", "heartbeat_failures",
     "probes", "probe_failures", "tokens_streamed",

@@ -1,0 +1,91 @@
+"""Batched LoRA gather-matmul: the device half of per-request adapters.
+
+S-LoRA (Sheng et al., 2023) shows that thousands of LoRA adapters can
+share ONE batched forward pass when the adapter weights live in a
+fixed-shape device pool and each batch row gathers its own factors by
+integer id — the exact pattern the serving engine already uses for
+per-slot sampling params and KV block tables: all per-slot variation
+is RUNTIME DATA, never compiled-program shape. These ops are that
+pattern applied to low-rank weight deltas.
+
+Pool layout (see `pddl_tpu/serve/tenant/adapters.py` for the host-side
+registry/refcount/LRU machinery):
+
+    pool_a  [P, d, r]   down-projection factors, one row per pool slot
+    pool_b  [P, r, V]   up-projection factors (scale pre-folded)
+
+Row 0 is the reserved IDENTITY row (all zeros — the "no adapter" case,
+mirroring the KV block pool's scratch-block-0 convention): a slot whose
+adapter id is 0 computes ``(h @ 0) @ 0 == 0`` and adds an exact float
+zero to its logits, so unadapted requests in a mixed batch are
+bit-identical to the base model with no branch in the compiled program.
+
+The adapted matrix in this repo's v1 tenancy scope is the LM HEAD
+(``delta_logits = (h @ A) @ B``): adapting only the output projection
+keeps every KV cache entry ADAPTER-INVARIANT — K/V remain pure
+functions of (prompt tokens, position, base params) — which is what
+lets the prefix cache and the paged block pool keep sharing prompt KV
+ACROSS tenants (an attention-projection LoRA would make shared blocks
+wrong for every other adapter). See docs/SERVING.md § "Multi-tenant
+serving" for the trade-off discussion.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+# The reserved identity pool row (all-zero factors = base model); the
+# host-side adapter pool never assigns it. Mirrors
+# `serve/kvcache/block_pool.SCRATCH_BLOCK`.
+IDENTITY_ROW = 0
+
+
+def batched_lora_delta(feats, pool_a, pool_b, rows):
+    """Per-row low-rank logit deltas for one fused serving tick.
+
+    Args:
+      feats: ``[B, d]`` pre-head features (post-final-norm — the tensor
+        the LM head consumes).
+      pool_a: ``[P, d, r]`` pooled down factors.
+      pool_b: ``[P, r, V]`` pooled up factors (scaling pre-folded).
+      rows: ``[B]`` int32 pool-row ids (0 = identity/no adapter).
+
+    Returns ``[B, V]`` float32 deltas to add to the base logits. All of
+    ``rows`` is runtime data: one compiled program serves every tenant
+    mix, and gathers cost O(B·(d·r + r·V)) regardless of how many
+    adapters are registered.
+    """
+    a = jnp.take(pool_a, rows, axis=0)  # [B, d, r]
+    b = jnp.take(pool_b, rows, axis=0)  # [B, r, V]
+    z = jnp.einsum("bd,bdr->br", feats.astype(jnp.float32), a)
+    return jnp.einsum("br,brv->bv", z, b)
+
+
+def adapter_pool_load(pool_a, pool_b, row, a, b):
+    """Load one adapter's factors into pool row ``row`` (runtime value —
+    one compiled program loads into any slot). Returns the updated
+    ``(pool_a, pool_b)``; NOT donated by the engine on purpose: the
+    update copies, so a faulted load can simply retry against the
+    intact old pool (no consumed-buffer hazard, unlike the KV trees)."""
+    row = jnp.asarray(row, jnp.int32)
+    return (jax.lax.dynamic_update_index_in_dim(
+                pool_a, a.astype(pool_a.dtype), row, 0),
+            jax.lax.dynamic_update_index_in_dim(
+                pool_b, b.astype(pool_b.dtype), row, 0))
+
+
+def merge_lora_into_head(params, a, b):
+    """TEST ORACLE: the merged-weights reference — fold one adapter into
+    ``lm_head.kernel`` of a params tree (``W' = W + A @ B``, scale
+    already folded into ``b`` like the pool stores it). Returns a new
+    tree; the batched pooled apply must be token-exact against
+    ``generate()`` over these merged params."""
+    merged = dict(params)
+    head = dict(merged["lm_head"])
+    kernel = head["kernel"]
+    v = b.shape[-1]
+    delta = jnp.asarray(a, kernel.dtype) @ jnp.asarray(b, kernel.dtype)
+    head["kernel"] = kernel.at[:, :v].add(delta)
+    merged["lm_head"] = head
+    return merged

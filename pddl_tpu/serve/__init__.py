@@ -8,9 +8,11 @@ deadline shed, prefill budget), `request.py` the per-request lifecycle,
 (radix index + device block pool), `faults.py` seeded deterministic
 fault injection, `drain.py` the SIGTERM drain/restore snapshot,
 `fleet/` the multi-replica tier (health-checked router, replica
-failover, live request migration). See `docs/SERVING.md` § "Online
-serving" and § "Serving fleet", and `docs/OPERATIONS.md` § "Failure
-modes & recovery (serving)" and § "Fleet runbook".
+failover, live request migration), `tenant/` the multi-tenant layer
+(paged per-request LoRA adapters + grammar-constrained decoding). See
+`docs/SERVING.md` § "Online serving", § "Serving fleet" and
+§ "Multi-tenant serving", and `docs/OPERATIONS.md` § "Failure modes &
+recovery (serving)", § "Fleet runbook" and § "Adapter pool sizing".
 """
 
 from pddl_tpu.serve.engine import ServeEngine
@@ -35,9 +37,12 @@ from pddl_tpu.serve.request import (
     SamplingParams,
 )
 from pddl_tpu.serve.scheduler import FCFSScheduler, SLOScheduler
+from pddl_tpu.serve.tenant import AdapterRegistry, TenantConfig
 
 __all__ = [
+    "AdapterRegistry",
     "AdmissionRejected",
+    "TenantConfig",
     "FCFSScheduler",
     "Priority",
     "SLOScheduler",

@@ -48,8 +48,17 @@ from pddl_tpu.serve.request import (
 # and KV is a pure function of (params, tokens), so every version —
 # v2 copy-engine snapshots included — restores through the same
 # replay/prefill path, into either engine mode.
-SNAPSHOT_VERSION = 3
-_READABLE_VERSIONS = frozenset({1, 2, 3})
+# Version 4 (multi-tenant serving, ISSUE 9): each entry carries the
+# request's ``adapter`` name and ``constraint`` spec dict (both
+# ``None`` for plain requests). Restore semantics: v1-v3 entries have
+# neither key and decode to "no adapter, unconstrained" — every older
+# snapshot restores into a tenant-capable engine unchanged, in either
+# engine mode; adapter weights are NEVER snapshotted (the registry is
+# deployment config, FSM state a pure function of the emitted tokens),
+# so the replay path rebuilds tenant streams exactly like KV. Future
+# versions still refuse below.
+SNAPSHOT_VERSION = 4
+_READABLE_VERSIONS = frozenset({1, 2, 3, 4})
 
 
 def encode_sampling(sampling: SamplingParams) -> Dict[str, object]:
@@ -93,6 +102,11 @@ def _encode_core(handle: RequestHandle, now_s: float) -> Dict[str, object]:
         "deadline_s": (float(handle.request.deadline_s)
                        if handle.request.deadline_s is not None else None),
         "priority": handle.request.priority.value,
+        # v4 tenant fields (both None for plain requests — and absent
+        # entirely from v1-v3 entries, which decode to the same).
+        "adapter": (str(handle.request.adapter)
+                    if handle.request.adapter is not None else None),
+        "constraint": handle.request.constraint,
         "elapsed_s": max(0.0, float(now_s - handle.arrival_s)),
         "tokens": [int(t) for t in handle.tokens],
         "ttft_s": (float(handle.ttft_s)
@@ -115,6 +129,10 @@ def decode_handle(entry: Dict[str, object], now_s: float) -> RequestHandle:
         # instead of raising on the missing key.
         priority=Priority(entry.get("priority",
                                     Priority.INTERACTIVE.value)),
+        # v1-v3 entries predate tenancy: absent keys restore as "no
+        # adapter, unconstrained" (what every pre-tenant request was).
+        adapter=entry.get("adapter"),
+        constraint=entry.get("constraint"),
     )
     handle = RequestHandle(
         req, arrival_s=float(now_s) - float(entry.get("elapsed_s", 0.0)))

@@ -100,7 +100,9 @@ import numpy as np
 from pddl_tpu.models.gpt import (
     _decode_cache_shapes,
     insert_cache_slot,
+    lm_head_logits,
     prefill_row,
+    prefill_row_features,
     prefill_row_from,
     sample_logits_batched,
     set_cache_block_tables,
@@ -108,6 +110,7 @@ from pddl_tpu.models.gpt import (
     slot_decode_cache,
 )
 from pddl_tpu.obs.ring import TelemetryRing
+from pddl_tpu.ops.lora import adapter_pool_load, batched_lora_delta
 from pddl_tpu.obs.trace import NULL_TRACER
 from pddl_tpu.serve import drain as drain_io
 from pddl_tpu.serve.faults import (
@@ -134,6 +137,13 @@ from pddl_tpu.serve.request import (
     SamplingParams,
 )
 from pddl_tpu.serve.scheduler import SLOScheduler
+from pddl_tpu.serve.tenant import (
+    AdapterPool,
+    AdapterPoolExhausted,
+    AdapterRegistry,
+    compile_constraint,
+    constraint_key,
+)
 
 
 class _SlotStateLost(RuntimeError):
@@ -266,6 +276,20 @@ class ServeEngine:
         admission) to free a slot for queued ``interactive`` work;
         ``0`` disables preemption. The cap is what keeps a paused
         stream from thrashing forever under sustained pressure.
+      tenant: optional :class:`~pddl_tpu.serve.tenant.TenantConfig` —
+        MULTI-TENANT serving (ISSUE 9, `serve/tenant/`): per-request
+        LoRA adapters from a paged device pool (per-slot int32 adapter
+        ids gathered inside the fused tick — one compiled program for
+        every tenant mix; admission pins the adapter row like a prefix
+        chain and charges a cold load against the prefill budget) and
+        grammar/JSON-schema-constrained decoding (a host-side token
+        FSM per request whose per-state allow mask is stamped as a
+        runtime ``[S, V]`` array ahead of the batched sampler; FSM
+        state re-derives from emitted tokens, so replay/drain/
+        migration stay token-exact). The v1 adaptation target is the
+        LM HEAD, which keeps KV adapter-invariant — prefix/paged KV
+        sharing stays valid ACROSS tenants. ``None`` (default) compiles
+        the plain programs: a non-tenant engine pays nothing.
       tracer: optional per-request tracer
         (:class:`~pddl_tpu.obs.trace.RequestTracer`); ``None`` installs
         the no-op :data:`~pddl_tpu.obs.trace.NULL_TRACER` — tracing
@@ -298,6 +322,7 @@ class ServeEngine:
                  max_replays: int = 3,
                  degraded_cooldown_s: float = 5.0,
                  preempt_cap: int = 2,
+                 tenant=None,
                  tracer=None, telemetry_capacity: int = 512):
         if max_slots < 1:
             raise ValueError(f"max_slots must be >= 1, got {max_slots}")
@@ -449,6 +474,63 @@ class ServeEngine:
             raise ValueError(f"preempt_cap must be >= 0, got {preempt_cap}")
         self._preempt_cap = int(preempt_cap)
 
+        # Multi-tenant state (`serve/tenant/`): the host-side adapter
+        # pool bookkeeping, the device factor pools, per-slot adapter
+        # rows, per-slot grammar masks, and the FSM cache. All absent
+        # (None) on a plain engine — tenancy is opt-in per engine, so
+        # existing deployments compile the exact same programs.
+        self._tenant = tenant
+        self._tenant_on = tenant is not None
+        if self._tenant_on:
+            registry = tenant.registry
+            if registry is None:
+                registry = AdapterRegistry(model.embed_dim,
+                                           model.vocab_size)
+                tenant.registry = registry
+            if (registry.embed_dim != model.embed_dim
+                    or registry.vocab_size != model.vocab_size):
+                raise ValueError(
+                    f"adapter registry shape ({registry.embed_dim}, "
+                    f"{registry.vocab_size}) does not match the model "
+                    f"({model.embed_dim}, {model.vocab_size})")
+            if tenant.token_strings is not None \
+                    and len(tenant.token_strings) != model.vocab_size:
+                raise ValueError(
+                    f"token_strings has {len(tenant.token_strings)} "
+                    f"entries; the grammar vocabulary must cover every "
+                    f"token id (vocab_size {model.vocab_size})")
+            pool_rows = (int(tenant.adapter_pool_slots)
+                         if tenant.adapter_pool_slots is not None
+                         else self.max_slots + 4)
+            if pool_rows < self.max_slots + 1:
+                raise ValueError(
+                    f"adapter_pool_slots {pool_rows} is below the live-"
+                    f"mix floor max_slots + 1 = {self.max_slots + 1} "
+                    "(every slot on a distinct adapter plus the "
+                    "identity row 0); see docs/OPERATIONS.md 'Adapter "
+                    "pool sizing'")
+            self._registry = registry
+            self._apool = AdapterPool(pool_rows)
+            self._apool_a = jnp.zeros(
+                (pool_rows, model.embed_dim, registry.rank), jnp.float32)
+            self._apool_b = jnp.zeros(
+                (pool_rows, registry.rank, model.vocab_size), jnp.float32)
+            self._arow = np.zeros(self.max_slots, np.int32)
+            self._masks = np.ones((self.max_slots, model.vocab_size),
+                                  np.bool_)
+            # The tick's mask arg stays DEVICE-resident and restages
+            # only when a host-side row changed (`_masks_dirty`): an
+            # adapters-only tenant mix (or idle constraints) then pays
+            # zero per-tick mask transfer — at a real vocab the [S, V]
+            # bool array is hundreds of KB per step otherwise.
+            self._masks_dev = None
+            self._masks_dirty = True
+            self._fsms: List[Optional[tuple]] = [None] * self.max_slots
+            self._fsm_cache: Dict[str, object] = {}
+        else:
+            self._registry = None
+            self._apool = None
+
         # One handle per occupied slot; all other per-slot state lives
         # in the arrays below (positions) or is derivable from the
         # handle (tokens emitted = len(handle.tokens)) — no duplicated
@@ -568,6 +650,109 @@ class ServeEngine:
                                              param_transform=pt)
             return _canon_paged(cache), logits
 
+        # --- tenant program bodies (the `tenant` arg docs) ---
+        # Same SITES, swapped bodies: the model runs ``features_only``,
+        # the LM head applies outside the module (`gpt.lm_head_logits`
+        # — op-for-op identical, so a no-adapter slot is bit-exact vs
+        # the base model), per-slot LoRA deltas gather from the device
+        # factor pools by runtime int32 row ids, and grammar masks land
+        # as a runtime [B, V] bool array right before the batched
+        # sampler (all-True rows pass logits through bitwise). Nothing
+        # here varies compiled-program shape — the zero-recompile pin
+        # holds over every tenant mix.
+        if self._tenant_on:
+            def _sample_first_t(logits, mask, temp, top_k, top_p, rng):
+                rng, sub = jax.random.split(rng)
+                tok = sample_logits_batched(
+                    sub, jnp.where(mask, logits, -jnp.inf),
+                    temperature=temp, top_k=top_k, top_p=top_p)
+                return tok, rng
+
+            def _adapter_load(pool_a, pool_b, row, a, b):
+                # Per-engine closure (the _insert rationale): a shared
+                # module-level jit would mix pool shapes across engines
+                # in compile_counts.
+                return adapter_pool_load(pool_a, pool_b, row, a, b)
+
+            def _tick_body(params, cache, tokens, temps, top_ks, top_ps,
+                           masks, pool_a, pool_b, arows, sub):
+                p2 = pt(params) if pt is not None else params
+                feats, mutated = dec.apply(
+                    {"params": p2, "cache": cache},
+                    tokens[:, None], train=False, mutable=["cache"],
+                    features_only=True)
+                logits = lm_head_logits(dec, p2, feats)[:, -1]
+                logits = logits + batched_lora_delta(
+                    feats[:, -1], pool_a, pool_b, arows)
+                nxt = sample_logits_batched(
+                    sub, jnp.where(masks, logits, -jnp.inf),
+                    temperature=temps, top_k=top_ks, top_p=top_ps)
+                return mutated["cache"], nxt
+
+            def _tick_t(params, cache, positions, tokens, temps, top_ks,
+                        top_ps, masks, pool_a, pool_b, arows, rng):
+                rng, sub = jax.random.split(rng)
+                cache = set_cache_positions(cache, positions)
+                cache, nxt = _tick_body(params, cache, tokens, temps,
+                                        top_ks, top_ps, masks, pool_a,
+                                        pool_b, arows, sub)
+                return cache, nxt, rng
+
+            def _tick_paged_t(params, cache, positions, tables, tokens,
+                              temps, top_ks, top_ps, masks, pool_a,
+                              pool_b, arows, rng):
+                rng, sub = jax.random.split(rng)
+                cache = set_cache_positions(cache, positions)
+                cache = set_cache_block_tables(cache, tables)
+                cache, nxt = _tick_body(params, cache, tokens, temps,
+                                        top_ks, top_ps, masks, pool_a,
+                                        pool_b, arows, sub)
+                return _canon_paged(cache), nxt, rng
+
+            def _lora1(last, last_feats, pool_a, pool_b, aid):
+                return last + batched_lora_delta(
+                    last_feats, pool_a, pool_b,
+                    jnp.full((1,), aid, jnp.int32))
+
+            def _prefill_t(params, prompt, length, aid, pool_a, pool_b):
+                cache, last, lf = prefill_row_features(
+                    dec, params, prompt, length, None, 0,
+                    param_transform=pt)
+                return cache, _lora1(last, lf, pool_a, pool_b, aid)
+
+            def _chunk_t(params, row, tokens, length, start, aid,
+                         pool_a, pool_b):
+                row, last, lf = prefill_row_features(
+                    dec, params, tokens, length, row, start,
+                    param_transform=pt)
+                return row, _lora1(last, lf, pool_a, pool_b, aid)
+
+            def _chunk_wide_t(params, row, tokens, length, start, aid,
+                              pool_a, pool_b):
+                # Distinct function object (wide-program discipline).
+                row, last, lf = prefill_row_features(
+                    dec, params, tokens, length, row, start,
+                    param_transform=pt)
+                return row, _lora1(last, lf, pool_a, pool_b, aid)
+
+            def _chunk_paged_t(params, cache, tokens, length, start,
+                               table, aid, pool_a, pool_b):
+                cache = set_cache_block_tables(cache, table)
+                cache, last, lf = prefill_row_features(
+                    dec, params, tokens, length, cache, start,
+                    param_transform=pt)
+                return _canon_paged(cache), _lora1(last, lf, pool_a,
+                                                   pool_b, aid)
+
+            def _chunk_paged_wide_t(params, cache, tokens, length, start,
+                                    table, aid, pool_a, pool_b):
+                cache = set_cache_block_tables(cache, table)
+                cache, last, lf = prefill_row_features(
+                    dec, params, tokens, length, cache, start,
+                    param_transform=pt)
+                return _canon_paged(cache), _lora1(last, lf, pool_a,
+                                                   pool_b, aid)
+
         # The resident programs (four without prefix caching; gather /
         # chunk-prefill / donate replace the one-shot prefill with it
         # on; in PAGED mode the set shrinks to tick + chunk widths +
@@ -580,17 +765,26 @@ class ServeEngine:
         # reference can never be used by mistake.
         self._donated_by_site = (_PAGED_DONATED_BY_SITE if self._paged
                                  else _DONATED_BY_SITE)
-        self._sample_first_p = jax.jit(_sample_first)
+        ten = self._tenant_on
+        self._sample_first_p = jax.jit(_sample_first_t if ten
+                                       else _sample_first)
+        # The adapter-load program copies (never donates — see
+        # ops/lora.adapter_pool_load), so a faulted load retries
+        # against the intact pool like any transient site.
+        self._adapter_load_p = jax.jit(_adapter_load) if ten else None
         if self._paged:
             self._insert_p = None
-            self._tick_p = jax.jit(_tick_paged, donate_argnums=(1,))
+            self._tick_p = jax.jit(_tick_paged_t if ten else _tick_paged,
+                                   donate_argnums=(1,))
             self._gather_p = None
-            self._chunk_p = jax.jit(_chunk_paged, donate_argnums=(1,))
+            self._chunk_p = jax.jit(_chunk_paged_t if ten else _chunk_paged,
+                                    donate_argnums=(1,))
             self._has_wide = (
                 self._chunk < self.prefill_len
                 and self.prefill_len + self.prefill_len // 4
                 <= model.max_len)
-            self._chunk_wide_p = (jax.jit(_chunk_paged_wide,
+            self._chunk_wide_p = (jax.jit(_chunk_paged_wide_t if ten
+                                          else _chunk_paged_wide,
                                           donate_argnums=(1,))
                                   if self._has_wide else None)
             self._donate_p = None
@@ -618,11 +812,13 @@ class ServeEngine:
                 self.set_tracer(tracer)
             return
         self._insert_p = jax.jit(_insert, donate_argnums=(0,))
-        self._tick_p = jax.jit(_tick, donate_argnums=(1,))
+        self._tick_p = jax.jit(_tick_t if ten else _tick,
+                               donate_argnums=(1,))
         if self._prefix_on:
             self._prefill_p = None
             self._gather_p = jax.jit(_gather, donate_argnums=(2,))
-            self._chunk_p = jax.jit(_chunk_prefill, donate_argnums=(1,))
+            self._chunk_p = jax.jit(_chunk_t if ten else _chunk_prefill,
+                                    donate_argnums=(1,))
             # A second, WIDE chunk program (full prefill_len) for cold /
             # barely-cached prompts: one fixed per-apply cost instead of
             # ceil(plen/chunk) of them, so enabling the prefix cache
@@ -636,7 +832,8 @@ class ServeEngine:
                 self._chunk < self.prefill_len
                 and self.prefill_len + self.prefill_len // 4
                 <= model.max_len)
-            self._chunk_wide_p = (jax.jit(_chunk_prefill_wide,
+            self._chunk_wide_p = (jax.jit(_chunk_wide_t if ten
+                                          else _chunk_prefill_wide,
                                           donate_argnums=(1,))
                                   if self._has_wide else None)
             self._donate_p = jax.jit(_donate, donate_argnums=(0,))
@@ -649,7 +846,7 @@ class ServeEngine:
                 lambda sd: jnp.zeros(sd.shape, sd.dtype),
                 _decode_cache_shapes(dec, 1))
         else:
-            self._prefill_p = jax.jit(_prefill)
+            self._prefill_p = jax.jit(_prefill_t if ten else _prefill)
             self._gather_p = self._chunk_p = self._donate_p = None
             self._chunk_wide_p = None
             self._has_wide = False
@@ -687,7 +884,9 @@ class ServeEngine:
     def submit(self, prompt: Sequence[int], max_new_tokens: int, *,
                sampling: Optional[SamplingParams] = None,
                deadline_s: Optional[float] = None,
-               priority: Priority = Priority.INTERACTIVE) -> RequestHandle:
+               priority: Priority = Priority.INTERACTIVE,
+               adapter: Optional[str] = None,
+               constraint: Optional[dict] = None) -> RequestHandle:
         """Queue one request; returns its streaming handle.
 
         Raises :class:`~pddl_tpu.serve.request.QueueFull` when the
@@ -699,7 +898,14 @@ class ServeEngine:
         traffic to estimate one, so a ``best_effort`` reject honestly
         hints a longer wait than an ``interactive`` one. After
         :meth:`drain` the engine accepts nothing (the process is on
-        its way out)."""
+        its way out).
+
+        Tenant fields (need ``tenant=TenantConfig(...)``): ``adapter``
+        names a registered LoRA adapter (``None`` = base model);
+        ``constraint`` is a grammar/schema spec dict
+        (``{"kind": "regex", "pattern": ...}`` or ``{"kind":
+        "json_schema", "schema": {...}}``) compiled HERE — a malformed
+        spec rejects the request loudly, never faults a tick."""
         if self._drained:
             raise RuntimeError(
                 "engine is drained (snapshot taken, admission stopped); "
@@ -719,10 +925,22 @@ class ServeEngine:
             raise ValueError(
                 f"prompt + new tokens {prompt.size + max_new_tokens} "
                 f"exceed max_len {self.model.max_len}")
+        if (adapter is not None or constraint is not None) \
+                and not self._tenant_on:
+            raise ValueError(
+                "adapter/constraint need a tenant-enabled engine "
+                "(ServeEngine(..., tenant=TenantConfig(...)))")
+        if adapter is not None and adapter not in self._registry:
+            raise ValueError(
+                f"adapter {adapter!r} is not registered "
+                f"(known: {self._registry.names})")
+        if constraint is not None:
+            self._compiled_fsm(constraint)  # validate + warm the cache
         req = Request(prompt=prompt.tolist(),
                       max_new_tokens=int(max_new_tokens),
                       sampling=sampling or SamplingParams(),
-                      deadline_s=deadline_s, priority=priority)
+                      deadline_s=deadline_s, priority=priority,
+                      adapter=adapter, constraint=constraint)
         handle = RequestHandle(req, arrival_s=self._clock())
         try:
             self.scheduler.submit(handle)
@@ -741,6 +959,8 @@ class ServeEngine:
         except Exception:
             self.metrics.record_rejected(priority.value)
             raise
+        if constraint is not None:
+            self.metrics.record_constrained()
         self._tracer.on_submit(handle, self.scheduler.depth)
         return handle
 
@@ -754,6 +974,17 @@ class ServeEngine:
         if not called."""
         if self._warm:
             return
+        first_mask = self._first_mask_args(None)  # () on a plain engine
+        if self._tenant_on:
+            # Warm the adapter-load program by writing zeros into the
+            # identity row — content unchanged (row 0 IS the zero
+            # adapter), program traced once.
+            self._apool_a, self._apool_b = self._adapter_load_p(
+                self._apool_a, self._apool_b, np.int32(0),
+                np.zeros((self.model.embed_dim, self._registry.rank),
+                         np.float32),
+                np.zeros((self._registry.rank, self.model.vocab_size),
+                         np.float32))
         if self._paged:
             # All-scratch tables: every warmup write lands in the junk
             # sink, the radix index stays empty, and every program
@@ -762,19 +993,19 @@ class ServeEngine:
             self._cache, logits = self._chunk_p(
                 self._params, self._cache,
                 np.zeros((1, self._chunk), np.int32), np.int32(1),
-                np.int32(0), t1)
+                np.int32(0), t1, *self._chunk_extra(0))
             if self._has_wide:
                 self._cache, logits = self._chunk_wide_p(
                     self._params, self._cache,
                     np.zeros((1, self.prefill_len), np.int32), np.int32(1),
-                    np.int32(0), t1)
+                    np.int32(0), t1, *self._chunk_extra(0))
             tok, self._rng = self._sample_first_p(
-                logits, np.float32(0.0), np.int32(0), np.float32(2.0),
-                self._rng)
+                logits, *first_mask, np.float32(0.0), np.int32(0),
+                np.float32(2.0), self._rng)
             self._cache, nxt, self._rng = self._tick_p(
                 self._params, self._cache, self._positions, self._tables,
                 self._tokens, self._temps, self._top_ks, self._top_ps,
-                self._rng)
+                *self._tick_extra(), self._rng)
             jax.block_until_ready((tok, nxt))
             self._warm = True
             return
@@ -784,26 +1015,28 @@ class ServeEngine:
                 self._row)
             row, logits = self._chunk_p(
                 self._params, row, np.zeros((1, self._chunk), np.int32),
-                np.int32(1), np.int32(0))
+                np.int32(1), np.int32(0), *self._chunk_extra(0))
             if self._has_wide:
                 row, logits = self._chunk_wide_p(
                     self._params, row,
                     np.zeros((1, self.prefill_len), np.int32),
-                    np.int32(1), np.int32(0))
+                    np.int32(1), np.int32(0), *self._chunk_extra(0))
             self._pool = self._donate_p(
                 self._pool, row, np.zeros(self._donate_cap, np.int32),
                 np.int32(0))
             self._row = row
         else:
             dummy = np.zeros((1, self.prefill_len), np.int32)
-            row, logits = self._prefill_p(self._params, dummy, 1)
+            row, logits = self._prefill_p(self._params, dummy, 1,
+                                          *self._chunk_extra(0))
         self._cache = self._insert_p(self._cache, row, 0, 0)
         tok, self._rng = self._sample_first_p(
-            logits, np.float32(0.0), np.int32(0), np.float32(2.0),
-            self._rng)
+            logits, *first_mask, np.float32(0.0), np.int32(0),
+            np.float32(2.0), self._rng)
         self._cache, nxt, self._rng = self._tick_p(
             self._params, self._cache, self._positions, self._tokens,
-            self._temps, self._top_ks, self._top_ps, self._rng)
+            self._temps, self._top_ks, self._top_ps, *self._tick_extra(),
+            self._rng)
         jax.block_until_ready((tok, nxt))
         self._warm = True
 
@@ -823,12 +1056,17 @@ class ServeEngine:
             if self._has_wide:
                 counts["chunk_prefill_wide"] = \
                     self._chunk_wide_p._cache_size()
+            if self._tenant_on:
+                counts["adapter_load"] = \
+                    self._adapter_load_p._cache_size()
             return counts
         counts = {
             "insert": self._insert_p._cache_size(),
             "tick": self._tick_p._cache_size(),
             "sample_first": self._sample_first_p._cache_size(),
         }
+        if self._tenant_on:
+            counts["adapter_load"] = self._adapter_load_p._cache_size()
         if self._prefix_on:
             counts["gather"] = self._gather_p._cache_size()
             counts["chunk_prefill"] = self._chunk_p._cache_size()
@@ -849,6 +1087,158 @@ class ServeEngine:
         """True when decode reads K/V straight from the block pool
         through per-slot block tables (no resident slot cache)."""
         return self._paged
+
+    # ----------------------------------------------------------- tenancy
+    @property
+    def tenant_enabled(self) -> bool:
+        """True when this engine compiled the multi-tenant program set
+        (per-slot LoRA adapters + grammar masks; `serve/tenant/`)."""
+        return self._tenant_on
+
+    @property
+    def adapter_registry(self):
+        """The engine's :class:`~pddl_tpu.serve.tenant.AdapterRegistry`
+        (``None`` on a plain engine). Adapters registered here become
+        submittable immediately — residency is handled at admission."""
+        return self._registry
+
+    @property
+    def adapter_pool_resident(self) -> int:
+        """Adapters currently device-resident (0 on a plain engine)."""
+        return self._apool.resident if self._tenant_on else 0
+
+    def _compiled_fsm(self, spec):
+        """Compile (or fetch) the token FSM for a constraint spec dict.
+        Cached by canonical spec key — N requests under one schema
+        share one automaton and one mask table."""
+        key = constraint_key(spec)
+        fsm = self._fsm_cache.get(key)
+        if fsm is None:
+            if self._tenant.token_strings is None:
+                raise ValueError(
+                    "constrained decoding needs TenantConfig."
+                    "token_strings (the token-id -> string vocabulary "
+                    "grammar compilation maps masks through)")
+            fsm = compile_constraint(spec, self._tenant.token_strings)
+            # Bounded like the process-wide cache it fronts
+            # (`grammar._FSM_CACHE`): client-supplied specs (e.g. a
+            # per-request ID baked into a pattern) must not grow host
+            # memory forever in a long-lived engine.
+            if len(self._fsm_cache) >= 256:
+                self._fsm_cache.pop(next(iter(self._fsm_cache)))
+            self._fsm_cache[key] = fsm
+        # Engine-specific (eos-dependent) viability, checked per call
+        # because the FSM cache is engine-agnostic: a constraint whose
+        # START state allows no token and has no eos escape (it matches
+        # only the empty string — e.g. "x*" over a vocabulary with no
+        # 'x') could never sample a first token; rejecting HERE fails
+        # the request at submit (or via the replay budget at restore)
+        # instead of crashing the step for everyone.
+        if fsm.is_dead_end(fsm.start, self.eos_token):
+            raise ValueError(
+                "constraint admits no first token over this engine's "
+                "vocabulary (it matches only the empty string, and the "
+                "engine has no eos token to emit)")
+        return fsm
+
+    def _acquire_adapter(self, name: str, fresh: bool = True) -> int:
+        """Resolve an adapter name to a PINNED device pool row, loading
+        the factors on a cold miss (LRU-evicting an unpinned row under
+        pressure — the prefix-chain discipline applied to weights).
+        ``fresh=False`` marks a replay/resume re-admission (pool
+        traffic counted, per-tenant request volume not). Escalates
+        unresolvable shortfalls as :class:`_SlotStateLost` so admission
+        charges a replay instead of crashing the step."""
+        row = self._apool.lookup(name)
+        if row is not None:
+            self.metrics.record_adapter_hit(name, self._apool.resident,
+                                            fresh=fresh)
+            self._apool.pin(row)
+            return row
+        try:
+            adapter = self._registry.get(name)
+        except KeyError as e:
+            # Permanently unserveable here (e.g. a migrated stream
+            # whose adapter this deployment never registered): the
+            # replay budget turns it into a terminal ERROR.
+            raise _SlotStateLost("adapter_admit", e) from e
+        try:
+            row = self._apool.assign(name)
+        except AdapterPoolExhausted as e:
+            raise _SlotStateLost("adapter_admit", e) from e
+        try:
+            self._apool_a, self._apool_b = self._device_call(
+                "adapter_load", self._adapter_load_p,
+                self._apool_a, self._apool_b, np.int32(row),
+                adapter.a, adapter.b)
+        except _SlotStateLost:
+            self._apool.unassign(row)
+            raise
+        self.metrics.record_adapter_load(name, self._apool.resident,
+                                         self._apool.evictions,
+                                         fresh=fresh)
+        self._apool.pin(row)
+        return row
+
+    def _release_adapter(self, row) -> None:
+        """Unpin a slot's (or a failed admission's) adapter row; row 0
+        (identity / no adapter) is a no-op."""
+        if self._tenant_on and int(row) != 0:
+            self._apool.unpin(int(row))
+
+    def _tenant_admit(self, handle):
+        """The tenant half of one admission: ``(pinned_adapter_row,
+        compiled_fsm_or_None)``. Raises :class:`_SlotStateLost` (self-
+        unwound — nothing left pinned) on unresolvable specs/pools."""
+        if not self._tenant_on:
+            return 0, None
+        req = handle.request
+        fsm = None
+        if req.constraint is not None:
+            try:
+                fsm = self._compiled_fsm(req.constraint)
+            except ValueError as e:
+                # submit() validates, so this is the restore/migration
+                # path seeing a spec this engine cannot compile: fail
+                # the REQUEST (via replay budget), not the engine.
+                raise _SlotStateLost("constraint_admit", e) from e
+        # "Fresh" means this request's FIRST service, not merely
+        # zero tokens: a pre-first-token replay (prefill faulted past
+        # the retry budget) has empty tokens but a replay charge, and
+        # must not double-count the capacity-planning series.
+        fresh = not handle.tokens and not handle.replays
+        arow = (self._acquire_adapter(req.adapter, fresh=fresh)
+                if req.adapter is not None else 0)
+        return arow, fsm
+
+    def _chunk_extra(self, aid):
+        """Extra prefill-program args in tenant mode (adapter id +
+        factor pools); empty on a plain engine."""
+        return ((np.int32(aid), self._apool_a, self._apool_b)
+                if self._tenant_on else ())
+
+    def _tick_extra(self):
+        """Extra fused-tick args in tenant mode (grammar masks + factor
+        pools + per-slot adapter rows); empty on a plain engine. The
+        mask ships as one device-resident array restaged only on
+        change."""
+        if not self._tenant_on:
+            return ()
+        if self._masks_dev is None or self._masks_dirty:
+            self._masks_dev = jnp.asarray(self._masks)
+            self._masks_dirty = False
+        return (self._masks_dev, self._apool_a, self._apool_b,
+                self._arow)
+
+    def _first_mask_args(self, fsm):
+        """The sample-first mask arg (``[1, V]``) in tenant mode: the
+        FSM's start-state allow row for constrained requests, all-True
+        (a bitwise logits pass-through) otherwise."""
+        if not self._tenant_on:
+            return ()
+        if fsm is None:
+            return (np.ones((1, self.model.vocab_size), np.bool_),)
+        return (fsm.allow_row(fsm.start, self.eos_token)[None],)
 
     @property
     def blocks_shared(self) -> int:
@@ -1104,6 +1494,18 @@ class ServeEngine:
         free list; donated prompt blocks stay cached under the radix
         index, unpinned below)."""
         self._slots[slot_id] = None
+        if self._tenant_on:
+            # Release the slot's adapter pin (the weights stay resident
+            # — that's the point — but become LRU-evictable once no
+            # live slot needs them) and reset the grammar state: an
+            # all-True mask is a bitwise logits pass-through, so the
+            # parked row's junk tick behaves exactly as before.
+            self._release_adapter(self._arow[slot_id])
+            self._arow[slot_id] = 0
+            if not self._masks[slot_id].all():
+                self._masks[slot_id, :] = True
+                self._masks_dirty = True
+            self._fsms[slot_id] = None
         if self._paged:
             if self._private[slot_id]:
                 self._prefix.release(self._private[slot_id])
@@ -1162,7 +1564,11 @@ class ServeEngine:
             # release would double-own the ids in the fresh free list).
             # Its handle is still at the head of `_admitting`, so the
             # next step re-admits it from scratch against the fresh
-            # pool, token-exactly.
+            # pool, token-exactly. Its ADAPTER pin is different: the
+            # adapter pool does NOT die with the paged reset, so the
+            # pin unwinds normally (re-admission re-acquires).
+            if self._slice is not None:
+                self._release_adapter(self._slice.get("arow", 0))
             self._slice = None
             # The pool held every live stream's KV (and the cached
             # chains): rebuild the whole paged world — same shapes,
@@ -1203,19 +1609,33 @@ class ServeEngine:
         ``FCFSScheduler.admit``). Degraded mode charges the full prompt
         (the cache is not consulted on the cold path)."""
         prompt = handle.request.prompt
-        if self._degraded:
-            return len(prompt)
-        match = self._prefix.match(prompt,
-                                   max_blocks=self._match_blocks(prompt))
-        return len(prompt) - match.n_blocks * self.prefix_block_size
+        if self._degraded or not self._prefix_on:
+            cost = len(prompt)
+        else:
+            match = self._prefix.match(
+                prompt, max_blocks=self._match_blocks(prompt))
+            cost = len(prompt) - match.n_blocks * self.prefix_block_size
+        # Tenancy-aware budget (ISSUE 9): a COLD adapter load is real
+        # admission-path work (a host->device factor transfer), so it
+        # charges like an uncached suffix; a resident adapter — like a
+        # cached prefix — charges nothing. Pop-time estimate with the
+        # same caveat as the prefix charge: a same-tick eviction can
+        # make the real work exceed it, which costs latency, never
+        # correctness.
+        if (self._tenant_on and handle.request.adapter is not None
+                and self._apool.row_of(handle.request.adapter) is None):
+            cost += int(self._tenant.adapter_load_tokens)
+        return cost
 
-    def _prefill_into_row(self, prompt: np.ndarray, handle=None):
+    def _prefill_into_row(self, prompt: np.ndarray, handle=None, aid=0):
         """Prefill one prompt into a row cache, reusing any cached
         prefix: gather the matched chain into the resident row buffers,
         chunk-prefill the suffix, donate the prompt's uncovered full
         blocks, pin the chain. ``handle`` is the admission's request
-        (tracing only — each dispatch lands on its span).
-        Returns ``(row_cache, last_logits, pinned_node_or_None)``."""
+        (tracing only — each dispatch lands on its span); ``aid`` the
+        tenant adapter pool row (0 = base model, ignored on a plain
+        engine). Returns ``(row_cache, last_logits,
+        pinned_node_or_None)``."""
         plen = prompt.size
         bs = self.prefix_block_size
         tr = self._tracer
@@ -1223,7 +1643,8 @@ class ServeEngine:
             padded = np.zeros((1, self.prefill_len), np.int32)
             padded[0, :plen] = prompt
             row, logits = self._device_call(
-                "prefill", self._prefill_p, self._params, padded, plen)
+                "prefill", self._prefill_p, self._params, padded, plen,
+                *self._chunk_extra(aid))
             tr.on_prefill_chunk(handle, "prefill", 0, plen,
                                 self._last_wall_s)
             return row, logits, None
@@ -1261,7 +1682,7 @@ class ServeEngine:
         def _dispatch(site, prog, chunk_toks, w, off):
             row_box[0], lg = self._device_call(
                 site, prog, self._params, row_box[0], chunk_toks,
-                np.int32(w), np.int32(off))
+                np.int32(w), np.int32(off), *self._chunk_extra(aid))
             self._row = row_box[0]
             return lg
 
@@ -1396,14 +1817,15 @@ class ServeEngine:
         table_row[m:m + len(private)] = private
         return node, m, table_row, private
 
-    def _prefill_paged(self, prompt: np.ndarray, handle=None):
+    def _prefill_paged(self, prompt: np.ndarray, handle=None, aid=0):
         """The paged twin of :meth:`_prefill_into_row`: a prefix hit
         PINS the matched chain and points the slot's block table at it
         in place (no gather copy), private blocks are allocated for the
         suffix, and the chunk programs write K/V straight into those
-        pool blocks. Returns ``(last_logits, pinned_node_or_None,
-        table_row [T] np.int32, private_ids)``; raises
-        :class:`_SlotStateLost` with its own resources unwound."""
+        pool blocks. ``aid`` as in :meth:`_prefill_into_row`. Returns
+        ``(last_logits, pinned_node_or_None, table_row [T] np.int32,
+        private_ids)``; raises :class:`_SlotStateLost` with its own
+        resources unwound."""
         node, m, table_row, private = self._paged_match_and_allocate(
             prompt, handle)
         n_cached = m * self.prefix_block_size
@@ -1413,7 +1835,7 @@ class ServeEngine:
         def _dispatch(site, prog, chunk_toks, w, off):
             self._cache, lg = self._device_call(
                 site, prog, self._params, self._cache, chunk_toks,
-                np.int32(w), np.int32(off), t1)
+                np.int32(w), np.int32(off), t1, *self._chunk_extra(aid))
             return lg
 
         try:
@@ -1520,9 +1942,10 @@ class ServeEngine:
             self._tracer.on_deadline_shed(handle)
             self._tracer.on_finish(handle, FinishReason.DEADLINE.value)
 
-        # The suffix-priced cost_fn walks the radix tree per pop; only
-        # pay that when a budget actually consumes the result.
-        use_cost = (self._prefix_on
+        # The suffix-priced (and adapter-load-priced) cost_fn walks the
+        # radix tree per pop; only pay that when a budget actually
+        # consumes the result.
+        use_cost = ((self._prefix_on or self._tenant_on)
                     and self.scheduler.prefill_token_budget is not None)
         # A kill mid-admission can leave a handle parked in
         # `_admitting`; it owns the first free slot before anything new
@@ -1617,6 +2040,12 @@ class ServeEngine:
         dispatch consumed (slot pool → live-slot replay; block pool →
         fresh pool + index), and charge the request a replay."""
         sl, self._slice = self._slice, None
+        if sl is not None:
+            # A parked slice owns its adapter pin (the whole-prompt
+            # paths release their own before raising, and then
+            # self._slice was never set). The adapter pool does NOT
+            # die with any KV rebuild, so the pin must unwind exactly.
+            self._release_adapter(sl.get("arow", 0))
         if self._paged:
             # A parked slice still owns its pin + private blocks (the
             # whole-prompt paged path releases its own before raising,
@@ -1636,18 +2065,35 @@ class ServeEngine:
 
     def _admit_one(self, sid: int, handle: RequestHandle) -> None:
         """Admit one popped handle into slot ``sid`` (the whole-prompt
-        path; the sliced path is :meth:`_start_slice`)."""
+        path; the sliced path is :meth:`_start_slice`). Tenant order:
+        the adapter pin + FSM compile land FIRST (so a cold load or an
+        unresolvable spec unwinds before any prefill work), and a
+        prefill failure releases the pin before escalating — the
+        install step owns the pin from there (its own failure path
+        releases, its success hands ownership to the slot)."""
         replay = bool(handle.tokens)
         self._tracer.on_admit(handle, sid, replay)
         prompt = np.asarray(handle.request.prompt, np.int32)
+        arow, fsm = self._tenant_admit(handle)
         if self._paged:
-            logits, node, table_row, private = self._prefill_paged(
-                prompt, handle)
+            try:
+                logits, node, table_row, private = self._prefill_paged(
+                    prompt, handle, arow)
+            except _SlotStateLost:
+                self._release_adapter(arow)
+                raise
             self._install_slot(sid, handle, None, logits, node,
-                               table_row=table_row, private=private)
+                               table_row=table_row, private=private,
+                               arow=arow, fsm=fsm)
             return
-        row, logits, node = self._prefill_into_row(prompt, handle)
-        self._install_slot(sid, handle, row, logits, node)
+        try:
+            row, logits, node = self._prefill_into_row(prompt, handle,
+                                                       arow)
+        except _SlotStateLost:
+            self._release_adapter(arow)
+            raise
+        self._install_slot(sid, handle, row, logits, node, arow=arow,
+                           fsm=fsm)
 
     # ------------------------------------------------ sliced admission
     def _start_slice(self, sid: int, handle: RequestHandle) -> bool:
@@ -1660,36 +2106,57 @@ class ServeEngine:
         prompt = np.asarray(handle.request.prompt, np.int32)
         replay = bool(handle.tokens)
         self._tracer.on_admit(handle, sid, replay)
-        if self._paged:
-            # Pin + allocate now (host-only, no gather dispatch — the
-            # matched blocks are referenced in place); the pin is what
-            # keeps the chain under this admission across the decode
-            # ticks that run between slices.
-            node, m, table_row, private = self._paged_match_and_allocate(
-                prompt, handle)
-            n_cached = m * self.prefix_block_size
+        arow, fsm = self._tenant_admit(handle)
+        # Pin-ownership tracking: a failure BEFORE the slice dict
+        # exists leaves the adapter pin with nobody else to unwind it;
+        # once created, the slice (via `_unwind_admission`) or —
+        # should the slice finish and the INSTALL fault — the install's
+        # own failure path owns the release. `self._slice is None`
+        # cannot distinguish "never created" from "created, finished,
+        # install faulted" (both are None here), so track creation
+        # explicitly or a refcount would underflow.
+        created = False
+        try:
+            if self._paged:
+                # Pin + allocate now (host-only, no gather dispatch —
+                # the matched blocks are referenced in place); the pin
+                # is what keeps the chain under this admission across
+                # the decode ticks that run between slices.
+                node, m, table_row, private = \
+                    self._paged_match_and_allocate(prompt, handle)
+                n_cached = m * self.prefix_block_size
+                self._slice = {"handle": handle, "sid": sid,
+                               "prompt": prompt, "off": n_cached,
+                               "n_cached": n_cached, "logits": None,
+                               "node": node, "table": table_row,
+                               "private": private, "arow": arow,
+                               "fsm": fsm}
+                created = True
+                return self._advance_slice(self._slice)
+            n_cached = 0
+            if not self._degraded:
+                match = self._prefix.match(
+                    prompt, max_blocks=self._match_blocks(prompt))
+                n_cached = match.n_blocks * self.prefix_block_size
+                self._tracer.on_prefix_match(handle, match.n_blocks,
+                                             n_cached)
+            if n_cached > 0:
+                ids = np.zeros(self._match_cap, np.int32)  # scratch-pad
+                ids[:match.n_blocks] = match.block_ids
+                self._row = self._device_call("gather", self._gather_p,
+                                              self._pool, ids, self._row)
+                self._tracer.on_prefill_chunk(handle, "gather", 0,
+                                              n_cached,
+                                              self._last_wall_s)
             self._slice = {"handle": handle, "sid": sid, "prompt": prompt,
                            "off": n_cached, "n_cached": n_cached,
-                           "logits": None, "node": node,
-                           "table": table_row, "private": private}
+                           "logits": None, "arow": arow, "fsm": fsm}
+            created = True
             return self._advance_slice(self._slice)
-        n_cached = 0
-        if not self._degraded:
-            match = self._prefix.match(
-                prompt, max_blocks=self._match_blocks(prompt))
-            n_cached = match.n_blocks * self.prefix_block_size
-            self._tracer.on_prefix_match(handle, match.n_blocks, n_cached)
-        if n_cached > 0:
-            ids = np.zeros(self._match_cap, np.int32)  # scratch-padded
-            ids[:match.n_blocks] = match.block_ids
-            self._row = self._device_call("gather", self._gather_p,
-                                          self._pool, ids, self._row)
-            self._tracer.on_prefill_chunk(handle, "gather", 0, n_cached,
-                                          self._last_wall_s)
-        self._slice = {"handle": handle, "sid": sid, "prompt": prompt,
-                       "off": n_cached, "n_cached": n_cached,
-                       "logits": None}
-        return self._advance_slice(self._slice)
+        except _SlotStateLost:
+            if not created:
+                self._release_adapter(arow)
+            raise
 
     def _continue_slice(self) -> bool:
         """Resume the parked prefill. Returns True when ``self._slice``
@@ -1710,6 +2177,7 @@ class ServeEngine:
                     self._prefix.release(sl["private"])
                 if sl.get("node") is not None:
                     self._prefix.unpin(sl["node"])
+            self._release_adapter(sl.get("arow", 0))
             self._slice = None
             if handle.cancelled:
                 handle.state = RequestState.CANCELLED
@@ -1749,15 +2217,17 @@ class ServeEngine:
             w = min(self._chunk, plen - off)
             chunk_toks = np.zeros((1, self._chunk), np.int32)
             chunk_toks[0, :w] = prompt[off:off + w]
+            extra = self._chunk_extra(sl.get("arow", 0))
             if self._paged:
                 self._cache, sl["logits"] = self._device_call(
                     "chunk_prefill", self._chunk_p, self._params,
                     self._cache, chunk_toks, np.int32(w), np.int32(off),
-                    sl["table"][None])
+                    sl["table"][None], *extra)
             else:
                 self._row, sl["logits"] = self._device_call(
                     "chunk_prefill", self._chunk_p, self._params,
-                    self._row, chunk_toks, np.int32(w), np.int32(off))
+                    self._row, chunk_toks, np.int32(w), np.int32(off),
+                    *extra)
             self._tracer.on_prefill_chunk(handle, "chunk_prefill", off, w,
                                           self._last_wall_s)
             sl["off"] = off + w
@@ -1797,7 +2267,8 @@ class ServeEngine:
             self._slice = None
             self._install_slot(sid, handle, None, sl["logits"], node,
                                table_row=sl["table"],
-                               private=sl["private"])
+                               private=sl["private"],
+                               arow=sl.get("arow", 0), fsm=sl.get("fsm"))
             return
         node = None
         if not self._degraded:
@@ -1806,10 +2277,12 @@ class ServeEngine:
             node = self._donate_tail(prompt, self._row, match,
                                      int(sl["n_cached"]))
         self._slice = None
-        self._install_slot(sid, handle, self._row, sl["logits"], node)
+        self._install_slot(sid, handle, self._row, sl["logits"], node,
+                           arow=sl.get("arow", 0), fsm=sl.get("fsm"))
 
     def _install_slot(self, sid: int, handle: RequestHandle, row, logits,
-                      node, table_row=None, private=None) -> None:
+                      node, table_row=None, private=None, arow=0,
+                      fsm=None) -> None:
         """Make a fully-prefilled row live in slot ``sid``. Two shapes:
         a FRESH request samples its first token from the prefill logits
         (that's TTFT); a REPLAYED one (``handle.tokens`` non-empty —
@@ -1820,11 +2293,34 @@ class ServeEngine:
         Paged mode passes ``table_row``/``private`` instead of ``row``:
         the KV is already where it lives (the pool), so there is no
         insert dispatch at all — installation is the host-side table
-        stamp."""
+        stamp.
+
+        Tenant mode passes ``arow`` (the admission's pinned adapter
+        pool row — ownership transfers to the slot here, or is
+        released on this method's own failure) and ``fsm`` (the
+        compiled constraint automaton): a fresh request samples its
+        first token under the FSM's start-state mask and advances; a
+        replayed one RE-DERIVES its FSM state from the emitted tokens
+        (state, like KV, is a pure function of the stream)."""
         req = handle.request
         plen = len(req.prompt)
         replay = bool(handle.tokens)
         t, k, p = req.sampling.as_arrays()
+        fsm_state = None
+        if fsm is not None and replay:
+            try:
+                fsm_state = fsm.advance_many(handle.tokens,
+                                             eos_token=self.eos_token)
+            except ValueError as e:
+                # A replayed stream the automaton rejects (corrupted
+                # migration mirror): fail the REQUEST via the replay
+                # budget, never the engine.
+                self._release_adapter(arow)
+                if self._paged and private:
+                    self._prefix.release(private)
+                if node is not None:
+                    self._prefix.unpin(node)
+                raise _SlotStateLost("constraint_admit", e) from e
         try:
             if not self._paged:
                 self._cache = self._device_call(
@@ -1835,6 +2331,7 @@ class ServeEngine:
             else:
                 tok, self._rng = self._device_call(
                     "sample_first", self._sample_first_p, logits,
+                    *self._first_mask_args(fsm),
                     np.float32(t), np.int32(k), np.float32(p), self._rng)
                 first = int(tok[0])
         except _SlotStateLost:
@@ -1842,6 +2339,7 @@ class ServeEngine:
                 self._prefix.release(private)
             if node is not None:
                 self._prefix.unpin(node)
+            self._release_adapter(arow)
             raise
         if self._paged:
             self._tables[sid] = table_row
@@ -1861,6 +2359,30 @@ class ServeEngine:
         self._temps[sid] = t
         self._top_ks[sid] = k
         self._top_ps[sid] = p
+        if self._tenant_on:
+            # The slot now owns the adapter pin (released at park) and
+            # the grammar state/mask row the coming ticks read.
+            self._arow[sid] = arow
+            if fsm is not None:
+                if not replay:
+                    if self.eos_token is not None \
+                            and first == self.eos_token:
+                        fsm_state = fsm.start  # evicted as EOS below
+                    else:
+                        fsm_state = fsm.advance(fsm.start, first)
+                        if fsm_state < 0:  # masked sample: impossible
+                            raise RuntimeError(
+                                "constrained first token escaped its "
+                                "start-state mask (engine bug)")
+                self._fsms[sid] = (fsm, fsm_state)
+                self._masks[sid] = fsm.allow_row(fsm_state,
+                                                 self.eos_token)
+                self._masks_dirty = True
+            else:
+                self._fsms[sid] = None
+                if not self._masks[sid].all():
+                    self._masks[sid, :] = True
+                    self._masks_dirty = True
         if replay:
             # Finish conditions were already evaluated for every
             # re-fed token before the fault — except possibly the LAST:
@@ -1869,17 +2391,27 @@ class ServeEngine:
             # the live edge alone (an in-engine replay can never be
             # complete — eviction beat it to the snapshot) or the first
             # post-replay tick samples one token past the stream's end.
+            # Constrained streams add the grammar edge: a migrated
+            # stream whose automaton has no continuation is COMPLETE.
             if (self.eos_token is not None
                     and handle.tokens[-1] == self.eos_token):
                 self._evict(sid, RequestState.FINISHED, FinishReason.EOS)
+            elif fsm is not None and fsm_state is not None \
+                    and fsm.is_dead_end(fsm_state, self.eos_token):
+                self._evict(sid, RequestState.FINISHED,
+                            FinishReason.GRAMMAR)
             elif len(handle.tokens) >= req.max_new_tokens:
                 self._evict(sid, RequestState.FINISHED,
                             FinishReason.LENGTH)
             return
-        # A one-token request (or an immediate eos) finishes at
-        # admission without ever joining a tick.
+        # A one-token request (or an immediate eos / an immediately
+        # complete grammar) finishes at admission without ever joining
+        # a tick.
         if self.eos_token is not None and first == self.eos_token:
             self._evict(sid, RequestState.FINISHED, FinishReason.EOS)
+        elif fsm is not None and fsm.is_dead_end(fsm_state,
+                                                 self.eos_token):
+            self._evict(sid, RequestState.FINISHED, FinishReason.GRAMMAR)
         elif req.max_new_tokens == 1:
             self._evict(sid, RequestState.FINISHED, FinishReason.LENGTH)
 
@@ -1925,12 +2457,14 @@ class ServeEngine:
                     self._cache, nxt, self._rng = self._device_call(
                         "tick", self._tick_p, self._params, self._cache,
                         self._positions, self._tables, self._tokens,
-                        self._temps, self._top_ks, self._top_ps, self._rng)
+                        self._temps, self._top_ks, self._top_ps,
+                        *self._tick_extra(), self._rng)
                 else:
                     self._cache, nxt, self._rng = self._device_call(
                         "tick", self._tick_p, self._params, self._cache,
                         self._positions, self._tokens, self._temps,
-                        self._top_ks, self._top_ps, self._rng)
+                        self._top_ks, self._top_ps, *self._tick_extra(),
+                        self._rng)
             except _SlotStateLost:
                 self._lose_live_slots()
                 nxt = None
@@ -1952,9 +2486,35 @@ class ServeEngine:
                     self._positions[sid] += 1
                     self._tokens[sid] = tok
                     self._tracer.on_token(handle, cur)
+                    fsm_entry = (self._fsms[sid] if self._tenant_on
+                                 else None)
                     if self.eos_token is not None and tok == self.eos_token:
+                        # For a constrained slot the mask only ever
+                        # allows eos in an ACCEPTING state, so this is
+                        # simultaneously grammar acceptance.
                         self._evict(sid, RequestState.FINISHED,
                                     FinishReason.EOS)
+                    elif fsm_entry is not None:
+                        fsm, state = fsm_entry
+                        state = fsm.advance(state, tok)
+                        if state < 0:  # masked sample: impossible
+                            raise RuntimeError(
+                                "constrained token escaped its state "
+                                "mask (engine bug)")
+                        self._fsms[sid] = (fsm, state)
+                        if fsm.is_dead_end(state, self.eos_token):
+                            # No legal continuation: the output is a
+                            # complete document (see FinishReason).
+                            self._evict(sid, RequestState.FINISHED,
+                                        FinishReason.GRAMMAR)
+                        elif len(handle.tokens) >= \
+                                handle.request.max_new_tokens:
+                            self._evict(sid, RequestState.FINISHED,
+                                        FinishReason.LENGTH)
+                        else:
+                            self._masks[sid] = fsm.allow_row(
+                                state, self.eos_token)
+                            self._masks_dirty = True
                     elif len(handle.tokens) >= handle.request.max_new_tokens:
                         self._evict(sid, RequestState.FINISHED,
                                     FinishReason.LENGTH)
@@ -2078,5 +2638,19 @@ class ServeEngine:
         if isinstance(source, str):
             source = drain_io.load_snapshot(source)
         handles = drain_io.restored_handles(source, self._clock())
+        if not self._tenant_on:
+            # A tenant stream restored onto a plain engine would
+            # silently serve the BASE model (wrong weights, no mask) —
+            # refuse loudly instead. v1-v3 snapshots carry neither
+            # field, so every pre-tenant snapshot restores here
+            # unchanged.
+            bad = [h for h in handles
+                   if h.request.adapter is not None
+                   or h.request.constraint is not None]
+            if bad:
+                raise ValueError(
+                    f"snapshot carries {len(bad)} tenant request(s) "
+                    "(adapter/constraint) but this engine has no "
+                    "tenant=TenantConfig(...)")
         self.scheduler.restore(handles)
         return handles

@@ -155,10 +155,12 @@ class LocalReplica:
     # ------------------------------------------------------------- intake
     def submit(self, rid: int, prompt, max_new_tokens: int,
                sampling: SamplingParams, deadline_s,
-               priority: Priority = Priority.INTERACTIVE) -> None:
+               priority: Priority = Priority.INTERACTIVE,
+               adapter=None, constraint=None) -> None:
         handle = self.engine.submit(prompt, max_new_tokens,
                                     sampling=sampling, deadline_s=deadline_s,
-                                    priority=priority)
+                                    priority=priority, adapter=adapter,
+                                    constraint=constraint)
         self._ledger.add(rid, handle)
 
     def cancel(self, rid: int) -> None:
@@ -358,16 +360,20 @@ class ProcessReplica:
     # ------------------------------------------------------------- intake
     def submit(self, rid: int, prompt, max_new_tokens: int,
                sampling: SamplingParams, deadline_s,
-               priority: Priority = Priority.INTERACTIVE) -> None:
+               priority: Priority = Priority.INTERACTIVE,
+               adapter=None, constraint=None) -> None:
         """Synchronous across the pipe: the worker acks admission or
         reports its typed QueueFull (depth + retry_after hint), which
-        re-raises here so the router's shed logic is driver-agnostic."""
+        re-raises here so the router's shed logic is driver-agnostic.
+        ``adapter``/``constraint`` (the tenant fields) are already
+        plain wire values — a name string and a spec dict."""
         self._send({"cmd": "submit", "rid": int(rid),
                     "prompt": [int(t) for t in prompt],
                     "max_new_tokens": int(max_new_tokens),
                     "sampling": sampling_to_wire(sampling),
                     "deadline_s": deadline_s,
-                    "priority": Priority(priority).value})
+                    "priority": Priority(priority).value,
+                    "adapter": adapter, "constraint": constraint})
         deadline = self._clock() + self._call_timeout_s
         while True:
             # Consume the WHOLE batch before acting on the ack: token

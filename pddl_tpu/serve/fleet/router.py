@@ -148,6 +148,10 @@ class FleetMetrics:
         self.routed_load_balanced = 0  # interactive shed off a hot
         #                                affinity replica (pressure-
         #                                aware routing)
+        self.routed_adapter = 0        # adapter-affinity hit: routed to
+        #                                the replica whose pool already
+        #                                holds the request's LoRA
+        #                                adapter (`serve/tenant/`)
         self.shed_rerouted = 0           # QueueFull → another replica took it
         self.shed_rejected = 0           # fleet-wide full: caller rejected
         # Admission control / brownout (`fleet/admission.py`): front-
@@ -327,6 +331,13 @@ class FleetRouter:
         self._max_sessions = int(max_sessions)
         self._sessions: "collections.OrderedDict[str, _ReplicaSlot]" = \
             collections.OrderedDict()
+        # Adapter-affinity homes (`serve/tenant/`): adapter name → the
+        # replica whose device pool last loaded it. Routing same-
+        # adapter traffic back keeps the pool warm (a cold load per
+        # replica per adapter, not per request); a death drops only its
+        # own entries, and the home FOLLOWS reality — it re-pins to
+        # wherever a request actually landed (shed reroutes included).
+        self._adapter_homes: Dict[str, _ReplicaSlot] = {}
         # (rid, FleetHandle) pairs with no surviving replica, waiting
         # for a probe to bring one back.
         self._orphans: List[Tuple[int, FleetHandle]] = []
@@ -435,6 +446,7 @@ class FleetRouter:
     def _route(self, prompt: List[int], session: Optional[str],
                healthy: List[_ReplicaSlot],
                priority: Priority = Priority.INTERACTIVE,
+               adapter: Optional[str] = None,
                ) -> Tuple[_ReplicaSlot, str]:
         if session is not None:
             stuck = self._sessions.get(session)
@@ -442,6 +454,21 @@ class FleetRouter:
                 self._sessions.move_to_end(session)  # LRU touch
                 if stuck.available:
                     return stuck, "sticky"
+        if adapter is not None:
+            # Adapter affinity outranks prefix affinity (reloading
+            # LoRA factors costs more than a cold prefix chunk) but
+            # yields to stickiness — a multi-turn session's KV lives
+            # where the session lives — and to the SAME interactive
+            # pressure escape prefix affinity has: a popular adapter
+            # must not funnel interactive traffic onto one replica
+            # until it hard-QueueFulls while siblings idle.
+            home = self._adapter_homes.get(adapter)
+            if home is not None and home.available:
+                escape = self._interactive_load_escape(home, healthy,
+                                                       priority)
+                if escape is not None:
+                    return escape, "load"
+                return home, "adapter"
         best, best_blocks = None, 0
         for slot in healthy:
             m = slot.shadow.match_blocks(prompt,
@@ -451,29 +478,47 @@ class FleetRouter:
                                    and slot.load < best.load):
                 best, best_blocks = slot, m
         if best is not None and best_blocks > 0:
-            # Priority-aware load shedding of the affinity choice: a
-            # warm cache is worth a queue wait to a BATCH request, but
-            # an INTERACTIVE one under an SLO prefers a cold prefill
-            # on an idle replica over queueing behind a hot spot. When
-            # the affinity winner's load crosses the threshold and a
-            # meaningfully lighter healthy replica exists, interactive
-            # traffic takes it instead (labeled "load" — the runbook's
-            # signal that affinity is saturating).
-            if (self._interactive_reroute_load is not None
-                    and priority is Priority.INTERACTIVE
-                    and best.load >= self._interactive_reroute_load):
-                lightest = min(healthy, key=lambda s: s.load)
-                if lightest is not best and lightest.load < best.load:
-                    return lightest, "load"
+            escape = self._interactive_load_escape(best, healthy,
+                                                   priority)
+            if escape is not None:
+                return escape, "load"
             return best, "affinity"
         return self._rendezvous(prompt, healthy), "hash"
+
+    def _interactive_load_escape(self, chosen: _ReplicaSlot,
+                                 healthy: List[_ReplicaSlot],
+                                 priority: Priority,
+                                 ) -> Optional[_ReplicaSlot]:
+        """Priority-aware load shedding of an affinity choice (warm
+        prefix OR warm adapter): a warm cache is worth a queue wait to
+        a BATCH request, but an INTERACTIVE one under an SLO prefers a
+        cold start on an idle replica over queueing behind a hot spot.
+        When the affinity winner's load crosses the threshold and a
+        meaningfully lighter healthy replica exists, returns it
+        (routed/labeled "load" — the runbook's signal that affinity is
+        saturating); else None (keep the affinity choice)."""
+        if (self._interactive_reroute_load is None
+                or priority is not Priority.INTERACTIVE
+                or chosen.load < self._interactive_reroute_load):
+            return None
+        lightest = min(healthy, key=lambda s: s.load)
+        if lightest is not chosen and lightest.load < chosen.load:
+            return lightest
+        return None
 
     def submit(self, prompt, max_new_tokens: int, *,
                sampling: Optional[SamplingParams] = None,
                deadline_s: Optional[float] = None,
                session: Optional[str] = None,
-               priority: Priority = Priority.INTERACTIVE) -> FleetHandle:
+               priority: Priority = Priority.INTERACTIVE,
+               adapter: Optional[str] = None,
+               constraint: Optional[dict] = None) -> FleetHandle:
         """Route one request; returns its fleet stream handle.
+
+        ``adapter``/``constraint`` (the tenant fields, `serve/tenant/`)
+        pass through to the replica engines; same-adapter traffic
+        routes to the replica whose pool already holds the factors
+        (adapter affinity — sticky sessions still outrank it).
 
         Raises :class:`NoHealthyReplica` when every circuit is open,
         :class:`~pddl_tpu.serve.request.AdmissionRejected` when the
@@ -492,7 +537,8 @@ class FleetRouter:
             raise NoHealthyReplica(
                 f"no healthy replica among {len(self._slots)} "
                 "(all circuits open)")
-        chosen, how = self._route(prompt, session, healthy, priority)
+        chosen, how = self._route(prompt, session, healthy, priority,
+                                  adapter)
         now = self._clock()
         if self._admission is not None:
             self._admission.update(now, self._degraded_replica_count())
@@ -529,7 +575,8 @@ class FleetRouter:
             rid = next(self._rids)
             try:
                 slot.driver.submit(rid, prompt, max_new_tokens,
-                                   sampling, deadline_s, priority)
+                                   sampling, deadline_s, priority,
+                                   adapter, constraint)
             except QueueFull as e:
                 sheds_seen += 1
                 if e.retry_after_s is not None:
@@ -543,7 +590,8 @@ class FleetRouter:
             fh = FleetHandle(
                 Request(prompt=prompt, max_new_tokens=int(max_new_tokens),
                         sampling=sampling, deadline_s=deadline_s,
-                        priority=priority),
+                        priority=priority, adapter=adapter,
+                        constraint=constraint),
                 arrival_s=self._clock(), session=session)
             fh.replica_id = slot.replica_id
             fh.state = RequestState.QUEUED
@@ -552,6 +600,11 @@ class FleetRouter:
             slot.shadow.observe(prompt, max_blocks=self._affinity_blocks)
             if session is not None:
                 self._session_pin(session, slot)
+            if adapter is not None:
+                # The home follows where the request actually LANDED
+                # (a shed reroute moves it): that replica's pool holds
+                # — or is about to load — the factors.
+                self._adapter_homes[adapter] = slot
             self.metrics.requests_routed += 1
             # Only a reroute forced by an actual QueueFull is load
             # shedding (the runbook reads shed_rerouted as
@@ -566,6 +619,8 @@ class FleetRouter:
                     to_replica=slot.replica_id)
             elif how == "sticky":
                 self.metrics.routed_sticky += 1
+            elif how == "adapter":
+                self.metrics.routed_adapter += 1
             elif how == "affinity":
                 self.metrics.routed_affinity += 1
             elif how == "load":
@@ -748,6 +803,11 @@ class FleetRouter:
         now = self._clock()
         slot.state = ReplicaLifecycle.DEAD
         slot.breaker.trip(now)
+        # Its adapter pool died with it: drop only ITS homes, so the
+        # next same-adapter submission re-homes wherever it lands.
+        self._adapter_homes = {name: home for name, home
+                               in self._adapter_homes.items()
+                               if home is not slot}
         self.metrics.replica_down_events += 1
         self._tracer.on_fleet_event(
             "replica_down", replica=slot.replica_id,

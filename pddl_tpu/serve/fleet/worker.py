@@ -67,6 +67,36 @@ def build_engine(config: Dict[str, object]):
     params = model.init(jax.random.key(int(config.get("param_seed", 0))),
                         dummy, train=False)["params"]
     aging = config.get("aging_s", 30.0)
+    # Multi-tenant passthrough (ISSUE 9, mirroring the r13 `paged`
+    # passthrough): a `tenant` sub-config builds the same registry on
+    # every process replica — adapters are (name, seed[, rank, scale])
+    # pairs materialized via the registry's deterministic
+    # `register_random`, so every replica (and the chaos oracle) holds
+    # bit-identical factors and migrated tenant streams stay
+    # token-exact across processes. `token_strings` enables grammar
+    # constraints; absent `tenant` keeps the plain engine so existing
+    # fleet configs stay comparable.
+    tenant_cfg = config.get("tenant")
+    tenant = None
+    if tenant_cfg:
+        from pddl_tpu.serve.tenant import AdapterRegistry, TenantConfig
+
+        registry = AdapterRegistry(
+            model.embed_dim, model.vocab_size,
+            rank=int(tenant_cfg.get("rank", 8)))
+        for name, spec in (tenant_cfg.get("adapters") or {}).items():
+            registry.register_random(
+                name, int(spec["seed"]),
+                scale=float(spec.get("scale", 0.05)),
+                rank=spec.get("rank"))
+        pool_slots = tenant_cfg.get("adapter_pool_slots")
+        tenant = TenantConfig(
+            registry=registry,
+            adapter_pool_slots=(int(pool_slots)
+                                if pool_slots is not None else None),
+            token_strings=tenant_cfg.get("token_strings"),
+            adapter_load_tokens=int(
+                tenant_cfg.get("adapter_load_tokens", 8)))
     return ServeEngine(
         model, {"params": params},
         max_slots=int(config.get("slots", 8)),
@@ -85,6 +115,7 @@ def build_engine(config: Dict[str, object]):
         # pool through per-slot block tables; absent keeps the copy
         # engine so existing bench configs stay comparable.
         paged=bool(config.get("paged", False)),
+        tenant=tenant,
         rng=jax.random.key(int(config.get("engine_seed", 0))))
 
 
@@ -124,7 +155,9 @@ def main(argv=None) -> int:
                     sampling=sampling_from_wire(cmd.get("sampling")),
                     deadline_s=cmd.get("deadline_s"),
                     priority=Priority(cmd.get(
-                        "priority", Priority.INTERACTIVE.value)))
+                        "priority", Priority.INTERACTIVE.value)),
+                    adapter=cmd.get("adapter"),
+                    constraint=cmd.get("constraint"))
             except QueueFull as e:
                 _emit({"ev": "queue_full", "rid": rid,
                        "queue_depth": e.queue_depth,

@@ -142,6 +142,18 @@ class ServeMetrics:
         self.copy_bytes_avoided = 0
         self.blocks_shared = 0       # gauge, engine-stamped per tick
         self.block_table_fill = 0.0  # gauge, engine-stamped per tick
+        # Multi-tenant telemetry (`serve/tenant/`; all zero on a plain
+        # engine): adapter pool hits vs cold loads (the hit RATE is the
+        # runbook's pool-sizing signal), LRU evictions under pressure,
+        # a live residency gauge, per-adapter admission counts as a
+        # labeled series, and the constrained-decoding counters.
+        self.adapter_hits = 0        # admission found the adapter resident
+        self.adapter_loads = 0       # cold host->device factor loads
+        self.adapter_evictions = 0   # LRU evictions of unpinned rows
+        self.adapter_pool_resident = 0  # gauge, engine-stamped
+        self.requests_by_adapter: Dict[str, int] = {}
+        self.constrained_requests = 0    # submissions carrying a spec
+        self.requests_grammar_complete = 0  # FinishReason.GRAMMAR settles
         # Resilience telemetry (`serve/faults.py`, engine retry/replay/
         # degraded paths): all zero on a fault-free engine.
         self.retries = 0             # failed device calls retried
@@ -206,6 +218,13 @@ class ServeMetrics:
             self.requests_failed += 1
         else:
             self.requests_finished += 1
+            if reason_value == "grammar":
+                # A grammar-complete stream is a SUCCESS (the FSM ran
+                # out of legal continuations because the output is a
+                # complete document) — counted inside finished, plus
+                # its own counter so the tenant dashboard can tell
+                # grammar closure from eos/length.
+                self.requests_grammar_complete += 1
             if priority in self.finished_by_priority:
                 self.finished_by_priority[priority] += 1
 
@@ -279,6 +298,42 @@ class ServeMetrics:
         self.blocks_shared = int(blocks_shared)
         self.block_table_fill = float(block_table_fill)
 
+    # ---------------------------------------------------------- tenancy
+    def record_adapter_hit(self, name: str, resident: int, *,
+                           fresh: bool = True) -> None:
+        """One admission found its adapter already device-resident;
+        ``resident`` stamps the pool-residency gauge in passing.
+        ``fresh=False`` (a replay / preemption-resume re-admission)
+        still counts pool traffic but NOT per-tenant request volume —
+        ``requests_by_adapter`` is the capacity-planning series and
+        must count each request once, however many times faults
+        re-admit it."""
+        self.adapter_hits += 1
+        if fresh:
+            self.requests_by_adapter[name] = \
+                self.requests_by_adapter.get(name, 0) + 1
+        self.adapter_pool_resident = int(resident)
+
+    def record_adapter_load(self, name: str, resident: int,
+                            evictions: int, *,
+                            fresh: bool = True) -> None:
+        """One COLD adapter load (host→device factor transfer on the
+        admission path); ``evictions`` is the pool's cumulative LRU
+        eviction count (stamped, like the prefix cache's). ``fresh``
+        as in :meth:`record_adapter_hit` — a replay's reload is real
+        pool traffic (it keeps the hit rate honest about thrash) but
+        not new request volume."""
+        self.adapter_loads += 1
+        if fresh:
+            self.requests_by_adapter[name] = \
+                self.requests_by_adapter.get(name, 0) + 1
+        self.adapter_pool_resident = int(resident)
+        self.adapter_evictions = int(evictions)
+
+    def record_constrained(self) -> None:
+        """One submission carried a grammar/schema constraint."""
+        self.constrained_requests += 1
+
     # ------------------------------------------------------ reporting
     def snapshot(self) -> Dict[str, object]:
         """The dashboard dict: counters plus latency percentiles (None
@@ -313,6 +368,19 @@ class ServeMetrics:
             "copy_bytes_avoided": self.copy_bytes_avoided,
             "blocks_shared": self.blocks_shared,
             "block_table_fill": round(self.block_table_fill, 6),
+            "adapter_hits": self.adapter_hits,
+            "adapter_loads": self.adapter_loads,
+            "adapter_evictions": self.adapter_evictions,
+            "adapter_hit_rate": (
+                self.adapter_hits / (self.adapter_hits + self.adapter_loads)
+                if (self.adapter_hits + self.adapter_loads) else None),
+            "adapter_pool_resident": self.adapter_pool_resident,
+            "constrained_requests": self.constrained_requests,
+            "requests_grammar_complete": self.requests_grammar_complete,
+            # Labeled series: one sample per adapter NAME seen (unlike
+            # the priority splits the label set is open — a tenant
+            # appears on first admission and never vanishes).
+            "requests_by_adapter": dict(self.requests_by_adapter),
             "retries": self.retries,
             "replays": self.replays,
             "preemptions": self.preemptions,

@@ -113,6 +113,10 @@ class RequestState(enum.Enum):
 class FinishReason(enum.Enum):
     LENGTH = "length"        # emitted max_new_tokens
     EOS = "eos"              # hit the engine's eos token (included)
+    GRAMMAR = "grammar"      # a constrained stream's FSM reached a state
+    #                          with no legal continuation: the output is
+    #                          COMPLETE per its grammar (a success, like
+    #                          eos — e.g. a JSON document's closing brace)
     CANCELLED = "cancelled"  # handle.cancel()
     TIMED_OUT = "timed_out"  # deadline_s exceeded while running
     DEADLINE = "deadline"    # deadline already expired at pop time (shed
@@ -157,13 +161,25 @@ _ids = itertools.count()
 
 @dataclasses.dataclass
 class Request:
-    """One generate request as the scheduler sees it."""
+    """One generate request as the scheduler sees it.
+
+    ``adapter``/``constraint`` are the multi-tenant fields (ISSUE 9;
+    `serve/tenant/`): the NAME of a registered LoRA adapter (``None`` =
+    base model) and a JSON-able constraint spec dict
+    (``{"kind": "regex"|"json_schema", ...}`` —
+    :func:`pddl_tpu.serve.tenant.compile_constraint`'s input; ``None``
+    = unconstrained). Both are plain wire-serializable values, so the
+    drain snapshot (v4) and the fleet's submit/migration protocol carry
+    them without new encode/decode pairs, and a replayed or migrated
+    stream resumes under the identical adapter + automaton."""
 
     prompt: Sequence[int]
     max_new_tokens: int
     sampling: SamplingParams = SamplingParams()
     deadline_s: Optional[float] = None  # wall budget from submit()
     priority: Priority = Priority.INTERACTIVE
+    adapter: Optional[str] = None       # registered LoRA adapter name
+    constraint: Optional[dict] = None   # grammar/schema spec dict
     request_id: int = dataclasses.field(default_factory=lambda: next(_ids))
 
 

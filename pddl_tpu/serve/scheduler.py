@@ -184,6 +184,11 @@ class SLOScheduler:
         request (default: full prompt length). The prefix-cache engine
         charges the UNCACHED SUFFIX length — a cached prefix costs no
         prefill work, so it must not consume admission budget either.
+        The tenant engine additionally charges a COLD adapter load
+        (``TenantConfig.adapter_load_tokens``) through the same
+        cost_fn: a host→device factor transfer is admission-path work
+        exactly like an uncached suffix, and a resident adapter — like
+        a cached prefix — charges nothing.
         The charge is a pop-time ESTIMATE: same-tick donations usually
         shrink the real work below it, but under pool pressure an
         earlier admission's eviction pass can reclaim a later request's

@@ -1,0 +1,96 @@
+"""Multi-tenant serving: per-request LoRA adapters + constrained decoding.
+
+The "model server → platform" jump (ROADMAP item 3): two subsystems
+sharing one admission path, both built on the engine's core invariant —
+all per-slot variation lives in RUNTIME arrays, never in
+compiled-program shape:
+
+- **Paged LoRA** (`adapters.py` + `ops/lora.py`): a registry of host-
+  resident adapters, a fixed-shape device pool with pin-on-admit
+  refcounts and LRU eviction (the KV block pool's discipline applied to
+  weights), per-slot int32 adapter ids gathered inside the fused tick —
+  ONE compiled program serves every tenant mix, and admission charges a
+  cold load against the prefill budget like an uncached prompt suffix.
+- **Constrained decoding** (`grammar.py`): regex / JSON-schema →
+  Brzozowski-derivative DFA → token FSM whose per-state allow mask is
+  stamped as a runtime ``[S, V]`` array ahead of the batched sampler;
+  FSM state is a pure function of emitted tokens, so replay, drain/
+  restore and fleet migration re-derive it exactly like KV.
+
+Enable with ``ServeEngine(..., tenant=TenantConfig(...))``; see
+docs/SERVING.md § "Multi-tenant serving" and docs/OPERATIONS.md
+§ "Adapter pool sizing".
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence
+
+from pddl_tpu.serve.tenant.adapters import (
+    AdapterPool,
+    AdapterPoolExhausted,
+    AdapterRegistry,
+    LoRAAdapter,
+)
+from pddl_tpu.serve.tenant.grammar import (
+    TokenFSM,
+    compile_constraint,
+    constraint_key,
+    decode_tokens,
+    encode_text,
+    json_schema_to_regex,
+    token_fsm_from_regex,
+)
+
+
+@dataclasses.dataclass
+class TenantConfig:
+    """Multi-tenancy knobs for :class:`~pddl_tpu.serve.ServeEngine`.
+
+    Args:
+      registry: the deployment's :class:`AdapterRegistry`; ``None``
+        builds an empty one sized to the model (adapters can be
+        registered before traffic). Its ``embed_dim``/``vocab_size``
+        must match the engine's model — validated loudly at engine
+        construction.
+      adapter_pool_slots: device pool rows INCLUDING the reserved
+        identity row 0 — how many distinct adapters can be resident at
+        once. ``None`` (default) auto-sizes to the engine's
+        ``max_slots + 4`` (the live-mix floor plus a little hit-rate
+        headroom). An EXPLICIT size must cover the floor
+        ``max_slots + 1`` (every slot on a distinct adapter plus the
+        identity row) — the engine validates it loudly; the headroom
+        above the floor is the hit-rate knob (docs/OPERATIONS.md
+        § "Adapter pool sizing").
+      token_strings: token-id → string vocabulary for grammar
+        compilation (index = token id; empty/missing strings make a
+        token never-legal under any constraint). Required before a
+        constrained ``submit()`` — adapters-only tenancy may leave it
+        ``None``.
+      adapter_load_tokens: prefill-budget tokens a COLD adapter load is
+        charged at admission (a resident adapter charges nothing). The
+        default prices the host→device factor transfer roughly like a
+        short prompt chunk.
+    """
+
+    registry: Optional[AdapterRegistry] = None
+    adapter_pool_slots: Optional[int] = None
+    token_strings: Optional[Sequence[str]] = None
+    adapter_load_tokens: int = 8
+
+
+__all__ = [
+    "AdapterPool",
+    "AdapterPoolExhausted",
+    "AdapterRegistry",
+    "LoRAAdapter",
+    "TenantConfig",
+    "TokenFSM",
+    "compile_constraint",
+    "constraint_key",
+    "decode_tokens",
+    "encode_text",
+    "json_schema_to_regex",
+    "token_fsm_from_regex",
+]

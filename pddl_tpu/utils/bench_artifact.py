@@ -111,7 +111,11 @@ def timed_stats(fn: Callable, sync: Callable, *,
 _HIGHER_BETTER = ("tokens_per_s", "tokens_per_sec", "speedup", "retained",
                   "reduction", "hit_rate", "accepted", "_per_tick",
                   "throughput", "goodput", "shed_absorbed",
-                  "eliminated", "tokens_per_byte")
+                  "eliminated", "tokens_per_byte",
+                  # r14 multi-tenant headlines: aggregate mixed-tenant
+                  # decode rate up is better (adapter_hit_rate rides the
+                  # "hit_rate" rule, mask_overhead_x the "overhead" one).
+                  "tenant_tok_s")
 _LOWER_BETTER = ("ttft", "latency", "_ms", "_wall_s", "overhead",
                  "_seconds", "tick_s", "step_s", "copy_us")
 _NEVER = ("spread", "samples", "per_pair", "per_repeat", "n_requests",

@@ -54,6 +54,15 @@ def test_metric_direction_vocabulary():
     assert metric_direction("hit_admission_ttft_paged_s") == -1
     assert metric_direction("hit_admission_speedup_x") == 1
     assert metric_direction("admission_copy_us_row") == -1
+    # The r14 multi-tenant headlines: merged-copy elimination, the
+    # mixed-tenant throughput-retained ratio and absolute rate, and the
+    # adapter hit rate up are better; the constrained-decode mask
+    # overhead down is better.
+    assert metric_direction("merged_copy_eliminated_x") == 1
+    assert metric_direction("tenant_throughput_retained_x") == 1
+    assert metric_direction("mixed_tenant_tok_s") == 1
+    assert metric_direction("adapter_hit_rate") == 1
+    assert metric_direction("mask_overhead_x") == -1
     # Raw byte tallies are scale context, not headlines.
     assert metric_direction("kv_bytes_used_row") == 0
     # Noise keys are never compared.
@@ -81,6 +90,46 @@ def test_r13_paged_artifact_is_gated():
                 "effective_cached_tokens_per_byte_paged",
                 "hit_admission_ttft_paged_s"):
         assert metric_direction(key) != 0, key
+
+
+def test_r14_tenant_artifact_is_gated():
+    """The multi-tenant artifact participates in the series: it loads,
+    keys into a (metric, config) group, its committed headlines clear
+    the ISSUE 9 bounds, they are DIRECTIONAL — and a same-config
+    r-record that regresses them fails `check_series` LOUDLY (the
+    regressing-record leg below is the gate-participation pin)."""
+    path = os.path.join(_BENCH_DIR, "r14_serve_tenant.json")
+    records = [r for r in load_artifact(path)
+               if artifact_key(r) is not None]
+    assert records, "r14_serve_tenant.json has no keyed record"
+    tenant = records[0]["results"]["tenant"]
+    # ISSUE 9 acceptance bounds on the committed medians.
+    assert tenant["merged_copy_eliminated_x"] >= 3.0
+    assert tenant["tenant_throughput_retained_x"] >= 0.85
+    assert tenant["mask_overhead_x"] <= 1.10
+    for key in ("merged_copy_eliminated_x",
+                "tenant_throughput_retained_x", "mixed_tenant_tok_s",
+                "mask_overhead_x"):
+        assert metric_direction(key) != 0, key
+    # A hypothetical r15 record at the SAME config whose tenant
+    # headlines regressed must fail the series gate loudly.
+    worse = copy.deepcopy(records[0])
+    worse["results"]["tenant"]["tenant_throughput_retained_x"] *= 0.8
+    worse["results"]["tenant"]["mask_overhead_x"] *= 1.5
+    import json as _json
+    import tempfile
+    with tempfile.TemporaryDirectory() as d:
+        old_p = os.path.join(d, "r14_t.json")
+        new_p = os.path.join(d, "r15_t.json")
+        with open(old_p, "w") as f:
+            _json.dump(records[0], f)
+        with open(new_p, "w") as f:
+            _json.dump(worse, f)
+        pairs, failures = check_series([old_p, new_p])
+        assert pairs == 1 and len(failures) == 1
+        paths = {r["path"] for r in failures[0]["regressions"]}
+        assert "results.tenant.tenant_throughput_retained_x" in paths
+        assert "results.tenant.mask_overhead_x" in paths
 
 
 def test_compare_flags_directional_regressions_only():
