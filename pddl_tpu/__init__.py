@@ -31,17 +31,37 @@ The package name abbreviates the reference repo name
 
 from pddl_tpu.version import __version__
 
-# Re-exports of the primary public API.  Heavy submodules (models, data,
-# train) are imported lazily by user code; core mesh/strategy types are cheap.
-from pddl_tpu.core.mesh import MeshConfig, build_mesh, local_device_count
-from pddl_tpu.core import collectives
-from pddl_tpu.core.sharding import MinSizePartitioner
+# Re-exports of the primary public API, resolved LAZILY (PEP 562): the
+# names below behave exactly as eager imports for user code
+# (``pddl_tpu.build_mesh``, ``from pddl_tpu import MeshConfig``), but
+# importing the bare package no longer pulls in jax. That keeps
+# import-free tooling import-free — ``python -m pddl_tpu.analysis``
+# (graftlint) is pure-AST by contract and must never pay (or depend
+# on) a jax import just to reach its own package.
+_LAZY_EXPORTS = {
+    "MeshConfig": ("pddl_tpu.core.mesh", "MeshConfig"),
+    "build_mesh": ("pddl_tpu.core.mesh", "build_mesh"),
+    "local_device_count": ("pddl_tpu.core.mesh", "local_device_count"),
+    "collectives": ("pddl_tpu.core.collectives", None),
+    "MinSizePartitioner": ("pddl_tpu.core.sharding", "MinSizePartitioner"),
+}
 
-__all__ = [
-    "__version__",
-    "MeshConfig",
-    "build_mesh",
-    "local_device_count",
-    "collectives",
-    "MinSizePartitioner",
-]
+__all__ = ["__version__"] + sorted(_LAZY_EXPORTS)
+
+
+def __getattr__(name):
+    try:
+        module_name, attr = _LAZY_EXPORTS[name]
+    except KeyError:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}") from None
+    import importlib
+
+    module = importlib.import_module(module_name)
+    value = module if attr is None else getattr(module, attr)
+    globals()[name] = value  # cache: next access skips __getattr__
+    return value
+
+
+def __dir__():
+    return sorted(set(globals()) | set(__all__))

@@ -1,0 +1,36 @@
+"""graftlint — static invariant analysis for the pddl_tpu engine.
+
+``python -m pddl_tpu.analysis --check pddl_tpu/`` machine-checks the
+stack's load-bearing conventions (pin/release pairing, donation
+discipline, recompile hazards, site vocabularies, exposition parity,
+snapshot hygiene) at pure-AST level: no jax import, no module
+execution, sub-second over the whole tree — cheap enough for every
+test run (``tests/test_analysis.py``, marker ``analysis``).
+
+See ``docs/ANALYSIS.md`` for the invariant catalogue, suppression and
+baseline syntax, and how to add a checker.
+"""
+
+from __future__ import annotations
+
+from pddl_tpu.analysis.core import (  # noqa: F401 - the public surface
+    DEFAULT_BASELINE,
+    Finding,
+    Project,
+    Rule,
+    all_rules,
+    apply_baseline,
+    load_baseline,
+    run_analysis,
+)
+
+__all__ = [
+    "DEFAULT_BASELINE",
+    "Finding",
+    "Project",
+    "Rule",
+    "all_rules",
+    "apply_baseline",
+    "load_baseline",
+    "run_analysis",
+]

@@ -1,0 +1,29 @@
+"""The graftlint rule registry — one module per invariant.
+
+Each checker file's docstring names the invariant it encodes and the
+CHANGES.md incident that motivated it; ``docs/ANALYSIS.md`` is the
+catalogue. Adding a checker: subclass
+:class:`pddl_tpu.analysis.core.Rule`, set ``name``/``doc``, implement
+``run(project)``, append the class here, and give it a seeded-bad
+fixture + good twin under ``tests/fixtures/graftlint/``.
+"""
+
+from __future__ import annotations
+
+from pddl_tpu.analysis.checkers.donation import DonationRule
+from pddl_tpu.analysis.checkers.exposition import ExpositionParityRule
+from pddl_tpu.analysis.checkers.pin_release import PinReleaseRule
+from pddl_tpu.analysis.checkers.recompile import RecompileHazardRule
+from pddl_tpu.analysis.checkers.site_vocab import SiteVocabRule
+from pddl_tpu.analysis.checkers.snapshot_vocab import SnapshotHygieneRule
+
+RULES = (
+    PinReleaseRule,
+    DonationRule,
+    RecompileHazardRule,
+    SiteVocabRule,
+    ExpositionParityRule,
+    SnapshotHygieneRule,
+)
+
+__all__ = ["RULES"] + [cls.__name__ for cls in RULES]

@@ -1,0 +1,170 @@
+"""Rule ``site-vocab``: one site-name vocabulary per engine —
+``_device_call`` literals, ``compile_counts()`` keys, and the paired
+``FaultPlan.SITES`` tuple must agree.
+
+The fault-injection machinery (utils/faults.py) validates every
+scheduled fault coordinate against ``FaultPlan.SITES`` "so a typo'd
+coordinate cannot silently never fire" — but nothing validated SITES
+itself against the engine it describes. A site added to the engine
+(a new compiled program + ``_device_call`` boundary) that never lands
+in the faults vocabulary is a device-call path chaos testing can
+never reach; a stale SITES entry is a vocabulary lying about the
+engine. (Found on the first run of this rule: ``adapter_load`` —
+added in r14 — was dispatchable and counted but missing from
+``serve/faults.py`` SITES, so no chaos profile could target the
+adapter-load path.)
+
+Checked per engine module:
+
+- every literal first argument of a ``_device_call(...)`` appears in
+  the module's ``compile_counts()`` key set;
+- every ``compile_counts()`` key appears in the paired faults module's
+  ``SITES`` tuple;
+- every ``SITES`` entry appears in ``compile_counts()`` keys.
+
+Pairing: a module containing both ``_device_call`` sites and a
+``SITES`` class is self-paired (test fixtures); otherwise the
+``ENGINE_FAULTS_PAIRS`` path map below (engine → faults module).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from pddl_tpu.analysis.core import (
+    Module,
+    Project,
+    Rule,
+    call_name,
+    const_str_tuple,
+    string_keys,
+)
+
+# Engine module -> its faults-vocabulary module (repo-relative path
+# suffixes; resolved through the project so fixtures can shadow them).
+ENGINE_FAULTS_PAIRS = (
+    ("pddl_tpu/serve/engine.py", "pddl_tpu/serve/faults.py"),
+    ("pddl_tpu/train/loop.py", "pddl_tpu/train/faults.py"),
+)
+
+
+def _device_call_sites(tree: ast.AST) -> List[Tuple[str, int]]:
+    sites = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and call_name(node) == "_device_call" \
+                and node.args:
+            first = node.args[0]
+            if isinstance(first, ast.Constant) and isinstance(first.value,
+                                                              str):
+                sites.append((first.value, node.lineno))
+    return sites
+
+
+def _compile_counts_keys(tree: ast.AST) -> Optional[Dict[str, int]]:
+    """String keys mentioned in the module's ``compile_counts``
+    function(s): dict-literal keys, ``counts["x"] = ...`` stores, and
+    literal first elements of tuple iterations."""
+    keys: Dict[str, int] = {}
+    found = False
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.FunctionDef)
+                and node.name == "compile_counts"):
+            continue
+        found = True
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Dict):
+                for key, line in string_keys(sub):
+                    keys.setdefault(key, line)
+            elif isinstance(sub, ast.Assign):
+                for target in sub.targets:
+                    if isinstance(target, ast.Subscript) \
+                            and isinstance(target.slice, ast.Constant) \
+                            and isinstance(target.slice.value, str):
+                        keys.setdefault(target.slice.value, target.lineno)
+            elif isinstance(sub, ast.Tuple) and sub.elts \
+                    and isinstance(sub.elts[0], ast.Constant) \
+                    and isinstance(sub.elts[0].value, str):
+                keys.setdefault(sub.elts[0].value, sub.lineno)
+    return keys if found else None
+
+
+def _sites_tuples(tree: ast.AST) -> List[Tuple[Set[str], int, str]]:
+    """Every class-level ``SITES = (...)`` assignment: (values, line,
+    class name)."""
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        for stmt in node.body:
+            targets = []
+            value = None
+            if isinstance(stmt, ast.Assign):
+                targets, value = stmt.targets, stmt.value
+            elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+                targets, value = [stmt.target], stmt.value
+            for t in targets:
+                if isinstance(t, ast.Name) and t.id == "SITES":
+                    vals = const_str_tuple(value)
+                    if vals is not None and vals:
+                        out.append((set(vals), stmt.lineno, node.name))
+    return out
+
+
+class SiteVocabRule(Rule):
+    name = "site-vocab"
+    doc = ("_device_call sites, compile_counts() keys, and the paired "
+           "FaultPlan.SITES must be one vocabulary")
+
+    def run(self, project: Project) -> Iterable:
+        for module in project.modules:
+            counts = _compile_counts_keys(module.tree)
+            if counts is None:
+                continue
+            sites = _device_call_sites(module.tree)
+            if not sites and not counts:
+                continue
+            # Every dispatched literal site must be a counted program.
+            for site, line in sites:
+                if site not in counts:
+                    yield self.finding(
+                        module, line,
+                        f"_device_call site {site!r} is not a "
+                        "compile_counts() key — the dispatch is "
+                        "invisible to the zero-recompile pin and "
+                        "untargetable by chaos")
+            vocab = self._paired_vocab(project, module)
+            if vocab is None:
+                continue
+            sites_set, faults_mod, vocab_line, cls = vocab
+            for key, line in sorted(counts.items()):
+                if key not in sites_set:
+                    yield self.finding(
+                        module, line,
+                        f"compile_counts() key {key!r} is missing from "
+                        f"{cls}.SITES ({faults_mod.rel}:{vocab_line}) — "
+                        "no fault profile can target this device-call "
+                        "site")
+            for site in sorted(sites_set - set(counts)):
+                yield self.finding(
+                    faults_mod, vocab_line,
+                    f"{cls}.SITES entry {site!r} matches no "
+                    f"compile_counts() key of {module.rel} — stale "
+                    "vocabulary")
+
+    def _paired_vocab(self, project: Project, module: Module):
+        own = _sites_tuples(module.tree)
+        if own:
+            vals, line, cls = own[0]
+            return vals, module, line, cls
+        for engine_suffix, faults_suffix in ENGINE_FAULTS_PAIRS:
+            if module.rel.endswith(engine_suffix):
+                faults_mod = project.module_by_suffix(faults_suffix)
+                if faults_mod is None:
+                    return None
+                tuples = _sites_tuples(faults_mod.tree)
+                if not tuples:
+                    return None
+                vals, line, cls = tuples[0]
+                return vals, faults_mod, line, cls
+        return None

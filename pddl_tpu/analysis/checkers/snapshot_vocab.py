@@ -1,0 +1,234 @@
+"""Rule ``snapshot-hygiene``: wire-format keys may only change with a
+``SNAPSHOT_VERSION`` bump, and bench-artifact headline keys must have
+a direction in the ``bench_artifact`` vocabulary.
+
+**(a) drain-snapshot entry keys.** ``serve/drain.py`` owns the
+serving snapshot wire format; r12 (priority), r13 (block tables) and
+r14 (adapter/constraint) each changed the entry shape WITH a version
+bump plus forward/backward-compat pins. The failure mode this rule
+closes: a key added or renamed without the bump — every restoring
+engine happily reads the versioned header, then mis-decodes the
+entries. Mechanism: the module must carry a literal manifest named
+``ENTRY_KEYS_V{SNAPSHOT_VERSION}`` matching the keys its encode
+functions actually emit (dict-literal keys plus ``entry["k"] = ...``
+stores in ``encode*``-named functions). Changing the encoder without
+updating the manifest fails; updating the manifest forces its name —
+and therefore ``SNAPSHOT_VERSION`` — through review.
+
+**(b) bench-artifact direction vocabulary.** The perf gate
+(``utils/bench_artifact.compare``) only guards keys it can assign a
+direction; a headline metric whose name matches no vocabulary rule
+silently exits the gate (the "quietest regression" the r11 review
+called out for vanished leaves — this is the same hole for NEW
+leaves). Every committed artifact leaf that is headline-shaped (ends
+in ``_x``, or a ``*tok_s``/``*tokens_per_s`` rate) must get a nonzero
+direction from the vocabulary parsed out of ``bench_artifact.py``
+(``_HIGHER_BETTER``/``_LOWER_BETTER``/``_NEVER`` — AST-extracted, no
+import).
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from pddl_tpu.analysis.core import (
+    Module,
+    Project,
+    Rule,
+    const_str_tuple,
+    string_keys,
+)
+
+BENCH_VOCAB_SUFFIX = "pddl_tpu/utils/bench_artifact.py"
+_MANIFEST_RE = re.compile(r"^ENTRY_KEYS_V(\d+)$")
+_HEADLINE_RE = re.compile(r"(_x$|tok_s$|tokens_per_s$)")
+
+
+class SnapshotHygieneRule(Rule):
+    name = "snapshot-hygiene"
+    doc = ("snapshot wire keys change only with a SNAPSHOT_VERSION "
+           "bump; artifact headline keys need a gate direction")
+
+    def __init__(self, artifacts_root: Optional[str] = None):
+        # Injectable for tests; default: the repo's committed series.
+        self._artifacts_root = artifacts_root
+
+    def run(self, project: Project) -> Iterable:
+        for module in project.modules:
+            yield from self._check_manifest(module)
+        yield from self._check_artifacts(project)
+
+    # ----------------------------------------------- entry manifests
+    def _check_manifest(self, module: Module) -> Iterable:
+        version: Optional[Tuple[int, int]] = None    # (value, line)
+        manifests: List[Tuple[int, List[str], int]] = []  # (v, keys, line)
+        for node in module.tree.body:
+            if not isinstance(node, ast.Assign):
+                continue
+            for target in node.targets:
+                if not isinstance(target, ast.Name):
+                    continue
+                if target.id == "SNAPSHOT_VERSION" \
+                        and isinstance(node.value, ast.Constant) \
+                        and isinstance(node.value.value, int):
+                    version = (node.value.value, node.lineno)
+                m = _MANIFEST_RE.match(target.id)
+                if m:
+                    keys = const_str_tuple(node.value)
+                    if keys is not None:
+                        manifests.append((int(m.group(1)), keys,
+                                          node.lineno))
+        if version is None:
+            return
+        encoded = self._encoded_keys(module.tree)
+        if encoded is None:
+            return
+        vnum, vline = version
+        current = [m for m in manifests if m[0] == vnum]
+        if not current:
+            yield self.finding(
+                module, vline,
+                f"SNAPSHOT_VERSION is {vnum} but no ENTRY_KEYS_V{vnum} "
+                "manifest exists — the wire format is unreviewable; "
+                "declare the entry-key manifest next to the version")
+            return
+        _, declared, mline = current[0]
+        actual = set(encoded)
+        if set(declared) != actual:
+            added = sorted(actual - set(declared))
+            removed = sorted(set(declared) - actual)
+            detail = []
+            if added:
+                detail.append(f"encoder emits undeclared {added}")
+            if removed:
+                detail.append(f"manifest declares unemitted {removed}")
+            yield self.finding(
+                module, mline,
+                "snapshot entry keys changed without a SNAPSHOT_VERSION "
+                f"bump: {'; '.join(detail)} — bump the version, rename "
+                f"the manifest to ENTRY_KEYS_V{vnum + 1}, and extend "
+                "the compat pins")
+
+    @staticmethod
+    def _encoded_keys(tree: ast.AST) -> Optional[Set[str]]:
+        """Keys the encode path emits: dict-literal keys in functions
+        named ``*encode*`` plus ``entry["k"] = ...`` stores there."""
+        keys: Set[str] = set()
+        found = False
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.FunctionDef)
+                    and "encode" in node.name
+                    and "sampling" not in node.name):
+                continue
+            found = True
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Dict):
+                    for k, _ in string_keys(sub):
+                        keys.add(k)
+                elif isinstance(sub, ast.Assign):
+                    for t in sub.targets:
+                        if isinstance(t, ast.Subscript) \
+                                and isinstance(t.slice, ast.Constant) \
+                                and isinstance(t.slice.value, str):
+                            keys.add(t.slice.value)
+        return keys if found else None
+
+    # --------------------------------------------- artifact headlines
+    def _check_artifacts(self, project: Project) -> Iterable:
+        vocab_mod = project.module_by_suffix(BENCH_VOCAB_SUFFIX)
+        if vocab_mod is None:
+            return
+        vocab = self._direction_vocab(vocab_mod.tree)
+        if vocab is None:
+            return
+        higher, lower, never = vocab
+        root = self._artifacts_root
+        if root is None:
+            root = os.path.join(project.root, "artifacts")
+        if not os.path.isdir(root):
+            return
+        flagged: Set[str] = set()
+        for dirpath, dirnames, filenames in os.walk(root):
+            dirnames.sort()
+            for name in sorted(filenames):
+                if not name.endswith(".json"):
+                    continue
+                path = os.path.join(dirpath, name)
+                try:
+                    with open(path, encoding="utf-8") as f:
+                        record = json.load(f)
+                except (OSError, ValueError):
+                    continue
+                rel = os.path.relpath(path, project.root) \
+                    if path.startswith(project.root) else path
+                for key in self._leaf_keys(record):
+                    if not _HEADLINE_RE.search(key):
+                        continue
+                    # None = a _NEVER match, a deliberate ruling ("not
+                    # a headline") — only direction 0 is a vocab GAP.
+                    if self._direction(key, higher, lower,
+                                       never) != 0:
+                        continue
+                    if key in flagged:
+                        continue
+                    flagged.add(key)
+                    yield Finding_for_artifact(self, vocab_mod, rel, key)
+
+    @staticmethod
+    def _direction_vocab(tree: ast.AST):
+        found: Dict[str, List[str]] = {}
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Assign):
+                continue
+            for target in node.targets:
+                if isinstance(target, ast.Name) and target.id in (
+                        "_HIGHER_BETTER", "_LOWER_BETTER", "_NEVER"):
+                    vals = const_str_tuple(node.value)
+                    if vals is not None:
+                        found[target.id] = vals
+        if set(found) != {"_HIGHER_BETTER", "_LOWER_BETTER", "_NEVER"}:
+            return None
+        return (found["_HIGHER_BETTER"], found["_LOWER_BETTER"],
+                found["_NEVER"])
+
+    @staticmethod
+    def _direction(key: str, higher, lower, never) -> Optional[int]:
+        """Mirror of bench_artifact.metric_direction over the
+        AST-extracted vocabulary, except a _NEVER match returns None
+        (an explicit ruling) rather than 0 (no ruling at all)."""
+        k = key.lower()
+        if any(m in k for m in never):
+            return None
+        for m in higher:
+            if m in k:
+                return 1
+        for m in lower:
+            if m in k:
+                return -1
+        return 0
+
+    def _leaf_keys(self, record) -> Iterable[str]:
+        if isinstance(record, dict):
+            for k, v in record.items():
+                if isinstance(v, (dict, list)):
+                    yield from self._leaf_keys(v)
+                elif isinstance(v, (int, float)) \
+                        and not isinstance(v, bool):
+                    yield str(k)
+        elif isinstance(record, list):
+            for item in record:
+                yield from self._leaf_keys(item)
+
+
+def Finding_for_artifact(rule: SnapshotHygieneRule, vocab_mod: Module,
+                         artifact_rel: str, key: str):
+    return rule.finding(
+        vocab_mod, 1,
+        f"artifact {artifact_rel} headline key {key!r} gets no "
+        "direction from the bench_artifact vocabulary — the perf gate "
+        "silently skips it; extend _HIGHER_BETTER/_LOWER_BETTER (or "
+        "_NEVER it with cause)")

@@ -60,6 +60,17 @@ from pddl_tpu.serve.request import (
 SNAPSHOT_VERSION = 4
 _READABLE_VERSIONS = frozenset({1, 2, 3, 4})
 
+# Machine-checked wire manifest (graftlint `snapshot-hygiene`,
+# docs/ANALYSIS.md): the exact entry keys ``_encode_core``/
+# ``encode_handle`` emit at the CURRENT snapshot version. Changing the
+# entry shape requires bumping SNAPSHOT_VERSION, renaming this tuple to
+# ENTRY_KEYS_V<new>, and extending the compat pins in the same commit —
+# the static checker fails the tree otherwise, which is what turns
+# "remembered to bump" into "cannot forget to bump".
+ENTRY_KEYS_V4 = ("prompt", "max_new_tokens", "sampling", "deadline_s",
+                 "priority", "adapter", "constraint", "elapsed_s",
+                 "tokens", "ttft_s", "block_table")
+
 
 def encode_sampling(sampling: SamplingParams) -> Dict[str, object]:
     """The one wire shape for sampling params — shared by snapshot

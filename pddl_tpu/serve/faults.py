@@ -43,4 +43,4 @@ class FaultPlan(_BaseFaultPlan):
     (== ``ServeEngine.compile_counts()`` keys)."""
 
     SITES = ("prefill", "gather", "chunk_prefill", "chunk_prefill_wide",
-             "donate", "insert", "tick", "sample_first")
+             "donate", "insert", "tick", "sample_first", "adapter_load")

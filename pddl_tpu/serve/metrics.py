@@ -382,6 +382,11 @@ class ServeMetrics:
             # appears on first admission and never vanishes).
             "requests_by_adapter": dict(self.requests_by_adapter),
             "retries": self.retries,
+            # Per-site retry attribution (open label set, like
+            # requests_by_adapter): WHERE the transient faults land —
+            # recorded since r08 but only exported since the graftlint
+            # exposition-parity rule caught it missing here.
+            "retry_sites": dict(self.retry_sites),
             "replays": self.replays,
             "preemptions": self.preemptions,
             "requests_failed": self.requests_failed,

@@ -375,15 +375,33 @@ def set_learning_rate(state: TrainState, value: float) -> TrainState:
         if hasattr(opt_state, "hyperparams") and "learning_rate" in opt_state.hyperparams:
             old = opt_state.hyperparams["learning_rate"]
             new_hp = dict(opt_state.hyperparams)
-            # A HOST (numpy) scalar, not jnp: a device scalar created here
-            # is host-local (SingleDeviceSharding), which a multi-host
-            # checkpoint save rejects; every process computes the same
-            # value, and the jitted step re-places it per the state
-            # sharding anyway.
-            import numpy as _np
+            # Stamp a DEVICE scalar, placed like the leaf it replaces.
+            # A host-numpy scalar here rides the next donated train
+            # step as a buffer the runtime does not own — the
+            # r10-documented container-jaxlib corruption class, and the
+            # roaming tier-1 flake (ROADMAP "Known flake": the final LR
+            # read back as float32-bits-of-int). Re-using the OLD
+            # leaf's sharding keeps the multi-host property the numpy
+            # choice was protecting: every process stamps the same
+            # value under the same (committed) sharding, so checkpoint
+            # saves still see a consistently-addressable array.
+            dtype = jnp.asarray(old).dtype
+            if isinstance(old, jax.Array) and hasattr(old, "sharding"):
+                new_hp["learning_rate"] = jax.device_put(
+                    jnp.asarray(value, dtype=dtype), old.sharding)
+            else:
+                # The leaf is ALREADY host numpy (a tree from an old
+                # pre-r15 setter — fresh init and verified-ckpt
+                # restore both produce jax.Arrays). Replacing host
+                # with host keeps the multi-host save property; a bare
+                # jnp scalar here would be host-local
+                # (SingleDeviceSharding), which a multi-host save
+                # rejects — and no NEW donation hazard is introduced,
+                # since the tree carried a host leaf before this call.
+                import numpy as _np
 
-            new_hp["learning_rate"] = _np.asarray(
-                value, dtype=jnp.asarray(old).dtype)
+                new_hp["learning_rate"] = _np.asarray(  # graftlint: disable=donation
+                    value, dtype=dtype)
             return opt_state._replace(hyperparams=new_hp)
         if isinstance(opt_state, tuple):
             subs = [_set(s) for s in opt_state]

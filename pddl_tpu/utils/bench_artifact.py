@@ -112,10 +112,17 @@ _HIGHER_BETTER = ("tokens_per_s", "tokens_per_sec", "speedup", "retained",
                   "reduction", "hit_rate", "accepted", "_per_tick",
                   "throughput", "goodput", "shed_absorbed",
                   "eliminated", "tokens_per_byte",
-                  # r14 multi-tenant headlines: aggregate mixed-tenant
-                  # decode rate up is better (adapter_hit_rate rides the
-                  # "hit_rate" rule, mask_overhead_x the "overhead" one).
-                  "tenant_tok_s")
+                  # Any *_tok_s leaf is a decode rate (r14's mixed/
+                  # plain/constrained legs included); adapter_hit_rate
+                  # rides "hit_rate", mask_overhead_x "overhead". The
+                  # graftlint snapshot-hygiene rule audits every
+                  # committed headline key against this vocabulary.
+                  "tok_s",
+                  # Throughput ratios against a clean baseline
+                  # (r09 tracing_off_vs_r08_clean_x, r11 vs_r08_clean_x)
+                  # and the tracing-on/off retention ratio: up = less
+                  # overhead lost.
+                  "clean_x", "tracing_on_over_off")
 _LOWER_BETTER = ("ttft", "latency", "_ms", "_wall_s", "overhead",
                  "_seconds", "tick_s", "step_s", "copy_us")
 _NEVER = ("spread", "samples", "per_pair", "per_repeat", "n_requests",

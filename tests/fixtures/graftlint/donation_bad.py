@@ -1,0 +1,31 @@
+"""Seeded-bad fixture for the ``donation`` rule: a read of a donated
+buffer after the call, and a host-numpy leaf stored into a donated
+tree (the set_learning_rate tier-1 flake, distilled)."""
+
+import jax
+import numpy as np
+
+
+class Engine:
+    def build(self, tick):
+        self._tick_p = jax.jit(tick, donate_argnums=(1,))
+
+    def step(self, tokens):
+        new_cache, out = self._tick_p(self._params, self._cache, tokens)
+        # BUG: self._cache was donated to the tick — its buffer is
+        # consumed; this read sees freed (or silently reused) memory.
+        stale = self._cache["k"]
+        self._cache = new_cache
+        return out, stale
+
+
+def set_learning_rate(state, value):
+    def _set(opt_state):
+        new_hp = dict(opt_state.hyperparams)
+        # BUG (the ROADMAP "Known flake"): a HOST numpy scalar stored
+        # into the opt_state tree rides the donated train step — the
+        # runtime donates a buffer it does not own.
+        new_hp["learning_rate"] = np.asarray(value, dtype=np.float32)
+        return opt_state._replace(hyperparams=new_hp)
+
+    return state.replace(opt_state=_set(state.opt_state))

@@ -1,0 +1,24 @@
+"""Seeded-bad fixture for the ``exposition-parity`` rule: a recorded
+counter that never surfaces in snapshot() (the real retry_sites gap
+this rule found in ServeMetrics), and a counter-key declaration typing
+a metric nobody emits."""
+
+# BUG: "ghost_total" is declared a counter but no snapshot emits it —
+# stale typing for a metric that does not exist.
+SERVE_COUNTER_KEYS = frozenset({"requests_finished", "ghost_total"})
+
+
+class Metrics:
+    def __init__(self):
+        self.requests_finished = 0
+        # BUG: recorded on every retry, never exported — invisible to
+        # the exposition AND to the runtime drift guard.
+        self.retry_sites = {}
+
+    def record_retry(self, site):
+        self.retry_sites[site] = self.retry_sites.get(site, 0) + 1
+
+    def snapshot(self):
+        return {
+            "requests_finished": self.requests_finished,
+        }

@@ -1,0 +1,26 @@
+"""Good twin for the ``exposition-parity`` fixture: every recorded
+field surfaces in snapshot(), every declared counter key is emitted.
+Must lint clean."""
+
+SERVE_COUNTER_KEYS = frozenset({"requests_finished"})
+
+
+class Metrics:
+    def __init__(self, reservoir_cap: int = 8192):
+        # Configuration (from a constructor parameter) — not a metric.
+        self.reservoir_cap = int(reservoir_cap)
+        self.requests_finished = 0
+        self.retry_sites = {}
+        self.ttft_s = []
+
+    def record_retry(self, site):
+        self.retry_sites[site] = self.retry_sites.get(site, 0) + 1
+
+    def snapshot(self):
+        return {
+            "requests_finished": self.requests_finished,
+            "retry_sites": dict(self.retry_sites),
+            # Derived keys cover their source field (ttft_s).
+            "ttft_p50_s": None,
+            "ttft_p99_s": None,
+        }

@@ -1,0 +1,37 @@
+"""Seeded-bad fixture: the r13 parked-slice drop (CHANGES.md PR 8
+review pass), distilled.
+
+A parked mid-prefill slice was dropped on the paged-world reset still
+holding freshly allocated block ids and a pinned index node — an early
+exit path that never released what admission had acquired. The
+graftlint ``pin-release`` rule must flag both escapes.
+"""
+
+
+class Engine:
+    def start_slice(self, prompt, n_blocks):
+        node = self.match(prompt)
+        self._prefix.pin(node)
+        private = self._prefix.allocate(n_blocks)
+        if self._draining:
+            # BUG (r13 class): the slice is dropped pre-reset WITHOUT
+            # releasing the private blocks or unpinning the node — the
+            # pool leaks the ids and the refcount wedges the chain.
+            return None
+        slice_state = {"node": node, "private": private, "off": 0}
+        self._slices.append(slice_state)
+        return slice_state
+
+    def start_slice_faulty_unwind(self, prompt, n_blocks):
+        node = self.match(prompt)
+        self._prefix.pin(node)
+        ids = self._prefix.allocate(n_blocks)
+        try:
+            self.scatter(ids)
+        except RuntimeError:
+            # BUG (r13 class): the exception unwind releases the ids
+            # but forgets the pin — the chain can never be evicted.
+            self._prefix.release(ids)
+            raise
+        self._prefix.extend(node, prompt, ids)
+        self._prefix.unpin(node)

@@ -1,0 +1,24 @@
+"""Seeded-bad fixture: the r14 adapter-pin double-release (CHANGES.md
+PR 9 review pass), distilled.
+
+A sliced admission that completed within its first step and whose
+install then faulted released the adapter pin TWICE on the unwind path
+(slice-done bookkeeping could not distinguish never-created from
+created-finished-then-faulted) — a refcount underflow. The graftlint
+``pin-release`` rule must flag the second release.
+"""
+
+
+class Engine:
+    def finish_slice_install(self, sl):
+        row = sl["arow"]
+        try:
+            self.install_slot(sl)
+        except RuntimeError:
+            # Slice teardown releases the adapter pin...
+            self._apool.unpin(row)
+            self.scrub(sl)
+            # BUG (r14 class): ...and the admission unwind releases the
+            # SAME pin again — refcount underflow on the fault path.
+            self._apool.unpin(row)
+            raise

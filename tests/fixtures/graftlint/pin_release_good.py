@@ -1,0 +1,64 @@
+"""Good twin for the ``pin-release`` fixtures: the same shapes with
+the discipline intact — release on every unwind path, exactly once,
+or an explicit hand-off to longer-lived state. Must lint clean.
+"""
+
+
+class Engine:
+    def start_slice(self, prompt, n_blocks):
+        node = self.match(prompt)
+        self._prefix.pin(node)
+        private = self._prefix.allocate(n_blocks)
+        if self._draining:
+            # Fixed r13 shape: the early exit releases everything the
+            # admission acquired before dropping the slice.
+            self._prefix.release(private)
+            self._prefix.unpin(node)
+            return None
+        slice_state = {"node": node, "private": private, "off": 0}
+        self._slices.append(slice_state)   # hand-off: slice owns them
+        return slice_state
+
+    def start_slice_clean_unwind(self, prompt, n_blocks):
+        node = self.match(prompt)
+        self._prefix.pin(node)
+        ids = self._prefix.allocate(n_blocks)
+        try:
+            self.scatter(ids)
+        except RuntimeError:
+            # Full unwind: ids AND pin, restoring the pre-admission
+            # refcount baseline exactly.
+            self._prefix.release(ids)
+            self._prefix.unpin(node)
+            raise
+        self._prefix.extend(node, prompt, ids)
+        self._prefix.unpin(node)
+
+    def finish_slice_install(self, sl):
+        row = sl["arow"]
+        try:
+            self.install_slot(sl)
+        except RuntimeError:
+            # Fixed r14 shape: exactly one release on the fault path.
+            self._apool.unpin(row)
+            self.scrub(sl)
+            raise
+
+    def acquire_adapter(self, name):
+        # Pin-then-return: ownership transfers to the caller — not a
+        # leak (the real engine's _acquire_adapter shape).
+        row = self._apool.assign(name)
+        try:
+            self.load(row)
+        except RuntimeError:
+            self._apool.unassign(row)
+            raise
+        self._apool.pin(row)
+        return row
+
+    def park_slot(self, slot_id):
+        # Releases of state owned elsewhere (pinned at admission,
+        # stored on self) — not double releases.
+        self._prefix.release(self._private[slot_id])
+        self._prefix.unpin(self._slot_nodes[slot_id])
+        self._slot_nodes[slot_id] = None

@@ -1,0 +1,16 @@
+"""Seeded-bad fixture for the ``recompile-hazard`` rule: a traced
+body closing over request attributes as Python scalars — every
+distinct value is a silent recompile of the serving tick."""
+
+import jax
+import jax.numpy as jnp
+
+
+def build_tick(req):
+    def _tick(params, cache, tokens):
+        # BUG: req.temperature is a per-request Python scalar baked
+        # into the trace — a new executable per distinct temperature.
+        scaled = cache["logits"] / req.temperature
+        return scaled, jnp.argmax(scaled, axis=-1)
+
+    return jax.jit(_tick)

@@ -1,0 +1,16 @@
+"""Good twin for the ``recompile-hazard`` fixture: per-request
+variation enters the traced body as a runtime array argument — one
+executable serves every value. Must lint clean."""
+
+import jax
+import jax.numpy as jnp
+
+
+def build_tick():
+    def _tick(params, cache, tokens, temps):
+        # temps is a [S] runtime array stamped by the host loop —
+        # attribute access on traced ARGUMENTS is array access.
+        scaled = cache["logits"] / temps[:, None]
+        return scaled, jnp.argmax(scaled, axis=-1)
+
+    return jax.jit(_tick)

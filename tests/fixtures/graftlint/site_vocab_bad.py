@@ -1,0 +1,26 @@
+"""Seeded-bad fixture for the ``site-vocab`` rule: a dispatched site
+missing from compile_counts, a counted site missing from SITES (the
+real adapter_load gap this rule found in serve/faults.py), and a
+stale SITES entry naming no program."""
+
+
+class FaultPlan:
+    # BUG: "gather" is stale (no such program here), and "adapter_load"
+    # (counted below) is missing — chaos can never target it.
+    SITES = ("tick", "prefill", "gather")
+
+
+class Engine:
+    def compile_counts(self):
+        return {
+            "tick": self._tick_p._cache_size(),
+            "prefill": self._prefill_p._cache_size(),
+            "adapter_load": self._adapter_load_p._cache_size(),
+        }
+
+    def step(self):
+        out = self._device_call("tick", self._tick_p, self._cache)
+        # BUG: "sample" is dispatched but is not a compile_counts key —
+        # invisible to the zero-recompile pin.
+        tok = self._device_call("sample", self._sample_p, out)
+        return tok

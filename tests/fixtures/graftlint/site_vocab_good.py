@@ -1,0 +1,22 @@
+"""Good twin for the ``site-vocab`` fixture: one vocabulary across
+dispatch sites, compile_counts keys, and SITES. Must lint clean."""
+
+
+class FaultPlan:
+    SITES = ("tick", "prefill", "sample", "adapter_load")
+
+
+class Engine:
+    def compile_counts(self):
+        return {
+            "tick": self._tick_p._cache_size(),
+            "prefill": self._prefill_p._cache_size(),
+            "sample": self._sample_p._cache_size(),
+            "adapter_load": self._adapter_load_p._cache_size(),
+        }
+
+    def step(self):
+        out = self._device_call("tick", self._tick_p, self._cache)
+        tok = self._device_call("sample", self._sample_p, out)
+        row = self._device_call("adapter_load", self._adapter_load_p, tok)
+        return row

@@ -1,0 +1,18 @@
+"""Seeded-bad fixture for the ``snapshot-hygiene`` rule: the encoder
+emits a key the versioned manifest does not declare — the wire format
+changed without a SNAPSHOT_VERSION bump."""
+
+SNAPSHOT_VERSION = 4
+
+ENTRY_KEYS_V4 = ("prompt", "tokens", "elapsed_s")
+
+
+def encode_handle(handle, now_s):
+    return {
+        "prompt": list(handle.request.prompt),
+        "tokens": list(handle.tokens),
+        "elapsed_s": float(now_s - handle.arrival_s),
+        # BUG: a new wire key with no version bump — every restoring
+        # engine reads the versioned header, then mis-decodes entries.
+        "adapter": handle.request.adapter,
+    }

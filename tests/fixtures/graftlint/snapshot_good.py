@@ -1,0 +1,16 @@
+"""Good twin for the ``snapshot-hygiene`` fixture: the manifest names
+the current version and matches the encoder exactly. Must lint
+clean."""
+
+SNAPSHOT_VERSION = 5
+
+ENTRY_KEYS_V5 = ("prompt", "tokens", "elapsed_s", "adapter")
+
+
+def encode_handle(handle, now_s):
+    return {
+        "prompt": list(handle.request.prompt),
+        "tokens": list(handle.tokens),
+        "elapsed_s": float(now_s - handle.arrival_s),
+        "adapter": handle.request.adapter,
+    }

@@ -1,0 +1,423 @@
+"""graftlint: the static invariant-analysis suite (ISSUE 10).
+
+Three layers, marker ``analysis``, all tier-1:
+
+1. **Golden fixtures** — every rule flags its seeded-bad fixture
+   (including re-creations of the r13 parked-slice drop and the r14
+   adapter double-release, the two review-pass bugs the pin-release
+   rule exists for) and passes its minimal good twin clean.
+2. **Framework semantics** — line/file suppressions, the baseline
+   (justified exceptions; stale entries fail), parse-error reporting.
+3. **The tree gate** — ``python -m pddl_tpu.analysis --check
+   pddl_tpu/`` exits clean from the repo root, stays pure-AST (no jax
+   in sys.modules), and runs fast enough for every test run.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from pddl_tpu.analysis import (
+    DEFAULT_BASELINE,
+    apply_baseline,
+    load_baseline,
+    run_analysis,
+)
+from pddl_tpu.analysis.checkers import RULES
+
+pytestmark = pytest.mark.analysis
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO_ROOT, "tests", "fixtures", "graftlint")
+
+
+def findings_for(path, rule=None):
+    findings, errors, _ = run_analysis([path])
+    assert not errors, errors
+    if rule is not None:
+        findings = [f for f in findings if f.rule == rule]
+    return findings
+
+
+# ------------------------------------------------------ golden fixtures
+
+# (rule, bad fixture, minimum findings expected from that rule)
+BAD_FIXTURES = [
+    ("pin-release", "pin_release_bad_r13.py", 3),
+    ("pin-release", "pin_release_bad_r14.py", 1),
+    ("donation", "donation_bad.py", 2),
+    ("recompile-hazard", "recompile_bad.py", 1),
+    ("site-vocab", "site_vocab_bad.py", 3),
+    ("exposition-parity", "exposition_bad.py", 2),
+    ("snapshot-hygiene", "snapshot_bad.py", 1),
+]
+
+GOOD_FIXTURES = [
+    "pin_release_good.py", "donation_good.py", "recompile_good.py",
+    "site_vocab_good.py", "exposition_good.py", "snapshot_good.py",
+]
+
+
+@pytest.mark.parametrize("rule,fixture,min_findings", BAD_FIXTURES,
+                         ids=[f[1] for f in BAD_FIXTURES])
+def test_bad_fixture_is_flagged(rule, fixture, min_findings):
+    found = findings_for(os.path.join(FIXTURES, fixture), rule)
+    assert len(found) >= min_findings, (
+        f"{fixture}: expected >= {min_findings} {rule!r} findings, "
+        f"got {[f.format() for f in found]}")
+
+
+@pytest.mark.parametrize("fixture", GOOD_FIXTURES)
+def test_good_twin_is_clean(fixture):
+    found = findings_for(os.path.join(FIXTURES, fixture))
+    assert found == [], [f.format() for f in found]
+
+
+def test_r13_parked_slice_findings_name_both_leaks():
+    """The r13 re-creation leaks a pinned node AND allocated block ids
+    on the early-return path; the rule must name both resources."""
+    found = findings_for(
+        os.path.join(FIXTURES, "pin_release_bad_r13.py"), "pin-release")
+    messages = " | ".join(f.message for f in found)
+    assert "node" in messages and "private" in messages
+    assert any(f.symbol.endswith("start_slice") for f in found)
+
+
+def test_r14_double_release_is_the_underflow_class():
+    found = findings_for(
+        os.path.join(FIXTURES, "pin_release_bad_r14.py"), "pin-release")
+    assert len(found) == 1
+    assert "underflow" in found[0].message
+    assert "unpin" in found[0].message
+
+
+# ----------------------------------------------- framework semantics
+
+
+def _write(tmp_path, name, body):
+    path = tmp_path / name
+    path.write_text(textwrap.dedent(body))
+    return str(path)
+
+
+LEAKY = """
+    class E:
+        def f(self, prompt):
+            node = self.match(prompt)
+            self._prefix.pin(node)
+            if self._draining:
+                return None{suffix}
+            self._store[prompt] = node
+"""
+
+
+def test_line_suppression_silences_exactly_that_rule(tmp_path):
+    bad = _write(tmp_path, "bad.py", LEAKY.format(suffix=""))
+    assert len(findings_for(bad, "pin-release")) == 1
+    suppressed = _write(
+        tmp_path, "suppressed.py",
+        LEAKY.format(suffix="  # graftlint: disable=pin-release"))
+    assert findings_for(suppressed) == []
+    wrong_rule = _write(
+        tmp_path, "wrong.py",
+        LEAKY.format(suffix="  # graftlint: disable=donation"))
+    assert len(findings_for(wrong_rule, "pin-release")) == 1
+
+
+def test_file_suppression(tmp_path):
+    body = "# graftlint: disable-file=pin-release\n" \
+        + textwrap.dedent(LEAKY.format(suffix=""))
+    path = tmp_path / "filewide.py"
+    path.write_text(body)
+    assert findings_for(str(path)) == []
+
+
+def test_baseline_absorbs_and_stale_entries_surface(tmp_path):
+    bad = _write(tmp_path, "bad.py", LEAKY.format(suffix=""))
+    findings, _, _ = run_analysis([bad])
+    assert len(findings) == 1
+    entry = {"rule": findings[0].rule, "path": findings[0].path,
+             "symbol": findings[0].symbol,
+             "reason": "fixture: justified for the test"}
+    kept, used, stale = apply_baseline(findings, [entry])
+    assert kept == [] and len(used) == 1 and stale == []
+    # A stale entry (nothing matches) must surface so the baseline can
+    # only shrink honestly.
+    ghost = dict(entry, symbol="E.nonexistent")
+    kept, used, stale = apply_baseline(findings, [entry, ghost])
+    assert kept == [] and stale == [ghost]
+
+
+def test_baseline_rejects_unjustified_entries(tmp_path):
+    path = tmp_path / "baseline.json"
+    path.write_text(json.dumps(
+        [{"rule": "pin-release", "path": "x.py", "symbol": "f",
+          "reason": "   "}]))
+    with pytest.raises(ValueError, match="reason"):
+        load_baseline(str(path))
+
+
+def test_parse_errors_are_reported_not_swallowed(tmp_path):
+    path = tmp_path / "broken.py"
+    path.write_text("def f(:\n")
+    findings, errors, _ = run_analysis([str(path)])
+    assert findings == []
+    assert len(errors) == 1 and "broken.py" in errors[0]
+
+
+def test_every_registered_rule_has_a_fixture_pair():
+    """Adding a checker without golden fixtures fails here, not in
+    review."""
+    covered = {rule for rule, _, _ in BAD_FIXTURES}
+    assert covered == {cls.name for cls in RULES}
+
+
+# ---------------------------------------------------------- tree gate
+
+
+def test_repo_baseline_is_valid_and_justified():
+    for entry in load_baseline(DEFAULT_BASELINE):
+        assert entry["reason"].strip()
+
+
+def test_tree_is_clean_via_cli_and_imports_no_jax():
+    """THE gate: `python -m pddl_tpu.analysis --check pddl_tpu/` exits
+    clean from the repo root, and the whole run never imports jax —
+    the pure-AST contract that keeps it safe and fast inside tier-1."""
+    code = (
+        "import sys, pddl_tpu.analysis.__main__ as m; "
+        "rc = m.main(['--check', 'pddl_tpu/']); "
+        "assert 'jax' not in sys.modules, 'analysis imported jax'; "
+        "sys.exit(rc)"
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", code], cwd=REPO_ROOT,
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, (
+        f"graftlint found unsuppressed/unbaselined findings:\n"
+        f"{proc.stdout}\n{proc.stderr}")
+
+
+def test_cli_fails_loudly_on_a_seeded_bug(tmp_path):
+    bad = _write(tmp_path, "bad.py", LEAKY.format(suffix=""))
+    proc = subprocess.run(
+        [sys.executable, "-m", "pddl_tpu.analysis", "--check", bad],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 1
+    assert "pin-release" in proc.stdout
+
+
+def test_artifact_vocab_gap_is_flagged(tmp_path):
+    """The (b) half of snapshot-hygiene: a committed artifact headline
+    key (``*_x`` / ``*tok_s``) that gets no direction from the
+    bench_artifact vocabulary is a metric the perf gate silently
+    skips."""
+    from pddl_tpu.analysis.checkers.snapshot_vocab import (
+        SnapshotHygieneRule,
+    )
+
+    art = tmp_path / "r99_bench.json"
+    art.write_text(json.dumps({
+        "metric": "x", "results": {
+            "frobnication_x": 1.7,          # no vocabulary rule -> flag
+            "decode_tok_s": 912.0,          # covered by "tok_s"
+            "warmup_s_spread_pct": 2.0,     # _NEVER'd -> deliberate
+        }}))
+    vocab = os.path.join(REPO_ROOT, "pddl_tpu", "utils",
+                         "bench_artifact.py")
+    rule = SnapshotHygieneRule(artifacts_root=str(tmp_path))
+    findings, errors, _ = run_analysis([vocab], rules=[rule])
+    assert not errors
+    flagged = [f for f in findings if "frobnication_x" in f.message]
+    assert len(flagged) == 1, [f.format() for f in findings]
+    assert not any("decode_tok_s" in f.message for f in findings)
+    assert not any("spread" in f.message for f in findings)
+
+
+def test_cli_rules_filter():
+    proc = subprocess.run(
+        [sys.executable, "-m", "pddl_tpu.analysis", "--list-rules"],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 0
+    for cls in RULES:
+        assert cls.name in proc.stdout
+
+
+def test_cli_exit_codes_distinguish_broken_run_from_findings(tmp_path):
+    """0 = clean, 1 = findings, 2 = the gate never really ran (bad
+    path / unparseable file) — a CI wrapper must be able to tell a
+    vacuous green from a real one."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "pddl_tpu.analysis", "--check",
+         "no_such_dir_xyz/"],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 2
+    assert "no such file" in proc.stderr
+    broken = tmp_path / "broken.py"
+    broken.write_text("def f(:\n")
+    proc = subprocess.run(
+        [sys.executable, "-m", "pddl_tpu.analysis", "--check",
+         str(broken)],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 2
+    empty = tmp_path / "empty_dir"
+    empty.mkdir()
+    proc = subprocess.run(
+        [sys.executable, "-m", "pddl_tpu.analysis", "--check",
+         str(empty)],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 2
+    assert "no Python files" in proc.stderr
+
+
+def test_suppression_honored_on_lazily_loaded_companion(tmp_path):
+    """A suppression in a companion module resolved through
+    module_by_suffix (e.g. the faults file paired with an engine) must
+    work even when only the engine file is on the command line —
+    targeted and full-tree runs must agree."""
+    engine = _write(tmp_path, "engine.py", """
+        class Engine:
+            def compile_counts(self):
+                return {"tick": 1}
+
+            def step(self):
+                return self._device_call("tick", self._tick_p)
+    """)
+    _write(tmp_path, "faults.py", """
+        class FaultPlan:
+            SITES = ("tick", "stale_site")  # graftlint: disable=site-vocab
+    """)
+    import pddl_tpu.analysis.checkers.site_vocab as sv
+
+    old_pairs = sv.ENGINE_FAULTS_PAIRS
+    sv.ENGINE_FAULTS_PAIRS = (("engine.py", "faults.py"),)
+    try:
+        findings, errors, _ = run_analysis([engine], root=str(tmp_path))
+        assert not errors
+        assert findings == [], [f.format() for f in findings]
+    finally:
+        sv.ENGINE_FAULTS_PAIRS = old_pairs
+
+
+def test_recompile_rule_covers_jit_of_partial(tmp_path):
+    src = """
+        import jax
+        from functools import partial
+
+        def build(req):
+            def _tick(params, cache):
+                return cache * req.temperature
+            return jax.jit(partial(_tick, 1))
+    """
+    path = _write(tmp_path, "m.py", src)
+    found = findings_for(path, "recompile-hazard")
+    assert len(found) == 1 and "req.temperature" in found[0].message
+
+
+def test_try_finally_release_is_not_a_leak(tmp_path):
+    """Python runs ``finally`` before a return/raise completes, so the
+    canonical cleanup idiom must lint clean — and a finally that
+    releases only half the obligations must still flag the rest."""
+    clean = _write(tmp_path, "clean.py", """
+        class E:
+            def f(self, n):
+                ids = self._pool.allocate(n)
+                try:
+                    if self.bad:
+                        raise RuntimeError("nope")
+                    return 1
+                finally:
+                    self._pool.release(ids)
+    """)
+    assert findings_for(clean) == [], \
+        [f.format() for f in findings_for(clean)]
+    partial = _write(tmp_path, "partial.py", """
+        class E:
+            def f(self, prompt, n):
+                node = self.match(prompt)
+                self._prefix.pin(node)
+                ids = self._prefix.allocate(n)
+                try:
+                    if self.bad:
+                        raise RuntimeError("nope")
+                    return 1
+                finally:
+                    self._prefix.release(ids)
+    """)
+    found = findings_for(partial, "pin-release")
+    assert found and all("node" in f.message for f in found), \
+        [f.format() for f in found]
+
+
+def test_scoped_run_does_not_report_out_of_scope_baseline_stale(tmp_path):
+    """A --rules/single-file run must not demand removal of a baseline
+    entry whose path/rule it never re-observed."""
+    bad = _write(tmp_path, "bad.py", LEAKY.format(suffix=""))
+    findings, _, analyzed = run_analysis([bad])
+    out_of_scope = {"rule": "pin-release", "path": "other/engine.py",
+                    "symbol": "E.g", "reason": "justified elsewhere"}
+    kept, used, stale = apply_baseline(
+        findings, [out_of_scope], analyzed_paths=analyzed,
+        active_rules={"pin-release"})
+    assert stale == [] and used == []
+    wrong_rule = {"rule": "donation", "path": findings[0].path,
+                  "symbol": findings[0].symbol, "reason": "x"}
+    kept, used, stale = apply_baseline(
+        findings, [wrong_rule], analyzed_paths=analyzed,
+        active_rules={"pin-release"})
+    assert stale == []
+    # In scope and unmatched -> still stale (the honesty property).
+    ghost = {"rule": "pin-release", "path": findings[0].path,
+             "symbol": "E.nonexistent", "reason": "x"}
+    kept, used, stale = apply_baseline(
+        findings, [ghost], analyzed_paths=analyzed,
+        active_rules={"pin-release"})
+    assert stale == [ghost]
+
+
+def test_donation_rule_ignores_sibling_branch_reads(tmp_path):
+    """A donate in one arm of an if must not flag a read in the
+    mutually-exclusive other arm — the structural continuation walk
+    replaces the old flat source-order scan."""
+    src = """
+        import jax
+
+        class E:
+            def build(self, step):
+                self._step_p = jax.jit(step, donate_argnums=(0,))
+
+            def run(self, batch, log):
+                if log:
+                    out = self._step_p(self._state, batch)
+                    return out
+                return self._render(self._state)
+    """
+    path = _write(tmp_path, "m.py", src)
+    assert findings_for(path, "donation") == [], \
+        [f.format() for f in findings_for(path, "donation")]
+
+
+def test_non_python_path_argument_is_an_error(tmp_path):
+    notes = tmp_path / "notes.txt"
+    notes.write_text("hello")
+    proc = subprocess.run(
+        [sys.executable, "-m", "pddl_tpu.analysis", "--check",
+         "pddl_tpu/analysis/core.py", str(notes)],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 2
+    assert "not a Python source file" in proc.stderr
+
+
+def test_duplicate_baseline_entries_rejected(tmp_path):
+    entry = {"rule": "pin-release", "path": "x.py", "symbol": "f",
+             "reason": "justified"}
+    path = tmp_path / "baseline.json"
+    path.write_text(json.dumps([entry, dict(entry)]))
+    with pytest.raises(ValueError, match="duplicate"):
+        load_baseline(str(path))

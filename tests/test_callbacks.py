@@ -172,3 +172,45 @@ def test_model_summary_prints_param_table(capsys):
 
     n = sum(x.size for x in jax.tree.leaves(tr.state.params))
     assert f"{n:,}" in err
+
+
+def test_set_learning_rate_stamps_a_device_leaf():
+    """Regression for the ROADMAP "Known flake": set_learning_rate
+    stored a HOST-numpy LR scalar into opt_state, which then rode the
+    DONATED train step — container jaxlib intermittently corrupted the
+    buffer (the final LR read back as float32-bits-of-int, roaming
+    between test_hvd_compat and the warmup test). The fix stamps a
+    device (jax.Array) leaf placed like the one it replaces; this pins
+    the leaf's type so the host-numpy shape cannot quietly return.
+    This is exactly the bug class graftlint's `donation` rule checks
+    statically (pddl_tpu/analysis/checkers/donation.py)."""
+    import jax
+
+    from pddl_tpu.train.state import set_learning_rate
+
+    tr = _trainer()
+    tr.fit(_ds(), epochs=1, steps_per_epoch=1, verbose=0)
+    state = set_learning_rate(tr.state, 5e-4)
+
+    def _find(opt_state):
+        if hasattr(opt_state, "hyperparams") \
+                and "learning_rate" in opt_state.hyperparams:
+            return opt_state.hyperparams["learning_rate"]
+        if isinstance(opt_state, tuple):
+            for sub in opt_state:
+                found = _find(sub)
+                if found is not None:
+                    return found
+        return None
+
+    leaf = _find(state.opt_state)
+    assert leaf is not None
+    assert isinstance(leaf, jax.Array), (
+        f"LR leaf must be device-resident, got {type(leaf)} — a host "
+        "buffer here rides the donated train step (the r10/flake class)")
+    assert np.isclose(float(jax.device_get(leaf)), 5e-4)
+    # The placement survives a real donated step: train one more step
+    # on the updated state and read the LR back uncorrupted.
+    tr.state = state
+    tr.fit(_ds(), epochs=1, steps_per_epoch=1, verbose=0)
+    assert 0 < get_learning_rate(tr.state) <= 5e-4 + 1e-9
