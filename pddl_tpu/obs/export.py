@@ -345,20 +345,45 @@ FLEET_COUNTER_KEYS = frozenset({
     "admission_rate_limited", "brownout_shed_best_effort",
     "brownout_rejected_cold", "brownout_capped_output",
     "brownout_escalations", "brownout_deescalations",
+    # Elastic scaling mechanism counters (`serve/fleet/autoscaler.py`
+    # is the policy; the router executes): replicas added/retired at
+    # runtime, and the requests scale-downs live-migrated. Per-class
+    # delivery splits flatten to tokens_streamed_<class>, typed
+    # counters below like the circuit_* transitions.
+    "scale_up_events", "scale_down_events", "scale_down_migrated",
 })
 
 
-def fleet_exposition(router) -> str:
+# The controller-side vocabulary (`serve/fleet/autoscaler.py`):
+# AutoscaleMetrics.snapshot() derives its keys from this set, exactly
+# the FLEET_COUNTER_KEYS discipline — one list to extend per counter.
+# Decision-tick splits flatten to decision_ticks_<decision>.
+AUTOSCALE_COUNTER_KEYS = frozenset({
+    "scale_up_started", "scale_up_completed", "scale_up_failed",
+    "scale_down_completed", "scale_down_vetoed", "spawn_timeouts",
+})
+
+
+def fleet_exposition(router, autoscaler=None) -> str:
     """The fleet-router scrape body: :class:`~pddl_tpu.serve.fleet.
-    FleetMetrics` counters (circuit transitions included as flattened
-    ``circuit_<from>_to_<to>`` counters) plus live per-replica gauges —
-    lifecycle, breaker state, and assigned load as labeled series keyed
-    by replica id. Same renderer/text format as serving and training,
-    so one Prometheus config scrapes all three tiers."""
+    FleetMetrics` counters (circuit transitions and per-class
+    ``tokens_streamed_<class>`` splits included as flattened counters)
+    plus live per-replica gauges — lifecycle, breaker state, and
+    assigned load as labeled series keyed by replica id. Same
+    renderer/text format as serving and training, so one Prometheus
+    config scrapes all three tiers.
+
+    ``autoscaler`` (defaults to the router's attached one, if any)
+    appends the elastic-scaling series under ``pddl_fleet_autoscale_``:
+    the controller counters (scale attempts/completions/vetoes, spawn
+    timeouts, decision-tick splits) and its live gauges (fleet size,
+    pending spawns, pressure, per-class goodput rates) — the scale
+    events the runbook reads during a capacity page."""
     snap = dict(router.metrics.snapshot())
     counters = FLEET_COUNTER_KEYS | {
         k for k in snap
-        if k.startswith(("circuit_", "admission_rejected_"))}
+        if k.startswith(("circuit_", "admission_rejected_",
+                         "tokens_streamed_"))}
     snap["replicas"] = len(router.replicas)
     snap["replicas_healthy"] = router.healthy_replicas
     if router.admission is not None:
@@ -373,8 +398,19 @@ def fleet_exposition(router) -> str:
         for s in router.replicas}
     snap["replica_load"] = {
         f"r{s.replica_id}": s.load for s in router.replicas}
-    return render_prometheus(snap, prefix="pddl_fleet",
-                             counters=frozenset(counters))
+    parts = [render_prometheus(snap, prefix="pddl_fleet",
+                               counters=frozenset(counters))]
+    if autoscaler is None:
+        autoscaler = getattr(router, "autoscaler", None)
+    if autoscaler is not None:
+        auto = dict(autoscaler.metrics.snapshot())
+        auto_counters = AUTOSCALE_COUNTER_KEYS | {
+            k for k in auto if k.startswith("decision_ticks_")}
+        auto.update(autoscaler.gauges())
+        parts.append(render_prometheus(
+            auto, prefix="pddl_fleet_autoscale",
+            counters=frozenset(auto_counters)))
+    return "".join(parts)
 
 
 def serve_exposition(metrics, engine=None, *,

@@ -21,6 +21,16 @@ discipline is crash-safety: the snapshot is written to a temp file and
 atomically renamed (the same torn-write rule `ckpt/checkpoint.py`
 enforces via orbax's tmp-dir protocol), so a kill mid-drain leaves
 either the old snapshot or the new one, never a half-written file.
+
+The same wire format is the fleet's LIVE-MIGRATION carrier, in three
+escalating uses: failover (r11 — a dying replica's snapshot restores
+on survivors), graceful fleet drain (`FleetRouter.drain`), and — since
+the elastic autoscaler (`serve/fleet/autoscaler.py`) — scheduled
+scale-down retirement, where the snapshot path is the NORMAL case
+rather than the lucky one: `FleetRouter.scale_down` captures the
+victim's queued+running streams here and restores them on survivors
+before the process exits, which is what makes "zero lost requests" a
+property of the wire format, not of timing.
 """
 
 from __future__ import annotations

@@ -1,16 +1,25 @@
 """Multi-replica serving fleet: health-checked router, replica
-failover, live request migration.
+failover, live request migration, elastic autoscaling.
 
 `router.py` is the front door (prefix-affinity + sticky-session +
 rendezvous routing, QueueFull shedding, failover with `serve/drain.py`
-as the migration wire format), `replica.py` the driver surface
-(:class:`LocalReplica` in-process for deterministic tier-1 chaos,
-:class:`ProcessReplica` over a stdio pipe for real multiprocess
-parallelism), `worker.py` the replica process entrypoint, `health.py`
-the per-replica circuit breaker, `admission.py` the overload front
-door (per-priority token buckets, overload detector, hysteretic
-brownout ladder). See `docs/OPERATIONS.md` § "Fleet runbook" and
-§ "Overload & brownout", and `docs/SERVING.md` § "Serving fleet".
+as the migration wire format, runtime `scale_up`/`scale_down`
+mechanics), `replica.py` the driver surface (:class:`LocalReplica`
+in-process for deterministic tier-1 chaos, :class:`ProcessReplica` over
+a stdio pipe for real multiprocess parallelism — with the typed
+:class:`ReplicaSpawnTimeout` + non-blocking ``poll_ready`` the
+autoscaler's concurrent warm-starts ride on), `worker.py` the replica
+process entrypoint, `health.py` the per-replica circuit breaker,
+`admission.py` the overload front door (per-priority token buckets,
+overload detector, hysteretic brownout ladder), `autoscaler.py` the
+pressure-driven capacity controller that closes the loop (scale-up
+ahead of the brownout ladder, scale-down by zero-loss live migration),
+`tracegen.py` the seeded scenario-diversity trace generator (diurnal
+curve, heavy-tail session mix, tenant popularity skew), and `replay.py`
+the hint-honoring open-loop replay client that meters
+goodput-per-replica-hour. See `docs/OPERATIONS.md` § "Fleet runbook",
+§ "Overload & brownout" and § "Autoscaling runbook", and
+`docs/SERVING.md` § "Serving fleet".
 """
 
 from pddl_tpu.serve.fleet.admission import (
@@ -20,11 +29,18 @@ from pddl_tpu.serve.fleet.admission import (
     OverloadDetector,
     TokenBucket,
 )
+from pddl_tpu.serve.fleet.autoscaler import (
+    AutoscaleMetrics,
+    FleetAutoscaler,
+    ScaleDecision,
+)
 from pddl_tpu.serve.fleet.health import BreakerState, CircuitBreaker
+from pddl_tpu.serve.fleet.replay import ReplayReport, replay_trace
 from pddl_tpu.serve.fleet.replica import (
     LocalReplica,
     ProcessReplica,
     ReplicaDied,
+    ReplicaSpawnTimeout,
 )
 from pddl_tpu.serve.fleet.router import (
     FleetHandle,
@@ -33,13 +49,16 @@ from pddl_tpu.serve.fleet.router import (
     NoHealthyReplica,
     ReplicaLifecycle,
 )
+from pddl_tpu.serve.fleet.tracegen import diurnal_trace
 
 __all__ = [
     "AdmissionControl",
+    "AutoscaleMetrics",
     "BreakerState",
     "BrownoutController",
     "BrownoutRung",
     "CircuitBreaker",
+    "FleetAutoscaler",
     "FleetHandle",
     "FleetMetrics",
     "FleetRouter",
@@ -47,7 +66,12 @@ __all__ = [
     "NoHealthyReplica",
     "OverloadDetector",
     "ProcessReplica",
+    "ReplayReport",
     "ReplicaDied",
     "ReplicaLifecycle",
+    "ReplicaSpawnTimeout",
+    "ScaleDecision",
     "TokenBucket",
+    "diurnal_trace",
+    "replay_trace",
 ]

@@ -62,6 +62,22 @@ class ReplicaDied(RuntimeError):
         super().__init__(f"replica {replica_id} died: {why}")
 
 
+class ReplicaSpawnTimeout(ReplicaDied):
+    """A spawned worker never became ready inside its budget. Subclass
+    of :class:`ReplicaDied` (every existing handler still catches it),
+    but TYPED so a scale-up controller can tell "this spawn wedged —
+    back off and retry later" from "a serving replica died — migrate
+    its work": the autoscaler keys its breaker-style spawn backoff off
+    this, instead of hanging the router's control loop behind a worker
+    that will never ack."""
+
+    def __init__(self, replica_id: int, waited_s: float):
+        self.waited_s = float(waited_s)
+        super().__init__(replica_id,
+                         f"spawn timed out after {waited_s:.1f}s "
+                         "(worker never became ready)")
+
+
 # The submit protocol's sampling wire shape IS the drain snapshot's —
 # one encode/decode pair (`serve/drain.py`) for both.
 sampling_to_wire = drain_io.encode_sampling
@@ -267,6 +283,13 @@ class ProcessReplica:
         self._spawn(wait_ready=wait_ready)
 
     # ------------------------------------------------------- process mgmt
+    def _worker_argv(self) -> List[str]:
+        """The child command line — a seam, so tests can stand in a
+        process that never acks ready (the spawn-timeout contract)
+        without re-implementing the spawn bookkeeping."""
+        return [self._python, "-m", "pddl_tpu.serve.fleet.worker",
+                "--config-json", json.dumps(self._config)]
+
     def _spawn(self, wait_ready: bool = True) -> None:
         # The worker must import pddl_tpu from wherever THIS process
         # found it — which may be a sys.path entry the child would not
@@ -281,11 +304,11 @@ class ProcessReplica:
         if pkg_root not in parts:
             env["PYTHONPATH"] = os.pathsep.join([pkg_root] + parts)
         self._proc = subprocess.Popen(
-            [self._python, "-m", "pddl_tpu.serve.fleet.worker",
-             "--config-json", json.dumps(self._config)],
+            self._worker_argv(),
             stdin=subprocess.PIPE, stdout=subprocess.PIPE,
             stderr=self._stderr, text=False, env=env)
         os.set_blocking(self._proc.stdout.fileno(), False)
+        self._spawn_started_s = self._clock()
         self._buf = b""
         self._pending: List[Dict[str, object]] = []
         self._unanswered_ping_s: Optional[float] = None
@@ -295,12 +318,20 @@ class ProcessReplica:
         if wait_ready:
             self.wait_ready()
 
-    def wait_ready(self) -> None:
+    def wait_ready(self, timeout_s: Optional[float] = None) -> None:
         """Block until the worker's ``ready`` ack (engine built and
         warmed). Split from :meth:`_spawn` so a fleet can launch every
         worker first (``wait_ready=False``) and pay the N warmup
-        compiles concurrently instead of serially."""
-        deadline = self._clock() + self._ready_timeout_s
+        compiles concurrently instead of serially.
+
+        ``timeout_s`` overrides the constructor's ``ready_timeout_s``
+        for THIS wait; either budget expiring kills the wedged worker
+        and raises the typed :class:`ReplicaSpawnTimeout`, so a caller
+        holding a control loop (the autoscaler's scale-up path) fails
+        the attempt fast instead of blocking serving behind it."""
+        budget = (self._ready_timeout_s if timeout_s is None
+                  else float(timeout_s))
+        deadline = self._clock() + budget
         while self.ready_compile_counts is None:
             for ev in self._read_events(block_s=0.1):
                 if ev.get("ev") == "ready":
@@ -313,7 +344,36 @@ class ProcessReplica:
                                   "before ready")
             if self._clock() > deadline:
                 self._proc.kill()
-                raise ReplicaDied(self.replica_id, "worker never became ready")
+                raise ReplicaSpawnTimeout(
+                    self.replica_id, self._clock() - self._spawn_started_s)
+
+    def poll_ready(self) -> bool:
+        """Non-blocking readiness probe for concurrent warm-starts: the
+        autoscaler spawns with ``wait_ready=False`` and polls this once
+        per control tick, so a scale-up compiles in the background while
+        the fleet keeps serving. Returns True once the ``ready`` ack has
+        arrived; raises :class:`ReplicaDied` if the worker exited first
+        and :class:`ReplicaSpawnTimeout` once ``ready_timeout_s`` has
+        elapsed since the spawn (the worker is killed — a wedged spawn
+        must not leak a zombie process)."""
+        if self.ready_compile_counts is not None:
+            return True
+        for ev in self._read_events():
+            if ev.get("ev") == "ready":
+                self.ready_compile_counts = ev.get("compile_counts")
+            else:
+                self._pending.append(ev)
+        if self.ready_compile_counts is not None:
+            return True
+        if self._proc.poll() is not None:
+            raise ReplicaDied(self.replica_id,
+                              f"worker exited rc={self._proc.returncode} "
+                              "before ready")
+        waited = self._clock() - self._spawn_started_s
+        if waited > self._ready_timeout_s:
+            self._proc.kill()
+            raise ReplicaSpawnTimeout(self.replica_id, waited)
+        return False
 
     def _send(self, cmd: Dict[str, object]) -> None:
         if self._proc.poll() is not None:

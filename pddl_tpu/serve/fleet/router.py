@@ -81,6 +81,11 @@ class NoHealthyReplica(RuntimeError):
 class ReplicaLifecycle(enum.Enum):
     UP = "up"
     DEAD = "dead"
+    # Retired by an elastic scale-down: the replica's in-flight work was
+    # LIVE-MIGRATED onto survivors (drain snapshot first, router mirrors
+    # as the fallback) and the slot left the rotation for good — unlike
+    # DEAD, nothing probes it back.
+    RETIRED = "retired"
 
 
 class FleetHandle:
@@ -166,6 +171,14 @@ class FleetMetrics:
         self.brownout_deescalations = 0
         self.rejected_by_priority: Dict[str, int] = {
             p.value: 0 for p in Priority}
+        # Elastic scaling (`fleet/autoscaler.py` is the policy; the
+        # router executes): replicas added/retired at runtime, and the
+        # requests a scale-down live-migrated off its victim. The
+        # policy-side counters (holds, cooldowns, spawn backoff) live
+        # on the autoscaler's own metrics.
+        self.scale_up_events = 0
+        self.scale_down_events = 0
+        self.scale_down_migrated = 0
         self.requests_finished = 0
         self.requests_failed = 0
         self.requests_orphaned = 0
@@ -173,6 +186,11 @@ class FleetMetrics:
         self.probes = 0
         self.probe_failures = 0
         self.tokens_streamed = 0
+        # Per-class delivery splits (tokens_streamed_<class> in the
+        # snapshot): the autoscaler's goodput signal — and the
+        # dashboard's — without a second accounting path.
+        self.tokens_streamed_by_priority: Dict[str, int] = {
+            p.value: 0 for p in Priority}
         self.circuit_transitions: Dict[str, int] = {}
 
     def snapshot(self) -> Dict[str, object]:
@@ -187,6 +205,8 @@ class FleetMetrics:
             out["circuit_" + key.replace("->", "_to_")] = n
         for cls, n in sorted(self.rejected_by_priority.items()):
             out["admission_rejected_" + cls] = n
+        for cls, n in sorted(self.tokens_streamed_by_priority.items()):
+            out["tokens_streamed_" + cls] = n
         return out
 
 
@@ -312,15 +332,15 @@ class FleetRouter:
                 f"interactive_reroute_load must be >= 1, got "
                 f"{interactive_reroute_load}")
         self.metrics = FleetMetrics()
-        breaker = dict(breaker or {})
+        # Kept beyond __init__: an elastic scale-up builds new slots
+        # with the SAME breaker policy and shadow sizing as the
+        # original fleet.
+        self._breaker_kw = dict(breaker or {})
+        self._shadow_capacity = int(shadow_capacity_blocks)
+        self._autoscaler = None
         self._slots: List[_ReplicaSlot] = []
         for driver in replicas:
-            slot = _ReplicaSlot(
-                driver,
-                CircuitBreaker(**breaker),
-                affinity_block_size, int(shadow_capacity_blocks))
-            slot.breaker.on_transition = self._circuit_observer(slot)
-            self._slots.append(slot)
+            self._new_slot(driver)
         self._by_rid: Dict[int, FleetHandle] = {}
         self._rids = itertools.count()
         # Sticky-session map, LRU-bounded: sessions outlive their
@@ -363,6 +383,13 @@ class FleetRouter:
     @property
     def admission(self) -> Optional[AdmissionControl]:
         return self._admission
+
+    @property
+    def clock(self):
+        """The router's monotonic clock (injectable for chaos tests) —
+        shared with the autoscaler so control-loop holds and cooldowns
+        live on the same epoch as breaker backoffs and heartbeats."""
+        return self._clock
 
     def _degraded_replica_count(self) -> int:
         """Replicas reporting DEGRADED (r08's OOM machinery) — fed to
@@ -716,6 +743,11 @@ class FleetRouter:
                 slot.breaker.record_success(now)
             tokens += self._apply_events(slot, events)
             self._forward_cancels(slot)
+        if self._autoscaler is not None:
+            # One controller decision per routing round, AFTER the slot
+            # loop: a scale-down mutates the slot list, which must never
+            # happen under the iteration above.
+            self._autoscaler.step(self._clock())
         return tokens
 
     def run(self, max_steps: Optional[int] = None,
@@ -764,6 +796,8 @@ class FleetRouter:
                         fh.state = RequestState.RUNNING
                     fh.tokens.extend(int(t) for t in toks)
                     tokens += len(toks)
+                    self.metrics.tokens_streamed_by_priority[
+                        fh.request.priority.value] += len(toks)
             elif kind == "finish":
                 rid = ev["rid"]
                 fh = self._by_rid.pop(rid, None)
@@ -816,6 +850,19 @@ class FleetRouter:
         # still produce one (`serve/drain.py` wire format, rid-tagged);
         # otherwise rebuild from the router mirrors — same format, the
         # prompt+emitted-token replay r08 pinned in-engine.
+        migrate, leftovers, via = self._evacuate(slot, now)
+        self._distribute(migrate, via)
+        if leftovers:
+            self._distribute(leftovers, "replay")
+
+    def _evacuate(self, slot: _ReplicaSlot, now: float) -> Tuple[
+            List[Tuple[int, Dict, FleetHandle]],
+            List[Tuple[int, Dict, FleetHandle]], str]:
+        """The capture-and-adopt half shared by death handling and
+        scale-down retirement: snapshot the replica (drain if it can,
+        router mirrors if not), adopt snapshot tokens into the fleet
+        handles, and return ``(migrate, leftovers, via)`` ready for
+        :meth:`_distribute` — the slot's assignment map is cleared."""
         pairs = self._capture(slot, now)
         via = "drain" if pairs is not None else "replay"
         if pairs is None:
@@ -845,9 +892,7 @@ class FleetRouter:
             migrate.append((rid, entry, fh))
         leftovers = self._mirror_leftovers(slot, {rid for rid, _ in pairs})
         slot.assigned.clear()
-        self._distribute(migrate, via)
-        if leftovers:
-            self._distribute(leftovers, "replay")
+        return migrate, leftovers, via
 
     def _capture(self, slot: _ReplicaSlot,
                  now: float) -> Optional[List[Tuple[int, Dict]]]:
@@ -993,6 +1038,103 @@ class FleetRouter:
                  if not fh.done],
                 "replay")
 
+    # ----------------------------------------------------- elastic scaling
+    def _new_slot(self, driver) -> _ReplicaSlot:
+        ids = [s.replica_id for s in self._slots]
+        if driver.replica_id in ids:
+            raise ValueError(
+                f"replica ids must be unique, got {driver.replica_id} "
+                f"already in {ids}")
+        slot = _ReplicaSlot(driver, CircuitBreaker(**self._breaker_kw),
+                            self._block_size, self._shadow_capacity)
+        slot.breaker.on_transition = self._circuit_observer(slot)
+        self._slots.append(slot)
+        return slot
+
+    def attach_autoscaler(self, autoscaler) -> None:
+        """Wire a :class:`~.autoscaler.FleetAutoscaler` into the step
+        cadence: the router pumps replicas, then the controller gets
+        one decision tick per round — so every existing entry point
+        (``run()``, bench loops, chaos harnesses) drives the control
+        loop without a second scheduler."""
+        self._autoscaler = autoscaler
+
+    @property
+    def autoscaler(self):
+        return self._autoscaler
+
+    def scale_up(self, driver) -> None:
+        """Add a READY replica driver to the rotation (the elastic
+        scale-up mechanism; the autoscaler is the policy deciding when,
+        and it spawns/warms the driver CONCURRENTLY before handing it
+        here — this call itself never blocks on a warmup). Parked
+        orphans re-enter service on the new replica immediately: a
+        scale-up during a total outage is also a recovery."""
+        if self._closed:
+            raise RuntimeError("fleet router is closed")
+        slot = self._new_slot(driver)
+        self.metrics.scale_up_events += 1
+        self._tracer.on_fleet_event(
+            "scale_up", replica=slot.replica_id,
+            replicas=len(self._slots))
+        if self._orphans:
+            orphans, self._orphans = self._orphans, []
+            self._distribute(
+                [(rid, self._wire_entry(fh), fh) for rid, fh in orphans
+                 if not fh.done],
+                "replay")
+
+    def scale_down(self, replica_id: int) -> int:
+        """Retire one replica by LIVE-MIGRATING its queued+running
+        streams onto the survivors, then removing it from the rotation
+        — zero lost requests by construction: the capture is the same
+        drain-snapshot discipline death handling uses (`serve/drain.py`
+        wire format; router-mirror replay as the fallback), but taken
+        GRACEFULLY, so the snapshot path is the normal case rather than
+        the lucky one. Returns the number of requests migrated off the
+        victim. Refuses (``ValueError``) when no OTHER available
+        replica exists to absorb them — a scale-down must never orphan
+        work, that is the whole contract."""
+        slot = next((s for s in self._slots
+                     if s.replica_id == int(replica_id)), None)
+        if slot is None:
+            raise ValueError(f"no replica {replica_id} in the fleet")
+        survivors = [s for s in self._slots
+                     if s is not slot and s.available]
+        if not survivors:
+            raise ValueError(
+                f"refusing to retire replica {replica_id}: no other "
+                "available replica to migrate its work onto")
+        now = self._clock()
+        migrate, leftovers, via = self._evacuate(slot, now)
+        slot.state = ReplicaLifecycle.RETIRED
+        self._slots.remove(slot)
+        self._adapter_homes = {name: home for name, home
+                               in self._adapter_homes.items()
+                               if home is not slot}
+        # Sticky sessions pinned here must not keep the retired slot
+        # (and, for local replicas, its whole closed engine) alive
+        # until LRU eviction: unlike a DEAD slot — which stays in
+        # `_slots` awaiting a probe — a retirement is final. Dropped
+        # sessions simply re-route by affinity; migration re-pins the
+        # in-flight ones to their new replica below.
+        for name in [n for n, s in self._sessions.items() if s is slot]:
+            del self._sessions[name]
+        n_moved = len(migrate) + len(leftovers)
+        self.metrics.scale_down_events += 1
+        self.metrics.scale_down_migrated += n_moved
+        self._tracer.on_fleet_event(
+            "scale_down", replica=slot.replica_id, migrated=n_moved,
+            via=via, replicas=len(self._slots))
+        self._distribute(migrate, via)
+        if leftovers:
+            self._distribute(leftovers, "replay")
+        try:
+            slot.driver.close()
+        except Exception:  # noqa: BLE001 - retirement is best-effort
+            pass
+        return n_moved
+
     # ------------------------------------------------------------ teardown
     def drain(self) -> Dict[str, object]:
         """Graceful fleet-wide drain: every live replica's in-flight
@@ -1027,6 +1169,8 @@ class FleetRouter:
 
     def close(self) -> None:
         self._closed = True
+        if self._autoscaler is not None:
+            self._autoscaler.close()  # an in-flight spawn dies too
         for slot in self._slots:
             try:
                 slot.driver.close()

@@ -122,9 +122,21 @@ _HIGHER_BETTER = ("tokens_per_s", "tokens_per_sec", "speedup", "retained",
                   # (r09 tracing_off_vs_r08_clean_x, r11 vs_r08_clean_x)
                   # and the tracing-on/off retention ratio: up = less
                   # overhead lost.
-                  "clean_x", "tracing_on_over_off")
+                  "clean_x", "tracing_on_over_off",
+                  # Elastic-autoscaling headlines (r16): goodput rides
+                  # the "goodput" rule; scale_events is the per-wave
+                  # floor of executed capacity transitions (an r-record
+                  # whose autoscaler stops scaling must fail loudly);
+                  # *_zero_lost counts requests live-migrated with
+                  # nothing lost — fewer proven-safe migrations is a
+                  # coverage regression.
+                  "scale_events", "zero_lost")
 _LOWER_BETTER = ("ttft", "latency", "_ms", "_wall_s", "overhead",
-                 "_seconds", "tick_s", "step_s", "copy_us")
+                 "_seconds", "tick_s", "step_s", "copy_us",
+                 # Time the brownout ladder spent engaged (r16): a
+                 # same-config record whose fleet browns out longer
+                 # regressed its overload posture.
+                 "rung_time")
 _NEVER = ("spread", "samples", "per_pair", "per_repeat", "n_requests",
           "count", "injected", "provenance", "seed", "offered")
 

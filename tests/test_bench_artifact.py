@@ -63,6 +63,16 @@ def test_metric_direction_vocabulary():
     assert metric_direction("mixed_tenant_tok_s") == 1
     assert metric_direction("adapter_hit_rate") == 1
     assert metric_direction("mask_overhead_x") == -1
+    # The r16 elastic-autoscaling headlines: goodput per replica-hour
+    # (and its vs-best-static ratio) up is better, executed scale
+    # events and zero-loss migration coverage up are better, time the
+    # brownout ladder spent engaged down is better.
+    assert metric_direction("goodput_per_replica_hour") == 1
+    assert metric_direction(
+        "goodput_per_replica_hour_vs_best_static_x") == 1
+    assert metric_direction("scale_events") == 1
+    assert metric_direction("migrated_zero_lost") == 1
+    assert metric_direction("brownout_rung_time_autoscaled_s") == -1
     # Raw byte tallies are scale context, not headlines.
     assert metric_direction("kv_bytes_used_row") == 0
     # Noise keys are never compared.
@@ -130,6 +140,63 @@ def test_r14_tenant_artifact_is_gated():
         paths = {r["path"] for r in failures[0]["regressions"]}
         assert "results.tenant.tenant_throughput_retained_x" in paths
         assert "results.tenant.mask_overhead_x" in paths
+
+
+def test_r16_autoscale_artifact_is_gated():
+    """The elastic-autoscaling artifact participates in the series: it
+    loads, keys into a (metric, config) group, its committed headlines
+    clear the ISSUE 11 bounds, they are DIRECTIONAL — and a same-config
+    r-record that regresses them fails `check_series` LOUDLY."""
+    path = os.path.join(_BENCH_DIR, "r16_serve_autoscale.json")
+    records = [r for r in load_artifact(path)
+               if artifact_key(r) is not None]
+    assert records, "r16_serve_autoscale.json has no keyed record"
+    auto = records[0]["results"]["autoscale"]
+    # ISSUE 11 acceptance bounds on the committed medians.
+    assert auto["goodput_per_replica_hour_vs_best_static_x"] >= 1.15
+    assert auto["scale_events"] >= 2
+    # BOTH directions per wave, from the raw per-wave lists — the
+    # scalar alone could hide a fleet that only ever grows.
+    assert all(u >= 1 for u in auto["scale_up_events_per_wave"])
+    assert all(d >= 1 for d in auto["scale_down_events_per_wave"])
+    assert auto["migrated_zero_lost"] >= 1
+    assert auto["requests_lost_total"] == 0
+    assert auto["brownout_rung_time_autoscaled_s"] \
+        < auto["brownout_rung_time_static_under_s"]
+    for key in ("goodput_per_replica_hour",
+                "goodput_per_replica_hour_vs_best_static_x",
+                "scale_events", "migrated_zero_lost",
+                "brownout_rung_time_autoscaled_s"):
+        assert metric_direction(key) != 0, key
+    # A hypothetical r17 record at the SAME config whose autoscale
+    # headlines regressed must fail the series gate loudly.
+    worse = copy.deepcopy(records[0])
+    worse["results"]["autoscale"][
+        "goodput_per_replica_hour_vs_best_static_x"] *= 0.8
+    worse["results"]["autoscale"]["scale_events"] = 0
+    worse["results"]["autoscale"]["brownout_rung_time_autoscaled_s"] = \
+        10.0 + 2.0 * auto["brownout_rung_time_autoscaled_s"]
+    import json as _json
+    import tempfile
+    with tempfile.TemporaryDirectory() as d:
+        old_p = os.path.join(d, "r16_a.json")
+        new_p = os.path.join(d, "r17_a.json")
+        with open(old_p, "w") as f:
+            _json.dump(records[0], f)
+        with open(new_p, "w") as f:
+            _json.dump(worse, f)
+        pairs, failures = check_series([old_p, new_p])
+        assert pairs == 1 and len(failures) == 1
+        paths = {r["path"] for r in failures[0]["regressions"]}
+        assert ("results.autoscale."
+                "goodput_per_replica_hour_vs_best_static_x") in paths
+        assert "results.autoscale.scale_events" in paths
+        if auto["brownout_rung_time_autoscaled_s"] > 0:
+            # compare() cannot flag growth off a zero baseline (no
+            # percentage exists); the bound assertion above still pins
+            # the committed value itself.
+            assert ("results.autoscale.brownout_rung_time_autoscaled_s"
+                    in paths)
 
 
 def test_compare_flags_directional_regressions_only():
