@@ -84,9 +84,6 @@ class SnapshotHygieneRule(Rule):
                                           node.lineno))
         if version is None:
             return
-        encoded = self._encoded_keys(module.tree)
-        if encoded is None:
-            return
         vnum, vline = version
         current = [m for m in manifests if m[0] == vnum]
         if not current:
@@ -97,6 +94,14 @@ class SnapshotHygieneRule(Rule):
                 "declare the entry-key manifest next to the version")
             return
         _, declared, mline = current[0]
+        # A helper named encode_<key> for a DECLARED entry key is a
+        # nested sub-encoder (its dict keys live under that entry key,
+        # e.g. encode_sampling/encode_spec) — only the entry-level
+        # encoders define the wire manifest.
+        sub_encoders = {f"encode_{k}" for k in declared}
+        encoded = self._encoded_keys(module.tree, sub_encoders)
+        if encoded is None:
+            return
         actual = set(encoded)
         if set(declared) != actual:
             added = sorted(actual - set(declared))
@@ -114,15 +119,18 @@ class SnapshotHygieneRule(Rule):
                 "the compat pins")
 
     @staticmethod
-    def _encoded_keys(tree: ast.AST) -> Optional[Set[str]]:
+    def _encoded_keys(tree: ast.AST,
+                      sub_encoders: Set[str]) -> Optional[Set[str]]:
         """Keys the encode path emits: dict-literal keys in functions
-        named ``*encode*`` plus ``entry["k"] = ...`` stores there."""
+        named ``*encode*`` plus ``entry["k"] = ...`` stores there.
+        ``sub_encoders`` (``encode_<declared key>`` helpers) are
+        skipped — their dicts nest under an entry key."""
         keys: Set[str] = set()
         found = False
         for node in ast.walk(tree):
             if not (isinstance(node, ast.FunctionDef)
                     and "encode" in node.name
-                    and "sampling" not in node.name):
+                    and node.name not in sub_encoders):
                 continue
             found = True
             for sub in ast.walk(node):

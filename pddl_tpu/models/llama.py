@@ -221,13 +221,15 @@ class LlamaAttention(nn.Module):
             "cache", "cache_index", lambda: jnp.zeros((), jnp.int32))
 
         i = index.value
-        if i.ndim and s != 1:
-            # Per-row [B] positions (the serving engine's slot model)
-            # decode one token per row per call; multi-token prefill
-            # happens as a batch-1 row inserted into its slot.
+        if i.ndim and s != 1 and ring is not None:
+            # Per-row [B] positions over a RING cache cannot take
+            # multi-token blocks: a partially-rejected speculative
+            # window would have overwritten in-window slots per row.
+            # Full-length caches (the only kind the serving engine
+            # admits) handle the vector multi-token write below.
             raise ValueError(
-                "per-row cache_index supports single-token steps only "
-                f"(got a {s}-token block)")
+                "per-row cache_index over a rolling ring cache supports "
+                f"single-token steps only (got a {s}-token block)")
         # [..., None] keeps one expression for both index ranks: scalar
         # i → positions [s]; per-row i → [B, s] (rope broadcasts a head
         # axis for the 2-D form).
@@ -272,12 +274,18 @@ class LlamaAttention(nn.Module):
         hist_k, hist_v = cached_k.value, cached_v.value
         if initialized:
             if i.ndim:
-                rows = jnp.arange(b)
-                slot = i % ring if ring is not None else i
+                # Per-row scatter at i[b] + arange(s) (ring rows wrap
+                # their slot; s > 1 is full-length-cache only — gated
+                # above). Multi-token blocks are the speculative verify
+                # write: out-of-range positions drop (jit scatter OOB),
+                # so draft lookahead past the cache edge never lands.
+                rows = jnp.arange(b)[:, None]          # [B, 1]
+                pos = i[:, None] + jnp.arange(s)       # [B, s]
+                slot = pos % ring if ring is not None else pos
                 cached_k.value = cached_k.value.at[rows, :, slot].set(
-                    k[:, :, 0])
+                    jnp.moveaxis(k, 1, 2))
                 cached_v.value = cached_v.value.at[rows, :, slot].set(
-                    v[:, :, 0])
+                    jnp.moveaxis(v, 1, 2))
             elif ring is None:
                 cached_k.value = jax.lax.dynamic_update_slice(
                     cached_k.value, k, (0, 0, i, 0))

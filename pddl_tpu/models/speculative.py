@@ -81,31 +81,43 @@ import jax.numpy as jnp
 
 from pddl_tpu.models.gpt import _decode_cache_shapes
 
-__all__ = ["generate_speculative"]
+__all__ = ["generate_speculative", "ngram_drafts"]
 
 
-def _ngram_drafts(toks, cur_pos, ngram: int, draft_len: int):
+def ngram_drafts(toks, cur_pos, ngram: int, draft_len: int):
     """Prompt-lookup draft: ``[B, draft_len]`` continuations of the most
     recent earlier occurrence of the trailing ``ngram``.
 
     ``toks`` is the full token buffer ``[B, L]`` (prompt + emitted so
     far; positions > ``cur_pos`` hold junk), ``cur_pos`` the position of
-    the last known token. All shapes static; `dynamic_slice` clamping
-    makes out-of-range starts harmless (they yield junk drafts, which
-    verification rejects — exactness never depends on the draft).
+    the last known token — a SCALAR (the one-shot loop below, whose
+    rows share one cache index) or a per-row ``[B]`` int32 vector (the
+    serving engine's slot model, where every row is an independent
+    request at its own depth). THE one drafter definition: the one-shot
+    ``generate_speculative`` loop and ``ServeEngine``'s per-slot draft
+    program both compile exactly this function, so the two paths cannot
+    drift (pinned by an equivalence test). All shapes static;
+    `dynamic_slice` clamping makes out-of-range starts harmless (they
+    yield junk drafts, which verification rejects — exactness never
+    depends on the draft).
     """
     b, length = toks.shape
-    # Trailing n-gram ending at cur_pos (clamped left at the buffer edge).
-    query = jax.lax.dynamic_slice(
-        toks, (0, cur_pos - (ngram - 1)), (b, ngram))
+    cur_pos = jnp.asarray(cur_pos, jnp.int32)
+    pos_b = jnp.broadcast_to(cur_pos, (b,))  # [B] either way
+    # Trailing n-gram ending at each row's cur_pos (clamped left at the
+    # buffer edge). Per-row dynamic_slice via vmap — identical to the
+    # historical shared-scalar slice when every row carries one value.
+    query = jax.vmap(
+        lambda row, p: jax.lax.dynamic_slice(
+            row, (p - (ngram - 1),), (ngram,)))(toks, pos_b)  # [B, ngram]
     # All length-n windows: wins[i, :, w] = toks[:, w + i].
     n_win = length - ngram + 1
     wins = jnp.stack([toks[:, i:i + n_win] for i in range(ngram)], axis=0)
     hit = jnp.all(wins == query.T[:, :, None], axis=0)  # [B, n_win]
-    # A usable window ends strictly before cur_pos (the window ending AT
-    # cur_pos is the query itself).
+    # A usable window ends strictly before the row's cur_pos (the
+    # window ending AT cur_pos is the query itself).
     starts = jnp.arange(n_win)[None, :]
-    usable = hit & (starts <= cur_pos - ngram)
+    usable = hit & (starts <= pos_b[:, None] - ngram)
     best = jnp.max(jnp.where(usable, starts, -1), axis=1)  # [B]
     found = best >= 0
 
@@ -117,6 +129,11 @@ def _ngram_drafts(toks, cur_pos, ngram: int, draft_len: int):
     # anyway) and occasionally right on run-length text.
     fallback = jnp.broadcast_to(query[:, -1:], (b, draft_len))
     return jnp.where(found[:, None], drafts, fallback)
+
+
+# The historical private name (kept so long-lived call sites and tests
+# keep working; the public name above is the API).
+_ngram_drafts = ngram_drafts
 
 
 def _rewind_index(cache, new_index):
@@ -207,7 +224,7 @@ def _spec_fns(dec, draft_len: int, ngram: int, param_transform=None,
         def body(state):
             toks, n_out, cache, ticks, rng = state
             cur_pos = prompt_len + n_out - 1  # position of the last token
-            drafts = _ngram_drafts(toks, cur_pos, ngram, draft_len)
+            drafts = ngram_drafts(toks, cur_pos, ngram, draft_len)
             cur = jax.lax.dynamic_slice(toks, (0, cur_pos), (b, 1))
             block = jnp.concatenate([cur, drafts], axis=1)  # [B, width]
             logits, mutated = dec.apply(

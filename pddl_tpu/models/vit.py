@@ -107,10 +107,13 @@ class MultiHeadAttention(nn.Module):
         ``cache_index`` may be a PER-ROW ``[B]`` vector instead of the
         scalar the cache initializes with — the continuous-batching
         serving engine's slot model, where each batch row is an
-        independent request at its own depth (``s == 1`` only: requests
-        prefill as batch-1 rows and are inserted into their slot). Each
-        row's K/V then lands at its own position and the masking in
-        ``decode_attention`` is per row.
+        independent request at its own depth. Each row's K/V then lands
+        at its own position(s) and the masking in ``decode_attention``
+        is per row. Multi-token blocks compose with the vector index
+        (the speculative verify step: every slot writes ``s`` tokens at
+        ``i[b] .. i[b]+s-1``, causal within the block); positions
+        beyond ``max_decode_len`` are DROPPED by the scatter — padding
+        or rejected-draft junk past the cache edge never lands.
         """
         h = self.num_heads
         # During init() the cache variables don't exist yet: create them
@@ -126,11 +129,6 @@ class MultiHeadAttention(nn.Module):
             "cache", "cache_index", lambda: jnp.zeros((), jnp.int32))
 
         i = index.value
-        if i.ndim and s != 1:
-            raise ValueError(
-                "per-row cache_index supports single-token steps "
-                f"only (got a {s}-token block); prefill requests "
-                "as batch-1 rows, then insert into their slot")
         if initialized and self.has_variable("cache", "block_table"):
             # PAGED serving: the cache leaves are the engine's shared
             # block POOL ``[N, H, block_size, D]`` and the per-slot
@@ -162,11 +160,17 @@ class MultiHeadAttention(nn.Module):
             return dense(features=h * head_dim, name="out")(o)
         if initialized:
             if i.ndim:
-                rows = jnp.arange(b)
-                cached_k.value = cached_k.value.at[rows, :, i].set(
-                    k[:, :, 0].astype(self.dtype))
-                cached_v.value = cached_v.value.at[rows, :, i].set(
-                    v[:, :, 0].astype(self.dtype))
+                # Per-row scatter at i[b] + arange(s): single-token
+                # decode ticks and multi-token speculative verify blocks
+                # share one write (out-of-range positions drop — the
+                # scatter's jit OOB rule — so draft lookahead past the
+                # cache edge is junk-safe by construction).
+                rows = jnp.arange(b)[:, None]          # [B, 1]
+                pos = i[:, None] + jnp.arange(s)       # [B, s]
+                cached_k.value = cached_k.value.at[rows, :, pos].set(
+                    jnp.moveaxis(k, 1, 2).astype(self.dtype))
+                cached_v.value = cached_v.value.at[rows, :, pos].set(
+                    jnp.moveaxis(v, 1, 2).astype(self.dtype))
             else:
                 cached_k.value = jax.lax.dynamic_update_slice(
                     cached_k.value, k.astype(self.dtype), (0, 0, i, 0))

@@ -117,6 +117,10 @@ SERVE_COUNTER_KEYS = frozenset({
     # gauge / requests_by_adapter stay gauges.)
     "adapter_hits", "adapter_loads", "adapter_evictions",
     "constrained_requests", "requests_grammar_complete",
+    # Speculative serving (engine ``spec_k > 0``): verify windows and
+    # the drafted/accepted token volume behind the acceptance-rate
+    # gauge (the rate itself stays a gauge).
+    "spec_ticks", "spec_drafted_tokens", "spec_accepted_tokens",
 })
 
 _NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
@@ -271,6 +275,12 @@ def engine_gauges(engine) -> Dict[str, object]:
         "paged": getattr(engine, "paged", False),
         "blocks_shared": getattr(engine, "blocks_shared", 0),
         "block_table_fill": getattr(engine, "block_table_fill", 0.0),
+        # Speculative-serving gauges (0 on a classic engine): the
+        # compiled draft width and whether a draft model (second paged
+        # cache tree) is doing the drafting.
+        "spec_k": getattr(engine, "spec_k", 0),
+        "spec_draft_model": getattr(engine, "spec_draft_model_enabled",
+                                    False),
         # Multi-tenant gauges (False/0 on a plain engine): whether the
         # tenant path is compiled in, and how many adapters are
         # device-resident right now (`serve/tenant/`).

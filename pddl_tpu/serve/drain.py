@@ -67,8 +67,19 @@ from pddl_tpu.serve.request import (
 # deployment config, FSM state a pure function of the emitted tokens),
 # so the replay path rebuilds tenant streams exactly like KV. Future
 # versions still refuse below.
-SNAPSHOT_VERSION = 4
-_READABLE_VERSIONS = frozenset({1, 2, 3, 4})
+# Version 5 (speculative serving, ISSUE 12): the header carries
+# ``spec_k`` (the drafting config the streams ran under) and each
+# entry a ``spec`` dict — the stream's lifetime ``{drafted, accepted}``
+# draft accounting, so a migrated speculative stream keeps honest
+# acceptance telemetry on its new replica. Neither is a restore INPUT
+# beyond the counters: KV, FSM state, and every drafter's state are
+# pure functions of (params, tokens), so v1-v5 snapshots all restore
+# through the same replay path into ANY engine — speculative or not,
+# row or paged (a speculative engine merely re-feeds the known tokens
+# spec_k+1 per verify window instead of one per tick). Future versions
+# still refuse below.
+SNAPSHOT_VERSION = 5
+_READABLE_VERSIONS = frozenset({1, 2, 3, 4, 5})
 
 # Machine-checked wire manifest (graftlint `snapshot-hygiene`,
 # docs/ANALYSIS.md): the exact entry keys ``_encode_core``/
@@ -77,9 +88,9 @@ _READABLE_VERSIONS = frozenset({1, 2, 3, 4})
 # ENTRY_KEYS_V<new>, and extending the compat pins in the same commit —
 # the static checker fails the tree otherwise, which is what turns
 # "remembered to bump" into "cannot forget to bump".
-ENTRY_KEYS_V4 = ("prompt", "max_new_tokens", "sampling", "deadline_s",
+ENTRY_KEYS_V5 = ("prompt", "max_new_tokens", "sampling", "deadline_s",
                  "priority", "adapter", "constraint", "elapsed_s",
-                 "tokens", "ttft_s", "block_table")
+                 "tokens", "ttft_s", "spec", "block_table")
 
 
 def encode_sampling(sampling: SamplingParams) -> Dict[str, object]:
@@ -99,6 +110,14 @@ def decode_sampling(d) -> SamplingParams:
     d = d or {}
     return SamplingParams(temperature=float(d.get("temperature", 0.0)),
                           top_k=d.get("top_k"), top_p=d.get("top_p"))
+
+
+def encode_spec(handle: RequestHandle) -> Dict[str, object]:
+    """The v5 per-entry speculative accounting (one encode/decode pair
+    like :func:`encode_sampling`): the stream's lifetime drafted/
+    accepted counters, zeros on non-speculative engines."""
+    return {"drafted": int(getattr(handle, "spec_drafted", 0)),
+            "accepted": int(getattr(handle, "spec_accepted", 0))}
 
 
 def encode_handle(handle: RequestHandle, now_s: float,
@@ -132,6 +151,10 @@ def _encode_core(handle: RequestHandle, now_s: float) -> Dict[str, object]:
         "tokens": [int(t) for t in handle.tokens],
         "ttft_s": (float(handle.ttft_s)
                    if handle.ttft_s is not None else None),
+        # v5: the stream's lifetime draft accounting (zeros on
+        # non-speculative engines and for never-served requests) — the
+        # acceptance telemetry follows the stream across migrations.
+        "spec": encode_spec(handle),
     }
 
 
@@ -159,6 +182,11 @@ def decode_handle(entry: Dict[str, object], now_s: float) -> RequestHandle:
         req, arrival_s=float(now_s) - float(entry.get("elapsed_s", 0.0)))
     handle.tokens = [int(t) for t in entry.get("tokens", [])]
     handle.ttft_s = entry.get("ttft_s")
+    # v1-v4 entries predate speculation: absent decodes as zeros (the
+    # accounting every pre-speculative stream implicitly had).
+    spec = entry.get("spec") or {}
+    handle.spec_drafted = int(spec.get("drafted", 0))
+    handle.spec_accepted = int(spec.get("accepted", 0))
     handle.state = RequestState.QUEUED
     return handle
 

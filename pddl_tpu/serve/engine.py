@@ -84,6 +84,34 @@ A request whose replays exceed ``max_replays`` fails terminally
 queued + running request's host state to disk; a fresh engine
 ``restore()``s the snapshot and resumes each stream token-exactly
 through the same replay machinery.
+
+Speculative serving (``spec_k > 0``; ISSUE 12 / ROADMAP item 3):
+the fused tick becomes a per-slot DRAFT/VERIFY window — Leviathan et
+al.'s speculative decoding lifted into Orca-style iteration-level
+scheduling. Each step a ``draft`` program proposes up to ``spec_k``
+tokens per slot (the shared n-gram drafter from
+`models/speculative.py` by default — zero extra weights — or a small
+draft model whose KV rides the same paged block pool as a second
+cache tree), and ONE batched ``verify`` dispatch runs the target
+model over the ``[S, spec_k+1]`` block at per-slot positions through
+the same multi-token machinery chunked prefill uses. Greedy slots
+accept the longest matching draft prefix — up to ``spec_k+1`` tokens
+from one tick, each the argmax of the true model given the true
+prefix, so the stream is token-exact vs non-speculative greedy —
+while sampled slots accept zero drafts and tick one token exactly as
+before. Accepted length comes back as a runtime ``[S]`` int32 array:
+mixed accept counts across the batch are DATA, never a recompile,
+exactly the invariant the grammar masks and LoRA ids already hold.
+Rejected draft suffixes roll back by stamping the host-side position
+counters (and, paged, by the table discipline): the stale K/V sits
+beyond the counter where the prefix-bounded sweep never reads it and
+the next window overwrites it — a rewind is a counter stamp, never a
+KV copy. Grammar-constrained slots speculate under the same FSM
+tables (per-position masks over the draft path; the per-slot FSM
+advances by the ACCEPTED length only), and replaying slots re-feed
+known tokens ``spec_k+1`` per window, so fault recovery and
+drain/restore/migration of speculative streams stay token-exact AND
+speed up by the same factor.
 """
 
 from __future__ import annotations
@@ -109,6 +137,7 @@ from pddl_tpu.models.gpt import (
     set_cache_positions,
     slot_decode_cache,
 )
+from pddl_tpu.models.speculative import ngram_drafts
 from pddl_tpu.obs.ring import TelemetryRing
 from pddl_tpu.ops.lora import adapter_pool_load, batched_lora_delta
 from pddl_tpu.obs.trace import NULL_TRACER
@@ -182,6 +211,18 @@ _DONATED_BY_SITE = {
 _PAGED_DONATED_BY_SITE = {
     "tick": "pool", "chunk_prefill": "pool", "chunk_prefill_wide": "pool",
 }
+
+# Speculative-engine additions (`spec_k > 0`): the ``verify`` program
+# replaces ``tick`` and donates the same resident tree; the draft-MODEL
+# program and its admission chunk donate the draft cache tree, which in
+# paged mode lives in the same block-id space as the pool — a consumed
+# draft tree therefore recovers exactly like a consumed pool (full
+# paged-world rebuild + live-slot replay). The n-gram ``draft`` program
+# donates nothing and is deliberately absent here — a lost draft call
+# degrades to fallback drafts, never to a KV rebuild — so the ``draft``
+# entry is stamped PER ENGINE (only when a draft model is drafting).
+_SPEC_DONATED_ROW = {"verify": "cache"}
+_SPEC_DONATED_PAGED = {"verify": "pool", "draft_prefill": "pool"}
 
 
 class ServeEngine:
@@ -290,6 +331,30 @@ class ServeEngine:
         LM HEAD, which keeps KV adapter-invariant — prefix/paged KV
         sharing stays valid ACROSS tenants. ``None`` (default) compiles
         the plain programs: a non-tenant engine pays nothing.
+      spec_k: SPECULATIVE SERVING (module docstring, ISSUE 12): draft
+        up to ``spec_k`` tokens per engaged slot per step and verify
+        them in one batched ``[S, spec_k+1]`` wide-logits dispatch —
+        greedy slots emit up to ``spec_k + 1`` tokens per tick,
+        token-exact vs the non-speculative greedy stream; sampled
+        slots keep ticking one token. ``0`` (default) compiles the
+        classic one-token tick — a non-speculative engine pays
+        nothing. Accepted lengths are runtime ``[S]`` data, so mixed
+        accept counts never recompile. Replays/restores re-feed known
+        tokens ``spec_k + 1`` per window through the same machinery.
+      spec_ngram: the n-gram drafter's lookup key length (the shared
+        :func:`~pddl_tpu.models.speculative.ngram_drafts` definition —
+        one drafter for the one-shot and serving paths).
+      spec_draft_model / spec_draft_variables: optional DRAFT MODEL
+        (paged engines only): a small ``generate()``-compatible model
+        whose per-slot KV rides the same block pool as a second cache
+        tree — same block ids, same tables, same radix sharing/dedup
+        (draft K/V is position-absolute and token-pure exactly like
+        the target's, so shared-prefix blocks stay bit-valid for both
+        trees). Admission chunk-prefills the prompt through it
+        (``draft_prefill`` site, narrow chunks); each step it drafts
+        ``spec_k`` tokens autoregressively (known replay tokens are
+        teacher-forced so its cache stays exact through recovery).
+        ``None`` keeps the zero-weight n-gram drafter.
       tracer: optional per-request tracer
         (:class:`~pddl_tpu.obs.trace.RequestTracer`); ``None`` installs
         the no-op :data:`~pddl_tpu.obs.trace.NULL_TRACER` — tracing
@@ -323,6 +388,8 @@ class ServeEngine:
                  degraded_cooldown_s: float = 5.0,
                  preempt_cap: int = 2,
                  tenant=None,
+                 spec_k: int = 0, spec_ngram: int = 3,
+                 spec_draft_model=None, spec_draft_variables=None,
                  tracer=None, telemetry_capacity: int = 512):
         if max_slots < 1:
             raise ValueError(f"max_slots must be >= 1, got {max_slots}")
@@ -474,6 +541,60 @@ class ServeEngine:
             raise ValueError(f"preempt_cap must be >= 0, got {preempt_cap}")
         self._preempt_cap = int(preempt_cap)
 
+        # Speculative serving (module docstring): static draft config —
+        # the verify width spec_k+1 is a compiled shape, everything
+        # per-slot (drafts, accepted lengths, caps, forced re-feeds)
+        # is runtime data.
+        if spec_k < 0:
+            raise ValueError(f"spec_k must be >= 0, got {spec_k}")
+        self._spec_k = int(spec_k)
+        self._spec_on = self._spec_k > 0
+        self._spec_ngram = int(spec_ngram)
+        self._draft_on = spec_draft_model is not None
+        if self._spec_on:
+            if self._spec_ngram < 1:
+                raise ValueError(
+                    f"spec_ngram must be >= 1, got {spec_ngram}")
+            # The host-side token history every drafter reads: prompt +
+            # emitted tokens per slot, the serving twin of the one-shot
+            # path's token buffer (positions past the live edge hold
+            # junk, which verification rejects by construction).
+            self._hist = np.zeros((self.max_slots, model.max_len),
+                                  np.int32)
+        if self._draft_on:
+            if not self._spec_on:
+                raise ValueError(
+                    "spec_draft_model needs spec_k >= 1 (the draft "
+                    "model only exists to fill the verify window)")
+            if not self._paged:
+                raise ValueError(
+                    "spec_draft_model rides the paged KV block pool as "
+                    "a second cache tree; pass paged=True (the n-gram "
+                    "drafter serves resident-row engines)")
+            if spec_draft_variables is None:
+                raise ValueError(
+                    "spec_draft_model needs spec_draft_variables "
+                    "({'params': ...})")
+            if spec_draft_model.vocab_size != model.vocab_size:
+                raise ValueError(
+                    f"draft model vocab {spec_draft_model.vocab_size} "
+                    f"!= target vocab {model.vocab_size}")
+            if spec_draft_model.max_len < model.max_len:
+                raise ValueError(
+                    f"draft model max_len {spec_draft_model.max_len} < "
+                    f"target max_len {model.max_len}: the draft cache "
+                    "must cover every position a stream can reach")
+            if getattr(spec_draft_model, "uses_ring_cache", False):
+                raise NotImplementedError(
+                    "draft models with rolling ring caches are not "
+                    "supported (same slot-reuse constraint as the "
+                    "target)")
+            self._ddec = spec_draft_model.clone(decode=True)
+            self._dparams = spec_draft_variables["params"]
+        elif spec_draft_variables is not None:
+            raise ValueError(
+                "spec_draft_variables without spec_draft_model")
+
         # Multi-tenant state (`serve/tenant/`): the host-side adapter
         # pool bookkeeping, the device factor pools, per-slot adapter
         # rows, per-slot grammar masks, and the FSM cache. All absent
@@ -525,6 +646,15 @@ class ServeEngine:
             # bool array is hundreds of KB per step otherwise.
             self._masks_dev = None
             self._masks_dirty = True
+            # Speculative engines additionally carry PER-POSITION masks
+            # [S, spec_k+1, V] for the verify block (the FSM states
+            # along each slot's draft path, stamped by the host walk
+            # each tick); same device-staging discipline as `_masks`.
+            self._masks_w = (np.ones(
+                (self.max_slots, self._spec_k + 1, model.vocab_size),
+                np.bool_) if self._spec_on else None)
+            self._masks_w_dev = None
+            self._masks_w_dirty = True
             self._fsms: List[Optional[tuple]] = [None] * self.max_slots
             self._fsm_cache: Dict[str, object] = {}
         else:
@@ -753,6 +883,150 @@ class ServeEngine:
                 return _canon_paged(cache), _lora1(last, lf, pool_a,
                                                    pool_b, aid)
 
+        # --- speculative program bodies (the `spec_k` arg docs) ---
+        # The VERIFY program replaces the fused tick: one apply over the
+        # [S, spec_k+1] block at per-slot positions (the multi-token
+        # vector-index write the model families grew for this), greedy
+        # acceptance as cumprod-of-matches against the block's own draft
+        # suffix, and the position-0 token through the SAME batched
+        # sampler the plain tick used — a sampled row (cap 0) is the
+        # old tick bit-for-bit in behavior. `caps` bounds acceptance per
+        # row (spec_k for plain greedy, the grammar walk's legal-prefix
+        # length for constrained rows, 0 for sampled rows); `forced >=
+        # 0` pins the accepted length outright (replay re-feeds: tokens
+        # known, model output discarded). Every one of them is [S]
+        # runtime data — mixed accept counts never vary program shape.
+        if self._spec_on:
+            def _verify_core(logits, block, temps, top_ks, top_ps, caps,
+                             forced, sub):
+                y = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                match = (block[:, 1:] == y[:, :-1]).astype(jnp.int32)
+                acc_model = jnp.sum(jnp.cumprod(match, axis=1), axis=1)
+                acc = jnp.where(forced >= 0, forced,
+                                jnp.minimum(acc_model, caps))
+                first = sample_logits_batched(
+                    sub, logits[:, 0], temperature=temps, top_k=top_ks,
+                    top_p=top_ps)
+                return y.at[:, 0].set(first), acc
+
+            def _verify(params, cache, positions, block, temps, top_ks,
+                        top_ps, caps, forced, rng):
+                rng, sub = jax.random.split(rng)
+                cache = set_cache_positions(cache, positions)
+                logits, mutated = dec.apply(
+                    {"params": (pt(params) if pt is not None else params),
+                     "cache": cache},
+                    block, train=False, mutable=["cache"])
+                w, acc = _verify_core(logits, block, temps, top_ks,
+                                      top_ps, caps, forced, sub)
+                return mutated["cache"], w, acc, rng
+
+            def _verify_paged(params, cache, positions, tables, block,
+                              temps, top_ks, top_ps, caps, forced, rng):
+                rng, sub = jax.random.split(rng)
+                cache = set_cache_positions(cache, positions)
+                cache = set_cache_block_tables(cache, tables)
+                logits, mutated = dec.apply(
+                    {"params": (pt(params) if pt is not None else params),
+                     "cache": cache},
+                    block, train=False, mutable=["cache"])
+                w, acc = _verify_core(logits, block, temps, top_ks,
+                                      top_ps, caps, forced, sub)
+                return _canon_paged(mutated["cache"]), w, acc, rng
+
+            if self._tenant_on:
+                # Tenant verify: per-slot LoRA deltas over EVERY block
+                # position (verification must judge drafts under the
+                # ADAPTED model) and per-POSITION grammar masks
+                # [S, W, V] — the draft path's FSM states, stamped by
+                # the host walk each tick.
+                def _verify_body_t(params, cache, block, temps, top_ks,
+                                   top_ps, masks, pool_a, pool_b, arows,
+                                   caps, forced, sub):
+                    p2 = pt(params) if pt is not None else params
+                    feats, mutated = dec.apply(
+                        {"params": p2, "cache": cache},
+                        block, train=False, mutable=["cache"],
+                        features_only=True)
+                    logits = lm_head_logits(dec, p2, feats)  # [S, W, V]
+                    s_, w_, v_ = logits.shape
+                    delta = batched_lora_delta(
+                        feats.reshape(s_ * w_, -1), pool_a, pool_b,
+                        jnp.repeat(arows, w_)).reshape(s_, w_, v_)
+                    logits = jnp.where(masks, logits + delta, -jnp.inf)
+                    w, acc = _verify_core(logits, block, temps, top_ks,
+                                          top_ps, caps, forced, sub)
+                    return mutated["cache"], w, acc
+
+                def _verify_t(params, cache, positions, block, temps,
+                              top_ks, top_ps, masks, pool_a, pool_b,
+                              arows, caps, forced, rng):
+                    rng, sub = jax.random.split(rng)
+                    cache = set_cache_positions(cache, positions)
+                    cache, w, acc = _verify_body_t(
+                        params, cache, block, temps, top_ks, top_ps,
+                        masks, pool_a, pool_b, arows, caps, forced, sub)
+                    return cache, w, acc, rng
+
+                def _verify_paged_t(params, cache, positions, tables,
+                                    block, temps, top_ks, top_ps, masks,
+                                    pool_a, pool_b, arows, caps, forced,
+                                    rng):
+                    rng, sub = jax.random.split(rng)
+                    cache = set_cache_positions(cache, positions)
+                    cache = set_cache_block_tables(cache, tables)
+                    cache, w, acc = _verify_body_t(
+                        params, cache, block, temps, top_ks, top_ps,
+                        masks, pool_a, pool_b, arows, caps, forced, sub)
+                    return _canon_paged(cache), w, acc, rng
+
+            spec_kk, spec_ng = self._spec_k, self._spec_ngram
+
+            def _draft_ngram(toks, positions):
+                # THE shared drafter definition (`models/speculative.py`
+                # — the one-shot loop compiles the same function with a
+                # scalar position; equivalence is pinned by test).
+                return ngram_drafts(toks, positions, spec_ng, spec_kk)
+
+            if self._draft_on:
+                ddec = self._ddec
+
+                def _draft_model_fn(dparams, dcache, positions, tables,
+                                    cur, forced, n_forced):
+                    dcache = set_cache_positions(dcache, positions)
+                    dcache = set_cache_block_tables(dcache, tables)
+                    tok = cur
+                    outs = []
+                    for j in range(spec_kk):
+                        logits, mutated = ddec.apply(
+                            {"params": dparams, "cache": dcache},
+                            tok[:, None], train=False, mutable=["cache"])
+                        dcache = mutated["cache"]
+                        nxt = jnp.argmax(logits[:, -1],
+                                         axis=-1).astype(jnp.int32)
+                        # Teacher-force known replay tokens: the draft
+                        # cache must hold the TRUE stream's K/V (not the
+                        # draft model's own guesses) through recovery.
+                        nxt = jnp.where(j < n_forced, forced[:, j], nxt)
+                        outs.append(nxt)
+                        tok = nxt
+                    # One extra apply writes the FINAL draft's K/V (its
+                    # logits are discarded): a fully-accepted window
+                    # would otherwise leave a one-position hole in the
+                    # draft cache and degrade every later draft.
+                    _, mutated = ddec.apply(
+                        {"params": dparams, "cache": dcache},
+                        tok[:, None], train=False, mutable=["cache"])
+                    return (_canon_paged(mutated["cache"]),
+                            jnp.stack(outs, axis=1))
+
+                def _draft_chunk(dparams, dcache, tokens, length, start,
+                                 table):
+                    dcache = set_cache_block_tables(dcache, table)
+                    dcache, _ = prefill_row_from(ddec, dparams, tokens,
+                                                 length, dcache, start)
+                    return _canon_paged(dcache)
+
         # The resident programs (four without prefix caching; gather /
         # chunk-prefill / donate replace the one-shot prefill with it
         # on; in PAGED mode the set shrinks to tick + chunk widths +
@@ -763,8 +1037,19 @@ class ServeEngine:
         # touches it — the engine always adopts the returned trees, so
         # the resident HBM buffers are reused in place and a stale
         # reference can never be used by mistake.
-        self._donated_by_site = (_PAGED_DONATED_BY_SITE if self._paged
-                                 else _DONATED_BY_SITE)
+        self._donated_by_site = dict(_PAGED_DONATED_BY_SITE if self._paged
+                                     else _DONATED_BY_SITE)
+        if self._spec_on:
+            self._donated_by_site.update(
+                _SPEC_DONATED_PAGED if self._paged else _SPEC_DONATED_ROW)
+            if self._draft_on:
+                # The draft-MODEL program donates the draft tree (the
+                # n-gram program donates nothing, so this entry exists
+                # only with a draft model): a REAL mid-dispatch error
+                # must never re-dispatch the consumed dcache — it
+                # escalates straight to the pool-class rebuild, which
+                # reconstructs both trees.
+                self._donated_by_site["draft"] = "pool"
         ten = self._tenant_on
         self._sample_first_p = jax.jit(_sample_first_t if ten
                                        else _sample_first)
@@ -807,6 +1092,28 @@ class ServeEngine:
                     self._cache)
                 if leaf.ndim > 2)
             self._kv_token_bytes = kv_bytes // (pool_blocks * bs)
+            self._verify_p = self._draft_p = self._dchunk_p = None
+            self._draft_model_p = None
+            self._dcache = None
+            if self._spec_on:
+                self._verify_p = jax.jit(
+                    _verify_paged_t if ten else _verify_paged,
+                    donate_argnums=(1,))
+                if self._draft_on:
+                    # A DISTINCT attribute from the (non-donating)
+                    # n-gram program: this one donates the draft tree.
+                    self._draft_model_p = jax.jit(_draft_model_fn,
+                                                  donate_argnums=(1,))
+                    self._dchunk_p = jax.jit(_draft_chunk,
+                                             donate_argnums=(1,))
+                    # The second cache tree riding the same pool: one
+                    # block-id space, one table, two KV trees (target +
+                    # draft) — sharing, dedup, flush, and reset all act
+                    # on both through the same ids.
+                    self._dcache = paged_decode_cache(self._ddec,
+                                                      pool_blocks, bs)
+                else:
+                    self._draft_p = jax.jit(_draft_ngram)
             self._warm = False
             if tracer is not None:
                 self.set_tracer(tracer)
@@ -854,6 +1161,13 @@ class ServeEngine:
             self._prefix = None
             self._row = None
 
+        self._verify_p = self._draft_p = self._dchunk_p = None
+        self._draft_model_p = None
+        self._dcache = None
+        if self._spec_on:
+            self._verify_p = jax.jit(_verify_t if ten else _verify,
+                                     donate_argnums=(1,))
+            self._draft_p = jax.jit(_draft_ngram)
         self._cache = slot_decode_cache(dec, self.max_slots)
         self._warm = False
         if tracer is not None:
@@ -1002,10 +1316,14 @@ class ServeEngine:
             tok, self._rng = self._sample_first_p(
                 logits, *first_mask, np.float32(0.0), np.int32(0),
                 np.float32(2.0), self._rng)
-            self._cache, nxt, self._rng = self._tick_p(
-                self._params, self._cache, self._positions, self._tables,
-                self._tokens, self._temps, self._top_ks, self._top_ps,
-                *self._tick_extra(), self._rng)
+            if self._spec_on:
+                nxt = self._warm_spec()
+            else:
+                self._cache, nxt, self._rng = self._tick_p(
+                    self._params, self._cache, self._positions,
+                    self._tables, self._tokens, self._temps,
+                    self._top_ks, self._top_ps, *self._tick_extra(),
+                    self._rng)
             jax.block_until_ready((tok, nxt))
             self._warm = True
             return
@@ -1033,12 +1351,51 @@ class ServeEngine:
         tok, self._rng = self._sample_first_p(
             logits, *first_mask, np.float32(0.0), np.int32(0),
             np.float32(2.0), self._rng)
-        self._cache, nxt, self._rng = self._tick_p(
-            self._params, self._cache, self._positions, self._tokens,
-            self._temps, self._top_ks, self._top_ps, *self._tick_extra(),
-            self._rng)
+        if self._spec_on:
+            nxt = self._warm_spec()
+        else:
+            self._cache, nxt, self._rng = self._tick_p(
+                self._params, self._cache, self._positions, self._tokens,
+                self._temps, self._top_ks, self._top_ps,
+                *self._tick_extra(), self._rng)
         jax.block_until_ready((tok, nxt))
         self._warm = True
+
+    def _warm_spec(self):
+        """Trace the draft/verify pair (and the draft model's admission
+        chunk) with all-dead inputs: caps 0 + forced -1 accept nothing,
+        junk writes land at parked positions (row mode) or the scratch
+        sink (paged all-scratch tables), so warmup leaves no trace in
+        any live state. Returns the verify window for the caller's
+        block_until_ready."""
+        s, k = self.max_slots, self._spec_k
+        forced_tok = np.zeros((s, k), np.int32)
+        forced_n = np.full(s, -1, np.int32)
+        if self._draft_on:
+            t1 = np.zeros((1, self._table_width), np.int32)
+            self._dcache = self._dchunk_p(
+                self._dparams, self._dcache,
+                np.zeros((1, self._chunk), np.int32), np.int32(1),
+                np.int32(0), t1)
+            self._dcache, drafts = self._draft_model_p(
+                self._dparams, self._dcache, self._positions,
+                self._tables, self._tokens, forced_tok, forced_n)
+        else:
+            drafts = self._draft_p(self._hist, self._positions)
+        block = np.zeros((s, k + 1), np.int32)
+        caps = np.zeros(s, np.int32)
+        if self._paged:
+            self._cache, w, acc, self._rng = self._verify_p(
+                self._params, self._cache, self._positions, self._tables,
+                block, self._temps, self._top_ks, self._top_ps,
+                *self._verify_extra(), caps, forced_n, self._rng)
+        else:
+            self._cache, w, acc, self._rng = self._verify_p(
+                self._params, self._cache, self._positions, block,
+                self._temps, self._top_ks, self._top_ps,
+                *self._verify_extra(), caps, forced_n, self._rng)
+        jax.block_until_ready(drafts)
+        return w
 
     def compile_counts(self) -> Dict[str, int]:
         """Compiled-executable count per resident program (the
@@ -1049,10 +1406,22 @@ class ServeEngine:
         runtime values, so the program set stays closed here too."""
         if self._paged:
             counts = {
-                "tick": self._tick_p._cache_size(),
                 "sample_first": self._sample_first_p._cache_size(),
                 "chunk_prefill": self._chunk_p._cache_size(),
             }
+            if self._spec_on:
+                # Speculative engines swap the one-token tick for the
+                # draft/verify pair (+ the draft model's admission
+                # chunk) — the site vocabulary graftlint keeps in
+                # lockstep with FaultPlan.SITES.
+                counts["verify"] = self._verify_p._cache_size()
+                counts["draft"] = (self._draft_model_p if self._draft_on
+                                   else self._draft_p)._cache_size()
+                if self._draft_on:
+                    counts["draft_prefill"] = \
+                        self._dchunk_p._cache_size()
+            else:
+                counts["tick"] = self._tick_p._cache_size()
             if self._has_wide:
                 counts["chunk_prefill_wide"] = \
                     self._chunk_wide_p._cache_size()
@@ -1062,9 +1431,13 @@ class ServeEngine:
             return counts
         counts = {
             "insert": self._insert_p._cache_size(),
-            "tick": self._tick_p._cache_size(),
             "sample_first": self._sample_first_p._cache_size(),
         }
+        if self._spec_on:
+            counts["verify"] = self._verify_p._cache_size()
+            counts["draft"] = self._draft_p._cache_size()
+        else:
+            counts["tick"] = self._tick_p._cache_size()
         if self._tenant_on:
             counts["adapter_load"] = self._adapter_load_p._cache_size()
         if self._prefix_on:
@@ -1087,6 +1460,23 @@ class ServeEngine:
         """True when decode reads K/V straight from the block pool
         through per-slot block tables (no resident slot cache)."""
         return self._paged
+
+    @property
+    def spec_enabled(self) -> bool:
+        """True when this engine compiled the speculative draft/verify
+        program pair (``spec_k > 0``; module docstring)."""
+        return self._spec_on
+
+    @property
+    def spec_k(self) -> int:
+        """Drafted tokens per slot per step (0 = classic tick)."""
+        return self._spec_k
+
+    @property
+    def spec_draft_model_enabled(self) -> bool:
+        """True when a draft model (second paged cache tree) drafts;
+        False means the zero-weight n-gram drafter (or spec off)."""
+        return self._draft_on
 
     # ----------------------------------------------------------- tenancy
     @property
@@ -1228,6 +1618,19 @@ class ServeEngine:
             self._masks_dev = jnp.asarray(self._masks)
             self._masks_dirty = False
         return (self._masks_dev, self._apool_a, self._apool_b,
+                self._arow)
+
+    def _verify_extra(self):
+        """Extra verify-program args in tenant mode (per-POSITION
+        grammar masks ``[S, spec_k+1, V]`` + factor pools + per-slot
+        adapter rows); empty on a plain engine. Same restage-on-change
+        staging as the tick masks."""
+        if not self._tenant_on:
+            return ()
+        if self._masks_w_dev is None or self._masks_w_dirty:
+            self._masks_w_dev = jnp.asarray(self._masks_w)
+            self._masks_w_dirty = False
+        return (self._masks_w_dev, self._apool_a, self._apool_b,
                 self._arow)
 
     def _first_mask_args(self, fsm):
@@ -1462,6 +1865,12 @@ class ServeEngine:
         slots FIRST — their KV lived here."""
         self._cache = paged_decode_cache(self._dec, self._prefix.num_blocks,
                                          self.prefix_block_size)
+        if self._draft_on:
+            # The draft tree shares the block-id space: a pool reset
+            # retires its storage too (replay rebuilds both trees).
+            self._dcache = paged_decode_cache(self._ddec,
+                                              self._prefix.num_blocks,
+                                              self.prefix_block_size)
         self._prefix = RadixPrefixCache(self.prefix_block_size,
                                         self._prefix.num_blocks)
         self._tables[:] = 0
@@ -1505,6 +1914,10 @@ class ServeEngine:
             if not self._masks[slot_id].all():
                 self._masks[slot_id, :] = True
                 self._masks_dirty = True
+            if self._masks_w is not None \
+                    and not self._masks_w[slot_id].all():
+                self._masks_w[slot_id, :, :] = True
+                self._masks_w_dirty = True
             self._fsms[slot_id] = None
         if self._paged:
             if self._private[slot_id]:
@@ -1625,6 +2038,13 @@ class ServeEngine:
         if (self._tenant_on and handle.request.adapter is not None
                 and self._apool.row_of(handle.request.adapter) is None):
             cost += int(self._tenant.adapter_load_tokens)
+        # Speculative engines charge a replay's catch-up re-feed against
+        # the budget at the ACCEPTED token count — the emitted tokens
+        # that really must re-enter the cache — never the drafted
+        # (spec_k+1)-wide compute the verify window spends reaching
+        # them (`scheduler.admit`'s accepted-not-drafted contract).
+        if self._spec_on and handle.tokens:
+            cost += len(handle.tokens)
         return cost
 
     def _prefill_into_row(self, prompt: np.ndarray, handle=None, aid=0):
@@ -1775,6 +2195,27 @@ class ServeEngine:
             off += w
         return logits
 
+    def _draft_prefill_loop(self, prompt: np.ndarray, off: int,
+                            table) -> None:
+        """Chunk-prefill the prompt's uncached suffix through the DRAFT
+        model into its pool tree (narrow chunks only — the draft model
+        is small by design, so a wide twin would double the program set
+        for marginal gain). Same offsets and blocks as the target's
+        chunks: a donated shared-prefix block carries valid draft K/V
+        for every future hit, exactly like the target K/V it sits
+        beside."""
+        plen = int(prompt.size)
+        off = int(off)
+        while off < plen:
+            w = min(self._chunk, plen - off)
+            chunk_toks = np.zeros((1, self._chunk), np.int32)
+            chunk_toks[0, :w] = prompt[off:off + w]
+            self._dcache = self._device_call(
+                "draft_prefill", self._dchunk_p, self._dparams,
+                self._dcache, chunk_toks, np.int32(w), np.int32(off),
+                table)
+            off += w
+
     # ------------------------------------------------- paged admission
     def _paged_match_and_allocate(self, prompt: np.ndarray, handle=None):
         """The shared front half of every paged admission (whole-prompt
@@ -1840,6 +2281,8 @@ class ServeEngine:
 
         try:
             logits = self._chunk_loop(prompt, n_cached, handle, _dispatch)
+            if self._draft_on:
+                self._draft_prefill_loop(prompt, n_cached, t1)
         except _SlotStateLost:
             # Injected faults consumed nothing: hand the resources
             # back. A REAL consumed-pool error resets the whole paged
@@ -1942,10 +2385,10 @@ class ServeEngine:
             self._tracer.on_deadline_shed(handle)
             self._tracer.on_finish(handle, FinishReason.DEADLINE.value)
 
-        # The suffix-priced (and adapter-load-priced) cost_fn walks the
-        # radix tree per pop; only pay that when a budget actually
-        # consumes the result.
-        use_cost = ((self._prefix_on or self._tenant_on)
+        # The suffix-priced (and adapter-load-priced, and spec-replay-
+        # priced) cost_fn walks the radix tree per pop; only pay that
+        # when a budget actually consumes the result.
+        use_cost = ((self._prefix_on or self._tenant_on or self._spec_on)
                     and self.scheduler.prefill_token_budget is not None)
         # A kill mid-admission can leave a handle parked in
         # `_admitting`; it owns the first free slot before anything new
@@ -1981,20 +2424,32 @@ class ServeEngine:
         for a live stream, but if a mis-sized explicit pool ever does,
         the slot is parked and REPLAYED rather than writing into a
         shared block."""
+        # A speculative tick writes the whole verify window, positions
+        # pos .. pos+spec_k: every block that extent touches must be
+        # writable before the dispatch (writes past the table deflect
+        # to scratch, so the extent clamps at the table edge).
+        span = self._spec_k if self._spec_on else 0
+        bs = self.prefix_block_size
         for sid, handle in enumerate(self._slots):
             if handle is None:
                 continue
-            blk = int(self._positions[sid]) // self.prefix_block_size
-            if blk >= self._table_width or self._tables[sid, blk] != 0:
+            lo = int(self._positions[sid]) // bs
+            hi = min((int(self._positions[sid]) + span) // bs,
+                     self._table_width - 1)
+            need = [blk for blk in range(lo, hi + 1)
+                    if blk >= 0 and self._tables[sid, blk] == 0]
+            if not need:
                 continue
-            ids = self._prefix.allocate(1)
-            if not ids:
+            ids = self._prefix.allocate(len(need))
+            if len(ids) < len(need):
+                self._prefix.release(ids)
                 self._park_slot(sid)
                 if self._mark_replay(handle):
                     self.scheduler.requeue_front([handle])
                 continue
-            self._tables[sid, blk] = ids[0]
-            self._private[sid].append(ids[0])
+            for blk, bid in zip(need, ids):
+                self._tables[sid, blk] = bid
+                self._private[sid].append(bid)
 
     def _preempt_for_interactive(self) -> List[int]:
         """Every slot is busy and ``interactive`` work is queued: park
@@ -2223,6 +2678,14 @@ class ServeEngine:
                     "chunk_prefill", self._chunk_p, self._params,
                     self._cache, chunk_toks, np.int32(w), np.int32(off),
                     sl["table"][None], *extra)
+                if self._draft_on:
+                    # The draft tree advances in lockstep with the
+                    # slices (same chunk, same blocks), so fairness and
+                    # the budget charge stay one number per slice.
+                    self._dcache = self._device_call(
+                        "draft_prefill", self._dchunk_p, self._dparams,
+                        self._dcache, chunk_toks, np.int32(w),
+                        np.int32(off), sl["table"][None])
             else:
                 self._row, sl["logits"] = self._device_call(
                     "chunk_prefill", self._chunk_p, self._params,
@@ -2359,6 +2822,17 @@ class ServeEngine:
         self._temps[sid] = t
         self._top_ks[sid] = k
         self._top_ps[sid] = p
+        if self._spec_on:
+            # The drafter's token history: prompt + every emitted token
+            # (one for a fresh admission, the full stream for a replay
+            # — whose re-feed then drafts from complete history). The
+            # row is zeroed first so a previous tenant's tail can never
+            # leak into an n-gram match.
+            self._hist[sid, :] = 0
+            self._hist[sid, :plen] = np.asarray(req.prompt, np.int32)
+            n = min(len(handle.tokens), self.model.max_len - plen)
+            if n > 0:
+                self._hist[sid, plen:plen + n] = handle.tokens[:n]
         if self._tenant_on:
             # The slot now owns the adapter pin (released at park) and
             # the grammar state/mask row the coming ticks read.
@@ -2415,12 +2889,218 @@ class ServeEngine:
         elif req.max_new_tokens == 1:
             self._evict(sid, RequestState.FINISHED, FinishReason.LENGTH)
 
+    # ------------------------------------------------- speculative tick
+    def _dispatch_draft(self, forced_tok, forced_n):
+        """Run the draft program; returns host ``[S, spec_k]`` drafts.
+
+        A draft failure is NEVER fatal to the streams: when the retry
+        budget runs out without a consumed buffer (injected faults, or
+        the weightless n-gram program, which donates nothing), the tick
+        falls back to repeat-last-token drafts — the n-gram drafter's
+        own no-match fallback — and pays acceptance, not correctness
+        (verification is the oracle either way). Only a REAL error that
+        may have consumed the donated draft tree escalates, and then it
+        recovers exactly like a consumed pool: full live-slot replay."""
+        try:
+            if self._draft_on:
+                self._dcache, drafts = self._device_call(
+                    "draft", self._draft_model_p, self._dparams,
+                    self._dcache, self._positions, self._tables,
+                    self._tokens, forced_tok, forced_n)
+            else:
+                drafts = self._device_call(
+                    "draft", self._draft_p, self._hist, self._positions)
+            return np.asarray(drafts)
+        except _SlotStateLost as lost:
+            if lost.consumed is not None:
+                raise
+            return np.repeat(self._tokens[:, None], self._spec_k, axis=1)
+
+    def _grammar_draft_walk(self, sid: int, fsm_entry, drafts_row):
+        """Walk one constrained slot's FSM along its draft path: stamp
+        the per-position allow masks the verify program samples under,
+        and return the accept cap — the longest draft prefix that is a
+        legal continuation (an allowed eos draft is itself acceptable
+        and ends the walk; everything past an illegal draft is
+        discarded by the cap, so its masks stay pass-through). The
+        slot's LIVE FSM state is untouched here: it advances by the
+        ACCEPTED length only, token by token, in the window loop."""
+        fsm, state = fsm_entry
+        mw = self._masks_w
+        mw[sid, 0] = self._masks[sid]
+        cap = 0
+        for j in range(1, self._spec_k + 1):
+            d = int(drafts_row[j - 1])
+            if not mw[sid, j - 1][d]:
+                mw[sid, j:, :] = True
+                break
+            if self.eos_token is not None and d == self.eos_token:
+                cap = j  # an accepted eos finishes the stream in-window
+                mw[sid, j:, :] = True
+                break
+            state = fsm.advance(state, d)
+            mw[sid, j] = fsm.allow_row(state, self.eos_token)
+            cap = j
+        self._masks_w_dirty = True
+        return cap
+
+    def _spec_tick(self, cur: int, live) -> int:
+        """One speculative fused step: draft → ONE batched verify over
+        the ``[S, spec_k+1]`` window → host-side accept/evict. Returns
+        tokens emitted (replay re-feeds emit nothing but advance up to
+        ``spec_k+1`` known tokens per window). Raises
+        :class:`_SlotStateLost` to the caller exactly like the plain
+        tick — the caller's recovery is identical."""
+        s, k = self.max_slots, self._spec_k
+        w_width = k + 1
+        forced_tok = np.zeros((s, k), np.int32)
+        forced_n = np.full(s, -1, np.int32)
+        for sid in live:
+            pend = self._slots[sid].replay_pending
+            if pend:
+                # Re-feed known tokens through the window, leaving the
+                # LAST one as next tick's cur (the non-spec invariant:
+                # the live edge's K/V is written by the tick that
+                # samples past it).
+                j = min(k, len(pend) - 1)
+                if j > 0:
+                    forced_tok[sid, :j] = pend[:j]
+                forced_n[sid] = j
+        drafts = self._dispatch_draft(forced_tok, forced_n)
+        block = np.zeros((s, w_width), np.int32)
+        block[:, 0] = self._tokens
+        block[:, 1:] = drafts
+        caps = np.zeros(s, np.int32)
+        drafted_tick = 0
+        for sid in live:
+            if forced_n[sid] >= 0:
+                block[sid, 1:] = 0
+                if forced_n[sid] > 0:
+                    block[sid, 1:1 + int(forced_n[sid])] = \
+                        forced_tok[sid, :int(forced_n[sid])]
+                continue
+            if self._temps[sid] > 0:
+                # Sampled streams tick one exact token (cap 0): the
+                # rejection-sampling verifier stays on the one-shot
+                # path; serving exactness comes first. A CONSTRAINED
+                # sampled row still draws that token under its FSM mask
+                # — the plain tick's pre-masking, lifted to position 0
+                # of the window (an unmasked draw could emit an illegal
+                # token and crash the host FSM advance for everyone).
+                if self._tenant_on and self._fsms[sid] is not None:
+                    self._masks_w[sid, 0] = self._masks[sid]
+                    self._masks_w[sid, 1:, :] = True
+                    self._masks_w_dirty = True
+                continue
+            fsm_entry = self._fsms[sid] if self._tenant_on else None
+            if fsm_entry is None:
+                caps[sid] = k
+            else:
+                caps[sid] = self._grammar_draft_walk(sid, fsm_entry,
+                                                     block[sid, 1:])
+            drafted_tick += int(caps[sid])
+        if self._paged:
+            self._cache, win, acc, self._rng = self._device_call(
+                "verify", self._verify_p, self._params, self._cache,
+                self._positions, self._tables, block, self._temps,
+                self._top_ks, self._top_ps, *self._verify_extra(),
+                caps, forced_n, self._rng)
+        else:
+            self._cache, win, acc, self._rng = self._device_call(
+                "verify", self._verify_p, self._params, self._cache,
+                self._positions, block, self._temps, self._top_ks,
+                self._top_ps, *self._verify_extra(), caps, forced_n,
+                self._rng)
+        win = np.asarray(win)  # per-tick host sync (streaming)
+        acc = np.asarray(acc)
+        new_tokens = 0
+        accepted_tick = 0
+        for sid in live:
+            handle = self._slots[sid]
+            if forced_n[sid] >= 0:
+                j = int(forced_n[sid])
+                del handle.replay_pending[:j]
+                self._positions[sid] += j + 1
+                self._tokens[sid] = handle.replay_pending.pop(0)
+                continue
+            n_emit = int(acc[sid]) + 1
+            if caps[sid] > 0:
+                accepted_tick += int(acc[sid])
+                handle.spec_drafted += int(caps[sid])
+                handle.spec_accepted += int(acc[sid])
+            pos0 = int(self._positions[sid])
+            # Write the WHOLE window into the drafter's history — the
+            # rejected tail beyond the accepted length included, exactly
+            # like the one-shot loop's token buffer. The tail is the
+            # model's own next-token predictions: an n-gram continuation
+            # that crosses the live edge then reads informed guesses
+            # instead of zeros (zeros collapsed acceptance on looping
+            # streams, found here), and the next window's write covers
+            # the whole stale extent before the edge can reach it —
+            # junk beyond the edge stays junk-safe, verification is
+            # still the only oracle.
+            end = min(pos0 + 1 + w_width, self._hist.shape[1])
+            if end > pos0 + 1:
+                self._hist[sid, pos0 + 1:end] = win[sid, :end - pos0 - 1]
+            evicted = False
+            for j in range(n_emit):
+                tok = int(win[sid, j])
+                handle.tokens.append(tok)
+                new_tokens += 1
+                self._tracer.on_token(handle, cur)
+                fsm_entry = (self._fsms[sid] if self._tenant_on
+                             else None)
+                if self.eos_token is not None and tok == self.eos_token:
+                    # Tokens past an in-window eos were never emitted —
+                    # the loop stops here, exactly where the
+                    # non-speculative stream would have stopped.
+                    self._evict(sid, RequestState.FINISHED,
+                                FinishReason.EOS)
+                    evicted = True
+                    break
+                if fsm_entry is not None:
+                    fsm, state = fsm_entry
+                    state = fsm.advance(state, tok)
+                    if state < 0:  # masked sample: impossible
+                        raise RuntimeError(
+                            "constrained token escaped its state mask "
+                            "(engine bug)")
+                    self._fsms[sid] = (fsm, state)
+                    if fsm.is_dead_end(state, self.eos_token):
+                        self._evict(sid, RequestState.FINISHED,
+                                    FinishReason.GRAMMAR)
+                        evicted = True
+                        break
+                    if len(handle.tokens) >= \
+                            handle.request.max_new_tokens:
+                        self._evict(sid, RequestState.FINISHED,
+                                    FinishReason.LENGTH)
+                        evicted = True
+                        break
+                    self._masks[sid] = fsm.allow_row(state,
+                                                     self.eos_token)
+                    self._masks_dirty = True
+                elif len(handle.tokens) >= \
+                        handle.request.max_new_tokens:
+                    self._evict(sid, RequestState.FINISHED,
+                                FinishReason.LENGTH)
+                    evicted = True
+                    break
+            if not evicted:
+                self._positions[sid] += n_emit
+                self._tokens[sid] = int(win[sid, n_emit - 1])
+        self.metrics.record_spec_tick(drafted_tick, accepted_tick)
+        return new_tokens
+
     def step(self) -> int:
         """One engine tick: (drain check) → reap → admit → one fused
         decode tick for all live slots → evict finished. Returns tokens
         emitted this step (admission first-tokens included; replay
         re-feeds emit nothing — those tokens were already streamed).
-        After a drain this is a no-op returning 0."""
+        With ``spec_k > 0`` the decode tick is the speculative
+        draft/verify window (:meth:`_spec_tick`) and may emit up to
+        ``spec_k + 1`` tokens per slot. After a drain this is a no-op
+        returning 0."""
         if not self._warm:
             self.warmup()
         if self._drain_flag and not self._drained:
@@ -2451,7 +3131,15 @@ class ServeEngine:
             self._paged_append_blocks()
         live = [i for i, s in enumerate(self._slots) if s is not None]
         new_tokens = 0
-        if live:
+        if live and self._spec_on:
+            try:
+                new_tokens = self._spec_tick(cur, live)
+            except _SlotStateLost:
+                # The verify window donates the resident tree exactly
+                # like the tick did (and a consumed draft tree shares
+                # the paged pool's fate): every live slot replays.
+                self._lose_live_slots()
+        elif live:
             try:
                 if self._paged:
                     self._cache, nxt, self._rng = self._device_call(
@@ -2609,6 +3297,11 @@ class ServeEngine:
             "version": drain_io.SNAPSHOT_VERSION,
             "drained_unix_s": time.time(),
             "paged": self._paged,
+            # v5: the drafting config the streams ran under — postmortem
+            # context (restore replays token-exactly into ANY engine,
+            # speculative or not; KV and FSM state are pure functions of
+            # the tokens, and so is every drafter).
+            "spec_k": self._spec_k,
             "requests": [drain_io.encode_handle(h, now,
                                                 block_table=tables.get(id(h)))
                          for h in handles],

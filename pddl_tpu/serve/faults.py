@@ -40,7 +40,17 @@ from pddl_tpu.utils.faults import FaultPlan as _BaseFaultPlan
 
 class FaultPlan(_BaseFaultPlan):
     """Seeded fault schedule over the engine's device-call sites
-    (== ``ServeEngine.compile_counts()`` keys)."""
+    (== ``ServeEngine.compile_counts()`` keys).
+
+    The speculative sites (ISSUE 12): ``draft`` (the n-gram or
+    draft-model proposal program — a lost draft call degrades to
+    fallback drafts, never to a KV rebuild, unless a real error
+    consumed the donated draft tree), ``verify`` (the wide-window
+    program that replaces ``tick`` on a ``spec_k > 0`` engine — same
+    donated-tree recovery: full live-slot replay), and
+    ``draft_prefill`` (the draft model's admission chunk, paged
+    engines only)."""
 
     SITES = ("prefill", "gather", "chunk_prefill", "chunk_prefill_wide",
-             "donate", "insert", "tick", "sample_first", "adapter_load")
+             "donate", "insert", "tick", "sample_first", "adapter_load",
+             "draft", "verify", "draft_prefill")

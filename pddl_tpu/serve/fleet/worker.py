@@ -116,6 +116,13 @@ def build_engine(config: Dict[str, object]):
         # engine so existing bench configs stay comparable.
         paged=bool(config.get("paged", False)),
         tenant=tenant,
+        # Speculative serving (ISSUE 12, mirroring the paged/tenant
+        # passthroughs): every replica drafts with the same k/ngram, so
+        # migrated speculative streams land on an engine that re-feeds
+        # them through the identical verify machinery. Absent keeps the
+        # classic tick so existing fleet configs stay comparable.
+        spec_k=int(config.get("spec_k", 0)),
+        spec_ngram=int(config.get("spec_ngram", 3)),
         rng=jax.random.key(int(config.get("engine_seed", 0))))
 
 

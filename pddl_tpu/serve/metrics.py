@@ -154,6 +154,16 @@ class ServeMetrics:
         self.requests_by_adapter: Dict[str, int] = {}
         self.constrained_requests = 0    # submissions carrying a spec
         self.requests_grammar_complete = 0  # FinishReason.GRAMMAR settles
+        # Speculative-serving telemetry (engine ``spec_k > 0``; all
+        # zero on a classic engine): verify windows dispatched, draft
+        # tokens offered for acceptance (per-slot caps summed — sampled
+        # rows and replay re-feeds offer none), and draft tokens the
+        # verifier accepted. The acceptance RATE (accepted/drafted) is
+        # the runbook's k-tuning signal: it falls as k grows past the
+        # workload's self-similarity, and the throughput win follows it.
+        self.spec_ticks = 0
+        self.spec_drafted_tokens = 0
+        self.spec_accepted_tokens = 0
         # Resilience telemetry (`serve/faults.py`, engine retry/replay/
         # degraded paths): all zero on a fault-free engine.
         self.retries = 0             # failed device calls retried
@@ -334,6 +344,15 @@ class ServeMetrics:
         """One submission carried a grammar/schema constraint."""
         self.constrained_requests += 1
 
+    # ------------------------------------------------------ speculation
+    def record_spec_tick(self, drafted: int, accepted: int) -> None:
+        """One speculative verify window: ``drafted`` tokens offered
+        for acceptance across the batch (sampled rows and forced replay
+        re-feeds offer none), ``accepted`` of them taken."""
+        self.spec_ticks += 1
+        self.spec_drafted_tokens += int(drafted)
+        self.spec_accepted_tokens += int(accepted)
+
     # ------------------------------------------------------ reporting
     def snapshot(self) -> Dict[str, object]:
         """The dashboard dict: counters plus latency percentiles (None
@@ -377,6 +396,12 @@ class ServeMetrics:
             "adapter_pool_resident": self.adapter_pool_resident,
             "constrained_requests": self.constrained_requests,
             "requests_grammar_complete": self.requests_grammar_complete,
+            "spec_ticks": self.spec_ticks,
+            "spec_drafted_tokens": self.spec_drafted_tokens,
+            "spec_accepted_tokens": self.spec_accepted_tokens,
+            "spec_acceptance_rate": (
+                self.spec_accepted_tokens / self.spec_drafted_tokens
+                if self.spec_drafted_tokens else None),
             # Labeled series: one sample per adapter NAME seen (unlike
             # the priority splits the label set is open — a tenant
             # appears on first admission and never vanishes).

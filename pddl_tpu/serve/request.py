@@ -217,6 +217,13 @@ class RequestHandle:
         self.replays = 0
         self.replay_pending: List[int] = []
         self.preemptions = 0
+        # Speculative-serving telemetry (engine ``spec_k > 0``): how
+        # many draft tokens this stream was offered and how many the
+        # verifier accepted — carried through drain/migration (snapshot
+        # v5) so a stream's lifetime acceptance accounting survives a
+        # replica move. Zero on non-speculative engines.
+        self.spec_drafted = 0
+        self.spec_accepted = 0
         self._cancel = False
 
     def cancel(self) -> None:

@@ -189,6 +189,15 @@ class SLOScheduler:
         cost_fn: a host→device factor transfer is admission-path work
         exactly like an uncached suffix, and a resident adapter — like
         a cached prefix — charges nothing.
+        The SPECULATIVE engine's contract (ISSUE 12): token-budget
+        accounting charges ACCEPTED, never DRAFTED, tokens. A replayed
+        stream's catch-up re-feed is charged at its emitted token
+        count (the tokens that really re-enter the cache), not the
+        ``(spec_k+1)``-wide verify compute spent reaching them; fresh
+        admissions charge exactly what a non-speculative engine
+        charges — drafting must never inflate an admission's price or
+        shrink the batch the budget admits (pinned by
+        ``tests/test_serve_spec.py``).
         The charge is a pop-time ESTIMATE: same-tick donations usually
         shrink the real work below it, but under pool pressure an
         earlier admission's eviction pass can reclaim a later request's

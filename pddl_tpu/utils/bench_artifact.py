@@ -130,7 +130,12 @@ _HIGHER_BETTER = ("tokens_per_s", "tokens_per_sec", "speedup", "retained",
                   # *_zero_lost counts requests live-migrated with
                   # nothing lost — fewer proven-safe migrations is a
                   # coverage regression.
-                  "scale_events", "zero_lost")
+                  "scale_events", "zero_lost",
+                  # Speculative-serving headlines (r17): acceptance_rate
+                  # is the draft-quality series behind the throughput
+                  # win (spec_tok_s rides "tok_s", spec_speedup_x rides
+                  # "speedup", tokens_per_tick rides "_per_tick").
+                  "acceptance_rate")
 _LOWER_BETTER = ("ttft", "latency", "_ms", "_wall_s", "overhead",
                  "_seconds", "tick_s", "step_s", "copy_us",
                  # Time the brownout ladder spent engaged (r16): a

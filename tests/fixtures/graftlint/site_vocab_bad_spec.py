@@ -1,0 +1,36 @@
+"""Seeded-bad fixture for the ``site-vocab`` rule, SPECULATIVE sites
+(ISSUE 12): a ``spec_k > 0`` engine grows ``draft``/``verify``/
+``draft_prefill`` device-call boundaries — exactly the gap class this
+rule exists for. Here the faults vocabulary predates the speculative
+programs: ``verify``/``draft_prefill`` are counted-and-dispatched but
+absent from SITES (no chaos profile could ever target the verify
+window or the draft model's admission chunk), and the retired
+``tick`` lingers as a stale entry naming no program."""
+
+
+class FaultPlan:
+    # BUG: "verify" and "draft_prefill" (counted below) are missing —
+    # the speculative recovery paths are untargetable by chaos — and
+    # "tick" is stale (the spec engine replaced it with "verify").
+    SITES = ("prefill", "draft", "tick")
+
+
+class Engine:
+    def compile_counts(self):
+        return {
+            "prefill": self._prefill_p._cache_size(),
+            "draft": self._draft_p._cache_size(),
+            "verify": self._verify_p._cache_size(),
+            "draft_prefill": self._dchunk_p._cache_size(),
+        }
+
+    def step(self):
+        drafts = self._device_call("draft", self._draft_p, self._hist)
+        out = self._device_call("verify", self._verify_p, self._cache,
+                                drafts)
+        return out
+
+    def admit(self):
+        self._dcache = self._device_call("draft_prefill", self._dchunk_p,
+                                         self._dcache)
+        return self._device_call("prefill", self._prefill_p, self._row)

@@ -1,0 +1,29 @@
+"""Good twin for the speculative ``site-vocab`` fixture: the
+draft/verify/draft_prefill program names appear in compile_counts(),
+FaultPlan.SITES, and the ``_device_call`` literals in lockstep. Must
+lint clean."""
+
+
+class FaultPlan:
+    SITES = ("prefill", "draft", "verify", "draft_prefill")
+
+
+class Engine:
+    def compile_counts(self):
+        return {
+            "prefill": self._prefill_p._cache_size(),
+            "draft": self._draft_p._cache_size(),
+            "verify": self._verify_p._cache_size(),
+            "draft_prefill": self._dchunk_p._cache_size(),
+        }
+
+    def step(self):
+        drafts = self._device_call("draft", self._draft_p, self._hist)
+        out = self._device_call("verify", self._verify_p, self._cache,
+                                drafts)
+        return out
+
+    def admit(self):
+        self._dcache = self._device_call("draft_prefill", self._dchunk_p,
+                                         self._dcache)
+        return self._device_call("prefill", self._prefill_p, self._row)

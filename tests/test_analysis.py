@@ -54,13 +54,17 @@ BAD_FIXTURES = [
     ("donation", "donation_bad.py", 2),
     ("recompile-hazard", "recompile_bad.py", 1),
     ("site-vocab", "site_vocab_bad.py", 3),
+    # The speculative-site twin (ISSUE 12): verify/draft_prefill
+    # counted-but-unlisted (2 findings) + the stale retired "tick" (1).
+    ("site-vocab", "site_vocab_bad_spec.py", 3),
     ("exposition-parity", "exposition_bad.py", 2),
     ("snapshot-hygiene", "snapshot_bad.py", 1),
 ]
 
 GOOD_FIXTURES = [
     "pin_release_good.py", "donation_good.py", "recompile_good.py",
-    "site_vocab_good.py", "exposition_good.py", "snapshot_good.py",
+    "site_vocab_good.py", "site_vocab_good_spec.py",
+    "exposition_good.py", "snapshot_good.py",
 ]
 
 

@@ -73,6 +73,15 @@ def test_metric_direction_vocabulary():
     assert metric_direction("scale_events") == 1
     assert metric_direction("migrated_zero_lost") == 1
     assert metric_direction("brownout_rung_time_autoscaled_s") == -1
+    # The r17 speculative-serving headlines (ISSUE 12): the spec
+    # engine's absolute rate and its paired speedup up are better, and
+    # the acceptance rate (draft quality behind the throughput win) up
+    # is better; tokens-per-tick rides the "_per_tick" rule.
+    assert metric_direction("spec_tok_s") == 1
+    assert metric_direction("spec_speedup_x") == 1
+    assert metric_direction("acceptance_rate") == 1
+    assert metric_direction("spec_acceptance_rate") == 1
+    assert metric_direction("tokens_per_tick") == 1
     # Raw byte tallies are scale context, not headlines.
     assert metric_direction("kv_bytes_used_row") == 0
     # Noise keys are never compared.
@@ -197,6 +206,57 @@ def test_r16_autoscale_artifact_is_gated():
             # the committed value itself.
             assert ("results.autoscale.brownout_rung_time_autoscaled_s"
                     in paths)
+
+
+def test_r17_spec_artifact_is_gated():
+    """The speculative-serving artifact participates in the series: it
+    loads, keys into a (metric, config) group, its committed headlines
+    clear the ISSUE 12 bounds (median speedup >= 1.3x at the default
+    k, EVERY pair >= 1.2x, the acceptance curve recorded, the chaos
+    leg token-exact with zero divergence), they are DIRECTIONAL — and
+    a same-config r-record that regresses them fails `check_series`
+    LOUDLY."""
+    path = os.path.join(_BENCH_DIR, "r17_serve_spec.json")
+    records = [r for r in load_artifact(path)
+               if artifact_key(r) is not None]
+    assert records, "r17_serve_spec.json has no keyed record"
+    spec = records[0]["results"]["spec"]
+    # ISSUE 12 acceptance bounds on the committed medians.
+    assert spec["spec_speedup_x"] >= 1.3
+    assert all(r >= 1.2 for r in spec["spec_speedup_per_pair"])
+    assert spec["all_streams_token_exact"] is True
+    curve = spec["acceptance_curve"]
+    assert len(curve) >= 3 and all("acceptance_rate" in c for c in curve)
+    # Draft quality falls as k outruns the workload's self-similarity
+    # (the runbook's k-tuning story, pinned on the committed curve).
+    ks = [c["k"] for c in curve]
+    assert ks == sorted(ks)
+    assert curve[0]["acceptance_rate"] > curve[-1]["acceptance_rate"]
+    chaos = spec["chaos"]
+    assert chaos["requests_token_exact"] >= 12
+    assert chaos["requests_migrated"] >= 1
+    for key in ("spec_tok_s", "spec_speedup_x", "acceptance_rate",
+                "tokens_per_tick"):
+        assert metric_direction(key) != 0, key
+    # A hypothetical r18 record at the SAME config whose speculative
+    # headlines regressed must fail the series gate loudly.
+    worse = copy.deepcopy(records[0])
+    worse["results"]["spec"]["spec_speedup_x"] *= 0.7
+    worse["results"]["spec"]["acceptance_rate"] *= 0.5
+    import json as _json
+    import tempfile
+    with tempfile.TemporaryDirectory() as d:
+        old_p = os.path.join(d, "r17_s.json")
+        new_p = os.path.join(d, "r18_s.json")
+        with open(old_p, "w") as f:
+            _json.dump(records[0], f)
+        with open(new_p, "w") as f:
+            _json.dump(worse, f)
+        pairs, failures = check_series([old_p, new_p])
+        assert pairs == 1 and len(failures) == 1
+        paths = {r["path"] for r in failures[0]["regressions"]}
+        assert "results.spec.spec_speedup_x" in paths
+        assert "results.spec.acceptance_rate" in paths
 
 
 def test_compare_flags_directional_regressions_only():

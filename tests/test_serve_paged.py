@@ -425,7 +425,7 @@ def test_paged_drain_restore_round_trip(gpt_setup):
     for _ in range(3):
         eng1.step()
     snap = eng1.drain()
-    assert snap["version"] == 4  # tenant fields ride v4; tables still here
+    assert snap["version"] == 5  # spec accounting rides v5; tables still here
     assert snap["paged"] is True
     running = [e for e in snap["requests"] if e.get("tokens")]
     assert running and all("block_table" in e for e in running)

@@ -258,7 +258,7 @@ def test_drain_snapshot_roundtrips_priority_and_deadline(gpt_setup):
     eng_a.step()
     clock_a.now = 4.0
     snapshot = eng_a.drain()
-    assert snapshot["version"] == drain_io.SNAPSHOT_VERSION == 4
+    assert snapshot["version"] == drain_io.SNAPSHOT_VERSION == 5
     by_len = {len(e["prompt"]): e for e in snapshot["requests"]}
     assert by_len[6]["priority"] == "batch"
     assert by_len[6]["deadline_s"] == 30.0

@@ -530,7 +530,7 @@ def test_drain_restore_v4_round_trip(gpt_setup, paged):
     for _ in range(3):
         eng1.step()
     snap = eng1.drain()
-    assert snap["version"] == 4
+    assert snap["version"] == 5
     entries = {len(e["prompt"]): e for e in snap["requests"]}
     assert entries[11]["adapter"] == "acme"
     assert entries[9]["constraint"] == spec
