@@ -54,8 +54,13 @@ from pddl_tpu.analysis.core import (
 
 # Verbs that create an obligation. "Value" acquires return the
 # resource (``ids = pool.allocate(n)``); "arg" acquires take it as the
-# first argument (``prefix.pin(node)``).
-ACQUIRE_VALUE = frozenset({"allocate", "assign", "acquire"})
+# first argument (``prefix.pin(node)``). ``pin_chain`` is the host
+# tier's match-and-pin (ISSUE 13, `serve/kvcache/hosttier.py`): a
+# promotion acquires the host chain through it and must ``unpin`` the
+# returned tip on every exit — the demote/promote pin pair this
+# vocabulary grew to cover (fixture: a promotion path that leaks the
+# host pin on fault-unwind, `pin_release_bad_hosttier.py`).
+ACQUIRE_VALUE = frozenset({"allocate", "assign", "acquire", "pin_chain"})
 ACQUIRE_ARG = frozenset({"pin"})
 RELEASE = frozenset({"release", "unpin", "unassign", "free"})
 # Hand-off to longer-lived structure needs no verb list: passing a

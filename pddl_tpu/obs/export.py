@@ -121,6 +121,11 @@ SERVE_COUNTER_KEYS = frozenset({
     # the drafted/accepted token volume behind the acceptance-rate
     # gauge (the rate itself stays a gauge).
     "spec_ticks", "spec_drafted_tokens", "spec_accepted_tokens",
+    # Tiered KV cache (`serve/kvcache/hosttier.py`): demotion/promotion
+    # traffic and the promotion budget charge (the residency gauge
+    # host_tier_bytes_resident stays a gauge).
+    "host_tier_spills", "host_tier_hits", "host_tier_promotions",
+    "host_tier_promote_tokens_charged",
 })
 
 _NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
@@ -281,6 +286,14 @@ def engine_gauges(engine) -> Dict[str, object]:
         "spec_k": getattr(engine, "spec_k", 0),
         "spec_draft_model": getattr(engine, "spec_draft_model_enabled",
                                     False),
+        # Tiered-KV-cache gauges (False/0 without a host tier): whether
+        # the spill tier is armed and its live host-side residency —
+        # the "Host tier sizing" runbook's watchlist lines.
+        "host_tier": getattr(engine, "host_tier_enabled", False),
+        "host_tier_bytes_resident": getattr(
+            engine, "host_tier_bytes_resident", 0),
+        "host_tier_blocks_resident": getattr(
+            engine, "host_tier_blocks_resident", 0),
         # Multi-tenant gauges (False/0 on a plain engine): whether the
         # tenant path is compiled in, and how many adapters are
         # device-resident right now (`serve/tenant/`).
@@ -361,6 +374,11 @@ FLEET_COUNTER_KEYS = frozenset({
     # delivery splits flatten to tokens_streamed_<class>, typed
     # counters below like the circuit_* transitions.
     "scale_up_events", "scale_down_events", "scale_down_migrated",
+    # Tiered KV cache at fleet level (ISSUE 13): prefix-affinity routes
+    # taken because a replica held the chain in HOST RAM (no replica
+    # had it in HBM), and replica-to-replica chain pulls — the
+    # duplicate-prefill eliminator — with the tokens they moved.
+    "routed_host_tier", "chain_pulls", "chain_pull_tokens",
 })
 
 

@@ -191,6 +191,51 @@ def decode_handle(entry: Dict[str, object], now_s: float) -> RequestHandle:
     return handle
 
 
+def kv_chain_to_wire(tokens: List[int], blocks) -> Dict[str, object]:
+    """The replica-to-replica prefix-transfer wire entry (ISSUE 13):
+    a cached chain's token ids plus each block's per-leaf K/V payload,
+    JSON-safe (raw bytes base64'd with shape/dtype), riding the same
+    JSON-line transports the drain snapshot rides. NOT a snapshot
+    entry — chains are cache contents, not requests — so it shares the
+    snapshot's encoding discipline (one encode/decode pair, here)
+    without touching the versioned entry manifest."""
+    import base64
+
+    import numpy as np
+
+    return {
+        "tokens": [int(t) for t in tokens],
+        "blocks": [
+            {key: {"shape": list(arr.shape), "dtype": str(arr.dtype),
+                   "b64": base64.b64encode(
+                       np.ascontiguousarray(arr).tobytes()).decode()}
+             for key, arr in block.items()}
+            for block in blocks],
+    }
+
+
+def kv_chain_from_wire(entry: Dict[str, object]):
+    """Decode :func:`kv_chain_to_wire`: ``(tokens, blocks)`` with each
+    block a ``{leaf_key: np.ndarray}`` dict. Shape/dtype are restored
+    verbatim; VALIDATION is the importer's job (the engine's host tier
+    checks every payload against its own leaf spec and refuses
+    mismatches, so a foreign-config chain degrades to a no-op)."""
+    import base64
+
+    import numpy as np
+
+    tokens = [int(t) for t in entry.get("tokens", [])]
+    blocks = []
+    for block in entry.get("blocks", []):
+        decoded = {}
+        for key, leaf in block.items():
+            arr = np.frombuffer(base64.b64decode(leaf["b64"]),
+                                dtype=np.dtype(leaf["dtype"]))
+            decoded[key] = arr.reshape([int(s) for s in leaf["shape"]])
+        blocks.append(decoded)
+    return tokens, blocks
+
+
 def save_snapshot(snapshot: Dict[str, object], path: str) -> None:
     """Atomic write (tmp + rename): a kill mid-drain must leave either
     the previous snapshot or this one, never a torn file."""

@@ -112,6 +112,27 @@ advances by the ACCEPTED length only), and replaying slots re-feed
 known tokens ``spec_k+1`` per window, so fault recovery and
 drain/restore/migration of speculative streams stay token-exact AND
 speed up by the same factor.
+
+Tiered KV cache (``host_tier=...``; ISSUE 13 / ROADMAP item 4,
+`serve/kvcache/hosttier.py`): millions of users means the warm prefix
+working set exceeds HBM by orders of magnitude, and the radix index's
+LRU reclaim used to answer that by freeing — the fleet re-prefilled
+any prefix that fell out of the pool. With a host tier armed, eviction
+becomes a POLICY DECISION: reuse-worthy victims (scored by chain
+length; recency rides the LRU order itself) spill their K/V D2H into a
+byte-budgeted pinned-host pool under a second token-keyed index, and
+an admission that misses HBM but hits the host tier PROMOTES the chain
+back — one ``host_promote`` H2D scatter (riding
+``ops.attention.cache_blocks_scatter`` over the donated pool, fixed
+padded shapes, zero recompiles) charged against the prefill-token
+budget through the scheduler's tenancy-aware ``cost_fn`` exactly like
+a cold adapter load, with fault/cancel/preempt unwind releasing the
+host-tier pins through the same discipline device chains use. The
+demotion D2H rides an eager ``cache_blocks_gather`` of the one dying
+block; degraded (post-OOM) mode bypasses the tier in BOTH directions
+(spilling during an OOM response would defeat the shedding). A
+disabled tier (``host_tier=None`` or byte budget 0) leaves the engine
+bit-identical to the untiered one — same programs, same tokens.
 """
 
 from __future__ import annotations
@@ -139,6 +160,7 @@ from pddl_tpu.models.gpt import (
 )
 from pddl_tpu.models.speculative import ngram_drafts
 from pddl_tpu.obs.ring import TelemetryRing
+from pddl_tpu.ops.attention import cache_blocks_gather, cache_blocks_scatter
 from pddl_tpu.ops.lora import adapter_pool_load, batched_lora_delta
 from pddl_tpu.obs.trace import NULL_TRACER
 from pddl_tpu.serve import drain as drain_io
@@ -148,6 +170,8 @@ from pddl_tpu.serve.faults import (
     classify,
 )
 from pddl_tpu.serve.kvcache import (
+    HostTierCache,
+    HostTierConfig,
     RadixPrefixCache,
     donate_prefix_blocks,
     gather_prefix_into_row,
@@ -297,6 +321,19 @@ class ServeEngine:
         can never starve for a writable block. Token-exact against the
         resident-row engine (the oracle) for every family/quant
         config; same drain/replay/chaos contracts.
+      host_tier: TIERED KV CACHE (module docstring, ISSUE 13): a
+        :class:`~pddl_tpu.serve.kvcache.HostTierConfig` (or a plain
+        int byte budget) arming the host-RAM spill tier under the
+        radix index — LRU eviction demotes reuse-worthy chains D2H
+        instead of freeing them, and admission promotes host-tier hits
+        back through the ``host_promote`` program, charged against the
+        prefill budget at ``promote_tokens_per_block`` per block.
+        Requires the prefix machinery; refused (for now) alongside
+        ``spec_draft_model`` — a promoted block carries target K/V
+        only, and the draft tree's twin block would be junk. ``None``
+        (default) or byte budget 0 disables the tier with a
+        bit-identical engine (same compiled-program set, same tokens —
+        the cold-path contract `tests/test_kv_tier.py` pins).
       fault_plan: optional :class:`~pddl_tpu.serve.faults.FaultPlan`
         consulted before every device dispatch (chaos tests, fault
         benches). ``None`` in production — real device errors take the
@@ -381,6 +418,7 @@ class ServeEngine:
                  prefix_block_size: int = 8,
                  prefix_chunk: Optional[int] = None,
                  paged: bool = False,
+                 host_tier=None,
                  fault_plan=None, max_retries: int = 3,
                  retry_backoff_s: float = 0.02,
                  backoff_sleep=time.sleep,
@@ -1114,6 +1152,7 @@ class ServeEngine:
                                                       pool_blocks, bs)
                 else:
                     self._draft_p = jax.jit(_draft_ngram)
+            self._init_host_tier(host_tier)
             self._warm = False
             if tracer is not None:
                 self.set_tracer(tracer)
@@ -1169,9 +1208,94 @@ class ServeEngine:
                                      donate_argnums=(1,))
             self._draft_p = jax.jit(_draft_ngram)
         self._cache = slot_decode_cache(dec, self.max_slots)
+        self._init_host_tier(host_tier)
         self._warm = False
         if tracer is not None:
             self.set_tracer(tracer)
+
+    def _init_host_tier(self, host_tier) -> None:
+        """Arm the host-RAM spill tier (the ``host_tier`` arg docs):
+        build the byte-budgeted :class:`HostTierCache` with this
+        engine's per-leaf block spec, compile the ONE promotion program
+        (``host_promote`` — a :func:`cache_blocks_scatter` per KV leaf
+        over the donated pool tree, fixed padded shapes), and install
+        the demotion hook on the radix index's eviction path. A
+        ``None``/zero-budget config installs NOTHING: the engine stays
+        bit-identical to an untiered one."""
+        self._host = None
+        self._promote_p = None
+        self._demote_p = None
+        self._host_promote_tokens = 0
+        if host_tier is None:
+            return
+        cfg = (host_tier if isinstance(host_tier, HostTierConfig)
+               else HostTierConfig(byte_budget=int(host_tier)))
+        if cfg.byte_budget == 0:
+            return
+        if not self._prefix_on:
+            raise ValueError(
+                "host_tier needs the prefix-cache machinery (the radix "
+                "eviction path is what demotes); leave "
+                "prefix_cache_blocks enabled or pass host_tier=None")
+        if self._draft_on:
+            raise NotImplementedError(
+                "host_tier with spec_draft_model is not supported yet: "
+                "a promoted block carries target K/V only, and the "
+                "draft tree's twin block would be junk — mirroring the "
+                "second cache tree through the tier is follow-on work")
+        target = self._cache if self._paged else self._pool
+        spec = {}
+        for path, leaf in jax.tree_util.tree_leaves_with_path(target):
+            if leaf.ndim < 3:
+                continue
+            spec[jax.tree_util.keystr(path)] = (
+                (1,) + tuple(leaf.shape[1:-2])
+                + (self.prefix_block_size, leaf.shape[-1]),
+                np.dtype(leaf.dtype))
+        self._host = HostTierCache(
+            self.prefix_block_size, cfg.byte_budget,
+            min_chain_blocks=cfg.min_chain_blocks, leaf_spec=spec)
+        self._host_promote_tokens = int(cfg.promote_tokens_per_block)
+
+        def _host_promote(pool, rows, ids):
+            # The H2D rides the SAME primitive donation rides
+            # (`ops.attention.cache_blocks_scatter`): one scatter per
+            # KV leaf over the donated pool tree, no model compute;
+            # padded ids land their junk in the scratch sink, and
+            # non-KV leaves (counters, tables) pass through untouched
+            # so the paged tree keeps its canonical placeholders.
+            def _s(path, pool_leaf, row_leaf):
+                if pool_leaf.ndim < 3:
+                    return pool_leaf
+                return cache_blocks_scatter(pool_leaf, row_leaf, ids, 0)
+            return jax.tree_util.tree_map_with_path(_s, pool, rows)
+
+        self._promote_p = jax.jit(_host_promote, donate_argnums=(0,))
+        # A REAL mid-dispatch promotion error may have consumed the
+        # donated pool tree — recovery is the pool-class rebuild
+        # (paged: the full live-slot replay), like donate/chunk.
+        self._donated_by_site["host_promote"] = "pool"
+
+        def _host_demote(pool, ids):
+            # The D2H read, same primitive as the admission gather
+            # (`ops.attention.cache_blocks_gather`) but jitted over
+            # the whole tree at a FIXED scratch-padded id width: the
+            # reclaim batch becomes ONE dispatch that traces once
+            # (per-leaf eager gathers re-specialize per batch width
+            # — mid-run backend compiles — and their dispatch
+            # overhead dominated the admission path). Read-only: no
+            # donation, no fault site — a failed read degrades to
+            # the old free-and-recompute path in `_demote_blocks`.
+            out = {}
+            for path, leaf in jax.tree_util.tree_leaves_with_path(pool):
+                if leaf.ndim < 3:
+                    continue
+                out[jax.tree_util.keystr(path)] = cache_blocks_gather(
+                    leaf, ids)
+            return out
+
+        self._demote_p = jax.jit(_host_demote)
+        self._prefix.on_evict = self._demote_blocks
 
     # ----------------------------------------------------- observability
     @property
@@ -1316,6 +1440,15 @@ class ServeEngine:
             tok, self._rng = self._sample_first_p(
                 logits, *first_mask, np.float32(0.0), np.int32(0),
                 np.float32(2.0), self._rng)
+            if self._host is not None:
+                # All-scratch promote: junk lands in the sink, the
+                # host tier stays empty, the program traces once —
+                # and the demote gather's one program likewise.
+                self._cache = self._promote_p(
+                    self._cache, self._assemble_promote_rows([]),
+                    np.zeros(self._match_cap, np.int32))
+                self._demote_p(self._cache,
+                               np.zeros(self._match_cap, np.int32))
             if self._spec_on:
                 nxt = self._warm_spec()
             else:
@@ -1343,6 +1476,15 @@ class ServeEngine:
                 self._pool, row, np.zeros(self._donate_cap, np.int32),
                 np.int32(0))
             self._row = row
+            if self._host is not None:
+                # All-scratch promote (the paged branch's twin): the
+                # host_promote program traces once at warmup too,
+                # and the demote gather's one program likewise.
+                self._pool = self._promote_p(
+                    self._pool, self._assemble_promote_rows([]),
+                    np.zeros(self._match_cap, np.int32))
+                self._demote_p(self._pool,
+                               np.zeros(self._match_cap, np.int32))
         else:
             dummy = np.zeros((1, self.prefill_len), np.int32)
             row, logits = self._prefill_p(self._params, dummy, 1,
@@ -1428,6 +1570,8 @@ class ServeEngine:
             if self._tenant_on:
                 counts["adapter_load"] = \
                     self._adapter_load_p._cache_size()
+            if self._host is not None:
+                counts["host_promote"] = self._promote_p._cache_size()
             return counts
         counts = {
             "insert": self._insert_p._cache_size(),
@@ -1447,6 +1591,8 @@ class ServeEngine:
                 counts["chunk_prefill_wide"] = \
                     self._chunk_wide_p._cache_size()
             counts["donate"] = self._donate_p._cache_size()
+            if self._host is not None:
+                counts["host_promote"] = self._promote_p._cache_size()
         else:
             counts["prefill"] = self._prefill_p._cache_size()
         return counts
@@ -1460,6 +1606,25 @@ class ServeEngine:
         """True when decode reads K/V straight from the block pool
         through per-slot block tables (no resident slot cache)."""
         return self._paged
+
+    @property
+    def host_tier_enabled(self) -> bool:
+        """True when the host-RAM spill tier is armed (module
+        docstring; ``host_tier=`` with a nonzero byte budget)."""
+        return self._host is not None
+
+    @property
+    def host_tier_bytes_resident(self) -> int:
+        """Host bytes the spill tier currently holds (0 with the tier
+        off) — the gauge the sizing runbook watches against the byte
+        budget (docs/OPERATIONS.md § "Host tier sizing")."""
+        return self._host.bytes_resident if self._host is not None else 0
+
+    @property
+    def host_tier_blocks_resident(self) -> int:
+        """Demoted blocks currently resident in the host tier."""
+        return (self._host.blocks_resident if self._host is not None
+                else 0)
 
     @property
     def spec_enabled(self) -> bool:
@@ -1855,6 +2020,12 @@ class ServeEngine:
         self._prefix = RadixPrefixCache(self.prefix_block_size,
                                         self._prefix.num_blocks)
         self._slot_nodes = [None] * self.max_slots
+        if self._host is not None:
+            # The old index died wholesale WITHOUT demotion (its
+            # storage may be consumed); the fresh one demotes again.
+            # Host-tier contents are independent host copies and
+            # survive the rebuild — still promotable.
+            self._prefix.on_evict = self._demote_blocks
 
     def _reset_paged_pool(self) -> None:
         """Rebuild the paged world after its one donated tree may have
@@ -1876,6 +2047,10 @@ class ServeEngine:
         self._tables[:] = 0
         self._private = [[] for _ in range(self.max_slots)]
         self._slot_nodes = [None] * self.max_slots
+        if self._host is not None:
+            # Same rule as the row-mode reset: the dead index demoted
+            # nothing, the fresh one does; host copies survive.
+            self._prefix.on_evict = self._demote_blocks
 
     def _recover_consumed(self, lost: _SlotStateLost) -> None:
         """Rebuild whatever resident donated tree a real mid-dispatch
@@ -2028,6 +2203,19 @@ class ServeEngine:
             match = self._prefix.match(
                 prompt, max_blocks=self._match_blocks(prompt))
             cost = len(prompt) - match.n_blocks * self.prefix_block_size
+            # Tiered KV cache (ISSUE 13): blocks the host tier will
+            # promote cost an H2D transfer, not a prefill — charge them
+            # at promote_tokens_per_block instead of block_size tokens
+            # (the adapter_load_tokens precedent: real admission-path
+            # work, priced at what it actually is). Same pop-time-
+            # estimate caveat as the prefix charge.
+            if self._host is not None:
+                h = self._host.match_depth(
+                    prompt, match.n_blocks,
+                    self._match_blocks(prompt) - match.n_blocks)
+                if h > 0:
+                    cost -= h * self.prefix_block_size
+                    cost += h * self._host_promote_tokens
         # Tenancy-aware budget (ISSUE 9): a COLD adapter load is real
         # admission-path work (a host->device factor transfer), so it
         # charges like an uncached suffix; a resident adapter — like a
@@ -2046,6 +2234,167 @@ class ServeEngine:
         if self._spec_on and handle.tokens:
             cost += len(handle.tokens)
         return cost
+
+    # ---------------------------------------------------- tiered KV cache
+    def _demote_blocks(self, victims) -> None:
+        """``radix.on_evict`` hook — eviction becomes demotion (module
+        docstring): spill the dying blocks' K/V D2H into the host tier
+        when their chains are reuse-worthy. The whole reclaim pass
+        moves through the jitted whole-tree gather (``_demote_p``,
+        one dispatch + one device sync; a read — the pool is never
+        copied, the one program traces at warmup, and demotion sits
+        on the admission path, where per-block eager dispatches
+        measured ~10x slower). Opportunistic by design: a refused or
+        failed spill
+        degrades to the old free-and-recompute path, never to an
+        error, and degraded mode spills nothing (the OOM flush
+        additionally bypasses this hook wholesale)."""
+        if self._degraded:
+            return
+        keep: List[tuple] = []
+        for node in victims:
+            if not self._host.spill_worthy(self._prefix.chain_depth(node)):
+                continue
+            tokens = self._prefix.chain_tokens(node)
+            if self._host.has_block(tokens):
+                continue  # kept across a promotion: nothing to move
+            keep.append((tokens, node.block_id))
+        if not keep:
+            return
+        try:
+            blocks = self._gather_blocks_host(
+                [bid for _, bid in keep])
+        except Exception as e:  # noqa: BLE001 - device faults only
+            if classify(e) is None:
+                raise  # not a device fault: bugs stay loud
+            return
+        for (tokens, _), data in zip(keep, blocks):
+            if self._host.store(tokens, data):
+                self.metrics.record_host_spill(self._host.bytes_resident)
+
+    def _gather_blocks_host(self, block_ids) -> List[Dict[str, np.ndarray]]:
+        """Pool blocks ``block_ids`` as per-block host payload dicts
+        keyed by leaf path — the demotion (and chain-export) D2H read:
+        the jitted whole-tree gather (``_demote_p``, fixed
+        ``match_cap`` width, scratch-padded — one dispatch per chunk,
+        traced once), one ``device_get`` for everything, then
+        host-side splits (copies, so an evicted sibling cannot pin
+        the batch buffer alive). Padded tail slices read scratch junk
+        and are simply not taken."""
+        target = self._cache if self._paged else self._pool
+        bs = self.prefix_block_size
+        w = self._match_cap
+        n = len(block_ids)
+        staged = []
+        for c in range(0, n, w):
+            ids = np.zeros(w, np.int32)
+            chunk = block_ids[c:c + w]
+            ids[:len(chunk)] = chunk
+            staged.append(self._demote_p(target, ids))
+        pulled = jax.device_get(staged)
+        out: List[Dict[str, np.ndarray]] = []
+        for c, st in zip(range(0, n, w), pulled):
+            out.extend({key: arr[..., j * bs:(j + 1) * bs, :].copy()
+                        for key, arr in st.items()}
+                       for j in range(min(w, n - c)))
+        return out
+
+    def _assemble_promote_rows(self, blocks: List[Dict[str, np.ndarray]]):
+        """The ``host_promote`` scatter's source tree: per KV leaf a
+        host row ``[1, ..., match_cap*bs, D]`` with the promoted
+        payloads at ``[0, k*bs)`` and ZEROS beyond — those positions
+        scatter into padded scratch ids, and the paged scratch block
+        must stay zero (an ``np.empty`` tail measurably corrupted
+        paged streams whose tables park on the sink). Non-KV leaves
+        are scalar placeholders. Fixed width, so the program traces
+        once."""
+        bs = self.prefix_block_size
+        target = self._cache if self._paged else self._pool
+
+        def _leaf(path, leaf):
+            if leaf.ndim < 3:
+                return np.zeros((), np.int32)
+            row = np.zeros((1,) + tuple(leaf.shape[1:-2])
+                           + (self._match_cap * bs, leaf.shape[-1]),
+                           leaf.dtype)
+            key = jax.tree_util.keystr(path)
+            for j, b in enumerate(blocks):
+                row[..., j * bs:(j + 1) * bs, :] = b[key]
+            return row
+
+        return jax.tree_util.tree_map_with_path(_leaf, target)
+
+    def _promote_host_chain(self, prompt: np.ndarray, handle=None) -> int:
+        """Promotion (module docstring): extend the device match with
+        host-tier blocks — allocate device ids under the ANCHOR's pin,
+        scatter the payloads H2D through the ``host_promote`` program,
+        and attach the ids to the radix index, so the admission that
+        follows simply matches a deeper chain. Self-unwinding: every
+        exit (allocator shortfall, injected fault, real consumed-pool
+        error) releases its ids and both pins exactly — the host-tier
+        pin through the same discipline device chains use. Returns the
+        promoted block count."""
+        if self._degraded:
+            return 0
+        cap = self._match_blocks(prompt)
+        match = self._prefix.match(prompt, max_blocks=cap)
+        if match.n_blocks >= cap:
+            return 0
+        tip = self._host.pin_chain(prompt, match.n_blocks,
+                                   cap - match.n_blocks)
+        promoted = 0
+        if tip is not None:
+            try:
+                promoted = self._promote_pinned(prompt, match, tip,
+                                                handle)
+            finally:
+                self._host.unpin(tip)
+        return promoted
+
+    def _promote_pinned(self, prompt: np.ndarray, match, tip,
+                        handle) -> int:
+        """The H2D half of a promotion, under the caller's host-tier
+        pin: allocate device ids beneath the ANCHOR's pin (eviction
+        must not steal the chain the ids extend from), dispatch the
+        scatter, attach the ids. Every failure path releases ids and
+        the anchor pin exactly."""
+        bs = self.prefix_block_size
+        m = match.n_blocks
+        anchor = match.node
+        self._prefix.pin(anchor)
+        try:
+            ids = self._prefix.allocate(tip.depth - m)
+            k = len(ids)
+            if k == 0:
+                self._prefix.release(ids)
+                return 0
+            node = tip
+            while node.depth > m + k:  # allocator came up short:
+                node = node.parent     # promote the prefix that fits
+            rows = self._assemble_promote_rows(
+                self._host.chain_data(node, k))
+            dids = np.zeros(self._match_cap, np.int32)
+            dids[:k] = ids
+            target = self._cache if self._paged else self._pool
+            try:
+                out = self._device_call("host_promote", self._promote_p,
+                                        target, rows, dids)
+            except _SlotStateLost:
+                self._prefix.release(ids)
+                raise
+            if self._paged:
+                self._cache = out
+            else:
+                self._pool = out
+            self._prefix.extend(anchor, prompt[m * bs:(m + k) * bs], ids)
+            self.metrics.record_host_promotion(
+                k, k * self._host_promote_tokens,
+                self._host.bytes_resident)
+            self._tracer.on_prefill_chunk(handle, "host_promote", m * bs,
+                                          k * bs, self._last_wall_s)
+            return k
+        finally:
+            self._prefix.unpin(anchor)
 
     def _prefill_into_row(self, prompt: np.ndarray, handle=None, aid=0):
         """Prefill one prompt into a row cache, reusing any cached
@@ -2068,6 +2417,12 @@ class ServeEngine:
             tr.on_prefill_chunk(handle, "prefill", 0, plen,
                                 self._last_wall_s)
             return row, logits, None
+        if self._host is not None:
+            # Tiered admission: promote any host-tier continuation of
+            # the device match FIRST, so the match below simply sees a
+            # deeper chain (self-unwinding; a promotion fault escalates
+            # exactly like any admission dispatch).
+            self._promote_host_chain(prompt, handle)
         # Degraded mode (post-OOM cool-down): the cache is neither
         # consulted nor grown — a pure cold chunked prefill, so serving
         # continues while the pool stays shed.
@@ -2233,6 +2588,11 @@ class ServeEngine:
         bs = self.prefix_block_size
         table_row = np.zeros(self._table_width, np.int32)
         node, m = None, 0
+        if self._host is not None:
+            # Tiered admission (the row path's twin): host-tier blocks
+            # promote into the pool first, so the match below pins the
+            # deeper chain in place.
+            self._promote_host_chain(prompt, handle)
         if not self._degraded:
             match = self._prefix.match(
                 prompt, max_blocks=self._match_blocks(prompt))
@@ -2588,6 +2948,10 @@ class ServeEngine:
                                "fsm": fsm}
                 created = True
                 return self._advance_slice(self._slice)
+            if self._host is not None:
+                # Tiered sliced admission: promote before the gather so
+                # the matched chain below includes the host-tier blocks.
+                self._promote_host_chain(prompt, handle)
             n_cached = 0
             if not self._degraded:
                 match = self._prefix.match(
@@ -3347,3 +3711,83 @@ class ServeEngine:
                     "tenant=TenantConfig(...)")
         self.scheduler.restore(handles)
         return handles
+
+    # ------------------------------------------- cross-replica transfer
+    def export_prefix_chain(self, tokens,
+                            max_blocks: Optional[int] = None):
+        """The replica-to-replica prefix-transfer EXPORT (ISSUE 13):
+        the longest cached chain for ``tokens`` — device radix match
+        first (read D2H in one batched eager gather, the same read
+        demotion uses), host-tier blocks extending it (already host
+        arrays, no transfer) — as a `serve/drain.py` chain wire entry
+        (:func:`~pddl_tpu.serve.drain.kv_chain_to_wire`). ``None``
+        when nothing is cached, the prefix machinery is off, the HOST
+        TIER is off (the D2H read rides the tier's jitted gather, and
+        a tier-less replica could not receive a peer's chain either —
+        exporting is a tiered-fleet feature), or the engine is
+        degraded (exporting from a shed cache would race the flush).
+        The matched chain is pinned for exactly the read."""
+        if (not self._prefix_on or self._host is None
+                or self._degraded or self._drained):
+            return None
+        tokens = np.asarray(tokens, np.int32).reshape(-1)
+        cap = self._match_blocks(tokens)
+        if max_blocks is not None:
+            cap = min(cap, int(max_blocks))
+        if cap < 1:
+            return None
+        match = self._prefix.match(tokens, max_blocks=cap)
+        m = match.n_blocks
+        blocks: List[Dict[str, np.ndarray]] = []
+        if m > 0:
+            self._prefix.pin(match.node)
+            try:
+                blocks = self._gather_blocks_host(match.block_ids)
+            except Exception as e:  # noqa: BLE001 - device faults only
+                if classify(e) is None:
+                    raise
+                blocks = []  # failed D2H: export nothing
+            finally:
+                self._prefix.unpin(match.node)
+            if len(blocks) < m:
+                return None
+        if m < cap:  # the top guard ensured the tier is armed
+            tip = self._host.pin_chain(tokens, m, cap - m)
+            if tip is not None:
+                try:
+                    blocks.extend(
+                        self._host.chain_data(tip, tip.depth - m))
+                finally:
+                    self._host.unpin(tip)
+        if not blocks:
+            return None
+        bs = self.prefix_block_size
+        return drain_io.kv_chain_to_wire(
+            [int(t) for t in tokens[:len(blocks) * bs]], blocks)
+
+    def import_prefix_chain(self, entry) -> int:
+        """The transfer IMPORT: decoded chain blocks enter the HOST
+        TIER (no device work on the routing path — the next admission
+        for the prefix promotes them H2D through the normal
+        budget-charged ``host_promote`` path, so a pulled chain pays
+        admission exactly what a locally-spilled one pays). Payloads
+        failing this engine's leaf spec are refused block-by-block
+        (`HostTierCache.store` validates). Returns blocks stored;
+        0 with the tier disabled."""
+        if self._host is None:
+            return 0
+        tokens, blocks = drain_io.kv_chain_from_wire(entry)
+        bs = self.prefix_block_size
+        stored = 0
+        for j, data in enumerate(blocks):
+            chain = tokens[:(j + 1) * bs]
+            if len(chain) < (j + 1) * bs:
+                break
+            if self._host.has_block(chain):
+                continue
+            if not self._host.store(chain, data):
+                break  # refused (spec mismatch / budget): a hole here
+                #        would end every deeper block's promotability
+            stored += 1
+            self.metrics.record_host_spill(self._host.bytes_resident)
+        return stored

@@ -49,8 +49,18 @@ class FaultPlan(_BaseFaultPlan):
     program that replaces ``tick`` on a ``spec_k > 0`` engine — same
     donated-tree recovery: full live-slot replay), and
     ``draft_prefill`` (the draft model's admission chunk, paged
-    engines only)."""
+    engines only).
+
+    The tiered-KV site (ISSUE 13): ``host_promote`` — the H2D scatter
+    that promotes a host-tier chain into the pool on a ``host_tier``
+    engine. Transients retry against the intact host copy (the tier
+    pin holds across retries); exhausted retries unwind the promotion
+    (ids + pins released exactly) and charge the admission a replay; a
+    REAL error may have consumed the donated pool tree and recovers
+    like donate/chunk (pool rebuild; paged: full live-slot replay).
+    Demotion is deliberately NOT a site: it is an eager opportunistic
+    read whose failure degrades to the old free-and-recompute path."""
 
     SITES = ("prefill", "gather", "chunk_prefill", "chunk_prefill_wide",
              "donate", "insert", "tick", "sample_first", "adapter_load",
-             "draft", "verify", "draft_prefill")
+             "draft", "verify", "draft_prefill", "host_promote")

@@ -244,6 +244,19 @@ class LocalReplica:
         lets the router settle it instead of migrating a done stream."""
         return self._ledger.harvest()
 
+    def export_chain(self, prompt: List[int],
+                     max_blocks: Optional[int] = None):
+        """Replica-to-replica prefix transfer OUT (ISSUE 13): the
+        engine's longest cached chain for ``prompt`` as a drain-module
+        chain wire entry, or None."""
+        return self.engine.export_prefix_chain(prompt,
+                                               max_blocks=max_blocks)
+
+    def import_chain(self, entry) -> int:
+        """Transfer IN: the chain lands in the engine's HOST tier;
+        returns blocks stored (0 = tier off / refused)."""
+        return self.engine.import_prefix_chain(entry)
+
     def respawn(self) -> None:
         self.engine = self._factory()
         self._ledger = HandleLedger()
@@ -528,6 +541,44 @@ class ProcessReplica:
             if counts is not None:
                 return counts
         raise ReplicaDied(self.replica_id, "counts request timed out")
+
+    def export_chain(self, prompt: List[int],
+                     max_blocks: Optional[int] = None):
+        """Replica-to-replica prefix transfer OUT, over the pipe:
+        synchronous like :meth:`compile_counts` (the router is about to
+        route based on the answer), bounded by ``call_timeout_s``.
+        Returns the chain wire entry or None."""
+        self._send({"cmd": "export_chain",
+                    "prompt": [int(t) for t in prompt],
+                    "max_blocks": (int(max_blocks)
+                                   if max_blocks is not None else None)})
+        deadline = self._clock() + self._call_timeout_s
+        while self._clock() < deadline:
+            entry = missing = object()
+            for ev in self._read_events(block_s=0.05):
+                if ev.get("ev") == "chain" and entry is missing:
+                    entry = ev.get("entry")
+                else:
+                    self._pending.append(ev)
+            if entry is not missing:
+                return entry
+        raise ReplicaDied(self.replica_id, "export_chain timed out")
+
+    def import_chain(self, entry) -> int:
+        """Transfer IN, over the pipe: the worker stores the chain in
+        its engine's host tier and acks with the stored-block count."""
+        self._send({"cmd": "import_chain", "entry": entry})
+        deadline = self._clock() + self._call_timeout_s
+        while self._clock() < deadline:
+            n = None
+            for ev in self._read_events(block_s=0.05):
+                if ev.get("ev") == "chain_imported" and n is None:
+                    n = int(ev.get("n", 0))
+                else:
+                    self._pending.append(ev)
+            if n is not None:
+                return n
+        raise ReplicaDied(self.replica_id, "import_chain timed out")
 
     # --------------------------------------------------------- resilience
     def drain_entries(self, now_s: float) -> List[Tuple[int, Dict]]:

@@ -15,9 +15,15 @@ healthy replica whose cache already holds its longest leading-block
 chain — shared system prompts land where their KV lives, which is what
 makes per-replica prefix caches pay at fleet scale. Sticky sessions
 (``session=``) keep multi-turn conversations on one replica for the
-same reason. Cold prompts route by RENDEZVOUS HASH of the leading
-blocks over the healthy set, so one replica's death remaps only its own
-keys. A full replica (typed
+same reason. With host-tier replicas (ISSUE 13) the shadow models the
+SECOND tier too — its own LRU eviction demotes chains into a host
+shadow, so affinity can route to "has it in host RAM" when no replica
+has it in HBM — and ``chain_pull_blocks`` arms replica-to-replica
+prefix transfer: a cold-routed request whose prefix a sibling holds
+gets the chain PULLED into the target's host tier over the drain-module
+chain wire format, eliminating the duplicate prefill fleet-wide. Cold
+prompts route by RENDEZVOUS HASH of the leading blocks over the healthy
+set, so one replica's death remaps only its own keys. A full replica (typed
 :class:`~pddl_tpu.serve.request.QueueFull`) sheds to the least-loaded
 healthy replica, carrying the ``retry_after_s`` hint forward; only a
 fleet-wide full queue rejects the caller.
@@ -157,6 +163,16 @@ class FleetMetrics:
         #                                the replica whose pool already
         #                                holds the request's LoRA
         #                                adapter (`serve/tenant/`)
+        self.routed_host_tier = 0      # affinity hit on a replica's
+        #                                HOST tier: no replica held the
+        #                                chain in HBM, one held it in
+        #                                host RAM (`kvcache/hosttier.py`)
+        # Replica-to-replica prefix transfer (ISSUE 13): chains pulled
+        # from the replica that held them into the routed target's host
+        # tier — duplicate prefill eliminated fleet-wide — and the
+        # prompt tokens those pulls moved.
+        self.chain_pulls = 0
+        self.chain_pull_tokens = 0
         self.shed_rerouted = 0           # QueueFull → another replica took it
         self.shed_rejected = 0           # fleet-wide full: caller rejected
         # Admission control / brownout (`fleet/admission.py`): front-
@@ -215,26 +231,62 @@ class _ShadowIndex:
     machinery (`serve/kvcache/radix.py`), but its "block ids" are
     placeholders — no device pool exists here. Optimistic by design
     (the replica's real cache may have evicted a chain the shadow still
-    holds); a stale hit costs one suboptimal route, never correctness."""
+    holds); a stale hit costs one suboptimal route, never correctness.
 
-    def __init__(self, block_size: int, capacity_blocks: int):
+    With ``host_capacity_blocks > 0`` the shadow models the replica's
+    SECOND tier too (ISSUE 13): the device shadow's own LRU eviction
+    demotes the victim's full chain into a host-shadow index — the same
+    eviction-becomes-demotion composition the engine runs, mirrored
+    structurally — so prefix-affinity can route to "has it in host
+    RAM" when no replica has it in HBM. Same optimism: the engine's
+    real policy (spill-worthiness, byte budget) may have decided
+    differently; a stale host hit costs one promotion-less route."""
+
+    def __init__(self, block_size: int, capacity_blocks: int,
+                 host_capacity_blocks: int = 0):
         self._bs = int(block_size)
         self._idx = RadixPrefixCache(self._bs, capacity_blocks + 1)
+        self._host = (RadixPrefixCache(self._bs, host_capacity_blocks + 1)
+                      if host_capacity_blocks > 0 else None)
+        if self._host is not None:
+            self._idx.on_evict = self._demote
+
+    def _demote(self, victims) -> None:
+        for node in victims:
+            tokens = self._idx.chain_tokens(node)
+            self._store(self._host, tokens, len(tokens) // self._bs)
 
     def match_blocks(self, prompt, max_blocks: int) -> int:
         return self._idx.match(prompt, max_blocks=max_blocks).n_blocks
 
+    def match_blocks_host(self, prompt, max_blocks: int) -> int:
+        """Leading blocks the HOST-tier shadow holds (0 when the
+        replica has no second tier)."""
+        if self._host is None:
+            return 0
+        return self._host.match(prompt, max_blocks=max_blocks).n_blocks
+
     def observe(self, prompt, max_blocks: int) -> None:
         """Record that this replica now holds the prompt's leading
         blocks (mirror of the engine's donate-side dedup walk)."""
-        match = self._idx.match(prompt, max_blocks=max_blocks)
-        node, stored = self._idx.descend(match.node, prompt, match.n_blocks)
+        self._store(self._idx, prompt, max_blocks)
+
+    def observe_host(self, prompt, max_blocks: int) -> None:
+        """Record that this replica's HOST tier now holds the prompt's
+        leading blocks (a replica-to-replica chain pull landed)."""
+        if self._host is not None:
+            self._store(self._host, prompt, max_blocks)
+
+    def _store(self, idx: RadixPrefixCache, prompt,
+               max_blocks: int) -> None:
+        match = idx.match(prompt, max_blocks=max_blocks)
+        node, stored = idx.descend(match.node, prompt, match.n_blocks)
         want = min(len(prompt) // self._bs, max_blocks) - stored
         if want <= 0:
             return
-        ids = self._idx.allocate(want)
+        ids = idx.allocate(want)
         if ids:
-            self._idx.extend(
+            idx.extend(
                 node,
                 prompt[stored * self._bs:(stored + len(ids)) * self._bs],
                 ids)
@@ -245,14 +297,16 @@ class _ReplicaSlot:
     + the fleet handles currently assigned to it."""
 
     def __init__(self, driver, breaker: CircuitBreaker,
-                 shadow_block_size: int, shadow_capacity: int):
+                 shadow_block_size: int, shadow_capacity: int,
+                 shadow_host_capacity: int = 0):
         self.driver = driver
         self.replica_id = driver.replica_id
         self.breaker = breaker
         self.state = ReplicaLifecycle.UP
         self.assigned: Dict[int, FleetHandle] = {}
-        self._shadow_cfg = (shadow_block_size, shadow_capacity)
-        self.shadow = _ShadowIndex(shadow_block_size, shadow_capacity)
+        self._shadow_cfg = (shadow_block_size, shadow_capacity,
+                            shadow_host_capacity)
+        self.shadow = _ShadowIndex(*self._shadow_cfg)
 
     def reset_shadow(self) -> None:
         self.shadow = _ShadowIndex(*self._shadow_cfg)
@@ -300,6 +354,24 @@ class FleetRouter:
         (batch / best_effort keep pure prefix affinity: they can
         afford the queue wait the warm cache buys back). ``None``
         (default) keeps pure affinity for every class.
+      shadow_host_capacity_blocks: per-replica HOST-TIER shadow size
+        (ISSUE 13): the device shadow's own LRU eviction demotes
+        chains into a second shadow index, mirroring the replicas'
+        ``host_tier`` engines, so prefix-affinity can route to "has it
+        in host RAM" when no replica has it in HBM (route label
+        ``host_tier``). ``0`` (default) keeps the shadow tier-blind —
+        exactly the r17 router.
+      chain_pull_blocks: replica-to-replica prefix transfer (ISSUE 13)
+        — when a request routes COLD (rendezvous hash, or a load
+        escape off the warm replica) and some OTHER healthy replica's
+        shadow holds at least this many leading blocks more than the
+        target, the router PULLS the chain: the source exports it over
+        the drain-module chain wire format
+        (`serve/drain.py` ``kv_chain_to_wire``) and the target imports
+        it into its HOST tier, where the admission promotes it instead
+        of re-prefilling — duplicate prefill eliminated fleet-wide.
+        Requires host-tier-enabled replicas to land anywhere. ``None``
+        (default) disables pulling.
     """
 
     def __init__(self, replicas: Sequence[object], *,
@@ -311,6 +383,8 @@ class FleetRouter:
                  max_sessions: int = 65536,
                  admission: Optional[AdmissionControl] = None,
                  interactive_reroute_load: Optional[int] = None,
+                 shadow_host_capacity_blocks: int = 0,
+                 chain_pull_blocks: Optional[int] = None,
                  clock=time.monotonic):
         if not replicas:
             raise ValueError("a fleet needs at least one replica")
@@ -337,6 +411,15 @@ class FleetRouter:
         # original fleet.
         self._breaker_kw = dict(breaker or {})
         self._shadow_capacity = int(shadow_capacity_blocks)
+        self._shadow_host_capacity = int(shadow_host_capacity_blocks)
+        self._chain_pull_blocks = (int(chain_pull_blocks)
+                                   if chain_pull_blocks is not None
+                                   else None)
+        if (self._chain_pull_blocks is not None
+                and self._chain_pull_blocks < 1):
+            raise ValueError(
+                f"chain_pull_blocks must be >= 1, got "
+                f"{chain_pull_blocks}")
         self._autoscaler = None
         self._slots: List[_ReplicaSlot] = []
         for driver in replicas:
@@ -474,13 +557,21 @@ class FleetRouter:
                healthy: List[_ReplicaSlot],
                priority: Priority = Priority.INTERACTIVE,
                adapter: Optional[str] = None,
-               ) -> Tuple[_ReplicaSlot, str]:
+               ) -> Tuple[_ReplicaSlot, str, Dict[int, int], Dict[int, int]]:
+        """Returns ``(slot, how, device_depths, host_depths)`` — the
+        depth maps (replica_id -> matched blocks) record exactly the
+        shadow walks this call performed, so ``_maybe_pull_chain`` can
+        reuse them instead of re-walking every shadow on the routing
+        path (a sticky/adapter return walked nothing; a device-affinity
+        return never walked the host shadows)."""
+        dev_depths: Dict[int, int] = {}
+        host_depths: Dict[int, int] = {}
         if session is not None:
             stuck = self._sessions.get(session)
             if stuck is not None:
                 self._sessions.move_to_end(session)  # LRU touch
                 if stuck.available:
-                    return stuck, "sticky"
+                    return stuck, "sticky", dev_depths, host_depths
         if adapter is not None:
             # Adapter affinity outranks prefix affinity (reloading
             # LoRA factors costs more than a cold prefix chunk) but
@@ -494,12 +585,13 @@ class FleetRouter:
                 escape = self._interactive_load_escape(home, healthy,
                                                        priority)
                 if escape is not None:
-                    return escape, "load"
-                return home, "adapter"
+                    return escape, "load", dev_depths, host_depths
+                return home, "adapter", dev_depths, host_depths
         best, best_blocks = None, 0
         for slot in healthy:
             m = slot.shadow.match_blocks(prompt,
                                          max_blocks=self._affinity_blocks)
+            dev_depths[slot.replica_id] = m
             if m > best_blocks or (m == best_blocks and m > 0
                                    and best is not None
                                    and slot.load < best.load):
@@ -508,9 +600,104 @@ class FleetRouter:
             escape = self._interactive_load_escape(best, healthy,
                                                    priority)
             if escape is not None:
-                return escape, "load"
-            return best, "affinity"
-        return self._rendezvous(prompt, healthy), "hash"
+                return escape, "load", dev_depths, host_depths
+            return best, "affinity", dev_depths, host_depths
+        # Second-tier affinity (ISSUE 13): no replica holds the prefix
+        # in HBM — route to the one whose HOST tier holds it (the
+        # engine promotes instead of re-prefilling), under the same
+        # interactive pressure escape HBM affinity has.
+        hbest, hblocks = None, 0
+        for slot in healthy:
+            hm = slot.shadow.match_blocks_host(
+                prompt, max_blocks=self._affinity_blocks)
+            host_depths[slot.replica_id] = hm
+            if hm > hblocks or (hm == hblocks and hm > 0
+                                and hbest is not None
+                                and slot.load < hbest.load):
+                hbest, hblocks = slot, hm
+        if hbest is not None and hblocks > 0:
+            escape = self._interactive_load_escape(hbest, healthy,
+                                                   priority)
+            if escape is not None:
+                return escape, "load", dev_depths, host_depths
+            return hbest, "host_tier", dev_depths, host_depths
+        return (self._rendezvous(prompt, healthy), "hash",
+                dev_depths, host_depths)
+
+    def _maybe_pull_chain(self, prompt: List[int], chosen: _ReplicaSlot,
+                          healthy: List[_ReplicaSlot],
+                          dev_depths: Optional[Dict[int, int]] = None,
+                          host_depths: Optional[Dict[int, int]] = None,
+                          ) -> None:
+        """Replica-to-replica prefix transfer (the ``chain_pull_blocks``
+        arg docs): when a sibling's shadow (HBM or host tier) holds
+        meaningfully more of the prompt's prefix than the routing
+        target, export the chain from the sibling and import it into
+        the target's host tier — the admission then PROMOTES instead of
+        re-prefilling, eliminating the duplicate prefill the cold route
+        would have paid. Best-effort end to end: a dead source, a
+        refused import (target tier off / budget / foreign config), or
+        an empty export all degrade to the plain cold admission.
+
+        ``dev_depths``/``host_depths`` are ``_route``'s own shadow-walk
+        results (replica_id -> matched blocks) — a depth already
+        computed on the routing path is reused, only the components
+        the route never walked (e.g. host shadows when device affinity
+        decided first) are walked here."""
+        blocks = self._affinity_blocks
+        dev_depths = dev_depths or {}
+        host_depths = host_depths or {}
+
+        def depth_of(slot: _ReplicaSlot) -> int:
+            d = dev_depths.get(slot.replica_id)
+            if d is None:
+                d = slot.shadow.match_blocks(prompt, max_blocks=blocks)
+            h = host_depths.get(slot.replica_id)
+            if h is None:
+                h = slot.shadow.match_blocks_host(prompt,
+                                                  max_blocks=blocks)
+            return max(d, h)
+
+        own = depth_of(chosen)
+        best_src, depth = None, own
+        for slot in healthy:
+            if slot is chosen:
+                continue
+            d = depth_of(slot)
+            if d > depth:
+                best_src, depth = slot, d
+        if best_src is None or depth - own < self._chain_pull_blocks:
+            return
+        export = getattr(best_src.driver, "export_chain", None)
+        import_fn = getattr(chosen.driver, "import_chain", None)
+        if export is None or import_fn is None:
+            return
+        try:
+            entry = export(list(prompt), depth)
+        except Exception:  # noqa: BLE001 - source may be dying; the
+            return         # next step() settles it, the pull just skips
+        if not entry:
+            return
+        try:
+            n = import_fn(entry)
+        except Exception:  # noqa: BLE001 - same best-effort contract
+            return
+        if n > 0:
+            self.metrics.chain_pulls += 1
+            self.metrics.chain_pull_tokens += n * self._block_size
+            # The target's host tier now covers the EXPORTED chain's
+            # depth (the import walks it from block 0, skipping blocks
+            # already resident) — NOT own + n: `own` may be a device-
+            # shadow match the host tier never held, and over-recording
+            # would suppress deeper pulls for every later sharer.
+            pulled_depth = (len(entry.get("tokens", []))
+                            // self._block_size
+                            if isinstance(entry, dict) else n)
+            chosen.shadow.observe_host(
+                prompt, max_blocks=min(blocks, pulled_depth))
+            self._tracer.on_fleet_event(
+                "chain_pull", from_replica=best_src.replica_id,
+                to_replica=chosen.replica_id, blocks=n)
 
     def _interactive_load_escape(self, chosen: _ReplicaSlot,
                                  healthy: List[_ReplicaSlot],
@@ -564,8 +751,8 @@ class FleetRouter:
             raise NoHealthyReplica(
                 f"no healthy replica among {len(self._slots)} "
                 "(all circuits open)")
-        chosen, how = self._route(prompt, session, healthy, priority,
-                                  adapter)
+        chosen, how, dev_depths, host_depths = self._route(
+            prompt, session, healthy, priority, adapter)
         now = self._clock()
         if self._admission is not None:
             self._admission.update(now, self._degraded_replica_count())
@@ -594,6 +781,12 @@ class FleetRouter:
             if capped < int(max_new_tokens):
                 self.metrics.brownout_capped_output += 1
                 max_new_tokens = capped
+        if self._chain_pull_blocks is not None and how in ("hash", "load"):
+            # The request is landing COLD somewhere even though a
+            # sibling may hold its prefix: pull the chain to the target
+            # before the engine sees the prompt (ISSUE 13).
+            self._maybe_pull_chain(prompt, chosen, healthy,
+                                   dev_depths, host_depths)
         order = [chosen] + sorted((s for s in healthy if s is not chosen),
                                   key=lambda s: s.load)
         hints: List[float] = []
@@ -652,6 +845,8 @@ class FleetRouter:
                 self.metrics.routed_affinity += 1
             elif how == "load":
                 self.metrics.routed_load_balanced += 1
+            elif how == "host_tier":
+                self.metrics.routed_host_tier += 1
             else:
                 self.metrics.routed_hash += 1
             if self._admission is not None:
@@ -1046,7 +1241,8 @@ class FleetRouter:
                 f"replica ids must be unique, got {driver.replica_id} "
                 f"already in {ids}")
         slot = _ReplicaSlot(driver, CircuitBreaker(**self._breaker_kw),
-                            self._block_size, self._shadow_capacity)
+                            self._block_size, self._shadow_capacity,
+                            self._shadow_host_capacity)
         slot.breaker.on_transition = self._circuit_observer(slot)
         self._slots.append(slot)
         return slot

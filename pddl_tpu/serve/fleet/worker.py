@@ -97,8 +97,24 @@ def build_engine(config: Dict[str, object]):
             token_strings=tenant_cfg.get("token_strings"),
             adapter_load_tokens=int(
                 tenant_cfg.get("adapter_load_tokens", 8)))
+    # Tiered KV cache (ISSUE 13, mirroring the paged/tenant/spec
+    # passthroughs): a nonzero host_tier_bytes arms the host-RAM spill
+    # tier on every process replica — which is also what makes the
+    # router's chain pulls land somewhere. Absent keeps the untiered
+    # engine so existing fleet configs stay comparable.
+    host_tier = None
+    if config.get("host_tier_bytes"):
+        from pddl_tpu.serve.kvcache import HostTierConfig
+
+        host_tier = HostTierConfig(
+            byte_budget=int(config["host_tier_bytes"]),
+            promote_tokens_per_block=int(
+                config.get("host_promote_tokens_per_block", 2)),
+            min_chain_blocks=int(
+                config.get("host_min_chain_blocks", 1)))
     return ServeEngine(
         model, {"params": params},
+        host_tier=host_tier,
         max_slots=int(config.get("slots", 8)),
         prefill_len=int(config.get("prefill_len", 64)),
         max_queue_depth=int(config.get("max_queue_depth", 64)),
@@ -212,6 +228,31 @@ def main(argv=None) -> int:
                            "n_tokens": 0})
                     continue
                 ledger.add(rid, h)
+        elif kind == "export_chain":
+            # Replica-to-replica prefix transfer OUT (ISSUE 13): the
+            # chain wire entry (or null) as a synchronous ack, like
+            # counts — the router routes on the answer. Per-command
+            # isolation (the submit/restore discipline): the pull is
+            # best-effort END TO END, so a failed export — tier off on
+            # this engine, a device fault mid-read — answers null, it
+            # never crashes a healthy replica serving live streams.
+            try:
+                entry = engine.export_prefix_chain(
+                    cmd["prompt"], max_blocks=cmd.get("max_blocks"))
+            except Exception as e:  # noqa: BLE001 - reject the pull
+                print(f"export_chain rejected: {e}", file=sys.stderr)
+                entry = None
+            _emit({"ev": "chain", "entry": entry})
+        elif kind == "import_chain":
+            # Same isolation inbound: a malformed wire entry (bad
+            # base64, an invalid dtype string from a foreign build)
+            # refuses the chain, not the worker.
+            try:
+                n = engine.import_prefix_chain(cmd["entry"])
+            except Exception as e:  # noqa: BLE001 - reject the entry
+                print(f"import_chain rejected: {e}", file=sys.stderr)
+                n = 0
+            _emit({"ev": "chain_imported", "n": n})
         elif kind == "drain":
             flags["drain"] = True
         elif kind == "shutdown":

@@ -6,6 +6,10 @@ slot prefix) and donate (slot prompt → pool blocks) assembly over the
 single-leaf primitives in :mod:`pddl_tpu.ops.attention`.
 `radix.py` is the HOST half: a refcounted, LRU-evicted radix tree over
 token ids mapping prompt prefixes to stored block chains.
+`hosttier.py` is the SECOND tier under both (ISSUE 13): a
+byte-budgeted pinned-host-memory pool where the radix index's LRU
+victims spill instead of dying, and from which admission promotes
+matched chains back H2D — see `docs/SERVING.md` § "Tiered KV cache".
 
 See `docs/SERVING.md` § "Prefix caching" for the design and the
 engine integration (`pddl_tpu/serve/engine.py`).
@@ -18,9 +22,12 @@ from pddl_tpu.serve.kvcache.block_pool import (
     paged_decode_cache,
     pool_nbytes,
 )
+from pddl_tpu.serve.kvcache.hosttier import HostTierCache, HostTierConfig
 from pddl_tpu.serve.kvcache.radix import RadixPrefixCache
 
 __all__ = [
+    "HostTierCache",
+    "HostTierConfig",
     "RadixPrefixCache",
     "donate_prefix_blocks",
     "gather_prefix_into_row",

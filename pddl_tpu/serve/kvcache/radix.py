@@ -90,6 +90,17 @@ class RadixPrefixCache:
         self._root = _Node(None, None, None)
         self._clock = itertools.count(1)
         self.evictions = 0
+        # Demotion hook (ISSUE 13, `kvcache/hosttier.py`): called once
+        # per reclaim pass with the LIST of victims BEFORE their block
+        # ids are freed — each node still attached (parent chain
+        # walkable, block id readable), so the engine can spill the
+        # blocks' K/V D2H into the host tier in ONE batched gather
+        # (per-victim calls measured ~7x slower on the admission
+        # path). The callback must not touch this index. None
+        # (default) keeps eviction a plain free; the degraded flush
+        # NEVER calls it (`flush_unpinned` — spilling during an OOM
+        # response would defeat the shedding).
+        self.on_evict = None
 
     # ------------------------------------------------------------ stats
     @property
@@ -149,6 +160,26 @@ class RadixPrefixCache:
             j += 1
         return node, j
 
+    def chain_tokens(self, node: _Node) -> List[int]:
+        """Root-path token ids of ``node`` (``depth * block_size`` of
+        them) — the chain identity the host tier (`hosttier.py`) keys a
+        demoted block under, and the prompt slice a promotion re-keys
+        it back from."""
+        keys: List[tuple] = []
+        while node is not self._root:
+            keys.append(node.key)
+            node = node.parent
+        keys.reverse()
+        return [int(t) for key in keys for t in key]
+
+    def chain_depth(self, node: _Node) -> int:
+        """Block count of ``node``'s root path (0 for the root)."""
+        depth = 0
+        while node is not self._root:
+            depth += 1
+            node = node.parent
+        return depth
+
     def chain_ids(self, node: _Node) -> List[int]:
         """Root-path block ids of ``node``, root-first — the stored
         chain a paged slot's block table must point at after donation
@@ -186,9 +217,16 @@ class RadixPrefixCache:
         unpin). Returns the number of blocks freed. Used by the engine
         when a RESOURCE_EXHAUSTED surfaces: the prefix cache is the one
         large optional HBM consumer, so shedding it is the graceful
-        response before any request has to fail."""
+        response before any request has to fail.
+
+        BYPASSES demotion deliberately (``demote=False`` below): this
+        path runs inside the OOM response, where the point is to shed
+        work, and a D2H spill per evicted block would spend transfers
+        — and host memory — exactly when the engine is trying to
+        survive. Degraded-mode eviction is a hard free, pinned
+        discriminatively by ``tests/test_kv_tier.py``."""
         before = self.blocks_free
-        self._reclaim(self.blocks_live)
+        self._reclaim(self.blocks_live, demote=False)
         return self.blocks_free - before
 
     # ------------------------------------------------------- allocation
@@ -211,30 +249,56 @@ class RadixPrefixCache:
         take = min(n, len(self._free))
         return [self._free.popleft() for _ in range(take)]
 
-    def _reclaim(self, need: int) -> None:
+    def _reclaim(self, need: int, demote: bool = True) -> None:
         """Evict up to ``need`` unpinned LEAVES, least recently accessed
         first. One DFS collects the whole evictable set per pass (not
         one full-tree scan PER block — allocation bursts sit on the
         admission/TTFT path); evicting a leaf can expose its parent as
         a new evictable leaf, so passes repeat until satisfied or
-        nothing is evictable."""
+        nothing is evictable.
+
+        With a demotion hook installed (``on_evict``), eviction is a
+        POLICY DECISION rather than a free: the WHOLE reclaim's victim
+        set — all passes, eviction order — is offered to the hook in
+        ONE call, still attached, block ids still valid, before any id
+        returns to the free list, so reuse-worthy chains spill to the
+        host tier instead of dying and the spill's D2H read is one
+        batched transfer per allocation shortfall rather than one per
+        pass (passes often take 1-2 leaves each, and the hook's
+        device round trip sits on the admission path). Victims are
+        marked, not freed, between passes, so exposing a parent as the
+        next pass's leaf needs no tree mutation before the hook runs.
+        ``demote=False`` (the degraded flush) skips the hook
+        unconditionally."""
+        call_hook = demote and self.on_evict is not None
+        all_taken: List[_Node] = []
+        marked = set()
         while need > 0:
             victims = []
             stack = [self._root]
             while stack:
                 node = stack.pop()
                 stack.extend(node.children.values())
-                if (node is not self._root and not node.children
-                        and node.ref == 0):
+                if (node is not self._root and node.ref == 0
+                        and id(node) not in marked
+                        and all(id(c) in marked
+                                for c in node.children.values())):
                     victims.append(node)
             if not victims:
-                return
+                break
             victims.sort(key=lambda v: v.last_access)
-            for victim in victims[:need]:
-                del victim.parent.children[victim.key]
-                self._free.append(victim.block_id)
-                self.evictions += 1
+            taken = victims[:need]
+            all_taken.extend(taken)
+            marked.update(id(v) for v in taken)
             need -= min(need, len(victims))
+        if not all_taken:
+            return
+        if call_hook:
+            self.on_evict(all_taken)
+        for victim in all_taken:
+            del victim.parent.children[victim.key]
+            self._free.append(victim.block_id)
+            self.evictions += 1
 
     # --------------------------------------------------------- insertion
     def extend(self, node: _Node, tokens: Sequence[int],

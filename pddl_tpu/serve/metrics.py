@@ -142,6 +142,18 @@ class ServeMetrics:
         self.copy_bytes_avoided = 0
         self.blocks_shared = 0       # gauge, engine-stamped per tick
         self.block_table_fill = 0.0  # gauge, engine-stamped per tick
+        # Tiered-KV-cache telemetry (`serve/kvcache/hosttier.py`; all
+        # zero without a host tier): blocks demoted into the tier
+        # (chain imports from replica pulls included), admissions whose
+        # host match promoted >= 1 block, blocks promoted back H2D,
+        # prefill-budget tokens those promotions were charged (the
+        # adapter_load_tokens precedent), and the resident-byte gauge
+        # the sizing runbook watches against the byte budget.
+        self.host_tier_spills = 0
+        self.host_tier_hits = 0
+        self.host_tier_promotions = 0
+        self.host_tier_promote_tokens_charged = 0
+        self.host_tier_bytes_resident = 0  # gauge, engine-stamped
         # Multi-tenant telemetry (`serve/tenant/`; all zero on a plain
         # engine): adapter pool hits vs cold loads (the hit RATE is the
         # runbook's pool-sizing signal), LRU evictions under pressure,
@@ -308,6 +320,24 @@ class ServeMetrics:
         self.blocks_shared = int(blocks_shared)
         self.block_table_fill = float(block_table_fill)
 
+    # ----------------------------------------------------- tiered cache
+    def record_host_spill(self, bytes_resident: int) -> None:
+        """One block entered the host tier — a demotion of an LRU
+        victim, or a replica-to-replica chain import; ``bytes_resident``
+        stamps the residency gauge in passing."""
+        self.host_tier_spills += 1
+        self.host_tier_bytes_resident = int(bytes_resident)
+
+    def record_host_promotion(self, blocks: int, tokens_charged: int,
+                              bytes_resident: int) -> None:
+        """One admission promoted ``blocks`` host-tier blocks back into
+        the device pool, charged ``tokens_charged`` against the prefill
+        budget."""
+        self.host_tier_hits += 1
+        self.host_tier_promotions += int(blocks)
+        self.host_tier_promote_tokens_charged += int(tokens_charged)
+        self.host_tier_bytes_resident = int(bytes_resident)
+
     # ---------------------------------------------------------- tenancy
     def record_adapter_hit(self, name: str, resident: int, *,
                            fresh: bool = True) -> None:
@@ -387,6 +417,12 @@ class ServeMetrics:
             "copy_bytes_avoided": self.copy_bytes_avoided,
             "blocks_shared": self.blocks_shared,
             "block_table_fill": round(self.block_table_fill, 6),
+            "host_tier_spills": self.host_tier_spills,
+            "host_tier_hits": self.host_tier_hits,
+            "host_tier_promotions": self.host_tier_promotions,
+            "host_tier_promote_tokens_charged":
+                self.host_tier_promote_tokens_charged,
+            "host_tier_bytes_resident": self.host_tier_bytes_resident,
             "adapter_hits": self.adapter_hits,
             "adapter_loads": self.adapter_loads,
             "adapter_evictions": self.adapter_evictions,

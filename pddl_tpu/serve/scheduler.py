@@ -189,6 +189,15 @@ class SLOScheduler:
         cost_fn: a host→device factor transfer is admission-path work
         exactly like an uncached suffix, and a resident adapter — like
         a cached prefix — charges nothing.
+        The TIERED engine (ISSUE 13) prices host-tier promotions the
+        same way: blocks the host tier will promote charge
+        ``HostTierConfig.promote_tokens_per_block`` each instead of
+        ``block_size`` prefill tokens — an H2D block transfer is real
+        admission work but much cheaper than recomputing the block, so
+        the budget admits more behind a promotion than behind the
+        prefill it replaced while still throttling promotion storms
+        (the runbook's "when promotion charges starve cold admissions"
+        lever works by raising this price).
         The SPECULATIVE engine's contract (ISSUE 12): token-budget
         accounting charges ACCEPTED, never DRAFTED, tokens. A replayed
         stream's catch-up re-feed is charged at its emitted token

@@ -135,15 +135,32 @@ _HIGHER_BETTER = ("tokens_per_s", "tokens_per_sec", "speedup", "retained",
                   # is the draft-quality series behind the throughput
                   # win (spec_tok_s rides "tok_s", spec_speedup_x rides
                   # "speedup", tokens_per_tick rides "_per_tick").
-                  "acceptance_rate")
+                  "acceptance_rate",
+                  # Tiered-KV-cache headlines (r18): demotion/promotion
+                  # traffic that stopped happening is a coverage
+                  # regression (spilled blocks are chains saved from
+                  # recompute, promoted blocks are prefills avoided);
+                  # "promot" covers both host_tier_promotions and
+                  # host_tier_promote_tokens_charged; hit-rate leaves
+                  # ride "hit_rate", the TTFT ratio rides "ttft"
+                  # below, chain pulls ride "chain_pull".
+                  "spill", "promot", "chain_pull")
 _LOWER_BETTER = ("ttft", "latency", "_ms", "_wall_s", "overhead",
                  "_seconds", "tick_s", "step_s", "copy_us",
                  # Time the brownout ladder spent engaged (r16): a
                  # same-config record whose fleet browns out longer
                  # regressed its overload posture.
-                 "rung_time")
+                 "rung_time",
+                 # Prefill tokens the fleet spent on prefixes a sibling
+                 # replica already held (r18): the number the chain
+                 # pull exists to eliminate.
+                 "duplicate_prefill")
 _NEVER = ("spread", "samples", "per_pair", "per_repeat", "n_requests",
-          "count", "injected", "provenance", "seed", "offered")
+          "count", "injected", "provenance", "seed", "offered",
+          # The r18 tier curve's sweep axis (working_set_x is a
+          # multiple of the pool size, not a measurement) — its _x
+          # suffix only LOOKS like a ratio headline.
+          "working_set")
 
 
 def metric_direction(key: str) -> int:

@@ -1,0 +1,27 @@
+"""Seeded-bad fixture: a host-tier promotion that LEAKS its pin on the
+fault-unwind path (the ISSUE 13 demote/promote pin-pair class).
+
+``pin_chain`` is the host tier's match-and-pin acquire
+(`pddl_tpu/serve/kvcache/hosttier.py`): the returned tip must be
+``unpin``-ed exactly once on every path out of the promotion. Here the
+unwind releases the device-side block ids but forgets the host pin, so
+the byte budget can never evict the chain again — a permanent host-
+memory leak per faulted promotion. The graftlint ``pin-release`` rule
+must flag the raise path.
+"""
+
+
+class Engine:
+    def promote_host_chain(self, prompt, m, cap):
+        tip = self._host.pin_chain(prompt, m, cap - m)
+        ids = self._prefix.allocate(cap - m)
+        try:
+            self.dispatch_scatter(ids)
+        except RuntimeError:
+            # BUG: the unwind hands back the device ids but LEAKS the
+            # host-tier pin — the chain is unevictable forever.
+            self._prefix.release(ids)
+            raise
+        self._prefix.extend(tip, prompt, ids)
+        self._host.unpin(tip)
+        return len(ids)

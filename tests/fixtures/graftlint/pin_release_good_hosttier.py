@@ -1,0 +1,23 @@
+"""Good twin of ``pin_release_bad_hosttier.py``: the same promotion
+shape with the host-tier pin pair intact — the fault-unwind releases
+the device ids AND the host pin, restoring the pre-promotion refcount
+baseline exactly (the discipline `ServeEngine._promote_host_chain`
+holds). Must lint clean.
+"""
+
+
+class Engine:
+    def promote_host_chain(self, prompt, m, cap):
+        tip = self._host.pin_chain(prompt, m, cap - m)
+        ids = self._prefix.allocate(cap - m)
+        try:
+            self.dispatch_scatter(ids)
+        except RuntimeError:
+            # Full unwind: device ids and the host-tier pin, exactly
+            # once each.
+            self._prefix.release(ids)
+            self._host.unpin(tip)
+            raise
+        self._prefix.extend(tip, prompt, ids)
+        self._host.unpin(tip)
+        return len(ids)

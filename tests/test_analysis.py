@@ -51,6 +51,9 @@ def findings_for(path, rule=None):
 BAD_FIXTURES = [
     ("pin-release", "pin_release_bad_r13.py", 3),
     ("pin-release", "pin_release_bad_r14.py", 1),
+    # The host-tier promotion twin (ISSUE 13): a fault-unwind that
+    # releases the device ids but leaks the pin_chain host pin.
+    ("pin-release", "pin_release_bad_hosttier.py", 1),
     ("donation", "donation_bad.py", 2),
     ("recompile-hazard", "recompile_bad.py", 1),
     ("site-vocab", "site_vocab_bad.py", 3),
@@ -62,7 +65,8 @@ BAD_FIXTURES = [
 ]
 
 GOOD_FIXTURES = [
-    "pin_release_good.py", "donation_good.py", "recompile_good.py",
+    "pin_release_good.py", "pin_release_good_hosttier.py",
+    "donation_good.py", "recompile_good.py",
     "site_vocab_good.py", "site_vocab_good_spec.py",
     "exposition_good.py", "snapshot_good.py",
 ]
@@ -99,6 +103,18 @@ def test_r14_double_release_is_the_underflow_class():
     assert len(found) == 1
     assert "underflow" in found[0].message
     assert "unpin" in found[0].message
+
+
+def test_hosttier_promotion_leak_names_the_pinned_tip():
+    """The ISSUE 13 class: the fault-unwind released the device ids
+    but the ``pin_chain`` host pin escapes the raise — the finding
+    must name the leaked tip, and only it (the ids were released)."""
+    found = findings_for(
+        os.path.join(FIXTURES, "pin_release_bad_hosttier.py"),
+        "pin-release")
+    messages = " | ".join(f.message for f in found)
+    assert "tip" in messages and "pin_chain" in messages
+    assert "ids" not in messages.replace("block ids", "")
 
 
 # ----------------------------------------------- framework semantics

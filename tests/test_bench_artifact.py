@@ -82,8 +82,22 @@ def test_metric_direction_vocabulary():
     assert metric_direction("acceptance_rate") == 1
     assert metric_direction("spec_acceptance_rate") == 1
     assert metric_direction("tokens_per_tick") == 1
+    # The r18 tiered-KV-cache headlines (ISSUE 13): tier hit rates and
+    # demotion/promotion traffic up are better (chains saved from
+    # recompute), the paired tiered-over-evict TTFT ratio down is
+    # better, duplicate prefill tokens down is better, and chain pulls
+    # (the fleet-wide eliminator) up are better.
+    assert metric_direction("hit_rate_tiered") == 1
+    assert metric_direction("host_tier_spills") == 1
+    assert metric_direction("host_tier_promotions") == 1
+    assert metric_direction("host_tier_promote_tokens_charged") == 1
+    assert metric_direction("mean_ttft_ratio_at_8x") == -1
+    assert metric_direction("ttft_tiered_over_evict_x") == -1
+    assert metric_direction("duplicate_prefill_tokens_blind") == -1
+    assert metric_direction("chain_pulls") == 1
     # Raw byte tallies are scale context, not headlines.
     assert metric_direction("kv_bytes_used_row") == 0
+    assert metric_direction("host_tier_bytes_resident") == 0
     # Noise keys are never compared.
     assert metric_direction("spread_pct") == 0
     assert metric_direction("ttft_inflation_per_pair") == 0
@@ -257,6 +271,72 @@ def test_r17_spec_artifact_is_gated():
         paths = {r["path"] for r in failures[0]["regressions"]}
         assert "results.spec.spec_speedup_x" in paths
         assert "results.spec.acceptance_rate" in paths
+
+
+def test_r18_tier_artifact_is_gated():
+    """The tiered-KV-cache artifact participates in the series: it
+    loads, keys into a (metric, config) group, its committed headlines
+    clear the ISSUE 13 bounds (mean-TTFT ratio <= 0.8x at the 8x
+    working set, EVERY pair at EVERY sweep point directional, the
+    2-replica chain pull eliminating duplicate prefill outright, the
+    tiered compile set = the evict set + exactly ``host_promote``),
+    they are DIRECTIONAL — and a same-config r-record that regresses
+    them fails `check_series` LOUDLY."""
+    path = os.path.join(_BENCH_DIR, "r18_serve_tier.json")
+    records = [r for r in load_artifact(path)
+               if artifact_key(r) is not None]
+    assert records, "r18_serve_tier.json has no keyed record"
+    tier = records[0]["results"]["tier"]
+    fleet = records[0]["results"]["fleet"]
+    # ISSUE 13 acceptance bounds on the committed medians.
+    assert tier["mean_ttft_ratio_at_8x"] <= 0.8
+    assert tier["all_pairs_directional"] is True
+    assert all(r < 1.0 for c in tier["curve"]
+               for r in c["ttft_ratio_per_pair"])
+    for c in tier["curve"]:
+        # The tier must actually be the lever at every sweep point:
+        # better hit rate, real demotion/promotion traffic.
+        assert c["hit_rate_tiered"] > c["hit_rate_evict"]
+        assert c["host_tier_spills"] > 0
+        assert c["host_tier_promotions"] > 0
+    ct = dict(tier["engine_compile_counts_tiered"])
+    ce = dict(tier["engine_compile_counts_evict"])
+    assert ct.pop("host_promote") == 1
+    assert ct == ce and all(n == 1 for n in ce.values())
+    # The fleet leg: duplicate prefill eliminated, not just reduced,
+    # with the streams bit-identical either way.
+    assert fleet["duplicate_prefill_tokens_blind"] > 0
+    assert fleet["duplicate_prefill_tokens_pulled"] == 0.0
+    assert fleet["all_pairs_directional"] is True
+    assert fleet["chain_pulls"] >= 1
+    assert fleet["streams_identical_blind_vs_pulled"] is True
+    for key in ("mean_ttft_ratio_at_8x", "hit_rate_tiered",
+                "host_tier_spills", "host_tier_promotions",
+                "duplicate_prefill_tokens_blind", "chain_pulls"):
+        assert metric_direction(key) != 0, key
+    # A hypothetical r19 record at the SAME config whose tier
+    # headlines regressed must fail the series gate loudly. (The
+    # committed duplicate_prefill_tokens_pulled is exactly 0 — growth
+    # off a zero baseline has no percentage, so the ratio and
+    # hit-rate legs carry the loudness.)
+    worse = copy.deepcopy(records[0])
+    worse["results"]["tier"]["mean_ttft_ratio_at_8x"] *= 1.4
+    for c in worse["results"]["tier"]["curve"]:
+        c["hit_rate_tiered"] *= 0.5
+    import json as _json
+    import tempfile
+    with tempfile.TemporaryDirectory() as d:
+        old_p = os.path.join(d, "r18_t.json")
+        new_p = os.path.join(d, "r19_t.json")
+        with open(old_p, "w") as f:
+            _json.dump(records[0], f)
+        with open(new_p, "w") as f:
+            _json.dump(worse, f)
+        pairs, failures = check_series([old_p, new_p])
+        assert pairs == 1 and len(failures) == 1
+        paths = {r["path"] for r in failures[0]["regressions"]}
+        assert "results.tier.mean_ttft_ratio_at_8x" in paths
+        assert any("hit_rate_tiered" in p for p in paths)
 
 
 def test_compare_flags_directional_regressions_only():
