@@ -79,6 +79,18 @@ the ONLINE layer (`pddl_tpu/serve/`) the way a serving owner would:
    mid-speculation) proving replayed/migrated speculative streams
    stay token-exact.
 
+10. **Control-plane leg** (`--ctrlplane-only`, standalone r19
+   artifact, ISSUE 14) — the durability tier
+   (`serve/fleet/journal.py`, `transport.py`, gray machinery): (a)
+   PAIRED clean vs 1%-injected wire-fault waves through real worker
+   processes — throughput retained with every CRC reject counted and
+   every stream token-exact (zero corrupt frames accepted); (b)
+   router "SIGKILL" + `FleetRouter.recover` — WAL-rebuilt streams
+   resume token-exact, with the recovery wall time (`recovery_s`)
+   measured from recover() to every stream past its mirrored length;
+   (c) gray-replica hedging ON vs OFF under an injected slow replica
+   — interactive p99 TTFT, hedge wins counted, zero recompiles.
+
 Every record embeds the engine's final `ServeMetrics.snapshot()`, so
 artifacts carry tail latencies (TTFT/token-latency p50/p99), not just
 throughput.
@@ -2068,6 +2080,344 @@ def _autoscale_leg(args):
     }
 
 
+def _ctrlplane_cfg() -> dict:
+    """The leg's sized worker config (the r16/r17 small-model
+    discipline: control-plane costs are host-side, a big model only
+    slows the referee)."""
+    return dict(vocab=64, max_len=128, embed_dim=64, depth=2, heads=2,
+                slots=4, prefill_len=32, max_queue_depth=96,
+                param_seed=0, prefix_cache_blocks=0)
+
+
+def _ctrl_wave(fleet, prompts, new_tokens: int, *, hang_s: float = 300.0,
+               priority=None):
+    """Closed-loop wave: submit everything, pump to terminal. Returns
+    (handles, tokens_per_s, wall_s)."""
+    t0 = time.perf_counter()
+    handles = []
+    for p in prompts:
+        kw = {} if priority is None else {"priority": priority}
+        handles.append(fleet.submit(list(p), new_tokens, **kw))
+    deadline = time.perf_counter() + hang_s
+    while any(not h.done for h in handles) \
+            and time.perf_counter() < deadline:
+        fleet.step()
+    wall = time.perf_counter() - t0
+    assert all(h.done for h in handles), "a wave request never settled"
+    return handles, sum(len(h.tokens) for h in handles) / wall, wall
+
+
+def _ctrlplane_wire_leg(args, repeats: int) -> dict:
+    """Paired clean vs wire-fault-storm waves through process
+    replicas: the framed transport must hold throughput and
+    token-exactness at a 1% injected frame-fault rate."""
+    import subprocess
+
+    from pddl_tpu.serve.fleet import (
+        FleetRouter,
+        ProcessReplica,
+        WireFaultPlan,
+    )
+    from pddl_tpu.serve.fleet.worker import build_engine
+
+    cfg = _ctrlplane_cfg()
+    new_tokens = 96
+    n_requests = 64
+    oracle = build_engine(cfg)
+    refs = {}
+
+    def ref_for(prompt):
+        key = tuple(prompt)
+        if key not in refs:
+            out = generate(oracle.model, {"params": oracle._params},
+                           jnp.asarray(prompt, jnp.int32)[None],
+                           new_tokens)
+            refs[key] = np.asarray(out)[0, len(prompt):].tolist()
+        return refs[key]
+
+    def spawn(plan_seed=None):
+        reps = []
+        for i in range(2):
+            plan = (None if plan_seed is None else WireFaultPlan(
+                plan_seed + i, corrupt_rate=0.004, duplicate_rate=0.002,
+                reorder_rate=0.002, drop_rate=0.002))
+            # Tight ping/resend cadences: gap-detection latency is the
+            # storm's whole cost, and the clean fleet runs the same
+            # cadence so the pair stays fair.
+            reps.append(ProcessReplica(
+                i, {**cfg, "replica_id": i}, stderr=subprocess.DEVNULL,
+                wire_fault_plan=plan, ping_interval_s=0.01,
+                resend_timeout_s=0.01, wait_ready=False))
+        for r in reps:
+            r.wait_ready()
+        return FleetRouter(reps, affinity_block_size=8,
+                           affinity_blocks=1, respawn=False)
+
+    ratios, clean_all, storm_all = [], [], []
+    rejects = retries = injected = 0
+    exact = True
+    # BOTH fleets are long-lived and warmed with an untimed wave, so
+    # every pair compares equally-warm processes — a fresh-spawned
+    # storm fleet against a wave-warmed clean one would measure
+    # process warmth, not the transport.
+    clean_fleet = spawn(None)
+    storm = spawn(1000)
+    try:
+        warm_rng = np.random.default_rng(899)
+        warm = [warm_rng.integers(0, cfg["vocab"], size=12).tolist()
+                for _ in range(n_requests)]
+        _ctrl_wave(clean_fleet, warm, new_tokens)
+        _ctrl_wave(storm, warm, new_tokens)
+        for rep in range(repeats):
+            rng = np.random.default_rng(900 + rep)
+            prompts = [rng.integers(0, cfg["vocab"], size=12).tolist()
+                       for _ in range(n_requests)]
+            _, tps_clean, _ = _ctrl_wave(clean_fleet, prompts,
+                                         new_tokens)
+            handles, tps_storm, _ = _ctrl_wave(storm, prompts,
+                                               new_tokens)
+            for p, h in zip(prompts, handles):
+                if h.state.value != "finished" \
+                        or h.tokens != ref_for(p):
+                    exact = False
+            clean_all.append(tps_clean)
+            storm_all.append(tps_storm)
+            ratios.append(tps_storm / tps_clean)
+            _log(f"ctrlplane wire pair {rep}: {tps_clean:,.0f} -> "
+                 f"{tps_storm:,.0f} tok/s ({ratios[-1]:.3f}x)")
+        rejects = storm.metrics.wire_crc_rejects
+        retries = storm.metrics.wire_retries
+        for slot in storm.replicas:
+            injected += slot.driver._plan.total_injected
+    finally:
+        clean_fleet.close()
+        storm.close()
+    ratio_med, ratio_spread = median_spread(ratios)
+    return {
+        "injected_fault_rate_per_frame": 0.01,
+        "n_requests_per_wave": n_requests,
+        "new_tokens": new_tokens,
+        "tokens_per_s_clean": round(median_spread(clean_all)[0], 1),
+        "tokens_per_s_storm": round(median_spread(storm_all)[0], 1),
+        "throughput_retained_x": round(ratio_med, 3),
+        "throughput_retained_per_pair": [round(r, 3) for r in ratios],
+        "throughput_retained_spread_pct": round(ratio_spread, 2),
+        "wire_faults_injected_total": injected,
+        "wire_crc_rejects_total": rejects,
+        "wire_retries_total": retries,
+        # Zero corrupt frames accepted is a codec property; the
+        # referee is every storm stream byte-identical to the oracle.
+        "corrupt_frames_accepted": 0 if exact else None,
+        "streams_token_exact": exact,
+    }
+
+
+def _ctrlplane_recovery_leg(model, variables, args,
+                            repeats: int) -> dict:
+    """Router WAL crash + recover: wall time from ``recover()`` until
+    every revived stream moved PAST its mirrored length (the streams
+    are serving again), plus full-stream token-exactness."""
+    from pddl_tpu.serve.fleet import (
+        FleetRouter,
+        LocalReplica,
+        RouterJournal,
+    )
+
+    def factory():
+        return ServeEngine(model, variables, max_slots=4,
+                           prefill_len=32, max_queue_depth=96,
+                           prefix_cache_blocks=0)
+
+    def replicas():
+        return [LocalReplica(i, factory) for i in range(2)]
+
+    new_tokens = 32
+    recovery_all, revived_all = [], []
+    exact = True
+    recompile_free = True
+    for rep in range(repeats):
+        d = tempfile.mkdtemp(prefix="pddl-ctrlplane-wal-")
+        try:
+            rng = np.random.default_rng(700 + rep)
+            prompts = [rng.integers(0, 64, size=12).tolist()
+                       for _ in range(12)]
+            refs = {tuple(p): _make_ref(model, variables, p, new_tokens)
+                    for p in prompts}
+            fleet = FleetRouter(replicas(), affinity_block_size=8,
+                                affinity_blocks=1, respawn=False,
+                                journal=RouterJournal(
+                                    d, fsync_batch_records=16))
+            for p in prompts:
+                fleet.submit(list(p), new_tokens)
+            for _ in range(10):  # mid-stream: mirrors partly populated
+                fleet.step()
+            # SIGKILL-equivalent: the router object is abandoned with
+            # its buffers unflushed; the WAL is all that survives.
+            t0 = time.perf_counter()
+            recovered, revived = FleetRouter.recover(
+                d, replicas(), affinity_block_size=8,
+                affinity_blocks=1, respawn=False)
+            at_recovery = {rid: len(fh.tokens)
+                           for rid, fh in revived.items()}
+            for _ in range(100000):
+                if not any(len(fh.tokens) <= at_recovery[rid]
+                           and not fh.done
+                           for rid, fh in revived.items()):
+                    break
+                recovered.step()
+            recovery_s = time.perf_counter() - t0
+            recovered.run(max_steps=100000)
+            for fh in revived.values():
+                if fh.state.value != "finished" or fh.tokens != refs[
+                        tuple(int(t) for t in fh.request.prompt)]:
+                    exact = False
+            counts = recovered.compile_counts()
+            if not counts or any(v != 1 for v in counts.values()):
+                recompile_free = False
+            recovered.close()
+            recovery_all.append(recovery_s)
+            revived_all.append(len(revived))
+            _log(f"ctrlplane recovery pair {rep}: {len(revived)} "
+                 f"streams resumed in {recovery_s:.3f}s")
+        finally:
+            shutil.rmtree(d, ignore_errors=True)
+    med, spread = median_spread(recovery_all)
+    return {
+        "kill": "router abandoned mid-stream with unflushed buffers "
+                "(WAL-only recovery), fresh replicas",
+        "recovery_s": round(med, 4),
+        "recovery_s_spread_pct": round(spread, 2),
+        "recovery_s_per_repeat": [round(r, 4) for r in recovery_all],
+        "streams_revived_per_repeat": revived_all,
+        "streams_token_exact": exact,
+        "zero_recompiles_recovered": recompile_free,
+    }
+
+
+def _make_ref(model, variables, prompt, n_new):
+    out = generate(model, variables,
+                   jnp.asarray(prompt, jnp.int32)[None], n_new)
+    return np.asarray(out)[0, len(prompt):].tolist()
+
+
+def _ctrlplane_hedge_leg(args, repeats: int) -> dict:
+    """Gray-replica hedging ON vs OFF under an injected slow WORKER
+    (real processes — the regime where a slow replica costs wall
+    time the router does not spend): interactive p99 TTFT for traffic
+    stuck to the suspect, paired per repeat."""
+    import subprocess
+
+    from pddl_tpu.serve.fleet import (
+        FleetRouter,
+        GrayDetector,
+        ProcessReplica,
+    )
+
+    cfg = {**_ctrlplane_cfg(), "slots": 2}
+    n_interactive = 8
+    delay_s = 0.03
+
+    def run_once(hedge: bool, seed: int):
+        reps = [ProcessReplica(i, {**cfg, "replica_id": i},
+                               stderr=subprocess.DEVNULL,
+                               ping_interval_s=0.05, wait_ready=False)
+                for i in range(2)]
+        for r in reps:
+            r.wait_ready()
+        fleet = FleetRouter(
+            reps, affinity_block_size=8, affinity_blocks=1,
+            respawn=False,
+            gray=GrayDetector(window=8, baseline=16, z_threshold=4.0,
+                              min_excess_s=0.01, consecutive=2),
+            gray_hedge=hedge, gray_drain=False)
+        try:
+            # Session-pin traffic to one replica; give the detector a
+            # clean-speed baseline from its self-reported tick walls.
+            pin = fleet.submit(list(range(1, 9)), 96, session="s",
+                               priority=Priority.BATCH)
+            victim = pin.replica_id
+            t_end = time.perf_counter() + 1.5
+            while time.perf_counter() < t_end:
+                fleet.step()
+            # Now make the worker GRAY (every tick +30 ms) and keep
+            # its two slots saturated with long batch streams.
+            victim_slot = next(s for s in fleet.replicas
+                               if s.replica_id == victim)
+            victim_slot.driver.set_tick_delay(delay_s)
+            busy = [fleet.submit(list(range(2, 10)), 96, session="s",
+                                 priority=Priority.BATCH)
+                    for _ in range(2)]
+            deadline = time.perf_counter() + 30
+            while victim not in fleet.gray.suspected \
+                    and time.perf_counter() < deadline:
+                fleet.step()
+            assert victim in fleet.gray.suspected, \
+                "suspicion never fired"
+            rng = np.random.default_rng(seed)
+            ttfts = []
+            for _ in range(n_interactive):
+                p = rng.integers(0, cfg["vocab"], size=10).tolist()
+                h = fleet.submit(p, 4, session="s")
+                hang = time.perf_counter() + 120
+                while not h.done and time.perf_counter() < hang:
+                    fleet.step()
+                assert h.done and h.ttft_s is not None
+                ttfts.append(h.ttft_s)
+            del busy  # batch streams need not finish: the leg
+            #           measures the interactive tail, not them
+            wins = fleet.metrics.hedge_wins
+            counts = fleet.compile_counts()
+            ok = bool(counts) and all(v == 1 for v in counts.values())
+            return float(np.percentile(ttfts, 99)), wins, ok
+        finally:
+            fleet.close()
+
+    ratios, on_all, off_all = [], [], []
+    wins_total = 0
+    recompile_free = True
+    for rep in range(repeats):
+        p99_off, _, ok_off = run_once(False, 800 + rep)
+        p99_on, wins, ok_on = run_once(True, 800 + rep)
+        wins_total += wins
+        recompile_free = recompile_free and ok_off and ok_on
+        on_all.append(p99_on)
+        off_all.append(p99_off)
+        ratios.append(p99_off / p99_on)
+        _log(f"ctrlplane hedge pair {rep}: p99 TTFT {p99_off:.4f}s "
+             f"-> {p99_on:.4f}s ({ratios[-1]:.2f}x, {wins} wins)")
+    ratio_med, ratio_spread = median_spread(ratios)
+    return {
+        "slow_replica": f"worker tick delay {delay_s * 1000:.0f} ms "
+                        "(set_tick_delay), detector-suspected from "
+                        "self-reported tick walls before measuring",
+        "interactive_requests_per_wave": n_interactive,
+        "ttft_p99_hedge_off_s": round(median_spread(off_all)[0], 4),
+        "ttft_p99_hedge_on_s": round(median_spread(on_all)[0], 4),
+        "hedged_ttft_p99_reduction_x": round(ratio_med, 3),
+        "hedged_ttft_reduction_per_pair": [round(r, 3) for r in ratios],
+        "hedged_ttft_reduction_spread_pct": round(ratio_spread, 2),
+        "hedge_wins_total": wins_total,
+        "all_pairs_directional": all(r > 1.0 for r in ratios),
+        "zero_recompiles": recompile_free,
+    }
+
+
+def _ctrlplane_leg(args) -> dict:
+    repeats = max(args.repeats, 5)
+    cfg = _ctrlplane_cfg()
+    model = GPT(vocab_size=cfg["vocab"], max_len=cfg["max_len"],
+                embed_dim=cfg["embed_dim"], depth=cfg["depth"],
+                num_heads=cfg["heads"], attention="reference")
+    dummy = jnp.ones((1, 16), jnp.int32)
+    params = model.init(jax.random.key(0), dummy,
+                        train=False)["params"]
+    variables = {"params": params}
+    wire = _ctrlplane_wire_leg(args, repeats)
+    recovery = _ctrlplane_recovery_leg(model, variables, args, repeats)
+    hedge = _ctrlplane_hedge_leg(args, repeats)
+    return {"wire": wire, "recovery": recovery, "hedge": hedge}
+
+
 def main() -> None:
     p = argparse.ArgumentParser()
     p.add_argument("--vocab", type=int, default=256)
@@ -2206,8 +2556,58 @@ def main() -> None:
                         "must FINISH to qualify as the best-static "
                         "baseline (and the autoscaled fleet is held "
                         "to the same bar)")
+    p.add_argument("--ctrlplane-only", action="store_true",
+                   help="run ONLY the control-plane durability leg "
+                        "(framed-transport wire storm, router WAL "
+                        "crash recovery, gray-replica hedging; "
+                        "ISSUE 14) and write a standalone artifact "
+                        "(r19_serve_ctrlplane.json)")
     p.add_argument("--out", default="")
     args = p.parse_args()
+
+    if args.ctrlplane_only:
+        repeats = max(args.repeats, 5)
+        _log(f"ctrlplane leg only: wire storm + WAL recovery + gray "
+             f"hedging, {repeats} paired runs each, gpt 2x64")
+        ctrl = _ctrlplane_leg(args)
+        record = {
+            "metric": "fleet_serving_ctrlplane_durability",
+            "unit": "ratio (storm/clean tok_s retained; hedge-off/on "
+                    "interactive p99 TTFT); seconds (WAL recovery)",
+            "config": {
+                "model": "gpt 2x64 (vocab 64, max_len 128)",
+                "process_replicas": 2,
+                "wire_fault_rate_per_frame": 0.01,
+                "transport": "PF1 length+CRC32+seq framing, dup "
+                             "suppression, gap detection, bounded "
+                             "resend (serve/fleet/transport.py)",
+                "journal": "CRC-framed fsync-batched router WAL, "
+                           "checkpoint+rotate cycle, mirror-replay "
+                           "recovery (serve/fleet/journal.py)",
+                "gray": "self-baseline latency-quantile detector, "
+                        "first-result-wins interactive hedging "
+                        "(serve/fleet/health.py GrayDetector)",
+            },
+            "provenance": provenance(repeats),
+            "results": {"ctrlplane": ctrl},
+            "device": jax.devices()[0].device_kind,
+        }
+        wire, rec, hedge = (ctrl["wire"], ctrl["recovery"],
+                            ctrl["hedge"])
+        _log(f"ctrlplane: wire retained "
+             f"{wire['throughput_retained_x']}x at 1% frame faults "
+             f"({wire['wire_crc_rejects_total']} CRC rejects, "
+             f"{wire['wire_retries_total']} retries, token-exact "
+             f"{wire['streams_token_exact']}); recovery "
+             f"{rec['recovery_s']}s median "
+             f"({rec['streams_revived_per_repeat']} streams, "
+             f"token-exact {rec['streams_token_exact']}); hedging cut "
+             f"interactive p99 TTFT {hedge['ttft_p99_hedge_off_s']}s "
+             f"-> {hedge['ttft_p99_hedge_on_s']}s "
+             f"({hedge['hedged_ttft_p99_reduction_x']}x, "
+             f"{hedge['hedge_wins_total']} hedge wins)")
+        _write_record(record, args.out)
+        return
 
     if args.autoscale_only:
         _log(f"autoscale leg only: diurnal "

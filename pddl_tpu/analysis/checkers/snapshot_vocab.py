@@ -1,19 +1,24 @@
 """Rule ``snapshot-hygiene``: wire-format keys may only change with a
-``SNAPSHOT_VERSION`` bump, and bench-artifact headline keys must have
-a direction in the ``bench_artifact`` vocabulary.
+version bump, and bench-artifact headline keys must have a direction
+in the ``bench_artifact`` vocabulary.
 
-**(a) drain-snapshot entry keys.** ``serve/drain.py`` owns the
-serving snapshot wire format; r12 (priority), r13 (block tables) and
-r14 (adapter/constraint) each changed the entry shape WITH a version
-bump plus forward/backward-compat pins. The failure mode this rule
-closes: a key added or renamed without the bump — every restoring
-engine happily reads the versioned header, then mis-decodes the
-entries. Mechanism: the module must carry a literal manifest named
+**(a) versioned wire manifests.** ``serve/drain.py`` owns the serving
+snapshot wire format; r12 (priority), r13 (block tables) and r14
+(adapter/constraint) each changed the entry shape WITH a version bump
+plus forward/backward-compat pins. The failure mode this rule closes:
+a key added or renamed without the bump — every restoring engine
+happily reads the versioned header, then mis-decodes the entries.
+Mechanism: the module must carry a literal manifest named
 ``ENTRY_KEYS_V{SNAPSHOT_VERSION}`` matching the keys its encode
 functions actually emit (dict-literal keys plus ``entry["k"] = ...``
 stores in ``encode*``-named functions). Changing the encoder without
 updating the manifest fails; updating the manifest forces its name —
-and therefore ``SNAPSHOT_VERSION`` — through review.
+and therefore ``SNAPSHOT_VERSION`` — through review. The SAME
+discipline covers the control-plane WAL (ISSUE 14):
+``serve/fleet/journal.py`` carries ``RECORD_KEYS_V{JOURNAL_VERSION}``
+pinned against its record encoders — a record-shape change without a
+``JOURNAL_VERSION`` bump fails the tree, because a recovering router
+mis-decoding its own log is the quietest way to lose requests.
 
 **(b) bench-artifact direction vocabulary.** The perf gate
 (``utils/bench_artifact.compare``) only guards keys it can assign a
@@ -44,13 +49,21 @@ from pddl_tpu.analysis.core import (
 )
 
 BENCH_VOCAB_SUFFIX = "pddl_tpu/utils/bench_artifact.py"
-_MANIFEST_RE = re.compile(r"^ENTRY_KEYS_V(\d+)$")
 _HEADLINE_RE = re.compile(r"(_x$|tok_s$|tokens_per_s$)")
+
+# The versioned-manifest families this rule enforces: (version
+# constant, manifest prefix). serve/drain.py carries SNAPSHOT_VERSION
+# + ENTRY_KEYS_V<n>; serve/fleet/journal.py carries JOURNAL_VERSION +
+# RECORD_KEYS_V<n> (ISSUE 14) — one mechanism, two wire formats.
+_MANIFEST_FAMILIES = (
+    ("SNAPSHOT_VERSION", "ENTRY_KEYS_V"),
+    ("JOURNAL_VERSION", "RECORD_KEYS_V"),
+)
 
 
 class SnapshotHygieneRule(Rule):
     name = "snapshot-hygiene"
-    doc = ("snapshot wire keys change only with a SNAPSHOT_VERSION "
+    doc = ("snapshot/journal wire keys change only with a version "
            "bump; artifact headline keys need a gate direction")
 
     def __init__(self, artifacts_root: Optional[str] = None):
@@ -59,11 +72,15 @@ class SnapshotHygieneRule(Rule):
 
     def run(self, project: Project) -> Iterable:
         for module in project.modules:
-            yield from self._check_manifest(module)
+            for version_name, prefix in _MANIFEST_FAMILIES:
+                yield from self._check_manifest(module, version_name,
+                                                prefix)
         yield from self._check_artifacts(project)
 
     # ----------------------------------------------- entry manifests
-    def _check_manifest(self, module: Module) -> Iterable:
+    def _check_manifest(self, module: Module, version_name: str,
+                        prefix: str) -> Iterable:
+        manifest_re = re.compile("^" + re.escape(prefix) + r"(\d+)$")
         version: Optional[Tuple[int, int]] = None    # (value, line)
         manifests: List[Tuple[int, List[str], int]] = []  # (v, keys, line)
         for node in module.tree.body:
@@ -72,11 +89,11 @@ class SnapshotHygieneRule(Rule):
             for target in node.targets:
                 if not isinstance(target, ast.Name):
                     continue
-                if target.id == "SNAPSHOT_VERSION" \
+                if target.id == version_name \
                         and isinstance(node.value, ast.Constant) \
                         and isinstance(node.value.value, int):
                     version = (node.value.value, node.lineno)
-                m = _MANIFEST_RE.match(target.id)
+                m = manifest_re.match(target.id)
                 if m:
                     keys = const_str_tuple(node.value)
                     if keys is not None:
@@ -89,9 +106,9 @@ class SnapshotHygieneRule(Rule):
         if not current:
             yield self.finding(
                 module, vline,
-                f"SNAPSHOT_VERSION is {vnum} but no ENTRY_KEYS_V{vnum} "
+                f"{version_name} is {vnum} but no {prefix}{vnum} "
                 "manifest exists — the wire format is unreviewable; "
-                "declare the entry-key manifest next to the version")
+                "declare the key manifest next to the version")
             return
         _, declared, mline = current[0]
         # A helper named encode_<key> for a DECLARED entry key is a
@@ -113,10 +130,10 @@ class SnapshotHygieneRule(Rule):
                 detail.append(f"manifest declares unemitted {removed}")
             yield self.finding(
                 module, mline,
-                "snapshot entry keys changed without a SNAPSHOT_VERSION "
-                f"bump: {'; '.join(detail)} — bump the version, rename "
-                f"the manifest to ENTRY_KEYS_V{vnum + 1}, and extend "
-                "the compat pins")
+                f"wire keys changed without a {version_name} bump: "
+                f"{'; '.join(detail)} — bump the version, rename the "
+                f"manifest to {prefix}{vnum + 1}, and extend the "
+                "compat pins")
 
     @staticmethod
     def _encoded_keys(tree: ast.AST,

@@ -379,6 +379,14 @@ FLEET_COUNTER_KEYS = frozenset({
     # had it in HBM), and replica-to-replica chain pulls — the
     # duplicate-prefill eliminator — with the tokens they moved.
     "routed_host_tier", "chain_pulls", "chain_pull_tokens",
+    # Control-plane durability & gray failure (ISSUE 14): interactive
+    # hedges launched off suspected-gray replicas / won by the hedge
+    # copy / duplicate copies cancelled, suspects proactively retired
+    # through the scale_down migration path, and the framed
+    # transport's resend rounds + CRC/length rejects aggregated from
+    # every process replica's wire stats.
+    "hedges_launched", "hedge_wins", "hedge_cancelled", "gray_drains",
+    "wire_retries", "wire_crc_rejects",
 })
 
 
@@ -414,6 +422,18 @@ def fleet_exposition(router, autoscaler=None) -> str:
                          "tokens_streamed_"))}
     snap["replicas"] = len(router.replicas)
     snap["replicas_healthy"] = router.healthy_replicas
+    # Control-plane durability gauges (ISSUE 14). Present even when
+    # the subsystem is unarmed — None renders NaN, the same
+    # present-but-unobserved philosophy as every other gauge, so a
+    # dashboard can tell "journal off" from "metric vanished".
+    journal = getattr(router, "journal", None)
+    snap["journal_bytes"] = (journal.wal_bytes
+                             if journal is not None else None)
+    snap["journal_lag_records"] = (journal.records_since_checkpoint
+                                   if journal is not None else None)
+    gray = getattr(router, "gray", None)
+    snap["replicas_suspected_gray"] = (len(gray.suspected)
+                                       if gray is not None else None)
     if router.admission is not None:
         # The ladder rung as a gauge: 0 NORMAL … 3 REJECT_COLD. The
         # runbook's first stop during an overload page.

@@ -17,8 +17,12 @@ ahead of the brownout ladder, scale-down by zero-loss live migration),
 `tracegen.py` the seeded scenario-diversity trace generator (diurnal
 curve, heavy-tail session mix, tenant popularity skew), and `replay.py`
 the hint-honoring open-loop replay client that meters
-goodput-per-replica-hour. See `docs/OPERATIONS.md` § "Fleet runbook",
-§ "Overload & brownout" and § "Autoscaling runbook", and
+goodput-per-replica-hour, `journal.py` the control-plane WAL that
+makes the ROUTER itself crash-recoverable (``FleetRouter.recover``),
+and `transport.py` the CRC-framed, sequence-checked, fault-injectable
+pipe protocol between :class:`ProcessReplica` and `worker.py`. See
+`docs/OPERATIONS.md` § "Fleet runbook", § "Overload & brownout",
+§ "Autoscaling runbook" and § "Control-plane failure & recovery", and
 `docs/SERVING.md` § "Serving fleet".
 """
 
@@ -34,7 +38,12 @@ from pddl_tpu.serve.fleet.autoscaler import (
     FleetAutoscaler,
     ScaleDecision,
 )
-from pddl_tpu.serve.fleet.health import BreakerState, CircuitBreaker
+from pddl_tpu.serve.fleet.health import (
+    BreakerState,
+    CircuitBreaker,
+    GrayDetector,
+)
+from pddl_tpu.serve.fleet.journal import RouterJournal
 from pddl_tpu.serve.fleet.replay import ReplayReport, replay_trace
 from pddl_tpu.serve.fleet.replica import (
     LocalReplica,
@@ -50,6 +59,13 @@ from pddl_tpu.serve.fleet.router import (
     ReplicaLifecycle,
 )
 from pddl_tpu.serve.fleet.tracegen import diurnal_trace
+from pddl_tpu.serve.fleet.transport import (
+    FrameReceiver,
+    FrameSender,
+    WireFaultKind,
+    WireFaultPlan,
+    WireFaultSpec,
+)
 
 __all__ = [
     "AdmissionControl",
@@ -62,6 +78,9 @@ __all__ = [
     "FleetHandle",
     "FleetMetrics",
     "FleetRouter",
+    "FrameReceiver",
+    "FrameSender",
+    "GrayDetector",
     "LocalReplica",
     "NoHealthyReplica",
     "OverloadDetector",
@@ -70,8 +89,12 @@ __all__ = [
     "ReplicaDied",
     "ReplicaLifecycle",
     "ReplicaSpawnTimeout",
+    "RouterJournal",
     "ScaleDecision",
     "TokenBucket",
+    "WireFaultKind",
+    "WireFaultPlan",
+    "WireFaultSpec",
     "diurnal_trace",
     "replay_trace",
 ]

@@ -25,12 +25,26 @@ serving tier — a local replica "beats" by completing a step, a process
 replica by answering pipe pings — and a beat older than
 ``heartbeat_timeout_s`` counts as a failure exactly like an explicit
 error does.
+
+The breaker answers DEAD-or-alive; Gray Failure (Huang et al.,
+HotOS '17) argues the component that actually takes production down is
+the one that is neither — alive enough to pass every ping, degraded
+enough to drag every request it touches. :class:`GrayDetector` is the
+second detector for exactly that differential: a per-replica latency-
+quantile drift monitor (recent p95 vs the replica's own established
+baseline, a z-score band with a consecutive-strike debounce) whose
+SUSPECTED verdict the router acts on PROACTIVELY — hedging interactive
+submissions to a healthy sibling and draining the suspect through the
+r16 ``scale_down`` live-migration path before it hard-fails — instead
+of waiting for the breaker's threshold that a gray replica, by
+definition, never trips.
 """
 
 from __future__ import annotations
 
 import enum
-from typing import Callable, Optional
+from collections import deque
+from typing import Callable, Deque, Dict, Optional, Set
 
 
 class BreakerState(enum.Enum):
@@ -125,3 +139,118 @@ class CircuitBreaker:
         self.open_until_s = now_s + self._backoff_s
         self._backoff_s = min(self._backoff_s * 2.0, self.backoff_max_s)
         self._to(BreakerState.OPEN)
+
+
+class GrayDetector:
+    """Latency-quantile degradation detector: per-replica per-tick
+    wall samples, recent-window p95 judged against the SAME replica's
+    own baseline window via a z-score band, with a consecutive-strike
+    debounce. Self-relative on purpose — a fleet-relative comparison
+    degenerates at N=2 (the slow replica drags the fleet statistic
+    with it), while "this replica drifted from what it used to be" is
+    the gray-failure differential itself.
+
+    Pure host-side state, no clock: feed it samples, read
+    :attr:`suspected`. The router observes each replica's ``step()``
+    wall per round — LATENCY faults in a replica's engine (the
+    `utils/faults.py` taxonomy) surface there, which is what makes a
+    gray replica injectable in tier-1.
+
+    Args:
+      window: recent samples whose p95 is judged.
+      baseline: older samples forming the replica's own baseline
+        (mean/std). Judging starts once a replica has
+        ``window + baseline`` samples — before that it is unknown,
+        never suspected.
+      z_threshold: strikes accrue while
+        ``(recent_p95 - baseline_mean) / baseline_std`` exceeds this.
+      min_excess_s: absolute drift floor — the band also requires
+        ``recent_p95 >= baseline_mean + min_excess_s``, so a replica
+        with a near-zero-variance baseline (std ~ 0 makes any wiggle
+        an infinite z) is not condemned over microseconds.
+      consecutive: strikes in a row before SUSPECTED (debounce), and
+        symmetrically the in-band samples in a row that CLEAR it.
+        While suspected the baseline is FROZEN — otherwise a
+        persistently slow replica would launder its own degradation
+        into the sliding baseline and absolve itself; recovery means
+        returning to the band of what it USED to be, after which its
+        history restarts fresh.
+    """
+
+    def __init__(self, *, window: int = 16, baseline: int = 32,
+                 z_threshold: float = 4.0, min_excess_s: float = 0.0,
+                 consecutive: int = 3):
+        if window < 4 or baseline < 4:
+            raise ValueError(
+                f"need window >= 4 and baseline >= 4, got "
+                f"{window}/{baseline}")
+        if consecutive < 1:
+            raise ValueError(
+                f"consecutive must be >= 1, got {consecutive}")
+        self.window = int(window)
+        self.baseline = int(baseline)
+        self.z_threshold = float(z_threshold)
+        self.min_excess_s = float(min_excess_s)
+        self.consecutive = int(consecutive)
+        self._samples: Dict[int, Deque[float]] = {}
+        self._strikes: Dict[int, int] = {}
+        self._recovery: Dict[int, int] = {}
+        # rid -> (baseline_mean, baseline_std) frozen at suspicion.
+        self._frozen: Dict[int, tuple] = {}
+        self.suspected: Set[int] = set()
+
+    def observe(self, replica_id: int, seconds: float) -> None:
+        """One per-tick wall sample; re-judges the replica when enough
+        history exists."""
+        rid = int(replica_id)
+        seconds = float(seconds)
+        if rid in self.suspected:
+            # Frozen baseline: the sample itself must return to the
+            # band of what the replica USED to be, `consecutive` times
+            # in a row, to clear suspicion — then history restarts.
+            mean, std = self._frozen[rid]
+            band = mean + max(self.min_excess_s,
+                              self.z_threshold * (std + 1e-9))
+            if seconds <= band:
+                self._recovery[rid] = self._recovery.get(rid, 0) + 1
+                if self._recovery[rid] >= self.consecutive:
+                    self.forget(rid)
+            else:
+                self._recovery[rid] = 0
+            return
+        dq = self._samples.setdefault(
+            rid, deque(maxlen=self.window + self.baseline))
+        dq.append(seconds)
+        if len(dq) < self.window + self.baseline:
+            return
+        samples = list(dq)
+        base = samples[:self.baseline]
+        recent = sorted(samples[self.baseline:])
+        p95 = recent[min(len(recent) - 1,
+                         int(0.95 * (len(recent) - 1) + 0.5))]
+        mean = sum(base) / len(base)
+        var = sum((x - mean) ** 2 for x in base) / len(base)
+        std = var ** 0.5
+        z = (p95 - mean) / (std + 1e-9)
+        if z > self.z_threshold and p95 >= mean + self.min_excess_s:
+            self._strikes[rid] = self._strikes.get(rid, 0) + 1
+            if self._strikes[rid] >= self.consecutive:
+                self.suspected.add(rid)
+                self._frozen[rid] = (mean, std)
+                self._recovery[rid] = 0
+        else:
+            self._strikes[rid] = 0
+
+    def forget(self, replica_id: int) -> None:
+        """Drop a replica's history and suspicion (death, retirement,
+        respawn, recovery — a fresh process re-earns a fresh
+        baseline)."""
+        rid = int(replica_id)
+        self._samples.pop(rid, None)
+        self._strikes.pop(rid, None)
+        self._recovery.pop(rid, None)
+        self._frozen.pop(rid, None)
+        self.suspected.discard(rid)
+
+    def is_suspected(self, replica_id: int) -> bool:
+        return int(replica_id) in self.suspected

@@ -1,0 +1,429 @@
+"""Router write-ahead log: the control plane becomes crash-safe.
+
+Every fault the fleet survives — device errors (r08/r10), replica
+death (r11), overload (r12), kill-mid-migration (r16/r18) — assumed
+the :class:`~pddl_tpu.serve.fleet.router.FleetRouter` process itself
+is immortal. This module removes that assumption with the CheckFreq
+discipline (Mohan et al., FAST '21) the training side already applies
+to its checkpoints (r10): journal first, verify on read, restore from
+the newest VERIFIED state.
+
+**The WAL.** :class:`RouterJournal` appends one CRC-framed binary
+record per control-plane event to ``wal.log``:
+
+- ``admit`` — a request entered the fleet (the full replayable
+  request: prompt, budget, sampling, priority, tenant fields,
+  session). Durable (fsync) BEFORE the caller's handle returns: an
+  acked admission survives a router SIGKILL, an unacked one was never
+  promised.
+- ``route`` — the rid -> replica binding (admission, migration, and
+  hedge bindings alike — the ``HandleLedger`` assignment journaled).
+- ``tokens`` — the emitted-token mirror delta. fsync-BATCHED: losing
+  a tail of token records is safe by construction, because the
+  mirror-replay contract (r08 -> r11) regenerates the identical
+  tokens from (params, prompt, tokens-so-far).
+- ``finish`` — the stream settled (with state/reason); recovery
+  replays admits minus finishes.
+
+Record framing on disk is ``magic | seq | length | crc32 | payload``;
+a torn tail (the record a SIGKILL cut mid-write) fails its CRC or
+length check and everything from the first unreadable record on is
+discarded — exactly the readable prefix is recovered, which is what
+"crash-exact" means for a log.
+
+**Checkpoint + truncate.** The WAL cannot grow forever; every
+``checkpoint_every_records`` appends the router snapshots its live
+mirrors — riding the `serve/drain.py` entry encoder, the SAME wire
+format migration uses — into ``checkpoint.json`` (tmp + fsync +
+atomic rename, with an embedded whole-file CRC), demotes the previous
+checkpoint to ``checkpoint.prev.json``, and rotates the WAL segment
+(``wal.log`` -> ``wal.prev.log``; the segment before THAT is the only
+thing deleted — it is covered by two generations of checkpoint).
+Every record carries a monotone ``seq`` and the checkpoint stores the
+last seq it covers, so a crash anywhere in the cycle replays nothing
+twice. A checkpoint that fails its CRC on read (torn by a crash
+mid-cycle, bit-rotted later) falls back to the previous verified one
+PLUS the rotated segment that checkpoint still covers — the r10
+newest-VERIFIED discipline, with no window where corruption loses
+acknowledged state.
+
+**Recovery.** :func:`read_state` folds checkpoint + WAL tail into
+``{rid: entry}`` drain-format wire entries for every in-flight
+stream; :meth:`~pddl_tpu.serve.fleet.router.FleetRouter.recover`
+builds a fresh router over fresh/re-spawned replicas and re-enters
+them through the r11 mirror-replay path — token-exact, zero special
+cases, because router death is now just the snapshot path's second
+"normal case".
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import struct
+import zlib
+from typing import Dict, List, Optional, Tuple
+
+from pddl_tpu.serve import drain as drain_io
+
+# Version 1: the initial control-plane WAL (ISSUE 14). Bumping the
+# record shape requires bumping this AND renaming RECORD_KEYS_V1 —
+# graftlint's snapshot-hygiene rule machine-checks the pairing, the
+# same discipline `serve/drain.py` carries for its snapshot entries.
+JOURNAL_VERSION = 1
+_READABLE_JOURNAL_VERSIONS = frozenset({1})
+
+# Machine-checked wire manifest (graftlint `snapshot-hygiene`): the
+# exact record keys the encode_* functions below emit at the CURRENT
+# journal version. Changing a record shape requires bumping
+# JOURNAL_VERSION and renaming this tuple to RECORD_KEYS_V<new> in the
+# same commit — the static checker fails the tree otherwise.
+RECORD_KEYS_V1 = ("rec", "rid", "prompt", "max_new_tokens", "sampling",
+                  "deadline_s", "priority", "adapter", "constraint",
+                  "session", "replica", "via", "toks", "state", "reason")
+
+_HEADER = struct.Struct(">4sQII")  # magic, seq, payload len, crc32
+_MAGIC = b"PJL1"
+
+
+def encode_admit(rid: int, request, session: Optional[str]) -> Dict:
+    """The admission record: everything replay needs to re-enter the
+    request from zero (the drain entry's request half, rid-tagged)."""
+    return {
+        "rec": "admit",
+        "rid": int(rid),
+        "prompt": [int(t) for t in request.prompt],
+        "max_new_tokens": int(request.max_new_tokens),
+        "sampling": drain_io.encode_sampling(request.sampling),
+        "deadline_s": (float(request.deadline_s)
+                       if request.deadline_s is not None else None),
+        "priority": request.priority.value,
+        "adapter": (str(request.adapter)
+                    if request.adapter is not None else None),
+        "constraint": request.constraint,
+        "session": session,
+    }
+
+
+def encode_route(rid: int, replica_id: int, via: str) -> Dict:
+    """The rid -> replica binding (``via``: the routing label, or
+    ``migration``/``hedge`` for re-binds)."""
+    return {"rec": "route", "rid": int(rid), "replica": int(replica_id),
+            "via": str(via)}
+
+
+def encode_tokens(rid: int, toks: List[int]) -> Dict:
+    """The emitted-token mirror delta (fsync-batched; safe to lose —
+    replay regenerates)."""
+    return {"rec": "tokens", "rid": int(rid),
+            "toks": [int(t) for t in toks]}
+
+
+def encode_finish(rid: int, state: str, reason: Optional[str]) -> Dict:
+    return {"rec": "finish", "rid": int(rid), "state": str(state),
+            "reason": reason}
+
+
+class RouterJournal:
+    """Append-only, CRC-framed, fsync-batched control-plane WAL with an
+    atomic checkpoint+truncate cycle.
+
+    Args:
+      journal_dir: directory holding ``wal.log`` / ``checkpoint.json``
+        / ``checkpoint.prev.json``. Created if absent; an existing
+        WAL/checkpoint is picked up (the recovery path) and appends
+        continue after the readable prefix.
+      fsync_batch_records: buffered (non-durable) appends are flushed
+        on every :meth:`tick` and fsynced once this many records are
+        pending — the token-delta batching knob. ``1`` fsyncs every
+        record (chaos tests wanting exact durability).
+      checkpoint_every_records: :attr:`checkpoint_due` turns True after
+        this many appended records since the last checkpoint; the
+        router runs the cycle from its step loop.
+    """
+
+    def __init__(self, journal_dir: str, *,
+                 fsync_batch_records: int = 64,
+                 checkpoint_every_records: int = 4096):
+        self.dir = journal_dir
+        os.makedirs(journal_dir, exist_ok=True)
+        self.wal_path = os.path.join(journal_dir, "wal.log")
+        self.wal_prev_path = os.path.join(journal_dir, "wal.prev.log")
+        self.checkpoint_path = os.path.join(journal_dir,
+                                            "checkpoint.json")
+        self.checkpoint_prev_path = os.path.join(journal_dir,
+                                                 "checkpoint.prev.json")
+        self._fsync_batch = max(1, int(fsync_batch_records))
+        self._checkpoint_every = max(1, int(checkpoint_every_records))
+        # Continue the seq line past whatever is already durable — and
+        # TRUNCATE the torn tail first: appending after unreadable
+        # bytes would put every later record (fsynced admits included)
+        # beyond the readable prefix recovery stops at.
+        last_seq = self._scan_last_seq()
+        self._next_seq = last_seq + 1
+        prefix = _readable_prefix_len(self.wal_path)
+        if prefix is not None:
+            with open(self.wal_path, "r+b") as f:
+                f.truncate(prefix)
+        self._fd = os.open(self.wal_path,
+                           os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
+        self._pending = 0          # appended but not yet fsynced
+        self._buffer: List[bytes] = []
+        self.records_since_checkpoint = 0
+        self.records_appended = 0
+        self.fsyncs = 0
+        self._closed = False
+
+    # ------------------------------------------------------------- append
+    def append(self, record: Dict, *, durable: bool = False) -> int:
+        """Frame + buffer one record; ``durable=True`` flushes AND
+        fsyncs before returning (the admit contract). Returns the
+        record's seq."""
+        if self._closed:
+            raise ValueError("journal is closed")
+        seq = self._next_seq
+        self._next_seq += 1
+        payload = json.dumps(record, separators=(",", ":")).encode()
+        frame = _HEADER.pack(_MAGIC, seq, len(payload),
+                             zlib.crc32(payload) & 0xFFFFFFFF) + payload
+        self._buffer.append(frame)
+        self._pending += 1
+        self.records_appended += 1
+        self.records_since_checkpoint += 1
+        if durable or self._pending >= self._fsync_batch:
+            self.commit()
+        return seq
+
+    def _flush(self, fsync: bool) -> None:
+        if self._buffer:
+            data = b"".join(self._buffer)
+            self._buffer = []
+            while data:
+                n = os.write(self._fd, data)
+                data = data[n:]
+        if fsync and self._pending:
+            os.fsync(self._fd)
+            self.fsyncs += 1
+            self._pending = 0
+
+    def commit(self) -> None:
+        """Flush the buffer and fsync — everything appended so far is
+        durable when this returns."""
+        self._flush(fsync=True)
+
+    def tick(self) -> None:
+        """The step-cadence flush: write buffered frames to the OS (so
+        a mere router restart loses nothing) but only fsync when the
+        batch threshold says so — the fsync-batching contract."""
+        self._flush(fsync=self._pending >= self._fsync_batch)
+
+    @property
+    def checkpoint_due(self) -> bool:
+        return self.records_since_checkpoint >= self._checkpoint_every
+
+    @property
+    def wal_bytes(self) -> int:
+        try:
+            return os.fstat(self._fd).st_size
+        except OSError:
+            return 0
+
+    # --------------------------------------------------------- checkpoint
+    def checkpoint(self, entries: List[Tuple[int, Dict]],
+                   next_rid: int) -> None:
+        """The atomic checkpoint+truncate cycle: snapshot the live
+        rid-tagged mirrors (drain-format entries — the encoder
+        migration already rides), make it durable and verified, THEN
+        truncate the WAL. Crash anywhere inside: recovery still finds
+        either (new checkpoint, truncated-or-full WAL with seqs the
+        checkpoint covers marked) or (previous checkpoint, full WAL)."""
+        self.commit()  # the checkpoint covers everything appended
+        covered_seq = self._next_seq - 1
+        body = {
+            "version": JOURNAL_VERSION,
+            "snapshot_version": drain_io.SNAPSHOT_VERSION,
+            "covered_seq": covered_seq,
+            "next_rid": int(next_rid),
+            "requests": [[int(rid), entry] for rid, entry in entries],
+        }
+        blob = json.dumps(body, sort_keys=True,
+                          separators=(",", ":")).encode()
+        wrapped = {"crc": zlib.crc32(blob) & 0xFFFFFFFF,
+                   "body": body}
+        tmp = self.checkpoint_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(wrapped, f)
+            f.flush()
+            os.fsync(f.fileno())
+        if os.path.exists(self.checkpoint_path):
+            os.replace(self.checkpoint_path, self.checkpoint_prev_path)
+        os.replace(tmp, self.checkpoint_path)
+        # Rotate the WAL segment rather than truncating it: the
+        # segment this checkpoint covers stays on disk as
+        # wal.prev.log until the NEXT cycle retires it, so a
+        # checkpoint that later fails its CRC can still fall back to
+        # checkpoint.prev + this segment with nothing lost. seq keeps
+        # counting upward so the covered_seq skip-rule stays monotone
+        # across cycles.
+        os.close(self._fd)
+        if os.path.exists(self.wal_path):
+            os.replace(self.wal_path, self.wal_prev_path)
+        self._fd = os.open(self.wal_path,
+                           os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
+        self.records_since_checkpoint = 0
+
+    # --------------------------------------------------------------- read
+    def _scan_last_seq(self) -> int:
+        last = 0
+        for path in (self.wal_prev_path, self.wal_path):
+            for seq, _ in iter_wal_records(path):
+                last = max(last, seq)
+        cp = load_checkpoint(self.dir)
+        if cp is not None:
+            last = max(last, int(cp.get("covered_seq", 0)))
+        return last
+
+    def close(self) -> None:
+        if not self._closed:
+            self.commit()
+            os.close(self._fd)
+            self._closed = True
+
+
+def _readable_prefix_len(wal_path: str) -> Optional[int]:
+    """Byte length of the WAL's readable prefix (None when the file
+    does not exist). Everything past it is a torn tail appends must
+    not land behind."""
+    try:
+        with open(wal_path, "rb") as f:
+            data = f.read()
+    except OSError:
+        return None
+    end = 0
+    off = 0
+    while off + _HEADER.size <= len(data):
+        magic, _, length, crc = _HEADER.unpack_from(data, off)
+        if magic != _MAGIC:
+            break
+        start = off + _HEADER.size
+        stop = start + length
+        if stop > len(data) \
+                or (zlib.crc32(data[start:stop]) & 0xFFFFFFFF) != crc:
+            break
+        off = stop
+        end = off
+    return end
+
+
+def iter_wal_records(wal_path: str):
+    """``(seq, record)`` for the READABLE prefix of a WAL file: stops
+    at the first torn/corrupt frame (a record whose header, length, or
+    CRC does not verify) — everything after it is untrusted."""
+    try:
+        with open(wal_path, "rb") as f:
+            data = f.read()
+    except OSError:
+        return
+    off = 0
+    while off + _HEADER.size <= len(data):
+        magic, seq, length, crc = _HEADER.unpack_from(data, off)
+        if magic != _MAGIC:
+            return  # lost framing: discard the tail
+        start = off + _HEADER.size
+        end = start + length
+        if end > len(data):
+            return  # torn tail: the record a crash cut mid-write
+        payload = data[start:end]
+        if (zlib.crc32(payload) & 0xFFFFFFFF) != crc:
+            return  # corrupt record: nothing after it is trusted
+        try:
+            record = json.loads(payload)
+        except ValueError:
+            return
+        yield int(seq), record
+        off = end
+
+
+def load_checkpoint(journal_dir: str) -> Optional[Dict]:
+    """The newest VERIFIED checkpoint body (r10 discipline): the
+    current file if its embedded CRC verifies, else the previous one,
+    else None (recover from the WAL alone)."""
+    for name in ("checkpoint.json", "checkpoint.prev.json"):
+        path = os.path.join(journal_dir, name)
+        try:
+            with open(path) as f:
+                wrapped = json.load(f)
+        except (OSError, ValueError):
+            continue
+        body = wrapped.get("body")
+        if not isinstance(body, dict):
+            continue
+        blob = json.dumps(body, sort_keys=True,
+                          separators=(",", ":")).encode()
+        if (zlib.crc32(blob) & 0xFFFFFFFF) != wrapped.get("crc"):
+            continue  # torn mid-cycle: fall back to the previous one
+        if body.get("version") not in _READABLE_JOURNAL_VERSIONS:
+            raise ValueError(
+                f"router journal version {body.get('version')!r} "
+                f"unsupported (this build reads "
+                f"{sorted(_READABLE_JOURNAL_VERSIONS)})")
+        return body
+
+
+def read_state(journal_dir: str) -> Tuple[Dict[int, Dict], int]:
+    """Fold checkpoint + WAL tail into the recoverable control-plane
+    state: ``({rid: drain-format entry}, next_rid)`` for every stream
+    that was admitted and had not finished. Entries carry the mirrored
+    tokens, so the r11 replay path continues each stream token-exactly
+    from what the journal durably saw (tokens past the last fsync
+    replay to the identical values — they are a pure function of
+    (params, prompt, tokens-so-far))."""
+    entries: Dict[int, Dict] = {}
+    max_rid = -1
+    covered_seq = 0
+    cp = load_checkpoint(journal_dir)
+    if cp is not None:
+        covered_seq = int(cp.get("covered_seq", 0))
+        max_rid = int(cp.get("next_rid", 0)) - 1
+        for rid, entry in cp.get("requests", []):
+            entries[int(rid)] = dict(entry)
+    finished: set = set()
+    records: List[Tuple[int, Dict]] = []
+    # Both segments, rotated-first: seqs are monotone across rotation,
+    # and the covered_seq filter drops whatever the verified
+    # checkpoint already folded in — including the whole prev segment
+    # when the CURRENT checkpoint verified, and only its pre-prev
+    # prefix when recovery fell back a generation.
+    for name in ("wal.prev.log", "wal.log"):
+        records.extend(iter_wal_records(os.path.join(journal_dir, name)))
+    records.sort(key=lambda p: p[0])
+    for seq, record in records:
+        if seq <= covered_seq:
+            continue  # the checkpoint already folded this record in
+        kind = record.get("rec")
+        rid = int(record.get("rid", -1))
+        max_rid = max(max_rid, rid)
+        if kind == "admit":
+            entry = {k: record.get(k) for k in
+                     ("prompt", "max_new_tokens", "sampling",
+                      "deadline_s", "priority", "adapter",
+                      "constraint")}
+            entry["tokens"] = []
+            entry["elapsed_s"] = 0.0
+            entry["ttft_s"] = None
+            entry["session"] = record.get("session")
+            entries[rid] = entry
+        elif kind == "tokens" and rid in entries:
+            entries[rid]["tokens"] = (
+                list(entries[rid].get("tokens", []))
+                + [int(t) for t in record.get("toks", [])])
+        elif kind == "finish":
+            finished.add(rid)
+            entries.pop(rid, None)
+        # "route" records rebuild nothing here: recovery re-routes on
+        # the fresh fleet (the old bindings name dead processes), but
+        # they make the decision history auditable and are what a
+        # partial-failover forensic reads.
+    for rid in finished:
+        entries.pop(rid, None)
+    return entries, max_rid + 1

@@ -44,6 +44,13 @@ import time
 from typing import Dict, List, Optional, Tuple
 
 from pddl_tpu.serve import drain as drain_io
+from pddl_tpu.serve.fleet.transport import (
+    MAX_FRAME_BYTES,
+    FrameReceiver,
+    FrameSender,
+    decode_control,
+    encode_control,
+)
 from pddl_tpu.serve.request import (
     Priority,
     QueueFull,
@@ -268,13 +275,23 @@ class LocalReplica:
 class ProcessReplica:
     """A worker process replica (`fleet/worker.py`) over a stdio pipe.
 
-    The parent writes JSON-line commands to the child's stdin and reads
-    JSON-line events from its stdout (non-blocking, buffered); pings
-    answered with pongs are the heartbeat, and process exit / pipe EOF
-    surfaces as :class:`ReplicaDied` from whatever call noticed first.
-    ``kill()`` (SIGKILL) is the un-drainable hard death the chaos/bench
-    legs inject; ``terminate()`` (SIGTERM) lets the worker drain and
-    ship its snapshot back, which the router can migrate losslessly.
+    The parent writes commands to the child's stdin and reads events
+    from its stdout (non-blocking, buffered); pings answered with
+    pongs are the heartbeat, and process exit / pipe EOF surfaces as
+    :class:`ReplicaDied` from whatever call noticed first. ``kill()``
+    (SIGKILL) is the un-drainable hard death the chaos/bench legs
+    inject; ``terminate()`` (SIGTERM) lets the worker drain and ship
+    its snapshot back, which the router can migrate losslessly.
+
+    Since ISSUE 14 the pipe speaks the FRAMED protocol by default
+    (`fleet/transport.py`): length-prefix + CRC32 + monotone sequence
+    per direction, duplicate suppression, gap detection with bounded
+    resend requests, and a max-frame guard — the wire is untrusted,
+    and ``wire_fault_plan`` makes its failure modes injectable
+    (corrupt/truncate/duplicate/reorder/delay/drop at seeded frame
+    coordinates, applied on this side of the pipe in BOTH directions
+    so one seeded plan governs the whole link). ``transport="lines"``
+    keeps the r11 raw JSON-line protocol for A/B comparison.
     """
 
     can_respawn = True
@@ -282,10 +299,29 @@ class ProcessReplica:
     def __init__(self, replica_id: int, worker_config: Dict[str, object], *,
                  python: str = sys.executable, ready_timeout_s: float = 300.0,
                  ping_interval_s: float = 0.25, drain_timeout_s: float = 10.0,
-                 call_timeout_s: float = 30.0,
+                 call_timeout_s: float = 30.0, transport: str = "framed",
+                 wire_fault_plan=None,
+                 max_frame_bytes: int = MAX_FRAME_BYTES,
+                 resend_timeout_s: float = 0.25,
+                 max_resend_requests: int = 16,
                  clock=time.monotonic, stderr=None, wait_ready: bool = True):
+        if transport not in ("framed", "lines"):
+            raise ValueError(
+                f"transport must be 'framed' or 'lines', got "
+                f"{transport!r}")
         self.replica_id = int(replica_id)
+        self._framed = transport == "framed"
         self._config = dict(worker_config)
+        self._config["framed"] = self._framed
+        # Both pipe ends must enforce the SAME cap (an explicit
+        # worker_config value wins — the asymmetric-cap chaos tests
+        # use that): a worker with a larger cap would emit snapshot/
+        # chain frames this side terminally refuses.
+        self._config.setdefault("max_frame_bytes", int(max_frame_bytes))
+        self._plan = wire_fault_plan
+        self._max_frame = int(max_frame_bytes)
+        self._resend_timeout_s = float(resend_timeout_s)
+        self._max_resend_requests = int(max_resend_requests)
         self._python = python
         self._ready_timeout_s = float(ready_timeout_s)
         self._ping_interval_s = float(ping_interval_s)
@@ -327,9 +363,35 @@ class ProcessReplica:
         self._unanswered_ping_s: Optional[float] = None
         self._last_ping_s = 0.0
         self._degraded = False
+        # Framed-transport state, fresh per process: per-direction
+        # sender/receiver, the ingress frame counter (the fault plan's
+        # deterministic step coordinate on the "ev" site), and the
+        # bounded resend-request machinery.
+        self._sender = FrameSender()
+        self._receiver = FrameReceiver(max_frame_bytes=self._max_frame)
+        self._ev_frame_no = 0
+        self._oversize_dropping = False
+        self._resend_attempts = 0
+        self._next_resend_at = 0.0
+        self._wire_retries = 0
+        self._tick_walls: List[float] = []
         self.ready_compile_counts: Optional[Dict[str, int]] = None
         if wait_ready:
             self.wait_ready()
+
+    def wire_stats(self) -> Dict[str, int]:
+        """Transport counters for the router's FleetMetrics fold (and
+        the bench's zero-corrupt-frames-accepted referee): resend
+        rounds requested, frames the CRC/length check refused, dups
+        dropped, gaps seen, typed oversize rejects."""
+        s = self._receiver.stats
+        return {"retries": self._wire_retries,
+                "crc_rejects": s["crc_rejects"],
+                "dups": s["dups"], "gaps": s["gaps"],
+                "too_large": s["too_large"],
+                "frames_ok": s["frames_ok"],
+                "frames_sent": self._sender.frames_sent,
+                "frames_resent": self._sender.frames_resent}
 
     def wait_ready(self, timeout_s: Optional[float] = None) -> None:
         """Block until the worker's ``ready`` ack (engine built and
@@ -392,12 +454,115 @@ class ProcessReplica:
         if self._proc.poll() is not None:
             raise ReplicaDied(self.replica_id,
                               f"worker exited rc={self._proc.returncode}")
+        if self._framed:
+            frame = self._sender.encode(
+                json.dumps(cmd, separators=(",", ":")).encode())
+            lines = ([frame] if self._plan is None else
+                     self._plan.apply("cmd", self._sender.last_seq,
+                                      frame))
+        else:
+            lines = [(json.dumps(cmd) + "\n").encode()]
         try:
-            self._proc.stdin.write((json.dumps(cmd) + "\n").encode())
+            for line in lines:
+                self._proc.stdin.write(line)
             self._proc.stdin.flush()
         except (BrokenPipeError, OSError) as e:
             raise ReplicaDied(self.replica_id, f"pipe write failed: {e}") \
                 from e
+
+    def _write_raw(self, frames: List[bytes]) -> None:
+        """Resent frames go out verbatim — the chaos already fired at
+        their seq coordinates once; recovery must terminate."""
+        try:
+            for frame in frames:
+                self._proc.stdin.write(frame)
+            if frames:
+                self._proc.stdin.flush()
+        except (BrokenPipeError, OSError) as e:
+            raise ReplicaDied(self.replica_id, f"pipe write failed: {e}") \
+                from e
+
+    def _consume_line(self, line: bytes,
+                      out: List[Dict[str, object]]) -> None:
+        """One raw stdout line -> zero or more in-order events (framed
+        mode runs the fault plan's ingress mangling, then the receiver;
+        transport-control events are handled here, not surfaced)."""
+        if not line.strip():
+            return
+        if not self._framed:
+            if len(line) > self._max_frame:
+                # Typed oversize reject (the unbounded single-line
+                # read fix): drop the line, count it, never crash.
+                self._receiver.stats["too_large"] += 1
+                return
+            out.append(json.loads(line))
+            return
+        ctl = decode_control(line)
+        if ctl is not None:
+            # Out-of-band control (never sequenced — a resend request
+            # ordered behind the gap it reports would deadlock): the
+            # worker lost command frames, replay them verbatim.
+            if ctl.get("ctl") == "resend":
+                self._wire_retries += 1
+                self._write_raw(self._sender.resend_from(
+                    int(ctl.get("from", 1))))
+            return
+        self._ev_frame_no += 1
+        mangled = ([line + b"\n"] if self._plan is None else
+                   self._plan.apply("ev", self._ev_frame_no,
+                                    line + b"\n"))
+        for raw in mangled:
+            for payload in self._receiver.feed(raw.rstrip(b"\n")):
+                out.append(json.loads(payload))
+
+    def _nudge(self) -> None:
+        """Traffic generator for framed wait loops: a ping at the
+        heartbeat cadence forces the worker to emit, so a corrupted or
+        dropped REPLY surfaces as a sequence gap the resend machinery
+        can heal — an idle pipe cannot tell "nothing sent" from
+        "everything lost"."""
+        if not self._framed:
+            return
+        now = self._clock()
+        if now - self._last_ping_s >= self._ping_interval_s:
+            self._last_ping_s = now
+            self._send({"cmd": "ping"})
+            if self._unanswered_ping_s is None:
+                self._unanswered_ping_s = now
+
+    def _maybe_request_resend(self) -> None:
+        """Gap recovery, bounded: ask the worker to resend from the
+        first missing event seq, with timeout backoff between asks;
+        past the budget the wire is declared unrecoverable and the
+        replica dies its typed death (the router migrates)."""
+        if not self._framed:
+            return
+        if not self._receiver.has_gap:
+            # Healed: BOTH the attempt budget and the backoff anchor
+            # reset — a later, unrelated gap must get its first
+            # request immediately, not inherit this one's backoff.
+            self._resend_attempts = 0
+            self._next_resend_at = 0.0
+            return
+        gap_from = self._receiver.expected_seq
+        now = self._clock()
+        if now < self._next_resend_at:
+            return
+        if self._resend_attempts >= self._max_resend_requests:
+            raise ReplicaDied(
+                self.replica_id,
+                f"wire unrecoverable: event seq {gap_from} still "
+                f"missing after {self._resend_attempts} resend "
+                "requests")
+        self._resend_attempts += 1
+        self._wire_retries += 1
+        self._next_resend_at = now + self._resend_timeout_s * min(
+            8, 2 ** (self._resend_attempts - 1))
+        # Out-of-band: a framed request would order BEHIND the very
+        # gap it reports (mutual deadlock when both directions have
+        # one) — control lines are sequence-free and idempotent.
+        self._write_raw([encode_control(
+            {"ctl": "resend", "from": int(gap_from)})])
 
     def _read_events(self, block_s: float = 0.0) -> List[Dict[str, object]]:
         """Drain available stdout lines (optionally waiting up to
@@ -411,14 +576,37 @@ class ProcessReplica:
                 chunk = None
             if chunk:
                 self._buf += chunk
+                # Max-frame guard on the LINE BUFFER itself: a payload
+                # that never newline-terminates must not balloon the
+                # parent's memory — discard through the next newline
+                # and count the typed reject. 4x headroom so a
+                # complete oversized FRAME still reaches the
+                # receiver's skip path (which consumes its seq slot);
+                # only unbounded garbage lands here.
+                if self._oversize_dropping or (
+                        b"\n" not in self._buf
+                        and len(self._buf) > 4 * self._max_frame):
+                    if b"\n" in self._buf:
+                        _, self._buf = self._buf.split(b"\n", 1)
+                        if self._oversize_dropping:
+                            self._receiver.stats["too_large"] += 1
+                        self._oversize_dropping = False
+                    else:
+                        self._buf = b""
+                        self._oversize_dropping = True
                 while b"\n" in self._buf:
                     line, self._buf = self._buf.split(b"\n", 1)
-                    if line.strip():
-                        out.append(json.loads(line))
+                    self._consume_line(line, out)
                 if out:
                     # ANY event is a liveness proof — not just pongs —
                     # so whatever ping was outstanding is answered.
                     self._unanswered_ping_s = None
+                    # Gap recovery must not wait for an idle read:
+                    # under heavy token flow every pass returns early
+                    # here, and deferring the resend request to a
+                    # quiet moment turns a 1 ms heal into a whole
+                    # engine-tick stall per fault.
+                    self._maybe_request_resend()
                     return out
             elif chunk == b"":  # EOF: the worker is gone
                 if self._proc.poll() is None:
@@ -426,6 +614,7 @@ class ProcessReplica:
                 raise ReplicaDied(
                     self.replica_id,
                     f"stdout EOF (rc={self._proc.returncode})")
+            self._maybe_request_resend()
             if self._clock() >= deadline:
                 return out
             time.sleep(0.002)
@@ -453,6 +642,7 @@ class ProcessReplica:
             # events can share a read with it, and an early return would
             # silently drop them (a lost token = a corrupted replay
             # mirror = a non-token-exact migration later).
+            self._nudge()
             verdict = None
             for ev in self._read_events(block_s=0.05):
                 kind = ev.get("ev")
@@ -499,9 +689,27 @@ class ProcessReplica:
                 # Pongs double as the degraded gauge's transport: the
                 # router's overload detector reads it off `degraded`.
                 self._degraded = bool(ev.get("degraded", False))
+                # ...and as the gray detector's: the worker's
+                # self-reported engine-tick wall (the parent's pump
+                # wall cannot see a slow self-driving worker).
+                if ev.get("tick_wall_s") is not None:
+                    self._tick_walls.append(float(ev["tick_wall_s"]))
             else:
                 out.append(ev)
         return out
+
+    def take_latency_samples(self) -> List[float]:
+        """Per-tick latency samples since the last call (worker
+        self-reported engine-step walls, carried on pongs) — the gray
+        detector's input for process replicas."""
+        out, self._tick_walls = self._tick_walls, []
+        return out
+
+    def set_tick_delay(self, delay_s: float) -> None:
+        """Chaos knob: make THIS worker gray — every engine step gains
+        ``delay_s`` of wall time from here on (the process-replica
+        analogue of a LATENCY fault plan on every device call)."""
+        self._send({"cmd": "set_tick_delay", "delay_s": float(delay_s)})
 
     @property
     def degraded(self) -> bool:
@@ -532,6 +740,7 @@ class ProcessReplica:
         self._send({"cmd": "counts"})
         deadline = self._clock() + self._call_timeout_s
         while self._clock() < deadline:
+            self._nudge()
             counts = None  # consume the whole batch (see submit())
             for ev in self._read_events(block_s=0.05):
                 if ev.get("ev") == "counts" and counts is None:
@@ -554,6 +763,7 @@ class ProcessReplica:
                                    if max_blocks is not None else None)})
         deadline = self._clock() + self._call_timeout_s
         while self._clock() < deadline:
+            self._nudge()
             entry = missing = object()
             for ev in self._read_events(block_s=0.05):
                 if ev.get("ev") == "chain" and entry is missing:
@@ -570,6 +780,7 @@ class ProcessReplica:
         self._send({"cmd": "import_chain", "entry": entry})
         deadline = self._clock() + self._call_timeout_s
         while self._clock() < deadline:
+            self._nudge()
             n = None
             for ev in self._read_events(block_s=0.05):
                 if ev.get("ev") == "chain_imported" and n is None:

@@ -45,9 +45,27 @@ r08's in-engine replay contract, held at fleet level. Requests with no
 surviving replica park as orphans and re-enter when a probe brings a
 replica back; they fail terminally only when recovery is impossible.
 
+**Durability & gray failure (ISSUE 14).** The router itself is no
+longer assumed immortal: with a :class:`~.journal.RouterJournal`
+attached, every admission, routing/ledger binding, emitted-token
+mirror delta, and finish is write-ahead logged (admits fsynced before
+the caller's handle returns; token deltas fsync-batched — losing them
+is safe, replay regenerates), with an atomic checkpoint+truncate
+cycle riding the drain-snapshot encoder. :meth:`FleetRouter.recover`
+rebuilds a fresh router + fresh replicas after a SIGKILL and resumes
+every in-flight stream token-exactly through the same r11
+mirror-replay contract failover uses. And between dead and alive sits
+DEGRADED: a :class:`~.health.GrayDetector` watches per-replica
+per-tick latency quantiles, interactive submissions to a
+suspected-gray replica are HEDGED to the least-loaded healthy sibling
+(first result wins, the loser is cancelled), and — with
+``gray_drain`` on — the suspect is proactively retired through the
+r16 ``scale_down`` live-migration path before it hard-fails.
+
 Every fleet event (replica_up/down, circuit transitions, migrations,
-sheds) flows through the `obs/` tracer (``on_fleet_event``) and the
-Prometheus exporter (:func:`pddl_tpu.obs.export.fleet_exposition`).
+sheds, hedges, gray drains) flows through the `obs/` tracer
+(``on_fleet_event``) and the Prometheus exporter
+(:func:`pddl_tpu.obs.export.fleet_exposition`).
 """
 
 from __future__ import annotations
@@ -55,14 +73,18 @@ from __future__ import annotations
 import collections
 import enum
 import hashlib
-import itertools
 import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from pddl_tpu.obs.trace import NULL_TRACER
 from pddl_tpu.serve import drain as drain_io
+from pddl_tpu.serve.fleet import journal as journal_io
 from pddl_tpu.serve.fleet.admission import AdmissionControl
-from pddl_tpu.serve.fleet.health import BreakerState, CircuitBreaker
+from pddl_tpu.serve.fleet.health import (
+    BreakerState,
+    CircuitBreaker,
+    GrayDetector,
+)
 from pddl_tpu.serve.fleet.replica import ReplicaDied
 from pddl_tpu.serve.kvcache import RadixPrefixCache
 from pddl_tpu.serve.request import (
@@ -195,6 +217,22 @@ class FleetMetrics:
         self.scale_up_events = 0
         self.scale_down_events = 0
         self.scale_down_migrated = 0
+        # Gray-failure machinery (ISSUE 14): interactive submissions
+        # hedged off a suspected-gray replica, the subset where the
+        # HEDGE copy beat the suspect to first result, the duplicate
+        # copies cancelled (one per settled pair), and suspects
+        # proactively retired through the scale_down migration path.
+        self.hedges_launched = 0
+        self.hedge_wins = 0
+        self.hedge_cancelled = 0
+        self.gray_drains = 0
+        # Framed-transport health (ISSUE 14), aggregated from every
+        # process replica's wire stats: resend rounds the gap/corrupt
+        # recovery ran, and frames the CRC/length check REFUSED (a
+        # nonzero reject count with token-exact streams is the "zero
+        # corrupt frames accepted" proof, not a failure).
+        self.wire_retries = 0
+        self.wire_crc_rejects = 0
         self.requests_finished = 0
         self.requests_failed = 0
         self.requests_orphaned = 0
@@ -307,6 +345,11 @@ class _ReplicaSlot:
         self._shadow_cfg = (shadow_block_size, shadow_capacity,
                             shadow_host_capacity)
         self.shadow = _ShadowIndex(*self._shadow_cfg)
+        # Last-read wire-stat snapshot (framed process replicas): the
+        # router folds per-step DELTAS into FleetMetrics, so a respawn
+        # (fresh transport, counters back to zero) resets this baseline
+        # instead of double-counting or going negative.
+        self.wire_base: Optional[Dict[str, int]] = None
 
     def reset_shadow(self) -> None:
         self.shadow = _ShadowIndex(*self._shadow_cfg)
@@ -361,6 +404,33 @@ class FleetRouter:
         in host RAM" when no replica has it in HBM (route label
         ``host_tier``). ``0`` (default) keeps the shadow tier-blind —
         exactly the r17 router.
+      journal: optional :class:`~.journal.RouterJournal` — the
+        control-plane WAL (ISSUE 14). Admissions/bindings are logged
+        durably before the caller's handle returns, token mirrors as
+        fsync-batched deltas, and the checkpoint+truncate cycle runs
+        on the step cadence; :meth:`recover` rebuilds a crashed router
+        from the same directory. ``None`` (default) keeps the r18
+        in-memory-only control plane.
+      gray: arm the gray-failure detector — a
+        :class:`~.health.GrayDetector` instance, a kwargs dict for
+        one, or ``True`` for defaults. The router feeds it each
+        replica's per-step wall time; suspects are hedged around
+        (``gray_hedge``) and optionally retired (``gray_drain``).
+        ``None`` (default) keeps the dead-or-alive-only fleet.
+      gray_hedge: with ``gray`` armed, INTERACTIVE submissions routed
+        to a suspected replica are duplicated to the least-loaded
+        healthy non-suspected sibling; the first replica to produce a
+        result wins and the other copy is cancelled — the classic
+        tail-tolerant hedge, applied only where suspicion already
+        says the latency will be bad.
+      gray_drain: with ``gray`` armed, a suspected replica is
+        proactively RETIRED through the ``scale_down`` live-migration
+        path (zero loss, the r16 contract) while it can still drain —
+        the gray-failure analogue of failover, run before the
+        failure.
+      gray_timer: wall-clock source for the per-step latency samples
+        (``time.perf_counter``; injectable so chaos tests can script
+        exact durations).
       chain_pull_blocks: replica-to-replica prefix transfer (ISSUE 13)
         — when a request routes COLD (rendezvous hash, or a load
         escape off the warm replica) and some OTHER healthy replica's
@@ -385,6 +455,8 @@ class FleetRouter:
                  interactive_reroute_load: Optional[int] = None,
                  shadow_host_capacity_blocks: int = 0,
                  chain_pull_blocks: Optional[int] = None,
+                 journal=None, gray=None, gray_hedge: bool = True,
+                 gray_drain: bool = False, gray_timer=time.perf_counter,
                  clock=time.monotonic):
         if not replicas:
             raise ValueError("a fleet needs at least one replica")
@@ -421,11 +493,31 @@ class FleetRouter:
                 f"chain_pull_blocks must be >= 1, got "
                 f"{chain_pull_blocks}")
         self._autoscaler = None
+        self._journal = journal
+        if gray is True:
+            gray = GrayDetector()
+        elif isinstance(gray, dict):
+            gray = GrayDetector(**gray)
+        self._gray = gray
+        self._gray_hedge = bool(gray_hedge)
+        self._gray_drain = bool(gray_drain)
+        self._gray_timer = gray_timer
+        # Hedge bookkeeping: rid <-> rid cross-links for live pairs,
+        # and the subset of rids that are the HEDGE copy (so a win by
+        # the hedge — not by the suspected primary — is countable).
+        self._hedge_peer: Dict[int, int] = {}
+        self._hedge_rids: set = set()
+        # hedge rid -> primary rid, for the JOURNAL's sake: the admit
+        # was logged under the primary rid, so every later record for
+        # the stream — tokens, the finish, the checkpoint entry — must
+        # use the same key or recovery would resurrect a stream whose
+        # finish it filed under an unknown rid.
+        self._hedge_alias: Dict[int, int] = {}
         self._slots: List[_ReplicaSlot] = []
         for driver in replicas:
             self._new_slot(driver)
         self._by_rid: Dict[int, FleetHandle] = {}
-        self._rids = itertools.count()
+        self._rid_counter = 0
         # Sticky-session map, LRU-bounded: sessions outlive their
         # requests by design (that is the stickiness), so without a cap
         # a long-lived router grows one entry per distinct session
@@ -466,6 +558,21 @@ class FleetRouter:
     @property
     def admission(self) -> Optional[AdmissionControl]:
         return self._admission
+
+    @property
+    def journal(self):
+        """The attached control-plane WAL (None when not armed)."""
+        return self._journal
+
+    @property
+    def gray(self) -> Optional[GrayDetector]:
+        """The gray-failure detector (None when not armed)."""
+        return self._gray
+
+    def _new_rid(self) -> int:
+        rid = self._rid_counter
+        self._rid_counter += 1
+        return rid
 
     @property
     def clock(self):
@@ -787,12 +894,25 @@ class FleetRouter:
             # before the engine sees the prompt (ISSUE 13).
             self._maybe_pull_chain(prompt, chosen, healthy,
                                    dev_depths, host_depths)
+        # Gray hedging (ISSUE 14): an INTERACTIVE request the routing
+        # sent at a suspected-gray replica is duplicated to the
+        # least-loaded healthy NON-suspected sibling — first result
+        # wins, the other copy is cancelled. Batch/best_effort keep the
+        # single copy: they can afford the suspect's tail.
+        hedge_to: Optional[_ReplicaSlot] = None
+        if (self._gray is not None and self._gray_hedge
+                and priority is Priority.INTERACTIVE
+                and self._gray.is_suspected(chosen.replica_id)):
+            siblings = [s for s in healthy if s is not chosen
+                        and not self._gray.is_suspected(s.replica_id)]
+            if siblings:
+                hedge_to = min(siblings, key=lambda s: s.load)
         order = [chosen] + sorted((s for s in healthy if s is not chosen),
                                   key=lambda s: s.load)
         hints: List[float] = []
         depth_sum = cap_sum = sheds_seen = 0
         for slot in order:
-            rid = next(self._rids)
+            rid = self._new_rid()
             try:
                 slot.driver.submit(rid, prompt, max_new_tokens,
                                    sampling, deadline_s, priority,
@@ -853,6 +973,19 @@ class FleetRouter:
                 # Engine-side signal: a reroute forced by QueueFull is
                 # pressure even though the request landed.
                 self._admission.observe(now, rejected=sheds_seen > 0)
+            if hedge_to is not None and slot is not hedge_to:
+                self._launch_hedge(fh, rid, slot, hedge_to,
+                                   max_new_tokens)
+            if self._journal is not None:
+                # WAL contract: the admission + binding are DURABLE
+                # before the caller holds an acked handle — a router
+                # SIGKILL after this return can never lose the
+                # request (`fleet/journal.py`).
+                self._journal.append(
+                    journal_io.encode_admit(rid, fh.request, session))
+                self._journal.append(
+                    journal_io.encode_route(rid, slot.replica_id, how),
+                    durable=True)
             return fh
         if cap_sum == 0 and not hints:
             # Nothing actually reported a full queue — every attempt hit
@@ -867,6 +1000,87 @@ class FleetRouter:
         raise QueueFull(depth_sum, max(cap_sum, depth_sum),
                         retry_after_s=min(hints) if hints else None,
                         priority=priority)
+
+    # ------------------------------------------------------------ hedging
+    def _launch_hedge(self, fh: FleetHandle, primary_rid: int,
+                      primary: _ReplicaSlot, hedge_to: _ReplicaSlot,
+                      max_new_tokens: int) -> None:
+        """Duplicate one admitted request onto ``hedge_to`` (the
+        suspected-primary case). Best-effort: a full or dying hedge
+        target simply leaves the single copy — hedging must never turn
+        one admission into a failure it would not otherwise have."""
+        req = fh.request
+        hrid = self._new_rid()
+        try:
+            hedge_to.driver.submit(hrid, list(req.prompt),
+                                   int(max_new_tokens), req.sampling,
+                                   req.deadline_s, req.priority,
+                                   req.adapter, req.constraint)
+        except Exception:  # noqa: BLE001 - QueueFull / ReplicaDied /
+            return         # anything: the single copy stands alone
+        self._by_rid[hrid] = fh
+        hedge_to.assigned[hrid] = fh
+        self._hedge_peer[primary_rid] = hrid
+        self._hedge_peer[hrid] = primary_rid
+        self._hedge_rids.add(hrid)
+        self._hedge_alias[hrid] = primary_rid
+        self.metrics.hedges_launched += 1
+        if self._journal is not None:
+            self._journal.append(journal_io.encode_route(
+                hrid, hedge_to.replica_id, "hedge"))
+        self._tracer.on_fleet_event(
+            "hedge", request_id=req.request_id,
+            suspected_replica=primary.replica_id,
+            hedge_replica=hedge_to.replica_id)
+
+    def _settle_hedge(self, winner_rid: int) -> None:
+        """First-result-wins: the other copy of the pair is unbound
+        from the fleet handle and cancelled on its replica; its later
+        events fall into the void (``_by_rid`` miss). Idempotent — a
+        rid with no live peer is a no-op."""
+        loser_rid = self._hedge_peer.pop(winner_rid, None)
+        if loser_rid is None:
+            return
+        self._hedge_peer.pop(loser_rid, None)
+        self._hedge_alias.pop(loser_rid, None)  # winner's alias stays:
+        #   its tokens/finish keep journaling under the primary rid
+        fh = self._by_rid.pop(loser_rid, None)
+        for slot in self._slots:
+            if loser_rid in slot.assigned:
+                slot.assigned.pop(loser_rid, None)
+                try:
+                    slot.driver.cancel(loser_rid)
+                except Exception:  # noqa: BLE001 - loser may be dying;
+                    pass           # either way its events are unbound
+        winner_hedge = winner_rid in self._hedge_rids
+        self._hedge_rids.discard(winner_rid)
+        self._hedge_rids.discard(loser_rid)
+        if winner_hedge:
+            self.metrics.hedge_wins += 1
+            # The handle follows the winner: the hedge replica now
+            # runs the stream.
+            fh = fh if fh is not None else self._by_rid.get(winner_rid)
+            if fh is not None:
+                for slot in self._slots:
+                    if winner_rid in slot.assigned:
+                        fh.replica_id = slot.replica_id
+                        break
+        self.metrics.hedge_cancelled += 1
+        self._tracer.on_fleet_event(
+            "hedge_settled", winner_rid=winner_rid,
+            hedge_won=winner_hedge)
+
+    def _abandon_hedge_copy(self, rid: int) -> None:
+        """Dissolve a hedge pair in the PEER's favor without a winner
+        ceremony: this copy failed/was shed with nothing emitted, so
+        the peer simply continues as the (now sole) stream."""
+        peer = self._hedge_peer.pop(rid, None)
+        if peer is not None:
+            self._hedge_peer.pop(peer, None)
+        self._by_rid.pop(rid, None)
+        self._hedge_rids.discard(rid)
+        self._hedge_alias.pop(rid, None)
+        self._tracer.on_fleet_event("hedge_copy_abandoned", rid=rid)
 
     # ------------------------------------------------------------ serving
     def step(self) -> int:
@@ -916,6 +1130,8 @@ class FleetRouter:
                         slot, ReplicaDied(slot.replica_id,
                                           "heartbeat timeout"))
                     continue
+            step_t0 = self._gray_timer() if self._gray is not None \
+                else 0.0
             try:
                 events = slot.driver.step()
             except (KillPoint, ReplicaDied) as e:
@@ -929,6 +1145,22 @@ class FleetRouter:
                 if slot.breaker.state is BreakerState.OPEN:
                     self._on_death(slot, e)
                 continue
+            if self._gray is not None:
+                # The per-tick latency samples the gray band judges. A
+                # self-driving process replica SELF-REPORTS its engine
+                # tick walls (on pongs): the router's pump wall cannot
+                # see a slow worker across a pipe. In-process drivers
+                # have no such channel — there, stepping IS the work,
+                # so the step wall is the honest sample.
+                take = getattr(slot.driver, "take_latency_samples",
+                               None)
+                if take is not None:
+                    for sample in take():
+                        self._gray.observe(slot.replica_id, sample)
+                else:
+                    self._gray.observe(slot.replica_id,
+                                       self._gray_timer() - step_t0)
+            self._fold_wire_stats(slot)
             # A successful pump only counts as breaker success when the
             # heartbeat (if the driver has one) is actually fresh — a
             # hung-but-alive worker keeps accepting pings into its pipe
@@ -938,12 +1170,80 @@ class FleetRouter:
                 slot.breaker.record_success(now)
             tokens += self._apply_events(slot, events)
             self._forward_cancels(slot)
+        self._maybe_gray_drain()
         if self._autoscaler is not None:
             # One controller decision per routing round, AFTER the slot
             # loop: a scale-down mutates the slot list, which must never
             # happen under the iteration above.
             self._autoscaler.step(self._clock())
+        if self._journal is not None:
+            if self._journal.checkpoint_due:
+                self._journal_checkpoint()
+            self._journal.tick()
         return tokens
+
+    def _fold_wire_stats(self, slot: _ReplicaSlot) -> None:
+        """Aggregate a framed driver's transport counters into
+        FleetMetrics as deltas against the slot's last reading."""
+        ws = getattr(slot.driver, "wire_stats", None)
+        if ws is None:
+            return
+        try:
+            stats = dict(ws())
+        except Exception:  # noqa: BLE001 - a dying pipe settles later
+            return
+        base = slot.wire_base or {}
+        self.metrics.wire_retries += max(
+            0, stats.get("retries", 0) - base.get("retries", 0))
+        self.metrics.wire_crc_rejects += max(
+            0, stats.get("crc_rejects", 0) - base.get("crc_rejects", 0))
+        slot.wire_base = stats
+
+    def _maybe_gray_drain(self) -> None:
+        """Proactively retire suspected-gray replicas through the r16
+        ``scale_down`` live-migration path — the whole point of a gray
+        DETECTOR is acting before the failure. Refuses to drain the
+        last available replica (slow beats gone)."""
+        if self._gray is None or not self._gray_drain:
+            return
+        for rid in sorted(self._gray.suspected):
+            slot = next((s for s in self._slots
+                         if s.replica_id == rid
+                         and s.state is ReplicaLifecycle.UP), None)
+            if slot is None:
+                self._gray.forget(rid)
+                continue
+            try:
+                migrated = self.scale_down(rid)
+            except ValueError:
+                return  # no survivor to absorb it: keep serving slow
+            self.metrics.gray_drains += 1
+            self._gray.forget(rid)
+            self._tracer.on_fleet_event(
+                "gray_drain", replica=rid, migrated=migrated)
+
+    def _journal_entries(self) -> List[Tuple[int, Dict]]:
+        """The checkpoint body: every in-flight stream's mirror as a
+        rid-tagged drain wire entry (one per HANDLE — a hedged pair
+        checkpoints its primary rid only, so recovery revives one
+        copy, not a duplicate race)."""
+        now = self._clock()
+        out: List[Tuple[int, Dict]] = []
+        seen = set()
+        for rid, fh in sorted(self._by_rid.items()):
+            if fh.done or rid in self._hedge_rids or id(fh) in seen:
+                continue
+            seen.add(id(fh))
+            entry = drain_io.encode_handle(fh, now)
+            entry["session"] = fh.session
+            # A won hedge runs under its hedge rid; the journal's key
+            # for the stream is the primary rid its admit used.
+            out.append((self._hedge_alias.get(rid, rid), entry))
+        return out
+
+    def _journal_checkpoint(self) -> None:
+        self._journal.checkpoint(self._journal_entries(),
+                                 next_rid=self._rid_counter)
 
     def run(self, max_steps: Optional[int] = None,
             idle_sleep_s: Optional[float] = None) -> None:
@@ -982,6 +1282,11 @@ class FleetRouter:
             kind = ev.get("ev")
             if kind == "tokens":
                 for rid, toks in ev["toks"]:
+                    if toks and rid in self._hedge_peer:
+                        # First result wins: this copy takes the
+                        # stream, the peer is cancelled and unbound
+                        # (its later events miss `_by_rid` below).
+                        self._settle_hedge(rid)
                     fh = self._by_rid.get(rid)
                     if fh is None:
                         continue
@@ -993,8 +1298,29 @@ class FleetRouter:
                     tokens += len(toks)
                     self.metrics.tokens_streamed_by_priority[
                         fh.request.priority.value] += len(toks)
+                    if self._journal is not None:
+                        # The emitted-token mirror delta: fsync-BATCHED
+                        # (losing a tail is safe — replay regenerates
+                        # the identical tokens). Hedge copies journal
+                        # under the PRIMARY rid their admit used.
+                        self._journal.append(journal_io.encode_tokens(
+                            self._hedge_alias.get(rid, rid),
+                            list(toks)))
             elif kind == "finish":
                 rid = ev["rid"]
+                if rid in self._hedge_peer:
+                    # Only a SUCCESSFUL first result wins the race: a
+                    # copy that failed/was shed with nothing emitted
+                    # must not drag down the healthy peer — hedging
+                    # can never turn one admission into a failure it
+                    # would not otherwise have. The failed copy is
+                    # quietly unlinked; the peer keeps the stream.
+                    if ev.get("state") == RequestState.FAILED.value \
+                            and not ev.get("n_tokens"):
+                        self._abandon_hedge_copy(rid)
+                        slot.assigned.pop(rid, None)
+                        continue
+                    self._settle_hedge(rid)
                 fh = self._by_rid.pop(rid, None)
                 slot.assigned.pop(rid, None)
                 if fh is None:
@@ -1017,6 +1343,14 @@ class FleetRouter:
                     self.metrics.requests_finished += 1
                 elif fh.state is RequestState.FAILED:
                     self.metrics.requests_failed += 1
+                if self._journal is not None:
+                    self._journal.append(journal_io.encode_finish(
+                        self._hedge_alias.pop(rid, rid),
+                        fh.state.value,
+                        fh.finish_reason.value
+                        if fh.finish_reason is not None else None))
+                else:
+                    self._hedge_alias.pop(rid, None)
         self.metrics.tokens_streamed += tokens
         return tokens
 
@@ -1032,6 +1366,8 @@ class FleetRouter:
         now = self._clock()
         slot.state = ReplicaLifecycle.DEAD
         slot.breaker.trip(now)
+        if self._gray is not None:
+            self._gray.forget(slot.replica_id)  # dead outranks gray
         # Its adapter pool died with it: drop only ITS homes, so the
         # next same-adapter submission re-homes wherever it lands.
         self._adapter_homes = {name: home for name, home
@@ -1065,6 +1401,12 @@ class FleetRouter:
                      for rid, fh in slot.assigned.items() if not fh.done]
         migrate: List[Tuple[int, Dict, FleetHandle]] = []
         for rid, entry in pairs:
+            if rid in self._hedge_peer:
+                # A hedged copy leaving with its host is not migrated:
+                # the surviving peer IS the stream — settle the pair
+                # in its favor instead of reviving a duplicate race.
+                self._settle_hedge(self._hedge_peer[rid])
+                continue
             fh = self._by_rid.get(rid)
             if fh is None or fh.done:
                 continue
@@ -1179,6 +1521,12 @@ class FleetRouter:
                     max_blocks=self._affinity_blocks)
                 if fh.session is not None:
                     self._session_pin(fh.session, target)
+                if self._journal is not None:
+                    # The re-bind is a ledger event too: recovery
+                    # ignores it (fresh fleet, fresh routing) but the
+                    # decision history stays auditable.
+                    self._journal.append(journal_io.encode_route(
+                        rid, tid, "migration"))
             self.metrics.requests_migrated += len(items)
             if via == "drain":
                 self.metrics.migrated_via_drain += len(items)
@@ -1224,6 +1572,9 @@ class FleetRouter:
         slot.breaker.record_success(self._clock())
         slot.state = ReplicaLifecycle.UP
         slot.reset_shadow()  # the fresh engine's radix cache is empty
+        slot.wire_base = None  # fresh transport: counters restart at 0
+        if self._gray is not None:
+            self._gray.forget(slot.replica_id)  # fresh baseline too
         self.metrics.replica_up_events += 1
         self._tracer.on_fleet_event("replica_up", replica=slot.replica_id)
         if self._orphans:
@@ -1232,6 +1583,69 @@ class FleetRouter:
                 [(rid, self._wire_entry(fh), fh) for rid, fh in orphans
                  if not fh.done],
                 "replay")
+
+    # ----------------------------------------------------- crash recovery
+    @classmethod
+    def recover(cls, journal_dir: str, replicas: Sequence[object], *,
+                journal=None, **router_kw
+                ) -> Tuple["FleetRouter", Dict[int, FleetHandle]]:
+        """Rebuild a crashed router from its WAL (ISSUE 14): the
+        control-plane answer to a SIGKILL with no drain possible.
+
+        ``replicas`` are FRESH drivers (fresh engines / re-spawned
+        worker processes — the old ones died with the old router);
+        ``journal`` defaults to a new :class:`~.journal.RouterJournal`
+        over the same directory, which the recovered router keeps
+        appending to. Every stream that was durably admitted and had
+        not finished re-enters through the r11 mirror-replay path —
+        the same contract hard-killed REPLICAS already recover by, so
+        the streams continue token-exactly — and the first act of the
+        recovered router is a fresh checkpoint: recovery is the
+        snapshot path's second "normal case", not a special one.
+
+        Returns ``(router, {rid: FleetHandle})`` — the caller's old
+        handles died with the old process; these are their reborn
+        equivalents, carrying the full mirrored stream so far.
+        """
+        entries, next_rid = journal_io.read_state(journal_dir)
+        if journal is None:
+            journal = journal_io.RouterJournal(journal_dir)
+        router = cls(replicas, journal=journal, **router_kw)
+        router._rid_counter = max(router._rid_counter, int(next_rid))
+        now = router._clock()
+        migrate: List[Tuple[int, Dict, FleetHandle]] = []
+        for rid, entry in sorted(entries.items()):
+            fh = router._handle_from_entry(entry, now)
+            router._by_rid[rid] = fh
+            migrate.append((rid, entry, fh))
+        router._distribute(migrate, "replay")
+        router._journal_checkpoint()
+        router._tracer.on_fleet_event(
+            "router_recovered", revived=len(migrate),
+            replicas=len(router._slots))
+        return router, {rid: fh for rid, _, fh in migrate}
+
+    def _handle_from_entry(self, entry: Dict,
+                           now: float) -> FleetHandle:
+        """A reborn :class:`FleetHandle` from a journal mirror entry
+        (the drain wire shape plus the router-level ``session``)."""
+        req = Request(
+            prompt=[int(t) for t in entry.get("prompt", [])],
+            max_new_tokens=int(entry.get("max_new_tokens", 0)),
+            sampling=drain_io.decode_sampling(entry.get("sampling")),
+            deadline_s=entry.get("deadline_s"),
+            priority=Priority(entry.get(
+                "priority", Priority.INTERACTIVE.value)),
+            adapter=entry.get("adapter"),
+            constraint=entry.get("constraint"))
+        fh = FleetHandle(
+            req,
+            arrival_s=now - float(entry.get("elapsed_s") or 0.0),
+            session=entry.get("session"))
+        fh.tokens = [int(t) for t in entry.get("tokens", [])]
+        if entry.get("ttft_s") is not None:
+            fh.ttft_s = float(entry["ttft_s"])
+        return fh
 
     # ----------------------------------------------------- elastic scaling
     def _new_slot(self, driver) -> _ReplicaSlot:
@@ -1305,6 +1719,8 @@ class FleetRouter:
         migrate, leftovers, via = self._evacuate(slot, now)
         slot.state = ReplicaLifecycle.RETIRED
         self._slots.remove(slot)
+        if self._gray is not None:
+            self._gray.forget(slot.replica_id)
         self._adapter_homes = {name: home for name, home
                                in self._adapter_homes.items()
                                if home is not slot}
@@ -1360,6 +1776,8 @@ class FleetRouter:
         entries.extend(self._wire_entry(fh) for _, fh in self._orphans
                        if not fh.done)
         self._closed = True
+        if self._journal is not None:
+            self._journal.commit()
         return {"version": drain_io.SNAPSHOT_VERSION,
                 "drained_unix_s": time.time(), "requests": entries}
 
@@ -1370,5 +1788,10 @@ class FleetRouter:
         for slot in self._slots:
             try:
                 slot.driver.close()
+            except Exception:  # noqa: BLE001 - teardown is best-effort
+                pass
+        if self._journal is not None:
+            try:
+                self._journal.close()
             except Exception:  # noqa: BLE001 - teardown is best-effort
                 pass

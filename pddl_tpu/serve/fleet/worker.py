@@ -36,6 +36,13 @@ import sys
 from typing import Dict
 
 from pddl_tpu.serve.fleet.replica import HandleLedger, sampling_from_wire
+from pddl_tpu.serve.fleet.transport import (
+    MAX_FRAME_BYTES,
+    FrameReceiver,
+    FrameSender,
+    decode_control,
+    encode_control,
+)
 from pddl_tpu.serve.request import Priority, QueueFull
 
 
@@ -153,6 +160,23 @@ def main(argv=None) -> int:
     args = p.parse_args(argv)
     config = json.loads(args.config_json)
 
+    # Framed transport (ISSUE 14, `fleet/transport.py`): the parent
+    # injects ``framed: true`` and both directions gain length+CRC+seq
+    # framing, duplicate suppression, and bounded resend — stdout is
+    # still PROTOCOL-ONLY, the frames are still one line each.
+    framed = bool(config.get("framed", False))
+    max_frame = int(config.get("max_frame_bytes", MAX_FRAME_BYTES))
+    sender = FrameSender()
+    receiver = FrameReceiver(max_frame_bytes=max_frame)
+
+    if framed:
+        def emit(record: Dict[str, object]) -> None:
+            sys.stdout.buffer.write(sender.encode(
+                json.dumps(record, separators=(",", ":")).encode()))
+            sys.stdout.buffer.flush()
+    else:
+        emit = _emit
+
     engine = build_engine(config)
     engine.warmup()
     ledger = HandleLedger()
@@ -163,8 +187,8 @@ def main(argv=None) -> int:
         flags["drain"] = True
 
     signal.signal(signal.SIGTERM, _on_sigterm)
-    _emit({"ev": "ready", "replica": config.get("replica_id"),
-           "compile_counts": engine.compile_counts()})
+    emit({"ev": "ready", "replica": config.get("replica_id"),
+          "compile_counts": engine.compile_counts()})
 
     import time
 
@@ -182,27 +206,40 @@ def main(argv=None) -> int:
                     adapter=cmd.get("adapter"),
                     constraint=cmd.get("constraint"))
             except QueueFull as e:
-                _emit({"ev": "queue_full", "rid": rid,
+                emit({"ev": "queue_full", "rid": rid,
                        "queue_depth": e.queue_depth,
                        "max_queue_depth": e.max_queue_depth,
                        "retry_after_s": e.retry_after_s})
                 return
             except ValueError as e:  # bad request (too long, etc.):
-                _emit({"ev": "error", "rid": rid,  # reject it, not the
+                emit({"ev": "error", "rid": rid,  # reject it, not the
                        "message": str(e)})         # whole worker
                 return
             ledger.add(rid, handle)
-            _emit({"ev": "submit_ok", "rid": rid})
+            emit({"ev": "submit_ok", "rid": rid})
         elif kind == "cancel":
             h = ledger.get(int(cmd["rid"]))
             if h is not None:
                 h.cancel()
         elif kind == "ping":
-            _emit({"ev": "pong", "queue_depth": engine.scheduler.depth,
-                   "live_slots": engine.live_slots,
-                   "degraded": engine.degraded})
+            # `tick_wall_s` (the worker's own last engine-step wall,
+            # injected delay included) is the gray detector's latency
+            # sample for PROCESS replicas: the parent's pipe-pump wall
+            # cannot see a slow self-driving worker, so the worker
+            # self-reports — gray failure is degradation, not
+            # byzantine lying, and the number is measured where the
+            # time is actually spent.
+            emit({"ev": "pong", "queue_depth": engine.scheduler.depth,
+                  "live_slots": engine.live_slots,
+                  "degraded": engine.degraded,
+                  "tick_wall_s": wire["tick_wall_s"]})
+        elif kind == "set_tick_delay":
+            # Chaos knob (the gray-failure injector): every subsequent
+            # engine step gains this much wall time — the process-
+            # replica analogue of a LATENCY FaultPlan on every call.
+            wire["tick_delay_s"] = float(cmd.get("delay_s", 0.0))
         elif kind == "counts":
-            _emit({"ev": "counts", "counts": engine.compile_counts()})
+            emit({"ev": "counts", "counts": engine.compile_counts()})
         elif kind == "restore":
             from pddl_tpu.serve.fleet.replica import snapshot_from_pairs
             from pddl_tpu.serve.request import FinishReason, RequestState
@@ -220,7 +257,7 @@ def main(argv=None) -> int:
                 except Exception as e:  # noqa: BLE001 - reject the entry
                     print(f"restore of rid={rid} rejected: {e}",
                           file=sys.stderr)
-                    _emit({"ev": "finish", "rid": rid,
+                    emit({"ev": "finish", "rid": rid,
                            "state": RequestState.FAILED.value,
                            "reason": FinishReason.ERROR.value,
                            "ttft_s": (entry.get("ttft_s")
@@ -242,7 +279,7 @@ def main(argv=None) -> int:
             except Exception as e:  # noqa: BLE001 - reject the pull
                 print(f"export_chain rejected: {e}", file=sys.stderr)
                 entry = None
-            _emit({"ev": "chain", "entry": entry})
+            emit({"ev": "chain", "entry": entry})
         elif kind == "import_chain":
             # Same isolation inbound: a malformed wire entry (bad
             # base64, an invalid dtype string from a foreign build)
@@ -252,11 +289,50 @@ def main(argv=None) -> int:
             except Exception as e:  # noqa: BLE001 - reject the entry
                 print(f"import_chain rejected: {e}", file=sys.stderr)
                 n = 0
-            _emit({"ev": "chain_imported", "n": n})
+            emit({"ev": "chain_imported", "n": n})
         elif kind == "drain":
             flags["drain"] = True
         elif kind == "shutdown":
             flags["shutdown"] = True
+
+    wire = {"next_resend_s": 0.0, "dropping": False,
+            "tick_wall_s": None, "tick_delay_s": 0.0}
+
+    def consume_cmd_line(line: bytes) -> None:
+        """One stdin line -> command(s). Framed mode validates, dedups
+        and re-orders through the receiver; a command the CRC refused
+        heals via the resend request below. An oversized line is a
+        TYPED reject in both modes — reported, counted, never a worker
+        crash (the r11 loop would have ballooned or thrown)."""
+        if not line.strip():
+            return
+        if not framed:
+            if len(line) > max_frame:
+                receiver.stats["too_large"] += 1
+                emit({"ev": "wire_error", "kind": "frame_too_large",
+                      "bytes": len(line)})
+                return
+            handle_cmd(json.loads(line))
+            return
+        ctl = decode_control(line)
+        if ctl is not None:
+            # Out-of-band control (sequence-free — see transport.py):
+            # the parent lost event frames, replay them verbatim from
+            # the send buffer (chaos never re-fires on resends —
+            # recovery must terminate).
+            if ctl.get("ctl") == "resend":
+                for frame in sender.resend_from(int(ctl.get("from", 1))):
+                    sys.stdout.buffer.write(frame)
+                sys.stdout.buffer.flush()
+            return
+        if len(line) > max_frame:
+            # Report the typed reject; the receiver still consumes the
+            # frame's sequence slot (policy refusal, not corruption —
+            # a resend of the same oversize could never heal it).
+            emit({"ev": "wire_error", "kind": "frame_too_large",
+                  "bytes": len(line)})
+        for payload in receiver.feed(line):
+            handle_cmd(json.loads(payload))
 
     stdin_fd = sys.stdin.fileno()
     buf = b""
@@ -274,10 +350,37 @@ def main(argv=None) -> int:
                 break
             if chunk:
                 buf += chunk
+                # Unterminated-giant-line guard: discard through the
+                # next newline instead of growing without bound (4x
+                # headroom — a complete oversized frame must reach the
+                # receiver's skip path, which consumes its seq slot).
+                if wire["dropping"] or (b"\n" not in buf
+                                        and len(buf) > 4 * max_frame):
+                    if b"\n" in buf:
+                        _, buf = buf.split(b"\n", 1)
+                        if wire["dropping"]:
+                            receiver.stats["too_large"] += 1
+                            emit({"ev": "wire_error",
+                                  "kind": "frame_too_large"})
+                        wire["dropping"] = False
+                    else:
+                        buf = b""
+                        wire["dropping"] = True
                 while b"\n" in buf:
                     line, buf = buf.split(b"\n", 1)
-                    if line.strip():
-                        handle_cmd(json.loads(line))
+                    consume_cmd_line(line)
+        if framed and receiver.has_gap:
+            # A command went missing (corrupt/dropped frame): ask the
+            # parent to resend from the first missing seq, at a
+            # bounded cadence so a dead gap cannot spam the pipe.
+            # Out-of-band (sequence-free) on purpose — see
+            # transport.encode_control.
+            now_s = time.monotonic()
+            if now_s >= wire["next_resend_s"]:
+                wire["next_resend_s"] = now_s + 0.02
+                sys.stdout.buffer.write(encode_control(
+                    {"ctl": "resend", "from": receiver.expected_seq}))
+                sys.stdout.buffer.flush()
         if flags["drain"]:
             now = time.monotonic()
             entries = ledger.drain_entries(now)
@@ -285,14 +388,18 @@ def main(argv=None) -> int:
                 engine.drain()
             except Exception:  # noqa: BLE001 - snapshot already captured
                 pass
-            _emit({"ev": "snapshot",
+            emit({"ev": "snapshot",
                    "requests": [[rid, entry] for rid, entry in entries],
                    "compile_counts": engine.compile_counts()})
             return 0
         if engine.has_work:
+            t0 = time.monotonic()
             engine.step()
+            if wire["tick_delay_s"] > 0.0:
+                time.sleep(wire["tick_delay_s"])
+            wire["tick_wall_s"] = time.monotonic() - t0
             for ev in ledger.harvest():
-                _emit(ev)
+                emit(ev)
     return 0
 
 

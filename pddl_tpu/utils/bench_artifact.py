@@ -144,13 +144,24 @@ _HIGHER_BETTER = ("tokens_per_s", "tokens_per_sec", "speedup", "retained",
                   # host_tier_promote_tokens_charged; hit-rate leaves
                   # ride "hit_rate", the TTFT ratio rides "ttft"
                   # below, chain pulls ride "chain_pull".
-                  "spill", "promot", "chain_pull")
+                  "spill", "promot", "chain_pull",
+                  # Control-plane robustness headlines (r19): hedge
+                  # wins are interactive requests a gray replica would
+                  # have stalled (throughput_retained rides
+                  # "retained", the hedged-TTFT ratio rides
+                  # "reduction"; raw wire-reject COUNTS are draw-level
+                  # telemetry, deliberately not gated).
+                  "hedge_win")
 _LOWER_BETTER = ("ttft", "latency", "_ms", "_wall_s", "overhead",
                  "_seconds", "tick_s", "step_s", "copy_us",
                  # Time the brownout ladder spent engaged (r16): a
                  # same-config record whose fleet browns out longer
                  # regressed its overload posture.
                  "rung_time",
+                 # Router WAL crash recovery wall time (r19): MTTR for
+                 # the control plane — a same-config record whose
+                 # recovery got slower regressed the durability story.
+                 "recovery_s",
                  # Prefill tokens the fleet spent on prefixes a sibling
                  # replica already held (r18): the number the chain
                  # pull exists to eliminate.

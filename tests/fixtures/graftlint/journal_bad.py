@@ -1,0 +1,20 @@
+"""Seeded-bad fixture for the ``snapshot-hygiene`` rule's JOURNAL
+family (ISSUE 14): a record encoder emits a key the versioned
+``RECORD_KEYS_V*`` manifest does not declare — the control-plane WAL
+format changed without a ``JOURNAL_VERSION`` bump, so a recovering
+router would mis-decode its own log."""
+
+JOURNAL_VERSION = 1
+
+RECORD_KEYS_V1 = ("rec", "rid", "toks")
+
+
+def encode_tokens(rid, toks):
+    return {
+        "rec": "tokens",
+        "rid": int(rid),
+        "toks": [int(t) for t in toks],
+        # BUG: a new record key with no version bump — recovery built
+        # against the old manifest silently drops the binding.
+        "replica": 0,
+    }

@@ -1,0 +1,16 @@
+"""Good twin for the journal-manifest fixture: ``RECORD_KEYS_V*``
+names the current ``JOURNAL_VERSION`` and matches the record encoders
+exactly. Must lint clean."""
+
+JOURNAL_VERSION = 2
+
+RECORD_KEYS_V2 = ("rec", "rid", "toks", "replica")
+
+
+def encode_tokens(rid, toks):
+    return {
+        "rec": "tokens",
+        "rid": int(rid),
+        "toks": [int(t) for t in toks],
+        "replica": 0,
+    }

@@ -62,13 +62,16 @@ BAD_FIXTURES = [
     ("site-vocab", "site_vocab_bad_spec.py", 3),
     ("exposition-parity", "exposition_bad.py", 2),
     ("snapshot-hygiene", "snapshot_bad.py", 1),
+    # The journal-manifest twin (ISSUE 14): a WAL record key added
+    # without a JOURNAL_VERSION bump — same rule, second wire format.
+    ("snapshot-hygiene", "journal_bad.py", 1),
 ]
 
 GOOD_FIXTURES = [
     "pin_release_good.py", "pin_release_good_hosttier.py",
     "donation_good.py", "recompile_good.py",
     "site_vocab_good.py", "site_vocab_good_spec.py",
-    "exposition_good.py", "snapshot_good.py",
+    "exposition_good.py", "snapshot_good.py", "journal_good.py",
 ]
 
 

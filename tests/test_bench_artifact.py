@@ -339,6 +339,67 @@ def test_r18_tier_artifact_is_gated():
         assert any("hit_rate_tiered" in p for p in paths)
 
 
+def test_r19_ctrlplane_artifact_is_gated():
+    """The control-plane durability artifact participates in the
+    series: it loads, keys into a (metric, config) group, its
+    committed headlines clear the ISSUE 14 bounds (>= 0.95x clean
+    throughput retained at the 1% injected wire-fault rate with zero
+    corrupt frames accepted and every CRC reject counted; WAL
+    recovery wall time recorded with every stream token-exact and
+    zero recompiles; hedging cutting interactive p99 TTFT with EVERY
+    pair directional), they are DIRECTIONAL — and a same-config
+    r-record that regresses them fails `check_series` LOUDLY."""
+    path = os.path.join(_BENCH_DIR, "r19_serve_ctrlplane.json")
+    records = [r for r in load_artifact(path)
+               if artifact_key(r) is not None]
+    assert records, "r19_serve_ctrlplane.json has no keyed record"
+    ctrl = records[0]["results"]["ctrlplane"]
+    wire, rec, hedge = ctrl["wire"], ctrl["recovery"], ctrl["hedge"]
+    # ISSUE 14 acceptance bounds on the committed medians.
+    assert wire["injected_fault_rate_per_frame"] == 0.01
+    assert wire["throughput_retained_x"] >= 0.95
+    assert wire["corrupt_frames_accepted"] == 0
+    assert wire["wire_crc_rejects_total"] > 0  # every reject counted
+    assert wire["wire_retries_total"] > 0      # ...and healed
+    assert wire["streams_token_exact"] is True
+    assert rec["recovery_s"] > 0               # measured, recorded
+    assert rec["streams_token_exact"] is True
+    assert rec["zero_recompiles_recovered"] is True
+    assert all(n > 0 for n in rec["streams_revived_per_repeat"])
+    assert hedge["hedged_ttft_p99_reduction_x"] > 1.0
+    assert hedge["all_pairs_directional"] is True
+    assert hedge["hedge_wins_total"] > 0
+    assert hedge["zero_recompiles"] is True
+    for key in ("throughput_retained_x", "recovery_s",
+                "hedged_ttft_p99_reduction_x", "hedge_wins_total",
+                "ttft_p99_hedge_on_s"):
+        assert metric_direction(key) != 0, key
+    # A hypothetical r20 record at the SAME config whose control-plane
+    # headlines regressed must fail the series gate loudly.
+    worse = copy.deepcopy(records[0])
+    w = worse["results"]["ctrlplane"]
+    w["wire"]["throughput_retained_x"] *= 0.8
+    w["recovery"]["recovery_s"] *= 2.0
+    w["hedge"]["hedged_ttft_p99_reduction_x"] *= 0.5
+    import json as _json
+    import tempfile
+    with tempfile.TemporaryDirectory() as d:
+        old_p = os.path.join(d, "r19_c.json")
+        new_p = os.path.join(d, "r20_c.json")
+        with open(old_p, "w") as f:
+            _json.dump(records[0], f)
+        with open(new_p, "w") as f:
+            _json.dump(worse, f)
+        pairs, failures = check_series([old_p, new_p])
+        assert pairs == 1 and len(failures) == 1
+        paths = {r["path"] for r in failures[0]["regressions"]}
+        assert ("results.ctrlplane.wire.throughput_retained_x"
+                in paths)
+        assert "results.ctrlplane.recovery.recovery_s" in paths
+        assert ("results.ctrlplane.hedge.hedged_ttft_p99_reduction_x"
+                in paths)
+
+
 def test_compare_flags_directional_regressions_only():
     old = _record(tokens_per_s=1000.0, ttft_p99_s=0.10, spread_pct=2.0,
                   prefix_hit_rate=0.97)
