@@ -91,6 +91,21 @@ the ONLINE layer (`pddl_tpu/serve/`) the way a serving owner would:
    (c) gray-replica hedging ON vs OFF under an injected slow replica
    — interactive p99 TTFT, hedge wins counted, zero recompiles.
 
+11. **Disaggregation leg** (`--disagg-only`, standalone r20 artifact,
+   ISSUE 17) — prefill/decode role split (`serve/fleet/disagg.py`):
+   the bursty LONG-PROMPT trace through a same-N pair of in-process
+   fleets, unified vs split (prefill pool + decode pool,
+   block-granular KV hand-off through the host tier), PAIRED per
+   repeat. Decode-side latency is tick-attributed: each token is
+   charged the wall duration of the engine step that produced it, so
+   prefill admissions sharing a replica show up as latency on its
+   co-resident decode streams. Headlines: decode-side p99 per-token
+   latency ratio (`decode_p99_interference` ≤ 0.8× — the
+   interference disaggregation exists to remove), aggregate tok/s
+   retained ≥ 0.95×, `handoff_ms` per shipped chain, every stream
+   token-exact across the two fleet shapes, zero recompiles on the
+   decode replicas.
+
 Every record embeds the engine's final `ServeMetrics.snapshot()`, so
 artifacts carry tail latencies (TTFT/token-latency p50/p99), not just
 throughput.
@@ -1756,6 +1771,330 @@ def _slo_leg(args, *, overload_x: float = 2.0,
     }
 
 
+def _disagg_prefill_len(args) -> int:
+    """Largest block-aligned prefill buffer that still fits beside
+    the restore chunk in the KV budget (the engine's
+    `prefill_len + prefix_chunk <= max_len` invariant)."""
+    return (args.max_len - 2 * _DISAGG_CHUNK) // 8 * 8
+
+
+# Restore-suffix chunk width: a handed-off chain covers every FULL
+# block of the prompt, so the destination's prefill-from-cache only
+# computes the partial tail block (+ the tokens decoded before the
+# move) — a narrow chunk program keeps that from paying a
+# quarter-buffer of padding per restore.
+_DISAGG_CHUNK = 16
+
+
+def _disagg_engine_factory(args, model, variables):
+    """Hand-off-capable engine for BOTH fleet shapes — the only
+    variable in a pair is the role assignment. Prefix cache ON (the
+    chain to export) and host tier ON (the landing zone, r18 wire
+    format). Admission is un-sliced: on this trace's 250+-token
+    prompts the r12 slice budget would triple TTFT to buy jitter
+    relief, and chunked prefill is the TRADEOFF disaggregation
+    removes, not a free alternative — the r12 SLO leg keeps
+    benchmarking the sliced operating point on its short-prompt
+    trace."""
+    def make():
+        return ServeEngine(
+            model, variables, max_slots=args.slots,
+            prefill_len=_disagg_prefill_len(args),
+            prefix_cache_blocks=256, prefix_block_size=8,
+            prefix_chunk=_DISAGG_CHUNK,
+            host_tier=1 << 28, max_queue_depth=2 * args.slots)
+    return make
+
+
+class _TimedLocalReplica:
+    """In-process replica that times its own engine ticks.
+
+    The leg runs LOCAL replicas (like the r18 tier fleet leg), for
+    two reasons that are one reason: the pddl_tpu target is a
+    TPU-native fleet where a KV-block DMA costs microseconds against
+    milliseconds of prefill compute, and a CPU worker pipe prices the
+    same transfer at base64+JSON rates — compute parity, a transport
+    artifact the paper's fabric does not have. In-process transfer
+    (`export_prefix_chain` buffers straight into the peer's host
+    tier) models the DMA side of that ratio, and per-tick timing
+    gives an arrival-clock-free read of decode cadence: every token
+    is charged the duration of the engine step that produced it, so
+    a prefill admission (or a restore) sharing the tick is charged to
+    its co-residents' tokens — interference measured where it
+    happens, not through the router's harvest loop."""
+
+    def __init__(self, replica_id, engine_factory, *, role="unified"):
+        from pddl_tpu.serve.fleet import LocalReplica
+
+        self._inner = LocalReplica(replica_id, engine_factory,
+                                   role=role)
+        self.last_step_s = 0.0
+
+    def step(self):
+        t0 = time.perf_counter()
+        try:
+            return self._inner.step()
+        finally:
+            self.last_step_s = time.perf_counter() - t0
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
+def _disagg_fleet(args, model, variables, roles, *, tracer=None):
+    from pddl_tpu.serve.fleet import FleetRouter
+
+    make = _disagg_engine_factory(args, model, variables)
+    replicas = [_TimedLocalReplica(i, make, role=role)
+                for i, role in enumerate(roles)]
+    return FleetRouter(replicas, affinity_block_size=8,
+                       affinity_blocks=2, respawn=False, tracer=tracer)
+
+
+def _disagg_warm(fleet, args, *, seed: int = 4242):
+    """Compile every program the wave will run — prefill, decode,
+    and (on decode replicas) the promote + restore-chunk path each
+    hand-off exercises — so the measured ticks are steady-state and
+    the zero-recompile pin holds over the wave itself."""
+    rng = np.random.default_rng(seed)
+    n = 2 * sum(1 for s in fleet.replicas
+                if getattr(s.driver, "role", "unified") != "prefill")
+    handles = [fleet.submit(
+        rng.integers(0, args.vocab,
+                     size=_disagg_prefill_len(args) - 8 * k)
+        .astype(np.int32).tolist(), 4) for k in range(1, n + 1)]
+    fleet.run(max_steps=4000)
+    assert all(h.done for h in handles), "disagg warmup stranded work"
+
+
+def _disagg_wave(fleet, schedule, *, hang_s: float = 600.0):
+    """One open-loop pass of the long-prompt trace. Decode-side
+    per-token latency pool: each harvested token is charged the wall
+    duration of the replica tick that produced it (first tokens — the
+    TTFT side, where prefill and the hand-off itself live — are
+    excluded; everything after, including the restored stream's first
+    post-move tick with its promote charge, is decode cadence)."""
+    t0 = time.perf_counter()
+    backlog = sorted(schedule, key=lambda e: e["t"])
+    by_id = {s.replica_id: s.driver for s in fleet.replicas}
+    handles, lats, seen = [], [], {}
+    while backlog or fleet.has_work or any(
+            not h.done for _, h in handles):
+        now = time.perf_counter() - t0
+        while backlog and backlog[0]["t"] <= now:
+            ev = backlog[0]
+            try:
+                handles.append((ev, fleet.submit(
+                    ev["prompt"], ev["new_tokens"])))
+                backlog.pop(0)
+            except QueueFull:
+                break  # re-offer on the next pump
+        fleet.step()
+        for i, (_ev, h) in enumerate(handles):
+            n = len(h.tokens)
+            prev_n = seen.get(i, 0)
+            if n > prev_n:
+                if prev_n > 0:
+                    lats.extend(
+                        [by_id[h.replica_id].last_step_s]
+                        * (n - prev_n))
+                seen[i] = n
+        assert time.perf_counter() - t0 < hang_s, "disagg wave hung"
+    wall = time.perf_counter() - t0
+    assert all(h.done for _, h in handles), "a stream never settled"
+    return {
+        "handles": handles,
+        "tokens_per_s": sum(len(h.tokens) for _, h in handles) / wall,
+        "decode_lat_p50_s": float(np.percentile(lats, 50)),
+        "decode_lat_p99_s": float(np.percentile(lats, 99)),
+        "wall_s": wall,
+    }
+
+
+def _disagg_capacity(args, model, variables) -> float:
+    """Sustained unified-fleet capacity on the LONG-PROMPT trace
+    (tokens/s, closed loop) — the offered-rate yardstick both halves
+    of every pair share."""
+    fleet = _disagg_fleet(args, model, variables,
+                          ["unified"] * args.disagg_replicas)
+    try:
+        _disagg_warm(fleet, args)
+        events, _ = _disagg_trace(args, seed=999)
+        t0 = time.perf_counter()
+        handles, backlog = [], list(events)
+        while backlog or fleet.has_work:
+            while backlog:
+                ev = backlog[0]
+                try:
+                    handles.append(fleet.submit(ev["prompt"],
+                                                ev["new_tokens"]))
+                    backlog.pop(0)
+                except QueueFull:
+                    break
+            fleet.step()
+            assert time.perf_counter() - t0 < 600.0, \
+                "disagg capacity leg hung"
+        wall = time.perf_counter() - t0
+        assert all(h.done for h in handles)
+        return sum(len(h.tokens) for h in handles) / wall
+    finally:
+        fleet.close()
+
+
+def _disagg_trace(args, *, seed: int):
+    """The r12 bursty multi-turn trace with the prompt knobs turned
+    to LONG: system prompts of ``--disagg-prompt-base`` tokens,
+    capped at the prefill buffer — prompts an order of magnitude past
+    the per-turn decode budget, so an admission genuinely contends
+    with decode on a unified replica. Two edits over the r12 shape:
+
+    - Per-SESSION system prompts (the r12 trace shares 4 across the
+      fleet, which the prefix cache absorbs into a handful of cold
+      prefills — with the cache necessarily ON for the hand-off
+      chain, a shared-prefix trace measures cache hits, not prefill
+      interference). Each session's FIRST turn is cache-cold — the
+      long cold prompt disaggregation exists for — while later turns
+      still exercise the affinity + prefix-cache path.
+    - Outputs stretched 4x (still Pareto-shaped, capped at the KV
+      budget): decode cadence is the measured quantity, so each
+      stream must live long enough that its p99 reflects
+      steady-state ticks."""
+    events, _ = _trace_schedule(
+        args.disagg_requests, args.vocab, seed,
+        prompt_base=args.disagg_prompt_base,
+        prompt_cap=_disagg_prefill_len(args) - 8)
+    rng = np.random.default_rng(seed + 1)
+    bases: dict = {}
+    out = []
+    for e in events:
+        base = bases.setdefault(e["session"], rng.integers(
+            0, args.vocab, size=args.disagg_prompt_base)
+            .astype(np.int32).tolist())
+        prompt = (base + e["prompt"][args.disagg_prompt_base:])[
+            :_disagg_prefill_len(args) - 8]
+        out.append(dict(e, prompt=prompt, new_tokens=int(min(
+            4 * e["new_tokens"], args.max_len - len(prompt) - 8))))
+    mean_new = float(np.mean([e["new_tokens"] for e in out]))
+    return out, mean_new
+
+
+def _disagg_leg(args):
+    """The ISSUE 17 leg: same-N unified vs role-split fleets, PAIRED
+    per repeat on the identical long-prompt schedule. The split
+    fleet must hold decode-side p99 token latency <= 0.8x unified
+    AND aggregate tok/s >= 0.95x, every pair directional, with every
+    stream token-exact across the two fleet shapes and zero
+    recompiles on the decode replicas."""
+    from pddl_tpu.obs import RequestTracer
+
+    n = args.disagg_replicas
+    n_prefill = args.disagg_prefill_replicas or max(1, n // 2)
+    assert 1 <= n_prefill < n, "need at least one replica per role"
+    model = GPT(vocab_size=args.vocab, max_len=args.max_len,
+                embed_dim=args.embed_dim, depth=args.depth,
+                num_heads=args.heads, attention="reference")
+    params = model.init(jax.random.key(0), jnp.ones((1, 8), jnp.int32),
+                        train=False)["params"]
+    variables = {"params": params}
+    cap_tps = _disagg_capacity(args, model, variables)
+    events, mean_new = _disagg_trace(args, seed=23)
+    offered_rps = args.disagg_load * cap_tps / mean_new
+    schedule = _scale_schedule(events, offered_rps)
+    split_roles = ["prefill"] * n_prefill + ["decode"] * (n - n_prefill)
+    decode_ids = set(range(n_prefill, n))
+    _log(f"disagg: unified capacity {cap_tps:,.0f} tok/s (N={n}), "
+         f"offering {offered_rps:.2f} req/s "
+         f"({args.disagg_load:.0%} load, mean_new {mean_new:.1f}); "
+         f"split {n_prefill} prefill + {n - n_prefill} decode")
+    uni_p99s, split_p99s, p99_ratios, tps_ratios = [], [], [], []
+    uni_tps_all, split_tps_all, handoff_ms_all = [], [], []
+    exact_all = True
+    handoffs_total = handoff_failures_total = 0
+    decode_counts_ok = True
+    split_metrics_last = None
+    for rep in range(args.repeats):
+        fleet = _disagg_fleet(args, model, variables, ["unified"] * n)
+        try:
+            _disagg_warm(fleet, args)
+            uni = _disagg_wave(fleet, schedule)
+        finally:
+            fleet.close()
+        oracle = {tuple(ev["prompt"]): list(h.tokens)
+                  for ev, h in uni["handles"]}
+        tracer = RequestTracer()
+        fleet = _disagg_fleet(args, model, variables, split_roles,
+                              tracer=tracer)
+        try:
+            _disagg_warm(fleet, args)
+            split = _disagg_wave(fleet, schedule)
+            for ev, h in split["handles"]:
+                if list(h.tokens) != oracle[tuple(ev["prompt"])]:
+                    exact_all = False
+            m = fleet.metrics
+            handoffs_total += m.handoffs_completed
+            handoff_failures_total += m.handoffs_failed
+            hand_ms = [e["ms"] for e in tracer.events_named("handoff")]
+            if hand_ms:
+                handoff_ms_all.append(float(np.median(hand_ms)))
+            counts = {k: v for k, v in fleet.compile_counts().items()
+                      if int(k.split("/")[0][1:]) in decode_ids}
+            decode_counts_ok = decode_counts_ok and bool(counts) \
+                and all(v == 1 for v in counts.values())
+            split_metrics_last = m.snapshot()
+        finally:
+            fleet.close()
+        uni_p99s.append(uni["decode_lat_p99_s"])
+        split_p99s.append(split["decode_lat_p99_s"])
+        p99_ratios.append(split["decode_lat_p99_s"]
+                          / uni["decode_lat_p99_s"])
+        tps_ratios.append(split["tokens_per_s"] / uni["tokens_per_s"])
+        uni_tps_all.append(uni["tokens_per_s"])
+        split_tps_all.append(split["tokens_per_s"])
+        _log(f"disagg pair {rep}: decode p99 "
+             f"{uni['decode_lat_p99_s'] * 1e3:.1f}ms -> "
+             f"{split['decode_lat_p99_s'] * 1e3:.1f}ms "
+             f"({p99_ratios[-1]:.3f}x), tok/s retained "
+             f"{tps_ratios[-1]:.3f}x, handoffs "
+             f"{m.handoffs_completed}, token-exact {exact_all}")
+    p99_med, p99_spread = median_spread(p99_ratios)
+    tps_med, tps_spread = median_spread(tps_ratios)
+    return {
+        "trace": "bursty multi-turn long-prompt sessions "
+                 f"(system prompts {args.disagg_prompt_base} tokens, "
+                 "bounded-Pareto output lengths stretched 4x)",
+        "replicas": n,
+        "split_shape": f"{n_prefill} prefill + {n - n_prefill} "
+                       "decode, block-granular KV hand-off",
+        "n_requests_per_wave": args.disagg_requests,
+        "mean_new_tokens": round(mean_new, 2),
+        "offered_load_x_capacity": args.disagg_load,
+        "unified_capacity_tokens_per_s": round(cap_tps, 1),
+        "unified_tokens_per_s": round(median_spread(uni_tps_all)[0], 1),
+        "split_tokens_per_s": round(median_spread(split_tps_all)[0], 1),
+        "tokens_per_s_retained_x": round(tps_med, 3),
+        "tokens_per_s_retained_per_pair": [round(r, 3)
+                                           for r in tps_ratios],
+        "tokens_per_s_retained_spread_pct": round(tps_spread, 2),
+        "tokens_per_s_retained_floor": 0.95,
+        "unified_decode_lat_p99_ms": round(
+            median_spread(uni_p99s)[0] * 1e3, 2),
+        "split_decode_lat_p99_ms": round(
+            median_spread(split_p99s)[0] * 1e3, 2),
+        "decode_p99_interference": round(p99_med, 3),
+        "decode_p99_interference_per_pair": [round(r, 3)
+                                             for r in p99_ratios],
+        "decode_p99_interference_spread_pct": round(p99_spread, 2),
+        "decode_p99_interference_bound": 0.8,
+        "all_pairs_directional": all(r < 1.0 for r in p99_ratios),
+        "handoff_ms": round(float(np.median(handoff_ms_all)), 3),
+        "handoffs_completed_total": int(handoffs_total),
+        "handoffs_failed_total": int(handoff_failures_total),
+        "streams_token_exact_split_vs_unified": exact_all,
+        "zero_recompiles_decode_replicas": decode_counts_ok,
+        "split_fleet_metrics_last_repeat": split_metrics_last,
+    }
+
+
 def _autoscale_cfg(args) -> dict:
     """Worker config for the autoscale leg. Two deliberate choices:
     small enough that a scale-up's spawn+warmup completes in seconds
@@ -2562,8 +2901,82 @@ def main() -> None:
                         "crash recovery, gray-replica hedging; "
                         "ISSUE 14) and write a standalone artifact "
                         "(r19_serve_ctrlplane.json)")
+    p.add_argument("--disagg-only", action="store_true",
+                   help="run ONLY the disaggregated prefill/decode leg "
+                        "(role-split fleet, block-granular KV "
+                        "hand-off; ISSUE 17) and write a standalone "
+                        "artifact (r20_serve_disagg.json)")
+    p.add_argument("--disagg-replicas", type=int, default=4,
+                   help="fleet size N for BOTH halves of each pair: "
+                        "N unified vs a same-N role split")
+    p.add_argument("--disagg-prefill-replicas", type=int, default=0,
+                   help="prefill-pool size inside the split fleet "
+                        "(0 = auto N//2; compute share, not token "
+                        "share — decode steps cost ~10x a batched "
+                        "prefill token on this model)")
+    p.add_argument("--disagg-requests", type=int, default=48,
+                   help="trace requests per wave")
+    p.add_argument("--disagg-prompt-base", type=int, default=256,
+                   help="per-session system-prompt length of the "
+                        "long-prompt trace (tokens)")
+    p.add_argument("--disagg-load", type=float, default=0.75,
+                   help="offered rate as a fraction of measured "
+                        "unified capacity")
     p.add_argument("--out", default="")
     args = p.parse_args()
+
+    if args.disagg_only:
+        repeats = max(args.repeats, 5)
+        args.repeats = repeats
+        _log(f"disagg leg only: {args.disagg_requests} long-prompt "
+             f"trace requests, N={args.disagg_replicas} unified vs "
+             f"same-N role split, {repeats} paired runs")
+        disagg = _disagg_leg(args)
+        record = {
+            "metric": "fleet_serving_disaggregated_prefill_decode",
+            "unit": "ratio (split/unified decode p99 inter-token "
+                    "latency; split/unified aggregate tok/s); "
+                    "milliseconds (KV hand-off)",
+            "config": {
+                "model": (f"gpt {args.depth}x{args.embed_dim} "
+                          f"(vocab {args.vocab}, max_len "
+                          f"{args.max_len})"),
+                "slots_per_replica": args.slots,
+                "replicas": args.disagg_replicas,
+                "prefill_len": _disagg_prefill_len(args),
+                "prompt_base": args.disagg_prompt_base,
+                "offered_load_x_capacity": args.disagg_load,
+                "roles": "router-side role-aware routing + "
+                         "first-token KV hand-off, WAL-journaled "
+                         "rebind (pddl_tpu/serve/fleet/disagg.py)",
+                "transfer": "export_prefix_chain -> host-tier "
+                            "import on in-process replicas "
+                            "(models TPU-DMA transfer cost << "
+                            "compute; a CPU pipe would price the "
+                            "copy at compute parity), fresh-rid "
+                            "hedge-alias rebind",
+                "latency_attribution": "per-token latency = wall "
+                                       "duration of the engine tick "
+                                       "that produced the token; "
+                                       "first tokens excluded",
+            },
+            "provenance": provenance(repeats),
+            "results": {"disagg": disagg},
+            "device": jax.devices()[0].device_kind,
+        }
+        _log(f"disagg: decode p99 "
+             f"{disagg['unified_decode_lat_p99_ms']}ms -> "
+             f"{disagg['split_decode_lat_p99_ms']}ms "
+             f"({disagg['decode_p99_interference']}x, bound "
+             f"{disagg['decode_p99_interference_bound']}x); tok/s "
+             f"retained {disagg['tokens_per_s_retained_x']}x (floor "
+             f"{disagg['tokens_per_s_retained_floor']}x); hand-off "
+             f"{disagg['handoff_ms']}ms median, "
+             f"{disagg['handoffs_completed_total']} shipped; "
+             f"token-exact "
+             f"{disagg['streams_token_exact_split_vs_unified']}")
+        _write_record(record, args.out)
+        return
 
     if args.ctrlplane_only:
         repeats = max(args.repeats, 5)

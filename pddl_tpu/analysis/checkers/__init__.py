@@ -14,6 +14,7 @@ from pddl_tpu.analysis.checkers.donation import DonationRule
 from pddl_tpu.analysis.checkers.exposition import ExpositionParityRule
 from pddl_tpu.analysis.checkers.pin_release import PinReleaseRule
 from pddl_tpu.analysis.checkers.recompile import RecompileHazardRule
+from pddl_tpu.analysis.checkers.role_vocab import RoleVocabRule
 from pddl_tpu.analysis.checkers.site_vocab import SiteVocabRule
 from pddl_tpu.analysis.checkers.snapshot_vocab import SnapshotHygieneRule
 
@@ -24,6 +25,7 @@ RULES = (
     SiteVocabRule,
     ExpositionParityRule,
     SnapshotHygieneRule,
+    RoleVocabRule,
 )
 
 __all__ = ["RULES"] + [cls.__name__ for cls in RULES]

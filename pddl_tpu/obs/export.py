@@ -387,6 +387,14 @@ FLEET_COUNTER_KEYS = frozenset({
     # every process replica's wire stats.
     "hedges_launched", "hedge_wins", "hedge_cancelled", "gray_drains",
     "wire_retries", "wire_crc_rejects",
+    # Disaggregated prefill/decode serving (ISSUE 17,
+    # `serve/fleet/disagg.py`): admissions routed to the prefill pool,
+    # prefill->decode stream hand-offs completed/failed, and the chain
+    # payload they moved. (`decode_long_prompt_stalls` is deliberately
+    # NOT here: it exports as a gauge, NaN while the fleet is not
+    # disaggregation-armed.)
+    "routed_prefill", "handoffs_completed", "handoffs_failed",
+    "handoff_bytes", "handoff_tokens",
 })
 
 
@@ -422,6 +430,19 @@ def fleet_exposition(router, autoscaler=None) -> str:
                          "tokens_streamed_"))}
     snap["replicas"] = len(router.replicas)
     snap["replicas_healthy"] = router.healthy_replicas
+    # Disaggregation (ISSUE 17): pool sizes as a role-labeled series
+    # (every vocabulary role present, so a dashboard's query shape
+    # does not depend on the fleet's), and the decode-side stall gauge
+    # — NaN while the fleet is not disaggregation-armed, the same
+    # present-but-unobserved philosophy as the journal gauges below.
+    role_counts = {role: 0 for role in ("prefill", "decode", "unified")}
+    for s in router.replicas:
+        role = getattr(s.driver, "role", "unified")
+        role_counts[role] = role_counts.get(role, 0) + 1
+    snap["replicas_by_role"] = role_counts
+    armed = bool(getattr(router, "disagg_armed", False))
+    snap["decode_long_prompt_stalls"] = (
+        router.metrics.decode_long_prompt_stalls if armed else None)
     # Control-plane durability gauges (ISSUE 14). Present even when
     # the subsystem is unarmed — None renders NaN, the same
     # present-but-unobserved philosophy as every other gauge, so a

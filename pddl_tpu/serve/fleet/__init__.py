@@ -20,7 +20,10 @@ the hint-honoring open-loop replay client that meters
 goodput-per-replica-hour, `journal.py` the control-plane WAL that
 makes the ROUTER itself crash-recoverable (``FleetRouter.recover``),
 and `transport.py` the CRC-framed, sequence-checked, fault-injectable
-pipe protocol between :class:`ProcessReplica` and `worker.py`. See
+pipe protocol between :class:`ProcessReplica` and `worker.py`, and
+`disagg.py` the disaggregated prefill/decode layer (ISSUE 17): replica
+ROLES, the prefill->decode KV hand-off executor, and the per-role
+autoscaler multiplexer. See
 `docs/OPERATIONS.md` § "Fleet runbook", § "Overload & brownout",
 § "Autoscaling runbook" and § "Control-plane failure & recovery", and
 `docs/SERVING.md` § "Serving fleet".
@@ -37,6 +40,12 @@ from pddl_tpu.serve.fleet.autoscaler import (
     AutoscaleMetrics,
     FleetAutoscaler,
     ScaleDecision,
+)
+from pddl_tpu.serve.fleet.disagg import (
+    ROLES,
+    HandoffManager,
+    RoleAutoscaler,
+    validate_role,
 )
 from pddl_tpu.serve.fleet.health import (
     BreakerState,
@@ -81,14 +90,17 @@ __all__ = [
     "FrameReceiver",
     "FrameSender",
     "GrayDetector",
+    "HandoffManager",
     "LocalReplica",
     "NoHealthyReplica",
     "OverloadDetector",
     "ProcessReplica",
+    "ROLES",
     "ReplayReport",
     "ReplicaDied",
     "ReplicaLifecycle",
     "ReplicaSpawnTimeout",
+    "RoleAutoscaler",
     "RouterJournal",
     "ScaleDecision",
     "TokenBucket",
@@ -97,4 +109,5 @@ __all__ = [
     "WireFaultSpec",
     "diurnal_trace",
     "replay_trace",
+    "validate_role",
 ]

@@ -62,6 +62,7 @@ import enum
 from collections import deque
 from typing import Deque, Dict, Optional, Tuple
 
+from pddl_tpu.serve.fleet.disagg import role_of, validate_role
 from pddl_tpu.serve.fleet.replica import ReplicaDied, ReplicaSpawnTimeout
 
 
@@ -141,6 +142,22 @@ class FleetAutoscaler:
       tracer: defaults to the router's tracer.
       clock: defaults to the router's clock (one epoch for holds,
         cooldowns, breaker backoffs, and heartbeats).
+      role: scope this controller to ONE role pool of a disaggregated
+        fleet (`fleet/disagg.py`): size bounds, mean load, and the
+        retirement victim are all computed over replicas of this role
+        only, and the factory is expected to produce drivers carrying
+        it. ``None`` (default) controls the whole fleet — the
+        pre-ISSUE-17 behavior.
+      attach: attach to the router's step cadence (the default).
+        :class:`~pddl_tpu.serve.fleet.disagg.RoleAutoscaler` passes
+        ``False`` — it multiplexes several controllers behind one
+        attachment, and a second ``attach_autoscaler`` would silently
+        replace the first.
+      id_alloc: optional ``fn() -> int`` minting replica ids. Per-role
+        controllers over one fleet MUST share an allocator (the
+        multiplexer provides it) — each minting independently would
+        collide on the shared id space. ``None`` uses an internal
+        counter seeded past the fleet's current ids.
     """
 
     def __init__(self, router, replica_factory, *,
@@ -152,7 +169,9 @@ class FleetAutoscaler:
                  goodput_window_s: float = 5.0,
                  spawn_backoff_base_s: float = 0.5,
                  spawn_backoff_max_s: float = 30.0,
-                 tracer=None, clock=None):
+                 tracer=None, clock=None,
+                 role: Optional[str] = None, attach: bool = True,
+                 id_alloc=None):
         if not 1 <= int(min_replicas) <= int(max_replicas):
             raise ValueError(
                 f"need 1 <= min_replicas <= max_replicas, got "
@@ -168,6 +187,8 @@ class FleetAutoscaler:
                 f"got {spawn_backoff_base_s}/{spawn_backoff_max_s}")
         self.router = router
         self._factory = replica_factory
+        self.role = (validate_role(role) if role is not None else None)
+        self._id_alloc = id_alloc
         self.min_replicas = int(min_replicas)
         self.max_replicas = int(max_replicas)
         self.up_pressure = float(up_pressure)
@@ -196,7 +217,8 @@ class FleetAutoscaler:
         self._last_load = 0.0
         # (t, {class: cumulative tokens}) ring for the goodput rates.
         self._goodput_ring: Deque[Tuple[float, Dict[str, int]]] = deque()
-        router.attach_autoscaler(self)
+        if attach:
+            router.attach_autoscaler(self)
 
     # ------------------------------------------------------------- signals
     def pressure(self, now: float) -> float:
@@ -208,10 +230,19 @@ class FleetAutoscaler:
             return 0.0
         return admission.detector.pressure(now)
 
+    def _pool(self):
+        """The replicas this controller governs: the whole fleet, or
+        — for a role-scoped controller — its role's pool only."""
+        slots = self.router.replicas
+        if self.role is None:
+            return slots
+        return [s for s in slots if role_of(s.driver) == self.role]
+
     def mean_load(self) -> float:
-        """Mean assigned requests per AVAILABLE replica (the routable
-        denominator: dead/open-circuit replicas serve nothing)."""
-        avail = [s for s in self.router.replicas if s.available]
+        """Mean assigned requests per AVAILABLE replica of this
+        controller's pool (the routable denominator: dead/open-circuit
+        replicas serve nothing)."""
+        avail = [s for s in self._pool() if s.available]
         if not avail:
             return 0.0
         return sum(s.load for s in avail) / len(avail)
@@ -265,7 +296,7 @@ class FleetAutoscaler:
             # persists past the cooldown re-earns its hold from zero.
             self._above_since = self._below_since = None
             return ScaleDecision.COOLDOWN
-        n = len(self.router.replicas)
+        n = len(self._pool())
         want_up = self._last_pressure >= self.up_pressure or (
             self.up_load is not None and self._last_load >= self.up_load)
         want_down = (self._last_pressure <= self.down_pressure
@@ -295,8 +326,11 @@ class FleetAutoscaler:
 
     # ------------------------------------------------------------ scale up
     def _start_spawn(self, now: float) -> ScaleDecision:
-        rid = self._next_id
-        self._next_id += 1
+        if self._id_alloc is not None:
+            rid = int(self._id_alloc())
+        else:
+            rid = self._next_id
+            self._next_id += 1
         self.metrics.scale_up_started += 1
         try:
             driver = self._factory(rid)
@@ -349,11 +383,20 @@ class FleetAutoscaler:
 
     # ---------------------------------------------------------- scale down
     def _retire_one(self, now: float) -> ScaleDecision:
-        avail = [s for s in self.router.replicas if s.available]
-        if len(avail) < 2:
+        avail = [s for s in self._pool() if s.available]
+        if len(avail) < 2 and self.role is None:
             return ScaleDecision.HOLD  # migration needs a survivor
+        if self.role is not None:
+            # A role-scoped retirement needs a survivor for the WORK
+            # (any available replica elsewhere qualifies — the router
+            # checks) but must also never empty its own pool below
+            # min_replicas, which the n-bound in _tick already holds;
+            # an empty or singleton pool simply has nothing optional
+            # to retire when min_replicas >= 1.
+            if not avail or len(self.router.replicas) < 2:
+                return ScaleDecision.HOLD
         victim = min(avail, key=lambda s: s.load)
-        if self.up_load is not None:
+        if self.up_load is not None and len(avail) >= 2:
             # Projection guard: survivors must absorb the victim's work
             # without re-crossing the scale-up band — a shrink that
             # causes the next grow is flapping with extra steps.
@@ -362,7 +405,15 @@ class FleetAutoscaler:
                 self.metrics.scale_down_vetoed += 1
                 self._below_since = None
                 return ScaleDecision.HOLD
-        self.router.scale_down(victim.replica_id)
+        try:
+            self.router.scale_down(victim.replica_id)
+        except ValueError:
+            # No other available replica fleet-wide to absorb the
+            # victim's work (possible for a role-scoped controller
+            # whose siblings' pools all died): a retirement must never
+            # orphan work, so hold and re-earn the band.
+            self._below_since = None
+            return ScaleDecision.HOLD
         self.metrics.scale_down_completed += 1
         self._arm_cooldown(now)
         return ScaleDecision.SCALE_DOWN
@@ -399,7 +450,7 @@ class FleetAutoscaler:
         state, the raw signals, and the per-class goodput rates as a
         labeled series."""
         return {
-            "replicas": len(self.router.replicas),
+            "replicas": len(self._pool()),
             "pending_spawns": 1 if self._pending is not None else 0,
             "pressure": self._last_pressure,
             "mean_load_per_replica": self._last_load,

@@ -24,6 +24,10 @@ record per control-plane event to ``wal.log``:
   tokens from (params, prompt, tokens-so-far).
 - ``finish`` — the stream settled (with state/reason); recovery
   replays admits minus finishes.
+- ``handoff`` — the disaggregated fleet's prefill->decode KV
+  rebinding (ISSUE 17): the finished prefill chain left
+  ``from_replica`` and the stream now decodes on ``replica``.
+  Audit-only on recovery, like ``route``.
 
 Record framing on disk is ``magic | seq | length | crc32 | payload``;
 a torn tail (the record a SIGKILL cut mid-write) fails its CRC or
@@ -66,21 +70,40 @@ from typing import Dict, List, Optional, Tuple
 
 from pddl_tpu.serve import drain as drain_io
 
-# Version 1: the initial control-plane WAL (ISSUE 14). Bumping the
-# record shape requires bumping this AND renaming RECORD_KEYS_V1 —
+# Version 2: version 1 (the initial ISSUE 14 control-plane WAL) plus
+# the ``handoff`` record — the prefill->decode KV rebinding the
+# disaggregated fleet stamps, which carries ``from_replica``. Bumping
+# the record shape requires bumping this AND renaming RECORD_KEYS_V2 —
 # graftlint's snapshot-hygiene rule machine-checks the pairing, the
 # same discipline `serve/drain.py` carries for its snapshot entries.
-JOURNAL_VERSION = 1
-_READABLE_JOURNAL_VERSIONS = frozenset({1})
+# V1 logs stay readable: the new record kind is additive and recovery
+# ignores it like ``route``.
+JOURNAL_VERSION = 2
+_READABLE_JOURNAL_VERSIONS = frozenset({1, 2})
 
 # Machine-checked wire manifest (graftlint `snapshot-hygiene`): the
 # exact record keys the encode_* functions below emit at the CURRENT
 # journal version. Changing a record shape requires bumping
 # JOURNAL_VERSION and renaming this tuple to RECORD_KEYS_V<new> in the
 # same commit — the static checker fails the tree otherwise.
-RECORD_KEYS_V1 = ("rec", "rid", "prompt", "max_new_tokens", "sampling",
+RECORD_KEYS_V2 = ("rec", "rid", "prompt", "max_new_tokens", "sampling",
                   "deadline_s", "priority", "adapter", "constraint",
-                  "session", "replica", "via", "toks", "state", "reason")
+                  "session", "replica", "via", "toks", "state", "reason",
+                  "from_replica")
+
+# Machine-checked record-kind vocabulary (graftlint `role-vocab`):
+# every ``"rec"`` literal an encoder below emits, exactly. Recovery's
+# fold dispatches on these; adding a kind here without a reader-side
+# decision (rebuild vs audit-only) is what the rule exists to catch.
+RECORD_KINDS = ("admit", "route", "tokens", "finish", "handoff")
+
+# Machine-checked ``via`` vocabulary (graftlint `role-vocab`): every
+# label a ``route`` record may carry — the router's routing labels
+# plus the re-bind provenances (``migration``/``hedge``). The
+# router's ROUTE_LABELS must be a subset; a label minted there but
+# missing here is a binding the forensic reader cannot classify.
+VIA_LABELS = ("sticky", "adapter", "affinity", "load", "host_tier",
+              "hash", "shed", "prefill", "migration", "hedge")
 
 _HEADER = struct.Struct(">4sQII")  # magic, seq, payload len, crc32
 _MAGIC = b"PJL1"
@@ -122,6 +145,17 @@ def encode_tokens(rid: int, toks: List[int]) -> Dict:
 def encode_finish(rid: int, state: str, reason: Optional[str]) -> Dict:
     return {"rec": "finish", "rid": int(rid), "state": str(state),
             "reason": reason}
+
+
+def encode_handoff(rid: int, from_replica: int, to_replica: int) -> Dict:
+    """The prefill->decode KV rebinding (disaggregated fleet, ISSUE
+    17): the finished prefill chain shipped from ``from_replica`` and
+    the stream now runs on ``to_replica``. Audit-only on recovery —
+    like ``route``, the fresh fleet re-routes — but it is what a
+    hand-off forensic reads."""
+    return {"rec": "handoff", "rid": int(rid),
+            "replica": int(to_replica),
+            "from_replica": int(from_replica)}
 
 
 class RouterJournal:
@@ -420,10 +454,10 @@ def read_state(journal_dir: str) -> Tuple[Dict[int, Dict], int]:
         elif kind == "finish":
             finished.add(rid)
             entries.pop(rid, None)
-        # "route" records rebuild nothing here: recovery re-routes on
-        # the fresh fleet (the old bindings name dead processes), but
-        # they make the decision history auditable and are what a
-        # partial-failover forensic reads.
+        # "route" and "handoff" records rebuild nothing here: recovery
+        # re-routes on the fresh fleet (the old bindings name dead
+        # processes), but they make the decision history auditable and
+        # are what a partial-failover or hand-off forensic reads.
     for rid in finished:
         entries.pop(rid, None)
     return entries, max_rid + 1

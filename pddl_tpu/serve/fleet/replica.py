@@ -44,6 +44,7 @@ import time
 from typing import Dict, List, Optional, Tuple
 
 from pddl_tpu.serve import drain as drain_io
+from pddl_tpu.serve.fleet.disagg import validate_role
 from pddl_tpu.serve.fleet.transport import (
     MAX_FRAME_BYTES,
     FrameReceiver,
@@ -165,12 +166,19 @@ class LocalReplica:
     :class:`~pddl_tpu.serve.ServeEngine`; keeping construction in a
     factory is what makes the circuit breaker's HALF_OPEN probe a real
     respawn instead of a pointless ping at a dead object.
+
+    ``role`` is the replica's place in a disaggregated fleet
+    (`fleet/disagg.py`): ``prefill``, ``decode``, or ``unified`` (the
+    default — both phases, the pre-ISSUE-17 behavior). The role is
+    router-side policy; the engine underneath is identical.
     """
 
     can_respawn = True
 
-    def __init__(self, replica_id: int, engine_factory):
+    def __init__(self, replica_id: int, engine_factory, *,
+                 role: str = "unified"):
         self.replica_id = int(replica_id)
+        self.role = validate_role(role)
         self._factory = engine_factory
         self.engine = engine_factory()
         self._ledger = HandleLedger()
@@ -297,6 +305,7 @@ class ProcessReplica:
     can_respawn = True
 
     def __init__(self, replica_id: int, worker_config: Dict[str, object], *,
+                 role: str = "unified",
                  python: str = sys.executable, ready_timeout_s: float = 300.0,
                  ping_interval_s: float = 0.25, drain_timeout_s: float = 10.0,
                  call_timeout_s: float = 30.0, transport: str = "framed",
@@ -313,6 +322,13 @@ class ProcessReplica:
         self._framed = transport == "framed"
         self._config = dict(worker_config)
         self._config["framed"] = self._framed
+        # Disaggregation role (`fleet/disagg.py`): an explicit
+        # worker_config value wins over the kwarg, and the worker
+        # validates it again on its side of the pipe (vocabulary
+        # parity, graftlint `role-vocab`).
+        self._config["role"] = validate_role(
+            self._config.get("role", role))
+        self.role = self._config["role"]
         # Both pipe ends must enforce the SAME cap (an explicit
         # worker_config value wins — the asymmetric-cap chaos tests
         # use that): a worker with a larger cap would emit snapshot/

@@ -28,6 +28,15 @@ set, so one replica's death remaps only its own keys. A full replica (typed
 healthy replica, carrying the ``retry_after_s`` hint forward; only a
 fleet-wide full queue rejects the caller.
 
+**Disaggregation (ISSUE 17).** When the fleet holds strict ``prefill``
+and ``decode`` role replicas (`fleet/disagg.py`), non-sticky
+admissions route to the prefill pool (label ``prefill``) and each
+stream hands off to a decode replica at first token — the finished KV
+chain ships over the r18 chain wire into the target's host tier, the
+rebinding journals as a ``handoff`` record, and decode replicas never
+pay a long prompt's prefill. An all-unified fleet (the default role)
+routes exactly as above.
+
 **Health.** Per-replica circuit breaker (`fleet/health.py`):
 consecutive failures or heartbeat silence trip CLOSED→OPEN, a bounded
 exponential backoff gates HALF_OPEN probes, and a successful probe (a
@@ -80,6 +89,7 @@ from pddl_tpu.obs.trace import NULL_TRACER
 from pddl_tpu.serve import drain as drain_io
 from pddl_tpu.serve.fleet import journal as journal_io
 from pddl_tpu.serve.fleet.admission import AdmissionControl
+from pddl_tpu.serve.fleet.disagg import HandoffManager, role_of
 from pddl_tpu.serve.fleet.health import (
     BreakerState,
     CircuitBreaker,
@@ -97,6 +107,15 @@ from pddl_tpu.serve.request import (
     SamplingParams,
 )
 from pddl_tpu.utils.faults import KillPoint
+
+# Machine-checked route-label vocabulary (graftlint `role-vocab`):
+# every label `_route`/`submit` can stamp on a routing decision. The
+# journal's `VIA_LABELS` manifest must cover all of them (plus its own
+# ledger-only labels, `migration`/`hedge`) — a label minted here that
+# the WAL reader cannot classify is a lint error, not a runtime
+# surprise.
+ROUTE_LABELS = ("sticky", "adapter", "affinity", "load", "host_tier",
+                "hash", "shed", "prefill")
 
 
 class NoHealthyReplica(RuntimeError):
@@ -189,12 +208,29 @@ class FleetMetrics:
         #                                HOST tier: no replica held the
         #                                chain in HBM, one held it in
         #                                host RAM (`kvcache/hosttier.py`)
+        self.routed_prefill = 0        # disaggregated fleet (ISSUE 17):
+        #                                cold prompt sent to the PREFILL
+        #                                pool; the stream hands off to a
+        #                                decode replica at first token
         # Replica-to-replica prefix transfer (ISSUE 13): chains pulled
         # from the replica that held them into the routed target's host
         # tier — duplicate prefill eliminated fleet-wide — and the
         # prompt tokens those pulls moved.
         self.chain_pulls = 0
         self.chain_pull_tokens = 0
+        # Prefill->decode hand-offs (`fleet/disagg.py`): streams
+        # rebound from the prefill pool to a decode replica at first
+        # token, the subset that failed (died mid-transfer or the
+        # target refused the KV), and the chain payload they moved.
+        # `decode_long_prompt_stalls` counts streams that had to KEEP
+        # decoding on a prefill replica because no decode replica
+        # could take them (once per stream) — the exposition gauges it
+        # NaN while the fleet is not disaggregation-armed.
+        self.handoffs_completed = 0
+        self.handoffs_failed = 0
+        self.handoff_bytes = 0
+        self.handoff_tokens = 0
+        self.decode_long_prompt_stalls = 0
         self.shed_rerouted = 0           # QueueFull → another replica took it
         self.shed_rejected = 0           # fleet-wide full: caller rejected
         # Admission control / brownout (`fleet/admission.py`): front-
@@ -513,6 +549,10 @@ class FleetRouter:
         # use the same key or recovery would resurrect a stream whose
         # finish it filed under an unknown rid.
         self._hedge_alias: Dict[int, int] = {}
+        # Prefill->decode stream rebinding (`fleet/disagg.py`). Always
+        # constructed; it only acts when a prefill-role slot emits
+        # tokens, so an all-unified fleet never touches it.
+        self._handoff = HandoffManager(self)
         self._slots: List[_ReplicaSlot] = []
         for driver in replicas:
             self._new_slot(driver)
@@ -616,6 +656,18 @@ class FleetRouter:
         return sum(s.available for s in self._slots)
 
     @property
+    def disagg_armed(self) -> bool:
+        """Disaggregated serving armed (ISSUE 17): the fleet holds at
+        least one strict-``prefill`` AND one strict-``decode`` replica.
+        A fleet-SHAPE property, not a health one — a split fleet whose
+        prefill pool momentarily died stays armed (routing degrades to
+        the unified path until a prefill replica returns); an
+        all-unified fleet never arms, which is the backward-compat
+        guarantee."""
+        roles = {role_of(s.driver) for s in self._slots}
+        return "prefill" in roles and "decode" in roles
+
+    @property
     def has_work(self) -> bool:
         return any(not fh.done for fh in self._by_rid.values()) \
             or bool(self._orphans)
@@ -679,6 +731,26 @@ class FleetRouter:
                 self._sessions.move_to_end(session)  # LRU touch
                 if stuck.available:
                     return stuck, "sticky", dev_depths, host_depths
+        if self.disagg_armed:
+            # Disaggregated fleet (ISSUE 17): every non-sticky
+            # admission lands on the PREFILL pool — cold prompts
+            # chunk-prefill there and hand off at first token, so a
+            # decode replica never stalls a tick on one. Prefix
+            # affinity applies WITHIN the pool (a shared system prompt
+            # still lands where its KV lives), least-loaded breaks
+            # cold ties. Adapter affinity is intentionally skipped:
+            # its home would drag long prompts onto whatever decode
+            # replica the stream handed off to last time. With the
+            # whole pool down, routing degrades to the unified path
+            # below — slow beats refused.
+            pool = [s for s in healthy
+                    if role_of(s.driver) == "prefill"]
+            if pool:
+                best = min(pool, key=lambda s: (
+                    -s.shadow.match_blocks(
+                        prompt, max_blocks=self._affinity_blocks),
+                    s.load))
+                return best, "prefill", dev_depths, host_depths
         if adapter is not None:
             # Adapter affinity outranks prefix affinity (reloading
             # LoRA factors costs more than a cold prefix chunk) but
@@ -967,6 +1039,8 @@ class FleetRouter:
                 self.metrics.routed_load_balanced += 1
             elif how == "host_tier":
                 self.metrics.routed_host_tier += 1
+            elif how == "prefill":
+                self.metrics.routed_prefill += 1
             else:
                 self.metrics.routed_hash += 1
             if self._admission is not None:
@@ -1170,6 +1244,11 @@ class FleetRouter:
                 slot.breaker.record_success(now)
             tokens += self._apply_events(slot, events)
             self._forward_cancels(slot)
+        # Prefill->decode hand-offs run AFTER the slot loop (a hand-off
+        # restores onto another slot — same no-mutation-under-iteration
+        # discipline the autoscaler tick below rides).
+        if self._handoff.pending:
+            self._handoff.execute()
         self._maybe_gray_drain()
         if self._autoscaler is not None:
             # One controller decision per routing round, AFTER the slot
@@ -1298,6 +1377,13 @@ class FleetRouter:
                     tokens += len(toks)
                     self.metrics.tokens_streamed_by_priority[
                         fh.request.priority.value] += len(toks)
+                    if toks and role_of(slot.driver) == "prefill" \
+                            and rid not in self._hedge_rids:
+                        # First token on a PREFILL slot: prefill is
+                        # done, decode has begun in the wrong place —
+                        # queue the stream's hand-off (executed after
+                        # the slot loop, `fleet/disagg.py`).
+                        self._handoff.note(rid)
                     if self._journal is not None:
                         # The emitted-token mirror delta: fsync-BATCHED
                         # (losing a tail is safe — replay regenerates

@@ -45,6 +45,13 @@ from pddl_tpu.serve.fleet.transport import (
 )
 from pddl_tpu.serve.request import Priority, QueueFull
 
+# Machine-checked role vocabulary (graftlint `role-vocab`): must stay
+# set-equal to `fleet/disagg.py`'s ROLES — declared as a literal on
+# BOTH sides of the process boundary on purpose, so the worker can
+# refuse a role this build has never heard of even when spawned by a
+# newer (or older) parent.
+ROLES = ("prefill", "decode", "unified")
+
 
 def build_engine(config: Dict[str, object]):
     """Engine from a flat config dict (the fleet's one model family for
@@ -160,6 +167,16 @@ def main(argv=None) -> int:
     args = p.parse_args(argv)
     config = json.loads(args.config_json)
 
+    # Disaggregation role (ISSUE 17): validated BEFORE the engine
+    # build — a misconfigured role is a config error the spawn should
+    # surface (the parent sees a ready timeout + this stderr line),
+    # not a replica that silently serves the wrong phase.
+    role = str(config.get("role", "unified"))
+    if role not in ROLES:
+        print(f"invalid replica role {role!r}: must be one of {ROLES}",
+              file=sys.stderr)
+        return 2
+
     # Framed transport (ISSUE 14, `fleet/transport.py`): the parent
     # injects ``framed: true`` and both directions gain length+CRC+seq
     # framing, duplicate suppression, and bounded resend — stdout is
@@ -188,7 +205,7 @@ def main(argv=None) -> int:
 
     signal.signal(signal.SIGTERM, _on_sigterm)
     emit({"ev": "ready", "replica": config.get("replica_id"),
-          "compile_counts": engine.compile_counts()})
+          "role": role, "compile_counts": engine.compile_counts()})
 
     import time
 

@@ -165,7 +165,12 @@ _LOWER_BETTER = ("ttft", "latency", "_ms", "_wall_s", "overhead",
                  # Prefill tokens the fleet spent on prefixes a sibling
                  # replica already held (r18): the number the chain
                  # pull exists to eliminate.
-                 "duplicate_prefill")
+                 "duplicate_prefill",
+                 # Decode-side p99 token latency under long-prompt
+                 # bursts (r20): the interference disaggregation
+                 # exists to remove — lower means prefill stopped
+                 # stealing decode ticks.
+                 "interference")
 _NEVER = ("spread", "samples", "per_pair", "per_repeat", "n_requests",
           "count", "injected", "provenance", "seed", "offered",
           # The r18 tier curve's sweep axis (working_set_x is a

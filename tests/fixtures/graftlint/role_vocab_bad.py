@@ -1,0 +1,41 @@
+"""Seeded-bad fixture for the ``role-vocab`` rule (ISSUE 17): the
+disaggregation vocabularies drift in every direction the rule covers.
+Self-paired — RECORD_KINDS, VIA_LABELS, and ROUTE_LABELS all live
+here, the fixture analogue of journal.py + router.py in one module.
+
+Seeded findings (5):
+- ``encode_handoff`` emits ``"handoff"``, which RECORD_KINDS never
+  declared — recovery has no reader-side decision for the kind;
+- RECORD_KINDS lists ``"finish"`` and ``"retired_kind"``, which no
+  encoder emits — two stale entries;
+- ROUTE_LABELS mints ``"mystery"``, absent from VIA_LABELS;
+- an ``encode_route`` call site passes the literal ``via="hedgerow"``,
+  absent from VIA_LABELS.
+"""
+
+RECORD_KINDS = ("admit", "route", "finish", "retired_kind")
+
+VIA_LABELS = ("sticky", "load", "migration", "hedge")
+
+ROUTE_LABELS = ("sticky", "load", "mystery")
+
+
+def encode_admit(rid):
+    return {"rec": "admit", "rid": int(rid)}
+
+
+def encode_route(rid, replica_id, via):
+    return {"rec": "route", "rid": int(rid), "replica": int(replica_id),
+            "via": str(via)}
+
+
+def encode_handoff(rid, from_replica, to_replica):
+    # BUG: a new record kind that never entered RECORD_KINDS.
+    return {"rec": "handoff", "rid": int(rid),
+            "replica": int(to_replica),
+            "from_replica": int(from_replica)}
+
+
+def journal_rebind(journal, rid, replica_id):
+    # BUG: a via label minted at the call site only.
+    journal.append(encode_route(rid, replica_id, "hedgerow"))

@@ -1,0 +1,29 @@
+"""Good twin for the role-vocab fixture: every emitted record kind is
+declared (and none is stale), ROUTE_LABELS is a subset of VIA_LABELS,
+and every literal ``via`` at an ``encode_route`` call site is
+classified. Must lint clean."""
+
+RECORD_KINDS = ("admit", "route", "handoff")
+
+VIA_LABELS = ("sticky", "load", "migration", "hedge")
+
+ROUTE_LABELS = ("sticky", "load")
+
+
+def encode_admit(rid):
+    return {"rec": "admit", "rid": int(rid)}
+
+
+def encode_route(rid, replica_id, via):
+    return {"rec": "route", "rid": int(rid), "replica": int(replica_id),
+            "via": str(via)}
+
+
+def encode_handoff(rid, from_replica, to_replica):
+    return {"rec": "handoff", "rid": int(rid),
+            "replica": int(to_replica),
+            "from_replica": int(from_replica)}
+
+
+def journal_rebind(journal, rid, replica_id):
+    journal.append(encode_route(rid, replica_id, "hedge"))

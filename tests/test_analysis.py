@@ -65,6 +65,10 @@ BAD_FIXTURES = [
     # The journal-manifest twin (ISSUE 14): a WAL record key added
     # without a JOURNAL_VERSION bump — same rule, second wire format.
     ("snapshot-hygiene", "journal_bad.py", 1),
+    # The disaggregation vocabularies (ISSUE 17): undeclared record
+    # kind + two stale kinds + an unclassified route label + an
+    # unclassified literal via at an encode_route call site.
+    ("role-vocab", "role_vocab_bad.py", 3),
 ]
 
 GOOD_FIXTURES = [
@@ -72,6 +76,7 @@ GOOD_FIXTURES = [
     "donation_good.py", "recompile_good.py",
     "site_vocab_good.py", "site_vocab_good_spec.py",
     "exposition_good.py", "snapshot_good.py", "journal_good.py",
+    "role_vocab_good.py",
 ]
 
 

@@ -400,6 +400,64 @@ def test_r19_ctrlplane_artifact_is_gated():
                 in paths)
 
 
+def test_r20_disagg_artifact_is_gated():
+    """The disaggregated-serving artifact participates in the series:
+    it loads, keys into a (metric, config) group, its committed
+    headlines clear the ISSUE 17 bounds (split-fleet decode-side p99
+    token latency <= 0.8x the same-N unified fleet with aggregate
+    tok/s >= 0.95x retained, EVERY pair directional; hand-off latency
+    measured per shipped chain; every stream token-exact across the
+    two fleet shapes; zero recompiles on the decode replicas), they
+    are DIRECTIONAL — and a same-config r-record that regresses them
+    fails `check_series` LOUDLY."""
+    path = os.path.join(_BENCH_DIR, "r20_serve_disagg.json")
+    records = [r for r in load_artifact(path)
+               if artifact_key(r) is not None]
+    assert records, "r20_serve_disagg.json has no keyed record"
+    d = records[0]["results"]["disagg"]
+    # ISSUE 17 acceptance bounds on the committed medians.
+    assert d["decode_p99_interference"] <= 0.8
+    assert d["decode_p99_interference_bound"] == 0.8
+    assert d["tokens_per_s_retained_x"] >= 0.95
+    assert d["tokens_per_s_retained_floor"] == 0.95
+    assert d["all_pairs_directional"] is True
+    assert len(d["decode_p99_interference_per_pair"]) >= 5
+    assert all(r <= 0.8 for r in d["decode_p99_interference_per_pair"])
+    assert all(r >= 0.95 for r in d["tokens_per_s_retained_per_pair"])
+    assert d["handoffs_completed_total"] > 0
+    assert d["handoff_ms"] > 0          # measured, recorded
+    assert d["streams_token_exact_split_vs_unified"] is True
+    assert d["zero_recompiles_decode_replicas"] is True
+    m = d["split_fleet_metrics_last_repeat"]
+    assert m["handoffs_completed"] > 0
+    for key in ("decode_p99_interference", "handoff_ms",
+                "tokens_per_s_retained_x", "split_decode_lat_p99_ms",
+                "unified_capacity_tokens_per_s"):
+        assert metric_direction(key) != 0, key
+    # A hypothetical r21 record at the SAME config whose disagg
+    # headlines regressed must fail the series gate loudly.
+    worse = copy.deepcopy(records[0])
+    w = worse["results"]["disagg"]
+    w["decode_p99_interference"] *= 2.0
+    w["tokens_per_s_retained_x"] *= 0.8
+    w["handoff_ms"] *= 3.0
+    import json as _json
+    import tempfile
+    with tempfile.TemporaryDirectory() as d_:
+        old_p = os.path.join(d_, "r20_d.json")
+        new_p = os.path.join(d_, "r21_d.json")
+        with open(old_p, "w") as f:
+            _json.dump(records[0], f)
+        with open(new_p, "w") as f:
+            _json.dump(worse, f)
+        pairs, failures = check_series([old_p, new_p])
+        assert pairs == 1 and len(failures) == 1
+        paths = {r["path"] for r in failures[0]["regressions"]}
+        assert "results.disagg.decode_p99_interference" in paths
+        assert "results.disagg.tokens_per_s_retained_x" in paths
+        assert "results.disagg.handoff_ms" in paths
+
+
 def test_compare_flags_directional_regressions_only():
     old = _record(tokens_per_s=1000.0, ttft_p99_s=0.10, spread_pct=2.0,
                   prefix_hit_rate=0.97)

@@ -844,7 +844,11 @@ def test_worker_self_reports_tick_walls_and_delay_knob():
             if len(slow) >= 3:
                 break
         assert len(slow) >= 3, "delay knob never surfaced in samples"
-        assert min(slow) > max(clean)
+        # Typical-vs-typical, not min-vs-max: a single scheduler
+        # hiccup in the clean phase can push one clean tick past the
+        # 50ms knob on a loaded host, and that outlier says nothing
+        # about the knob. The knob must shift the TYPICAL tick.
+        assert float(np.median(slow)) > float(np.median(clean))
     finally:
         rep.close()
 

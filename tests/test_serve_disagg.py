@@ -1,0 +1,473 @@
+"""Disaggregated prefill/decode serving (`pddl_tpu/serve/fleet/
+disagg.py` + role plumbing), CPU.
+
+The contracts under test:
+
+- **Backward compatibility**: an all-unified fleet never arms — zero
+  hand-offs, zero prefill routes, r19 behavior bit-for-bit.
+- **The hand-off** (``@pytest.mark.disagg``): on a split fleet every
+  cold prompt routes to the prefill pool, chunk-prefills there, and
+  the finished KV chain ships to a decode replica — every stream
+  token-identical to the one-shot oracle, every hand-off journaled
+  under the original rid, zero recompiles on the decode replicas
+  after warmup (the per-replica ``pin_zero_recompiles``).
+- **Chaos**: the prefill replica dies mid-KV-hand-off (seeded
+  3-coordinate matrix): the in-flight chain unwinds on the source,
+  the stream re-prefills elsewhere token-exact, and no host-tier pins
+  leak. A REFUSED transfer (tier-less decode target) keeps the stream
+  decoding where it prefilled — slow beats wrong.
+- **Stall accounting**: with no decode replica available the move
+  waits and ``decode_long_prompt_stalls`` counts ONCE per stream.
+- **Recovery**: a router crash mid-split-fleet recovers from the WAL
+  (handoff records in the log are audit-only) and every stream
+  finishes token-exact on a fresh split fleet.
+- **Per-role autoscaling**: the prefill pool scales up on its own
+  load signal while the decode pool holds; one shared replica-id
+  line; role gauges as labeled series.
+- **Observability**: role counts, hand-off counters, and the
+  stall gauge (NaN while unarmed) render and re-parse through the
+  strict Prometheus referee.
+"""
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pddl_tpu.models.gpt import tiny_gpt
+from pddl_tpu.obs import RequestTracer, fleet_exposition, parse_prometheus_text
+from pddl_tpu.serve import ServeEngine
+from pddl_tpu.serve.fleet import (
+    FleetRouter,
+    LocalReplica,
+    ReplicaDied,
+    RoleAutoscaler,
+    RouterJournal,
+    ScaleDecision,
+    validate_role,
+)
+from pddl_tpu.serve.fleet import disagg as disagg_mod
+from pddl_tpu.serve.fleet import journal as journal_io
+from pddl_tpu.serve.fleet import router as router_mod
+from pddl_tpu.serve.fleet import worker as worker_mod
+from pddl_tpu.serve.request import RequestState
+from conftest import ref_greedy as _ref_greedy, FakeClock as _FakeClock
+
+pytestmark = pytest.mark.disagg
+
+BS = 8  # prefix/affinity block size, shared router <-> engines
+
+
+@pytest.fixture(scope="module")
+def gpt_setup():
+    model = tiny_gpt(vocab_size=32, max_len=64)
+    prompt = jnp.ones((1, 8), jnp.int32)
+    params = model.init(jax.random.key(0), prompt, train=False)["params"]
+    return model, {"params": params}
+
+
+def _no_sleep(_):
+    pass
+
+
+def _engine_factory(model, variables, *, host=1 << 24):
+    """Hand-off-capable engine: prefix cache ON (the chain to export)
+    and host tier ON (the landing zone) — ``host=None`` builds the
+    tier-less twin the refusal leg needs."""
+    def make():
+        return ServeEngine(model, variables, max_slots=2, prefill_len=32,
+                           prefix_cache_blocks=24, prefix_block_size=BS,
+                           prefix_chunk=BS, host_tier=host,
+                           max_queue_depth=64, backoff_sleep=_no_sleep)
+    return make
+
+
+def _split_fleet(model, variables, n_prefill, n_decode, *,
+                 decode_host=1 << 24, tracer=None, clock=None,
+                 replica_cls=LocalReplica, **router_kw):
+    """n_prefill prefill-role + n_decode decode-role LocalReplicas
+    (prefill ids first) over hand-off-capable engines."""
+    pf = _engine_factory(model, variables)
+    df = _engine_factory(model, variables, host=decode_host)
+    replicas = [replica_cls(i, pf, role="prefill")
+                for i in range(n_prefill)]
+    replicas += [replica_cls(n_prefill + i, df, role="decode")
+                 for i in range(n_decode)]
+    import time
+    return FleetRouter(
+        replicas, affinity_block_size=BS, affinity_blocks=1,
+        respawn=False, tracer=tracer,
+        clock=clock if clock is not None else time.monotonic,
+        **router_kw)
+
+
+def _workload(n_requests, seed=0):
+    """Cold prompts >= 1 full block (the exportable chain) with short
+    greedy continuations — every stream oracle-comparable."""
+    rng = np.random.default_rng(seed)
+    reqs = []
+    for _ in range(n_requests):
+        plen = int(rng.integers(12, 25))
+        reqs.append((rng.integers(0, 32, size=plen).astype(np.int32),
+                     int(rng.integers(3, 8))))
+    return reqs
+
+
+# ------------------------------------------------------------ vocabulary
+def test_role_vocabulary_parity():
+    """The cross-module agreements graftlint `role-vocab` pins, as a
+    runtime smoke test: worker mirrors disagg's ROLES, the router's
+    route labels are journal-classifiable, handoff is a record kind."""
+    assert worker_mod.ROLES == disagg_mod.ROLES
+    assert set(router_mod.ROUTE_LABELS) <= set(journal_io.VIA_LABELS)
+    assert "handoff" in journal_io.RECORD_KINDS
+    assert "from_replica" in journal_io.RECORD_KEYS_V2
+
+
+def test_validate_role():
+    assert validate_role(None) == "unified"
+    for role in disagg_mod.ROLES:
+        assert validate_role(role) == role
+    with pytest.raises(ValueError, match="replica role"):
+        validate_role("prefil")
+    with pytest.raises(ValueError, match="replica role"):
+        LocalReplica(0, lambda: None, role="both")
+
+
+# ------------------------------------------------- backward compatibility
+def test_unified_fleet_never_arms(gpt_setup):
+    """No strict roles -> not armed: zero prefill routes, zero
+    hand-offs, streams finish exactly as an r19 fleet would."""
+    model, variables = gpt_setup
+    factory = _engine_factory(model, variables)
+    fleet = FleetRouter(
+        [LocalReplica(0, factory), LocalReplica(1, factory)],
+        affinity_block_size=BS, affinity_blocks=1, respawn=False)
+    assert not fleet.disagg_armed
+    reqs = _workload(4, seed=3)
+    refs = [_ref_greedy(model, variables, p, n) for p, n in reqs]
+    handles = [fleet.submit(p, n) for p, n in reqs]
+    fleet.run(max_steps=600)
+    assert [list(h.tokens) for h in handles] == refs
+    assert fleet.metrics.routed_prefill == 0
+    assert fleet.metrics.handoffs_completed == 0
+    assert fleet.metrics.handoffs_failed == 0
+    fleet.close()
+
+
+# ------------------------------------------------------- the hand-off
+def test_split_fleet_hands_off_and_stays_token_exact(
+        gpt_setup, pin_zero_recompiles):
+    """The tentpole: every cold prompt routes prefill, ships its chain,
+    and decodes on a decode replica — token-exact vs the unified
+    oracle, journaled, counted, with zero recompiles on every replica
+    after warmup."""
+    model, variables = gpt_setup
+    tracer = RequestTracer()
+    fleet = _split_fleet(model, variables, 1, 2, tracer=tracer)
+    assert fleet.disagg_armed
+    fleet = pin_zero_recompiles(fleet)
+    reqs = _workload(6, seed=1)
+    refs = [_ref_greedy(model, variables, p, n) for p, n in reqs]
+    handles = [fleet.submit(p, n) for p, n in reqs]
+    fleet.run(max_steps=1200)
+    decode_ids = {1, 2}
+    for h, ref in zip(handles, refs):
+        assert h.state == RequestState.FINISHED
+        assert list(h.tokens) == ref
+        assert h.replica_id in decode_ids, \
+            "stream finished on the prefill replica despite a hand-off"
+        assert h.migrations >= 1
+    m = fleet.metrics
+    assert m.routed_prefill == len(reqs)
+    assert m.handoffs_completed == len(reqs)
+    assert m.handoffs_failed == 0
+    assert m.handoff_bytes > 0
+    assert m.handoff_tokens >= len(reqs) * BS
+    events = tracer.events_named("handoff")
+    assert len(events) == len(reqs)
+    for ev in events:
+        assert ev["from_replica"] == 0 and ev["to_replica"] in decode_ids
+        assert ev["blocks"] >= 1 and ev["ms"] >= 0.0
+    # The decode replicas' host tiers hold the shipped chains, pins
+    # all released.
+    for slot in fleet.replicas:
+        host = slot.driver.engine._host
+        assert host.pins_outstanding == 0
+    assert any(fleet.replicas[i].driver.engine.host_tier_bytes_resident
+               > 0 for i in decode_ids)
+    fleet.close()
+
+
+def test_handoff_journal_records_under_original_rid(gpt_setup, tmp_path):
+    """The WAL leg: one handoff record per stream, stamped with the
+    prefill source and filed under the ORIGINAL rid (the alias
+    discipline — tokens/finish keep keying to the admit)."""
+    model, variables = gpt_setup
+    fleet = _split_fleet(
+        model, variables, 1, 1,
+        journal=RouterJournal(str(tmp_path / "wal"),
+                              fsync_batch_records=1))
+    reqs = _workload(2, seed=5)
+    handles = [fleet.submit(p, n) for p, n in reqs]
+    fleet.run(max_steps=600)
+    assert all(h.state == RequestState.FINISHED for h in handles)
+    assert fleet.metrics.handoffs_completed == len(reqs)
+    fleet.close()
+    records = [rec for _, rec in journal_io.iter_wal_records(
+        str(tmp_path / "wal" / "wal.log"))]
+    admits = {r["rid"] for r in records if r["rec"] == "admit"}
+    handoffs = [r for r in records if r["rec"] == "handoff"]
+    finishes = {r["rid"] for r in records if r["rec"] == "finish"}
+    assert len(handoffs) == len(reqs)
+    for rec in handoffs:
+        assert rec["from_replica"] == 0 and rec["replica"] == 1
+        assert rec["rid"] in admits, \
+            "handoff journaled under a fresh rid the admit never saw"
+    assert finishes == admits, \
+        "post-handoff finish records lost the admit's rid alias"
+    # Audit-only on recovery: everything finished, nothing to replay.
+    entries, _ = journal_io.read_state(str(tmp_path / "wal"))
+    assert entries == {}
+
+
+# ------------------------------------------------------------------ chaos
+@pytest.mark.chaos
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_prefill_dies_mid_handoff_unwinds_and_reprefills(gpt_setup,
+                                                         seed):
+    """The seeded mid-KV-hand-off kill coordinate: the prefill source
+    dies inside the chain export of the (seed+1)-th hand-off. The
+    in-flight chain unwinds with the replica, every stream re-enters
+    elsewhere and finishes token-exact, and no host-tier pin leaks on
+    the survivor."""
+    model, variables = gpt_setup
+    arm = {"countdown": seed + 1}
+
+    class DiesMidExport(LocalReplica):
+        def export_chain(self, prompt, max_blocks=None):
+            arm["countdown"] -= 1
+            if arm["countdown"] == 0:
+                raise ReplicaDied(self.replica_id,
+                                  "killed mid-KV-hand-off")
+            return super().export_chain(prompt, max_blocks)
+
+    tracer = RequestTracer()
+    fleet = _split_fleet(model, variables, 1, 1, tracer=tracer,
+                         replica_cls=DiesMidExport)
+    reqs = _workload(4, seed=seed)
+    refs = [_ref_greedy(model, variables, p, n) for p, n in reqs]
+    handles = [fleet.submit(p, n) for p, n in reqs]
+    fleet.run(max_steps=1200)
+    assert not fleet.has_work
+    for h, ref in zip(handles, refs):
+        assert h.state == RequestState.FINISHED
+        assert list(h.tokens) == ref, \
+            f"stream diverged across the mid-hand-off kill (seed {seed})"
+    assert fleet.metrics.handoffs_failed >= 1
+    assert fleet.metrics.replica_down_events == 1
+    downs = tracer.events_named("replica_down")
+    assert len(downs) == 1 and downs[0]["replica"] == 0
+    # The decode survivor leaked no pins across the unwind + replay.
+    survivor = fleet.replicas[1].driver.engine
+    assert survivor._host.pins_outstanding == 0
+    fleet.close()
+
+
+def test_refused_transfer_keeps_stream_on_prefill(gpt_setup):
+    """A tier-less decode target refuses the chain: moving the stream
+    would re-prefill the long prompt there, so it STAYS on the prefill
+    replica (slow beats wrong), finishes token-exact, and the refusal
+    is counted + traced exactly once per stream."""
+    model, variables = gpt_setup
+    tracer = RequestTracer()
+    fleet = _split_fleet(model, variables, 1, 1, decode_host=None,
+                         tracer=tracer)
+    reqs = _workload(2, seed=9)
+    refs = [_ref_greedy(model, variables, p, n) for p, n in reqs]
+    handles = [fleet.submit(p, n) for p, n in reqs]
+    fleet.run(max_steps=600)
+    for h, ref in zip(handles, refs):
+        assert h.state == RequestState.FINISHED
+        assert list(h.tokens) == ref
+        assert h.replica_id == 0  # never moved
+        assert h.migrations == 0
+    assert fleet.metrics.handoffs_completed == 0
+    assert fleet.metrics.handoffs_failed == len(reqs)
+    refusals = tracer.events_named("handoff_refused")
+    assert len(refusals) == len(reqs)  # no per-round retry storm
+    fleet.close()
+
+
+def test_decode_stall_counts_once_per_stream(gpt_setup):
+    """Every decode replica down: the hand-off waits (re-noted each
+    tokens event) and the stall counter moves ONCE per stream, however
+    many rounds the stall lasts."""
+    model, variables = gpt_setup
+
+    class DiesOnFirstStep(LocalReplica):
+        def step(self):
+            raise ReplicaDied(self.replica_id, "decode pool outage")
+
+    pf = _engine_factory(model, variables)
+    df = _engine_factory(model, variables)
+    fleet = FleetRouter(
+        [LocalReplica(0, pf, role="prefill"),
+         DiesOnFirstStep(1, df, role="decode")],
+        affinity_block_size=BS, affinity_blocks=1, respawn=False)
+    assert fleet.disagg_armed  # armed is fleet SHAPE, not health
+    reqs = _workload(2, seed=4)
+    refs = [_ref_greedy(model, variables, p, n) for p, n in reqs]
+    handles = [fleet.submit(p, n) for p, n in reqs]
+    fleet.run(max_steps=600)
+    for h, ref in zip(handles, refs):
+        assert h.state == RequestState.FINISHED
+        assert list(h.tokens) == ref
+        assert h.replica_id == 0  # decoded where it prefilled
+    assert fleet.metrics.decode_long_prompt_stalls == len(reqs)
+    assert fleet.metrics.handoffs_completed == 0
+    fleet.close()
+
+
+# --------------------------------------------------------------- recovery
+def test_router_crash_recovers_split_fleet_token_exact(gpt_setup,
+                                                       tmp_path):
+    """Router SIGKILL mid-hand-off-era traffic: the WAL (admits,
+    tokens, handoff records) folds back into in-flight streams, a
+    FRESH split fleet re-enters them through mirror replay, and every
+    stream finishes token-exact — handoff records are audit-only."""
+    model, variables = gpt_setup
+    d = str(tmp_path / "wal")
+    fleet = _split_fleet(
+        model, variables, 1, 1,
+        journal=RouterJournal(d, fsync_batch_records=1))
+    rng = np.random.default_rng(6)
+    # Long enough generations that the kill lands mid-stream.
+    reqs = [(rng.integers(0, 32, size=int(rng.integers(12, 25)))
+             .astype(np.int32), 14) for _ in range(3)]
+    refs = [_ref_greedy(model, variables, p, n) for p, n in reqs]
+    handles = [fleet.submit(p, n) for p, n in reqs]
+    for _ in range(6):  # tokens flowing, at least one hand-off stamped
+        fleet.step()
+    assert any(h.tokens for h in handles)
+    assert not any(h.done for h in handles)
+    assert fleet.metrics.handoffs_completed >= 1
+    # SIGKILL: the router object is abandoned, no drain, no close.
+    records = [rec for _, rec in journal_io.iter_wal_records(
+        str(tmp_path / "wal" / "wal.log"))]
+    assert any(r["rec"] == "handoff" for r in records)
+    pf = _engine_factory(model, variables)
+    df = _engine_factory(model, variables)
+    recovered, revived = FleetRouter.recover(
+        d, [LocalReplica(10, pf, role="prefill"),
+            LocalReplica(11, df, role="decode")],
+        affinity_block_size=BS, affinity_blocks=1, respawn=False)
+    assert recovered.disagg_armed
+    assert len(revived) == len(reqs)
+    recovered.run(max_steps=1200)
+    by_prompt = {tuple(int(t) for t in p): ref
+                 for (p, _n), ref in zip(reqs, refs)}
+    for fh in revived.values():
+        assert fh.state == RequestState.FINISHED
+        assert list(fh.tokens) == by_prompt[
+            tuple(int(t) for t in fh.request.prompt)]
+    recovered.close()
+
+
+# ------------------------------------------------------ per-role scaling
+def test_role_autoscaler_scales_prefill_pool_independently(gpt_setup):
+    """Cold-prompt load lands on the prefill pool only; its controller
+    scales up on its own load band while the idle decode pool HOLDs —
+    one shared replica-id line, role gauges as labeled series."""
+    model, variables = gpt_setup
+    clock = _FakeClock(100.0)
+    fleet = _split_fleet(model, variables, 1, 1, clock=clock)
+    pf = _engine_factory(model, variables)
+    df = _engine_factory(model, variables)
+    ras = RoleAutoscaler(
+        fleet,
+        {"prefill": lambda rid: LocalReplica(rid, pf, role="prefill"),
+         "decode": lambda rid: LocalReplica(rid, df, role="decode")},
+        per_role={"prefill": dict(up_load=1.0)},
+        min_replicas=1, max_replicas=3, up_load=50.0, up_hold_s=0.0)
+    assert fleet.autoscaler is ras
+    for p, n in _workload(3, seed=2):
+        fleet.submit(p, n)  # armed routing: all three land on prefill
+    decisions = ras.step(clock.now)
+    assert decisions["prefill"] == ScaleDecision.SCALE_UP
+    assert decisions["decode"] == ScaleDecision.HOLD
+    assert len(fleet.replicas) == 3
+    new = next(s for s in fleet.replicas if s.replica_id == 2)
+    assert new.driver.role == "prefill"  # shared id line: 0,1 taken
+    gauges = ras.gauges()
+    assert gauges["role_replicas"] == {"prefill": 2, "decode": 1}
+    assert gauges["pending_spawns"] == 0
+    assert ras.metrics.snapshot()["scale_up_completed"] == 1
+    fleet.run(max_steps=1200)
+    assert not fleet.has_work
+    fleet.close()
+
+
+# ---------------------------------------------------------- observability
+def test_exposition_disagg_series_both_directions(gpt_setup):
+    model, variables = gpt_setup
+    fleet = _split_fleet(model, variables, 1, 2)
+    reqs = _workload(3, seed=8)
+    handles = [fleet.submit(p, n) for p, n in reqs]
+    fleet.run(max_steps=900)
+    assert all(h.done for h in handles)
+    m = fleet.metrics
+    samples, types = parse_prometheus_text(fleet_exposition(fleet))
+    by_role = {role: samples[("pddl_fleet_replicas_by_role",
+                              (("key", role),))]
+               for role in ("prefill", "decode", "unified")}
+    assert by_role == {"prefill": 1.0, "decode": 2.0, "unified": 0.0}
+    for key, want in [("routed_prefill", m.routed_prefill),
+                      ("handoffs_completed", m.handoffs_completed),
+                      ("handoffs_failed", m.handoffs_failed),
+                      ("handoff_bytes", m.handoff_bytes),
+                      ("handoff_tokens", m.handoff_tokens)]:
+        name = f"pddl_fleet_{key}_total"
+        assert types[name] == "counter"
+        assert samples[(name, ())] == float(want)
+    assert m.handoffs_completed >= 1
+    # Armed: the stall gauge observes (0 here — no decode outage).
+    assert types["pddl_fleet_decode_long_prompt_stalls"] == "gauge"
+    assert samples[("pddl_fleet_decode_long_prompt_stalls", ())] == 0.0
+    fleet.close()
+    # Unarmed fleet: role series still complete, stall gauge NaN
+    # (present but unobserved — "off" is distinguishable from
+    # "vanished").
+    factory = _engine_factory(model, variables)
+    bare = FleetRouter([LocalReplica(0, factory)],
+                       affinity_block_size=BS, affinity_blocks=1)
+    samples, _ = parse_prometheus_text(fleet_exposition(bare))
+    assert samples[("pddl_fleet_replicas_by_role",
+                    (("key", "unified"),))] == 1.0
+    assert samples[("pddl_fleet_replicas_by_role",
+                    (("key", "prefill"),))] == 0.0
+    assert math.isnan(
+        samples[("pddl_fleet_decode_long_prompt_stalls", ())])
+    bare.close()
+
+
+def test_exposition_carries_role_autoscaler_gauges(gpt_setup):
+    model, variables = gpt_setup
+    clock = _FakeClock(10.0)
+    fleet = _split_fleet(model, variables, 1, 1, clock=clock)
+    pf = _engine_factory(model, variables)
+    RoleAutoscaler(
+        fleet,
+        {"prefill": lambda rid: LocalReplica(rid, pf, role="prefill")},
+        min_replicas=1, max_replicas=2, up_load=50.0)
+    samples, types = parse_prometheus_text(fleet_exposition(fleet))
+    assert samples[("pddl_fleet_autoscale_role_replicas",
+                    (("key", "prefill"),))] == 1.0
+    assert samples[("pddl_fleet_autoscale_role_max_replicas",
+                    (("key", "prefill"),))] == 2.0
+    assert samples[("pddl_fleet_autoscale_replicas", ())] == 2.0
+    assert types["pddl_fleet_autoscale_scale_up_started_total"] \
+        == "counter"
+    fleet.close()
