@@ -48,6 +48,15 @@ ENGINE_FAULTS_PAIRS = (
     ("pddl_tpu/train/loop.py", "pddl_tpu/train/faults.py"),
 )
 
+# Storage-gate module -> its storage-faults vocabulary (ISSUE 18):
+# the journal VFS's ``_storage_op`` gate literals, its STORAGE_OPS
+# manifest, and ``StorageFaultPlan.SITES`` are one vocabulary — same
+# invariant as the device leg, one layer down the stack.
+STORAGE_FAULTS_PAIRS = (
+    ("pddl_tpu/serve/fleet/journal.py", "pddl_tpu/utils/faults.py",
+     "StorageFaultPlan"),
+)
+
 
 def _device_call_sites(tree: ast.AST) -> List[Tuple[str, int]]:
     sites = []
@@ -111,6 +120,36 @@ def _sites_tuples(tree: ast.AST) -> List[Tuple[Set[str], int, str]]:
     return out
 
 
+def _storage_op_sites(tree: ast.AST) -> List[Tuple[str, int]]:
+    """Literal first arguments of ``_storage_op(...)`` gate calls."""
+    sites = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and call_name(node) == "_storage_op" \
+                and node.args:
+            first = node.args[0]
+            if isinstance(first, ast.Constant) and isinstance(first.value,
+                                                              str):
+                sites.append((first.value, node.lineno))
+    return sites
+
+
+def _storage_ops_tuple(tree: ast.AST) -> Optional[Tuple[Set[str], int]]:
+    """The module-level ``STORAGE_OPS = (...)`` manifest, if any."""
+    for node in ast.walk(tree):
+        targets: List[ast.expr] = []
+        value = None
+        if isinstance(node, ast.Assign):
+            targets, value = node.targets, node.value
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets, value = [node.target], node.value
+        for t in targets:
+            if isinstance(t, ast.Name) and t.id == "STORAGE_OPS":
+                vals = const_str_tuple(value)
+                if vals is not None and vals:
+                    return set(vals), node.lineno
+    return None
+
+
 class SiteVocabRule(Rule):
     name = "site-vocab"
     doc = ("_device_call sites, compile_counts() keys, and the paired "
@@ -151,6 +190,66 @@ class SiteVocabRule(Rule):
                     f"{cls}.SITES entry {site!r} matches no "
                     f"compile_counts() key of {module.rel} — stale "
                     "vocabulary")
+        yield from self._run_storage(project)
+
+    def _run_storage(self, project: Project) -> Iterable:
+        """The storage leg (ISSUE 18): ``_storage_op`` gate literals,
+        the STORAGE_OPS manifest, and the paired
+        ``StorageFaultPlan.SITES`` must be one vocabulary."""
+        for module in project.modules:
+            ops = _storage_ops_tuple(module.tree)
+            gates = _storage_op_sites(module.tree)
+            if ops is None or not gates:
+                continue
+            ops_set, ops_line = ops
+            for op, line in gates:
+                if op not in ops_set:
+                    yield self.finding(
+                        module, line,
+                        f"_storage_op gate {op!r} is not in the "
+                        "STORAGE_OPS manifest — the VFS dispatches an "
+                        "op no storage-fault profile can target")
+            gated = {op for op, _ in gates}
+            for op in sorted(ops_set - gated):
+                yield self.finding(
+                    module, ops_line,
+                    f"STORAGE_OPS entry {op!r} matches no _storage_op "
+                    "gate — stale manifest")
+            vocab = self._paired_storage_vocab(project, module)
+            if vocab is None:
+                continue
+            sites_set, faults_mod, vocab_line, cls = vocab
+            for op in sorted(ops_set - sites_set):
+                yield self.finding(
+                    module, ops_line,
+                    f"STORAGE_OPS entry {op!r} is missing from "
+                    f"{cls}.SITES ({faults_mod.rel}:{vocab_line}) — the "
+                    "plan's schedule validation would reject a "
+                    "coordinate the journal actually gates")
+            for op in sorted(sites_set - ops_set):
+                yield self.finding(
+                    faults_mod, vocab_line,
+                    f"{cls}.SITES entry {op!r} matches no STORAGE_OPS "
+                    f"entry of {module.rel} — stale vocabulary")
+
+    def _paired_storage_vocab(self, project: Project, module: Module):
+        own = [t for t in _sites_tuples(module.tree)
+               if t[2] == "StorageFaultPlan"]
+        if own:
+            vals, line, cls = own[0]
+            return vals, module, line, cls
+        for gate_suffix, faults_suffix, cls_name in STORAGE_FAULTS_PAIRS:
+            if module.rel.endswith(gate_suffix):
+                faults_mod = project.module_by_suffix(faults_suffix)
+                if faults_mod is None:
+                    return None
+                tuples = [t for t in _sites_tuples(faults_mod.tree)
+                          if t[2] == cls_name]
+                if not tuples:
+                    return None
+                vals, line, cls = tuples[0]
+                return vals, faults_mod, line, cls
+        return None
 
     def _paired_vocab(self, project: Project, module: Module):
         own = _sites_tuples(module.tree)

@@ -1,0 +1,18 @@
+"""Multi-plane chaos campaigns (ISSUE 18).
+
+One fault plane per test file proves each recovery path in isolation;
+production failures arrive COMPOSED — a wire storm while a disk
+degrades while a replica grays out while the scheduler SIGKILLs the
+router. `conductor.py` turns the repo's fault planes (device
+`FaultPlan` sites, `WireFaultPlan`, `StorageFaultPlan`, gray
+slow-walls, hard kills, router crash+recover) into seeded randomized
+campaigns against a full fleet, with an invariant referee after every
+run.
+"""
+
+from pddl_tpu.chaos.conductor import (CampaignReport, ChaosAction,
+                                      ChaosConductor, ReplicaChaos,
+                                      local_kill)
+
+__all__ = ["CampaignReport", "ChaosAction", "ChaosConductor",
+           "ReplicaChaos", "local_kill"]

@@ -395,6 +395,13 @@ FLEET_COUNTER_KEYS = frozenset({
     # disaggregation-armed.)
     "routed_prefill", "handoffs_completed", "handoffs_failed",
     "handoff_bytes", "handoff_tokens",
+    # Journal storage health (ISSUE 18, `serve/fleet/journal.py`):
+    # every OSError the WAL's VFS shim surfaced (bounded-backoff
+    # retries included), entries into the NON_DURABLE degraded mode,
+    # and re-arms back to durable. The live alarmed state is the
+    # `journal_non_durable` gauge below.
+    "journal_storage_errors", "journal_degraded_events",
+    "journal_rearms",
 })
 
 
@@ -452,6 +459,12 @@ def fleet_exposition(router, autoscaler=None) -> str:
                              if journal is not None else None)
     snap["journal_lag_records"] = (journal.records_since_checkpoint
                                    if journal is not None else None)
+    # The widened loss-on-crash window, live (ISSUE 18): 1 while the
+    # WAL runs NON_DURABLE (acks flowing, backlog in memory), 0 while
+    # durable, NaN when no journal is armed. THE disk-failure pager.
+    snap["journal_non_durable"] = (
+        int(bool(getattr(journal, "non_durable", False)))
+        if journal is not None else None)
     gray = getattr(router, "gray", None)
     snap["replicas_suspected_gray"] = (len(gray.suspected)
                                        if gray is not None else None)

@@ -59,6 +59,7 @@ and traced via ``on_fleet_event("autoscale", ...)``.
 from __future__ import annotations
 
 import enum
+import random
 from collections import deque
 from typing import Deque, Dict, Optional, Tuple
 
@@ -169,6 +170,8 @@ class FleetAutoscaler:
                  goodput_window_s: float = 5.0,
                  spawn_backoff_base_s: float = 0.5,
                  spawn_backoff_max_s: float = 30.0,
+                 spawn_jitter_frac: float = 0.0,
+                 spawn_jitter_seed: Optional[int] = None,
                  tracer=None, clock=None,
                  role: Optional[str] = None, attach: bool = True,
                  id_alloc=None):
@@ -185,6 +188,10 @@ class FleetAutoscaler:
             raise ValueError(
                 f"need 0 < spawn_backoff_base_s <= spawn_backoff_max_s, "
                 f"got {spawn_backoff_base_s}/{spawn_backoff_max_s}")
+        if not 0.0 <= spawn_jitter_frac < 1.0:
+            raise ValueError(
+                f"spawn_jitter_frac must be in [0, 1), got "
+                f"{spawn_jitter_frac}")
         self.router = router
         self._factory = replica_factory
         self.role = (validate_role(role) if role is not None else None)
@@ -211,6 +218,13 @@ class FleetAutoscaler:
         self._spawn_backoff_s = float(spawn_backoff_base_s)
         self._spawn_backoff_base_s = float(spawn_backoff_base_s)
         self._spawn_backoff_max_s = float(spawn_backoff_max_s)
+        # Subtractive retry jitter (ISSUE 18): several controllers
+        # recovering from the same incident (role pools, restarted
+        # fleets) must not all retry their spawns at the same instant.
+        # Same discipline as CircuitBreaker.jitter_frac — a jittered
+        # retry never fires LATER than the deterministic schedule.
+        self._spawn_jitter_frac = float(spawn_jitter_frac)
+        self._spawn_rng = random.Random(spawn_jitter_seed)
         self._spawn_retry_at = float("-inf")
         self._last_decision = ScaleDecision.HOLD
         self._last_pressure = 0.0
@@ -372,7 +386,11 @@ class FleetAutoscaler:
                       cause: BaseException) -> None:
         self.metrics.scale_up_failed += 1
         self._pending = None
-        self._spawn_retry_at = now + self._spawn_backoff_s
+        interval = self._spawn_backoff_s
+        if self._spawn_jitter_frac > 0.0:
+            interval *= 1.0 - self._spawn_jitter_frac \
+                * self._spawn_rng.random()
+        self._spawn_retry_at = now + interval
         self._spawn_backoff_s = min(self._spawn_backoff_s * 2.0,
                                     self._spawn_backoff_max_s)
         self._above_since = None  # re-earn the hold before retrying

@@ -43,8 +43,9 @@ definition, never trips.
 from __future__ import annotations
 
 import enum
+import random
 from collections import deque
-from typing import Callable, Deque, Dict, Optional, Set
+from typing import Callable, Deque, Dict, List, Optional, Set
 
 
 class BreakerState(enum.Enum):
@@ -60,6 +61,15 @@ class CircuitBreaker:
       failure_threshold: consecutive failures that trip CLOSED → OPEN.
       backoff_base_s: first OPEN interval; doubles per re-open.
       backoff_max_s: backoff cap (bounded exponential).
+      jitter_frac: seeded desynchronization (ISSUE 18) — each OPEN
+        interval is scaled by ``1 - jitter_frac * U[0, 1)``, so a
+        mass-kill does not schedule every replica's HALF_OPEN probe at
+        the same instant (the synchronized respawn herd). Subtractive
+        on purpose: a jittered probe never fires LATER than the
+        deterministic schedule, so backoff bounds still hold. Default
+        0.0 (exact doubling — the unit-testable schedule); the router
+        arms it fleet-wide with a per-replica seed.
+      seed: PRNG seed for the jitter draws (deterministic per replica).
       on_transition: optional ``fn(old: BreakerState, new: BreakerState)``
         — the router wires this to its metrics/tracer so every
         transition is observable.
@@ -67,6 +77,7 @@ class CircuitBreaker:
 
     def __init__(self, *, failure_threshold: int = 3,
                  backoff_base_s: float = 0.5, backoff_max_s: float = 30.0,
+                 jitter_frac: float = 0.0, seed: Optional[int] = None,
                  on_transition: Optional[Callable] = None):
         if failure_threshold < 1:
             raise ValueError(
@@ -75,9 +86,14 @@ class CircuitBreaker:
             raise ValueError(
                 f"need 0 < backoff_base_s <= backoff_max_s, got "
                 f"{backoff_base_s}/{backoff_max_s}")
+        if not 0.0 <= jitter_frac < 1.0:
+            raise ValueError(
+                f"jitter_frac must be in [0, 1), got {jitter_frac}")
         self.failure_threshold = int(failure_threshold)
         self.backoff_base_s = float(backoff_base_s)
         self.backoff_max_s = float(backoff_max_s)
+        self.jitter_frac = float(jitter_frac)
+        self._rng = random.Random(seed)
         self.on_transition = on_transition
         self.state = BreakerState.CLOSED
         self.consecutive_failures = 0
@@ -136,7 +152,10 @@ class CircuitBreaker:
             self._reopen(now_s)
 
     def _reopen(self, now_s: float) -> None:
-        self.open_until_s = now_s + self._backoff_s
+        interval = self._backoff_s
+        if self.jitter_frac > 0.0:
+            interval *= 1.0 - self.jitter_frac * self._rng.random()
+        self.open_until_s = now_s + interval
         self._backoff_s = min(self._backoff_s * 2.0, self.backoff_max_s)
         self._to(BreakerState.OPEN)
 
@@ -175,11 +194,20 @@ class GrayDetector:
         into the sliding baseline and absolve itself; recovery means
         returning to the band of what it USED to be, after which its
         history restarts fresh.
+      smooth: median-of-``smooth`` prefilter (ISSUE 18 de-flake):
+        raw samples collect in groups of ``smooth`` and only each
+        group's MEDIAN enters the windows. With a small ``window`` the
+        recent p95 is effectively the max, so one real scheduler
+        hiccup on a loaded host either falsely suspects a healthy
+        replica or inflates a baseline's std enough to never suspect
+        a gray one; a median absorbs the isolated spike while a
+        genuine slow-wall (every sample slow) passes straight
+        through. ``1`` (default) judges every raw sample unchanged.
     """
 
     def __init__(self, *, window: int = 16, baseline: int = 32,
                  z_threshold: float = 4.0, min_excess_s: float = 0.0,
-                 consecutive: int = 3):
+                 consecutive: int = 3, smooth: int = 1):
         if window < 4 or baseline < 4:
             raise ValueError(
                 f"need window >= 4 and baseline >= 4, got "
@@ -187,11 +215,15 @@ class GrayDetector:
         if consecutive < 1:
             raise ValueError(
                 f"consecutive must be >= 1, got {consecutive}")
+        if smooth < 1:
+            raise ValueError(f"smooth must be >= 1, got {smooth}")
         self.window = int(window)
         self.baseline = int(baseline)
         self.z_threshold = float(z_threshold)
         self.min_excess_s = float(min_excess_s)
         self.consecutive = int(consecutive)
+        self.smooth = int(smooth)
+        self._pending: Dict[int, List[float]] = {}
         self._samples: Dict[int, Deque[float]] = {}
         self._strikes: Dict[int, int] = {}
         self._recovery: Dict[int, int] = {}
@@ -204,6 +236,14 @@ class GrayDetector:
         history exists."""
         rid = int(replica_id)
         seconds = float(seconds)
+        if self.smooth > 1:
+            pend = self._pending.setdefault(rid, [])
+            pend.append(seconds)
+            if len(pend) < self.smooth:
+                return
+            pend.sort()
+            seconds = pend[len(pend) // 2]
+            self._pending[rid] = []
         if rid in self.suspected:
             # Frozen baseline: the sample itself must return to the
             # band of what the replica USED to be, `consecutive` times
@@ -246,6 +286,7 @@ class GrayDetector:
         respawn, recovery — a fresh process re-earns a fresh
         baseline)."""
         rid = int(replica_id)
+        self._pending.pop(rid, None)
         self._samples.pop(rid, None)
         self._strikes.pop(rid, None)
         self._recovery.pop(rid, None)

@@ -58,17 +58,47 @@ builds a fresh router over fresh/re-spawned replicas and re-enters
 them through the r11 mirror-replay path — token-exact, zero special
 cases, because router death is now just the snapshot path's second
 "normal case".
+
+**Storage faults (ISSUE 18).** The WAL exists to survive crashes, so
+it cannot itself be fail-stop-naive about the disk under it. Every
+file op routes through :class:`_JournalVFS` (faults injectable via
+``utils.faults.StorageFaultPlan``), and failures degrade instead of
+propagating out of ``append``:
+
+- transient write/fsync errors retry with bounded backoff;
+- persistent failure enters **NON_DURABLE** mode: acks keep flowing
+  (``append`` never raises ``OSError``), the backlog is retained
+  in-memory, the widened loss-on-crash window is alarmed via the
+  ``journal_non_durable`` gauge + a traced event, and rate-limited
+  re-arm probes (plus every durable append, which still attempts a
+  synchronous write+fsync) restore durability the moment the disk
+  recovers — the r08 OOM-degraded discipline applied to storage;
+- ``ENOSPC`` sets :attr:`RouterJournal.emergency_checkpoint_due` so
+  the router runs an immediate checkpoint+rotate, reclaiming the
+  covered segment instead of blind-retrying a full disk;
+- a mid-:meth:`RouterJournal.checkpoint` failure aborts with the
+  checkpoint/prev pair still readable and never leaves ``_fd``
+  closed for good (recovery falls back to the newest VERIFIED state,
+  the r10 rule; probes re-open the WAL).
+
+A torn write leaves garbage bytes past the last good frame; the
+journal tracks the known-good byte offset and truncates back to it
+before writing again, so retries never bury readable records behind
+an unreadable tail.
 """
 
 from __future__ import annotations
 
+import errno
 import json
 import os
 import struct
+import time
 import zlib
 from typing import Dict, List, Optional, Tuple
 
 from pddl_tpu.serve import drain as drain_io
+from pddl_tpu.utils.faults import StorageFaultKind
 
 # Version 2: version 1 (the initial ISSUE 14 control-plane WAL) plus
 # the ``handoff`` record — the prefill->decode KV rebinding the
@@ -107,6 +137,61 @@ VIA_LABELS = ("sticky", "adapter", "affinity", "load", "host_tier",
 
 _HEADER = struct.Struct(">4sQII")  # magic, seq, payload len, crc32
 _MAGIC = b"PJL1"
+
+# Machine-checked storage-op vocabulary (graftlint `site-vocab`,
+# storage leg): every file-operation site the journal dispatches
+# through ``_JournalVFS._storage_op``, exactly — and the SITES of
+# ``utils.faults.StorageFaultPlan`` must equal it. An op gated here
+# but missing from the plan is a fault coordinate chaos can never
+# reach; a plan site nothing dispatches is a schedule that silently
+# never fires.
+STORAGE_OPS = ("open", "write", "fsync", "replace", "fstat")
+
+
+class _JournalVFS:
+    """The journal's file-op seam: every ``os``-level operation the WAL
+    and checkpoint cycle perform goes through here, and an optional
+    :class:`~pddl_tpu.utils.faults.StorageFaultPlan` is consulted
+    immediately BEFORE each real call — same injection-before-dispatch
+    discipline as the device ``_device_call`` sites, so a fault never
+    leaves the real op half-observed. TORN is the one exception by
+    design: the plan *returns* it and :meth:`write` persists a prefix
+    of the buffer before raising EIO, modeling the power-cut shape
+    ``_readable_prefix_len`` truncates at recovery."""
+
+    def __init__(self, plan=None):
+        self.plan = plan
+
+    def _storage_op(self, op: str) -> Optional[StorageFaultKind]:
+        if self.plan is None:
+            return None
+        return self.plan.check(op)
+
+    def open(self, path: str, flags: int, mode: int = 0o644) -> int:
+        self._storage_op("open")
+        return os.open(path, flags, mode)
+
+    def write(self, fd: int, data) -> int:
+        kind = self._storage_op("write")
+        if kind is StorageFaultKind.TORN:
+            half = len(data) // 2
+            if half:
+                os.write(fd, data[:half])
+            raise OSError(errno.EIO,
+                          f"injected torn write ({half}/{len(data)} bytes)")
+        return os.write(fd, data)
+
+    def fsync(self, fd: int) -> None:
+        self._storage_op("fsync")
+        os.fsync(fd)
+
+    def replace(self, src: str, dst: str) -> None:
+        self._storage_op("replace")
+        os.replace(src, dst)
+
+    def fstat(self, fd: int):
+        self._storage_op("fstat")
+        return os.fstat(fd)
 
 
 def encode_admit(rid: int, request, session: Optional[str]) -> Dict:
@@ -174,11 +259,28 @@ class RouterJournal:
       checkpoint_every_records: :attr:`checkpoint_due` turns True after
         this many appended records since the last checkpoint; the
         router runs the cycle from its step loop.
+      storage_plan: optional
+        :class:`~pddl_tpu.utils.faults.StorageFaultPlan` the VFS shim
+        consults before every file op (chaos/testing).
+      retry_limit: bounded-backoff retries for a transient write/fsync
+        error while still durable; past it the journal degrades to
+        NON_DURABLE. While already degraded every attempt is single-
+        shot (it doubles as a probe) — no backoff hammering.
+      retry_backoff_s: first retry delay (doubles per retry).
+      rearm_interval_s: minimum spacing of NON_DURABLE re-arm probes
+        driven from :meth:`tick`.
+      clock / sleep_fn: injectable time sources (tests use fakes).
     """
 
     def __init__(self, journal_dir: str, *,
                  fsync_batch_records: int = 64,
-                 checkpoint_every_records: int = 4096):
+                 checkpoint_every_records: int = 4096,
+                 storage_plan=None,
+                 retry_limit: int = 3,
+                 retry_backoff_s: float = 0.001,
+                 rearm_interval_s: float = 0.25,
+                 clock=time.monotonic,
+                 sleep_fn=time.sleep):
         self.dir = journal_dir
         os.makedirs(journal_dir, exist_ok=True)
         self.wal_path = os.path.join(journal_dir, "wal.log")
@@ -189,18 +291,33 @@ class RouterJournal:
                                                  "checkpoint.prev.json")
         self._fsync_batch = max(1, int(fsync_batch_records))
         self._checkpoint_every = max(1, int(checkpoint_every_records))
+        self.vfs = _JournalVFS(storage_plan)
+        self._retry_limit = max(0, int(retry_limit))
+        self._retry_backoff_s = float(retry_backoff_s)
+        self._rearm_interval_s = float(rearm_interval_s)
+        self._clock = clock
+        self._sleep = sleep_fn
+        # Degradation state (the r08 discipline applied to storage).
+        self.non_durable = False
+        self.emergency_checkpoint_due = False
+        self.storage_errors = 0    # every OSError observed, retries incl.
+        self.degraded_events = 0   # entries into NON_DURABLE
+        self.rearms = 0            # exits from NON_DURABLE
+        self._next_probe_s = 0.0
+        self._wal_bytes_last = 0
+        # Observer ``fn(event, detail_dict)`` — the router wires it to
+        # its tracer + FleetMetrics so degradation is alarmable.
+        self.on_storage_event = None
         # Continue the seq line past whatever is already durable — and
         # TRUNCATE the torn tail first: appending after unreadable
         # bytes would put every later record (fsynced admits included)
         # beyond the readable prefix recovery stops at.
         last_seq = self._scan_last_seq()
         self._next_seq = last_seq + 1
-        prefix = _readable_prefix_len(self.wal_path)
-        if prefix is not None:
-            with open(self.wal_path, "r+b") as f:
-                f.truncate(prefix)
-        self._fd = os.open(self.wal_path,
-                           os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
+        self._fd: Optional[int] = None
+        self._good_bytes = 0       # byte offset of the last good frame end
+        self._dirty_tail = False   # garbage past _good_bytes (failed write)
+        self._open_wal()           # loud on a dead disk: arming must fail
         self._pending = 0          # appended but not yet fsynced
         self._buffer: List[bytes] = []
         self.records_since_checkpoint = 0
@@ -212,7 +329,13 @@ class RouterJournal:
     def append(self, record: Dict, *, durable: bool = False) -> int:
         """Frame + buffer one record; ``durable=True`` flushes AND
         fsyncs before returning (the admit contract). Returns the
-        record's seq."""
+        record's seq.
+
+        NEVER raises ``OSError``: a storage failure degrades the
+        journal to NON_DURABLE (alarmed, probed, backlog retained)
+        instead of killing the control plane that exists to survive
+        crashes. Callers check :attr:`non_durable` / wire
+        :attr:`on_storage_event` when they need to know."""
         if self._closed:
             raise ValueError("journal is closed")
         seq = self._next_seq
@@ -225,31 +348,160 @@ class RouterJournal:
         self.records_appended += 1
         self.records_since_checkpoint += 1
         if durable or self._pending >= self._fsync_batch:
-            self.commit()
+            if self.non_durable:
+                # Writes pause while degraded: the batch threshold must
+                # not hammer the dead disk with an O(backlog) join per
+                # batch — durable appends become rate-limited probe
+                # opportunities instead, same cadence as tick().
+                self._maybe_probe()
+            else:
+                self.commit()
         return seq
 
-    def _flush(self, fsync: bool) -> None:
+    # -------------------------------------------------- degradation core
+    def _observe(self, event: str, **detail) -> None:
+        if self.on_storage_event is not None:
+            self.on_storage_event(event, detail)
+
+    def _enter_non_durable(self, op: str, err: OSError) -> None:
+        if not self.non_durable:
+            self.non_durable = True
+            self.degraded_events += 1
+            self._observe("journal_degraded", op=op,
+                          errno=err.errno, error=str(err))
+        self._next_probe_s = self._clock() + self._rearm_interval_s
+
+    def _rearm(self) -> None:
+        if self.non_durable:
+            self.non_durable = False
+            self.rearms += 1
+            self._observe("journal_rearmed")
+
+    def _open_wal(self) -> None:
+        """(Re)open the WAL fd after truncating any unreadable tail —
+        shared by __init__, the checkpoint rotate, and re-arm probes.
+        Raises ``OSError`` (callers decide loud vs degrade)."""
+        prefix = _readable_prefix_len(self.wal_path)
+        if prefix is not None:
+            with open(self.wal_path, "r+b") as f:
+                f.truncate(prefix)
+        self._fd = self.vfs.open(
+            self.wal_path, os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
+        self._good_bytes = prefix or 0
+        self._dirty_tail = False
+        self._wal_bytes_last = self._good_bytes
+
+    def _try_reopen(self) -> bool:
+        try:
+            self._open_wal()
+            return True
+        except OSError as e:
+            self.storage_errors += 1
+            self._observe("journal_storage_error", op="open",
+                          errno=e.errno, error=str(e))
+            self._enter_non_durable("open", e)
+            return False
+
+    def _repair_tail(self) -> bool:
+        """Truncate garbage a failed/torn write left past the last good
+        frame, so a retry never buries readable records behind an
+        unreadable tail."""
+        if not self._dirty_tail:
+            return True
+        try:
+            os.ftruncate(self._fd, self._good_bytes)
+            self._dirty_tail = False
+            return True
+        except OSError:
+            return False
+
+    def _write_all(self, data: bytes) -> None:
+        view = memoryview(data)
+        while view:
+            n = self.vfs.write(self._fd, view)
+            view = view[n:]
+
+    def _guard(self, fn, op: str) -> bool:
+        """Run one file op with bounded-backoff retries (single-shot
+        while already degraded — every attempt then doubles as a
+        probe). On persistent failure: degrade, don't raise."""
+        attempts = 1 if self.non_durable else self._retry_limit + 1
+        delay = self._retry_backoff_s
+        err: Optional[OSError] = None
+        for i in range(attempts):
+            try:
+                fn()
+                return True
+            except OSError as e:
+                err = e
+                self.storage_errors += 1
+                self._observe("journal_storage_error", op=op,
+                              errno=e.errno, error=str(e))
+                if op == "write":
+                    # Unknown how much landed: mark the tail dirty and
+                    # repair before any retry.
+                    self._dirty_tail = True
+                    if not self._repair_tail():
+                        break
+                if e.errno == errno.ENOSPC:
+                    # A full disk is not transient: reclaim space via
+                    # an emergency checkpoint+rotate, don't blind-retry.
+                    self.emergency_checkpoint_due = True
+                    break
+                if i + 1 < attempts:
+                    self._sleep(delay)
+                    delay *= 2.0
+        self._enter_non_durable(op, err)
+        return False
+
+    def _flush(self, fsync: bool) -> bool:
+        if self._fd is None and not self._try_reopen():
+            return False
+        if not self._repair_tail():
+            return False
         if self._buffer:
             data = b"".join(self._buffer)
+            if not self._guard(lambda: self._write_all(data), "write"):
+                return False
+            self._good_bytes += len(data)
             self._buffer = []
-            while data:
-                n = os.write(self._fd, data)
-                data = data[n:]
-        if fsync and self._pending:
-            os.fsync(self._fd)
+        if fsync and (self._pending or self.non_durable):
+            # While degraded, fsync even with nothing pending: the
+            # successful fsync IS the probe signal that re-arms.
+            if not self._guard(lambda: self.vfs.fsync(self._fd), "fsync"):
+                return False
             self.fsyncs += 1
             self._pending = 0
+        if fsync and self.non_durable:
+            self._rearm()
+        return True
 
-    def commit(self) -> None:
-        """Flush the buffer and fsync — everything appended so far is
-        durable when this returns."""
-        self._flush(fsync=True)
+    def commit(self) -> bool:
+        """Flush the buffer and fsync. Returns True when everything
+        appended so far is durable; False when the journal is (now)
+        running NON_DURABLE with the backlog retained in-memory."""
+        return self._flush(fsync=True)
 
     def tick(self) -> None:
         """The step-cadence flush: write buffered frames to the OS (so
         a mere router restart loses nothing) but only fsync when the
-        batch threshold says so — the fsync-batching contract."""
+        batch threshold says so — the fsync-batching contract. While
+        NON_DURABLE, writes pause and this fires a rate-limited re-arm
+        probe instead (a full flush+fsync attempt) — no hammering a
+        dead disk every step."""
+        if self.non_durable:
+            self._maybe_probe()
+            return
         self._flush(fsync=self._pending >= self._fsync_batch)
+
+    def _maybe_probe(self) -> None:
+        """One rate-limited re-arm attempt (full flush+fsync) if the
+        probe interval has elapsed — the ONLY disk path while degraded,
+        shared by tick() and the append batch threshold."""
+        now = self._clock()
+        if now >= self._next_probe_s:
+            self._next_probe_s = now + self._rearm_interval_s
+            self._flush(fsync=True)
 
     @property
     def checkpoint_due(self) -> bool:
@@ -257,21 +509,41 @@ class RouterJournal:
 
     @property
     def wal_bytes(self) -> int:
-        try:
-            return os.fstat(self._fd).st_size
-        except OSError:
-            return 0
+        """On-disk WAL size — last KNOWN size when ``fstat`` fails
+        (counted, not swallowed to 0: a zero here reads as "empty WAL"
+        to checkpoint/size telemetry during exactly the disk failures
+        it should be reporting)."""
+        if self._fd is not None:
+            try:
+                self._wal_bytes_last = int(
+                    self.vfs.fstat(self._fd).st_size)
+            except OSError as e:
+                self.storage_errors += 1
+                self._observe("journal_storage_error", op="fstat",
+                              errno=e.errno, error=str(e))
+        return self._wal_bytes_last
 
     # --------------------------------------------------------- checkpoint
     def checkpoint(self, entries: List[Tuple[int, Dict]],
-                   next_rid: int) -> None:
+                   next_rid: int) -> bool:
         """The atomic checkpoint+truncate cycle: snapshot the live
         rid-tagged mirrors (drain-format entries — the encoder
         migration already rides), make it durable and verified, THEN
         truncate the WAL. Crash anywhere inside: recovery still finds
         either (new checkpoint, truncated-or-full WAL with seqs the
-        checkpoint covers marked) or (previous checkpoint, full WAL)."""
-        self.commit()  # the checkpoint covers everything appended
+        checkpoint covers marked) or (previous checkpoint, full WAL).
+
+        Storage-fault contract (ISSUE 18): a failure anywhere in the
+        cycle ABORTS with the checkpoint/prev pair still readable
+        (recovery falls back to the newest VERIFIED state, the r10
+        rule) and never leaves ``_fd`` closed for good — at worst the
+        WAL fd is down and NON_DURABLE probes re-open it. Returns True
+        on a completed cycle. A verified checkpoint makes everything
+        it covers durable regardless of the WAL backlog, so success
+        drops the covered backlog and re-arms a degraded journal;
+        this is also exactly how the ``ENOSPC`` emergency path
+        reclaims space (the rotate retires the oldest segment)."""
+        self.commit()  # best effort: the snapshot comes from live mirrors
         covered_seq = self._next_seq - 1
         body = {
             "version": JOURNAL_VERSION,
@@ -282,16 +554,43 @@ class RouterJournal:
         }
         blob = json.dumps(body, sort_keys=True,
                           separators=(",", ":")).encode()
-        wrapped = {"crc": zlib.crc32(blob) & 0xFFFFFFFF,
-                   "body": body}
+        payload = json.dumps({"crc": zlib.crc32(blob) & 0xFFFFFFFF,
+                              "body": body}).encode()
         tmp = self.checkpoint_path + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(wrapped, f)
-            f.flush()
-            os.fsync(f.fileno())
-        if os.path.exists(self.checkpoint_path):
-            os.replace(self.checkpoint_path, self.checkpoint_prev_path)
-        os.replace(tmp, self.checkpoint_path)
+        try:
+            fd = self.vfs.open(
+                tmp, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o644)
+            try:
+                view = memoryview(payload)
+                while view:
+                    n = self.vfs.write(fd, view)
+                    view = view[n:]
+                self.vfs.fsync(fd)
+            finally:
+                os.close(fd)
+            if os.path.exists(self.checkpoint_path):
+                self.vfs.replace(self.checkpoint_path,
+                                 self.checkpoint_prev_path)
+            self.vfs.replace(tmp, self.checkpoint_path)
+        except OSError as e:
+            # Abort with the pair readable: the worst interleaving
+            # (current demoted to prev, tmp never promoted) still
+            # leaves the old checkpoint verified at the prev slot.
+            self.storage_errors += 1
+            self._observe("journal_checkpoint_failed", op="checkpoint",
+                          errno=e.errno, error=str(e))
+            self._enter_non_durable("checkpoint", e)
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            return False
+        # The new checkpoint is durable and verified: every buffered
+        # frame has seq <= covered_seq, so a retained NON_DURABLE
+        # backlog is covered and can be dropped.
+        self.emergency_checkpoint_due = False
+        self._buffer = []
+        self._pending = 0
         # Rotate the WAL segment rather than truncating it: the
         # segment this checkpoint covers stays on disk as
         # wal.prev.log until the NEXT cycle retires it, so a
@@ -299,12 +598,29 @@ class RouterJournal:
         # checkpoint.prev + this segment with nothing lost. seq keeps
         # counting upward so the covered_seq skip-rule stays monotone
         # across cycles.
-        os.close(self._fd)
-        if os.path.exists(self.wal_path):
-            os.replace(self.wal_path, self.wal_prev_path)
-        self._fd = os.open(self.wal_path,
-                           os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
+        if self._fd is not None:
+            try:
+                os.close(self._fd)
+            except OSError:
+                pass
+            self._fd = None
+        rotated = True
+        try:
+            if os.path.exists(self.wal_path):
+                self.vfs.replace(self.wal_path, self.wal_prev_path)
+        except OSError as e:
+            # Rotation is an optimization (space reclaim); appends can
+            # continue in the un-rotated segment — the covered_seq
+            # skip-rule keeps replay exact either way.
+            rotated = False
+            self.storage_errors += 1
+            self._observe("journal_storage_error", op="replace",
+                          errno=e.errno, error=str(e))
         self.records_since_checkpoint = 0
+        if not self._try_reopen():
+            return False  # fd down; NON_DURABLE probes keep retrying
+        self._rearm()     # checkpoint + live fd = durable again
+        return rotated
 
     # --------------------------------------------------------------- read
     def _scan_last_seq(self) -> int:
@@ -319,8 +635,13 @@ class RouterJournal:
 
     def close(self) -> None:
         if not self._closed:
-            self.commit()
-            os.close(self._fd)
+            self.commit()  # best effort — close never raises OSError
+            if self._fd is not None:
+                try:
+                    os.close(self._fd)
+                except OSError:
+                    pass
+                self._fd = None
             self._closed = True
 
 

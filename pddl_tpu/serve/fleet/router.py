@@ -269,6 +269,16 @@ class FleetMetrics:
         # corrupt frames accepted" proof, not a failure).
         self.wire_retries = 0
         self.wire_crc_rejects = 0
+        # Journal storage health (ISSUE 18), mirrored from the WAL's
+        # degradation machinery: every OSError the VFS shim surfaced
+        # (retries included), entries into the NON_DURABLE degraded
+        # mode, and re-arms back to durable. A nonzero error count
+        # with zero degraded events is the bounded-backoff retry loop
+        # absorbing a transient disk; the gauge (`journal_non_durable`)
+        # carries the live alarmed state.
+        self.journal_storage_errors = 0
+        self.journal_degraded_events = 0
+        self.journal_rearms = 0
         self.requests_finished = 0
         self.requests_failed = 0
         self.requests_orphaned = 0
@@ -530,6 +540,12 @@ class FleetRouter:
                 f"{chain_pull_blocks}")
         self._autoscaler = None
         self._journal = journal
+        if journal is not None:
+            # Storage degradation is alarmable, not silent: every VFS
+            # error, NON_DURABLE entry, and re-arm lands in the trace
+            # with its (op, errno) coordinate and mirrors into the
+            # fleet counters the exposition exports.
+            journal.on_storage_event = self._on_journal_storage_event
         if gray is True:
             gray = GrayDetector()
         elif isinstance(gray, dict):
@@ -1256,7 +1272,13 @@ class FleetRouter:
             # happen under the iteration above.
             self._autoscaler.step(self._clock())
         if self._journal is not None:
-            if self._journal.checkpoint_due:
+            # emergency_checkpoint_due: the WAL hit ENOSPC — an
+            # immediate checkpoint+rotate retires the oldest segment
+            # (the only space the journal owns) instead of blind-
+            # retrying writes against a full disk.
+            if (self._journal.checkpoint_due
+                    or getattr(self._journal,
+                               "emergency_checkpoint_due", False)):
                 self._journal_checkpoint()
             self._journal.tick()
         return tokens
@@ -1323,6 +1345,22 @@ class FleetRouter:
     def _journal_checkpoint(self) -> None:
         self._journal.checkpoint(self._journal_entries(),
                                  next_rid=self._rid_counter)
+
+    def _on_journal_storage_event(self, event: str, detail: Dict) -> None:
+        """The WAL's degradation observer: mirror storage health into
+        FleetMetrics and the trace. ``journal_storage_error`` fires per
+        OSError (retries included); ``journal_degraded`` /
+        ``journal_rearmed`` bracket the NON_DURABLE window the
+        ``journal_non_durable`` gauge alarms."""
+        if event == "journal_storage_error":
+            self.metrics.journal_storage_errors += 1
+        elif event == "journal_degraded":
+            self.metrics.journal_degraded_events += 1
+        elif event == "journal_rearmed":
+            self.metrics.journal_rearms += 1
+        elif event == "journal_checkpoint_failed":
+            self.metrics.journal_storage_errors += 1
+        self._tracer.on_fleet_event(event, **detail)
 
     def run(self, max_steps: Optional[int] = None,
             idle_sleep_s: Optional[float] = None) -> None:
@@ -1740,7 +1778,16 @@ class FleetRouter:
             raise ValueError(
                 f"replica ids must be unique, got {driver.replica_id} "
                 f"already in {ids}")
-        slot = _ReplicaSlot(driver, CircuitBreaker(**self._breaker_kw),
+        # Fleet-wide probe desynchronization (ISSUE 18): a mass-kill
+        # must not schedule every replica's HALF_OPEN probe on the
+        # same doubling schedule, so each breaker gets subtractive
+        # jitter seeded by its replica id — deterministic per replica,
+        # divergent across the fleet. An explicit breaker= policy can
+        # still pin either knob.
+        kw = dict(self._breaker_kw)
+        kw.setdefault("jitter_frac", 0.1)
+        kw.setdefault("seed", int(driver.replica_id))
+        slot = _ReplicaSlot(driver, CircuitBreaker(**kw),
                             self._block_size, self._shadow_capacity,
                             self._shadow_host_capacity)
         slot.breaker.on_transition = self._circuit_observer(slot)

@@ -151,7 +151,14 @@ _HIGHER_BETTER = ("tokens_per_s", "tokens_per_sec", "speedup", "retained",
                   # "retained", the hedged-TTFT ratio rides
                   # "reduction"; raw wire-reject COUNTS are draw-level
                   # telemetry, deliberately not gated).
-                  "hedge_win")
+                  "hedge_win",
+                  # Storage-fault availability (r21): the fraction of
+                  # clean throughput the fleet holds while its WAL is
+                  # degraded NON_DURABLE under a persistent-EIO storm
+                  # — a dying disk must cost serving nothing (re-arm
+                  # latency rides "latency", campaign recovery rides
+                  # "recovery_s").
+                  "availability")
 _LOWER_BETTER = ("ttft", "latency", "_ms", "_wall_s", "overhead",
                  "_seconds", "tick_s", "step_s", "copy_us",
                  # Time the brownout ladder spent engaged (r16): a

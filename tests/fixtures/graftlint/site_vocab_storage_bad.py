@@ -1,0 +1,35 @@
+"""Seeded-bad fixture for the ``site-vocab`` storage leg (ISSUE 18):
+a ``_storage_op`` gate missing from the STORAGE_OPS manifest, a stale
+manifest entry gating nothing, and a manifest/SITES split — the plan
+would reject coordinates the journal actually gates, and carries a
+site the journal never dispatches."""
+
+# BUG: "fdatasync" is stale (no gate below dispatches it), and the
+# "unlink" gate in close() is missing — untargetable by chaos.
+STORAGE_OPS = ("open", "write", "fsync", "fdatasync")
+
+
+class StorageFaultPlan:
+    # BUG: "replace" matches no STORAGE_OPS entry (stale vocabulary),
+    # and "fdatasync" (in the manifest) is missing — scheduling a
+    # fault at a manifest op would raise at plan construction.
+    SITES = ("open", "write", "fsync", "replace")
+
+
+class JournalVFS:
+    def open(self, path, flags, mode=0o644):
+        self._storage_op("open")
+        return _os_open(path, flags, mode)
+
+    def write(self, fd, data):
+        self._storage_op("write")
+        return _os_write(fd, data)
+
+    def fsync(self, fd):
+        self._storage_op("fsync")
+        _os_fsync(fd)
+
+    def close(self, path):
+        # BUG: "unlink" is dispatched but not a STORAGE_OPS entry.
+        self._storage_op("unlink")
+        _os_unlink(path)

@@ -1,0 +1,23 @@
+"""Good twin for the ``site-vocab`` storage-leg fixture: one
+vocabulary across ``_storage_op`` gates, the STORAGE_OPS manifest,
+and ``StorageFaultPlan.SITES``. Must lint clean."""
+
+STORAGE_OPS = ("open", "write", "fsync")
+
+
+class StorageFaultPlan:
+    SITES = ("open", "write", "fsync")
+
+
+class JournalVFS:
+    def open(self, path, flags, mode=0o644):
+        self._storage_op("open")
+        return _os_open(path, flags, mode)
+
+    def write(self, fd, data):
+        self._storage_op("write")
+        return _os_write(fd, data)
+
+    def fsync(self, fd):
+        self._storage_op("fsync")
+        _os_fsync(fd)

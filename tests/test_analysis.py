@@ -60,6 +60,10 @@ BAD_FIXTURES = [
     # The speculative-site twin (ISSUE 12): verify/draft_prefill
     # counted-but-unlisted (2 findings) + the stale retired "tick" (1).
     ("site-vocab", "site_vocab_bad_spec.py", 3),
+    # The storage leg (ISSUE 18): an unmanifested _storage_op gate, a
+    # stale manifest entry, and a manifest/StorageFaultPlan.SITES
+    # split (one missing + one stale) — 4 findings.
+    ("site-vocab", "site_vocab_storage_bad.py", 4),
     ("exposition-parity", "exposition_bad.py", 2),
     ("snapshot-hygiene", "snapshot_bad.py", 1),
     # The journal-manifest twin (ISSUE 14): a WAL record key added
@@ -75,6 +79,7 @@ GOOD_FIXTURES = [
     "pin_release_good.py", "pin_release_good_hosttier.py",
     "donation_good.py", "recompile_good.py",
     "site_vocab_good.py", "site_vocab_good_spec.py",
+    "site_vocab_storage_good.py",
     "exposition_good.py", "snapshot_good.py", "journal_good.py",
     "role_vocab_good.py",
 ]

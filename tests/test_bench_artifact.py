@@ -458,6 +458,70 @@ def test_r20_disagg_artifact_is_gated():
         assert "results.disagg.handoff_ms" in paths
 
 
+def test_r21_chaosd_artifact_is_gated():
+    """The storage-chaos artifact participates in the series: it
+    loads, keys into a (metric, config) group, its committed headlines
+    clear the ISSUE 18 bounds (>= 0.95x clean throughput held while
+    the WAL is degraded NON_DURABLE under a persistent-EIO storm with
+    every stream token-exact; durability re-armed within one probe
+    interval; all 3 composed-plane conductor campaigns green across
+    every referee invariant), they are DIRECTIONAL — and a same-config
+    r-record that regresses them fails `check_series` LOUDLY."""
+    path = os.path.join(_BENCH_DIR, "r21_serve_chaosd.json")
+    records = [r for r in load_artifact(path)
+               if artifact_key(r) is not None]
+    assert records, "r21_serve_chaosd.json has no keyed record"
+    avail = records[0]["results"]["storm"]
+    camp = records[0]["results"]["campaign"]
+    # ISSUE 18 acceptance bounds on the committed medians.
+    assert avail["non_durable_availability_x"] >= 0.95
+    assert avail["storage_faults_injected_total"] > 0  # storm landed
+    assert avail["journal_degraded_events_total"] > 0  # ...degraded
+    assert avail["journal_rearms_total"] == \
+        avail["journal_degraded_events_total"]  # every incident healed
+    assert avail["rearm_within_one_probe_interval"] is True
+    assert avail["rearm_latency_s"] > 0            # measured, recorded
+    assert avail["streams_token_exact"] is True
+    assert camp["campaigns_all_ok"] is True
+    assert camp["invariants_failed"] == []
+    assert len(camp["seeds"]) == 3                 # the 3-seed matrix
+    assert set(camp["planes_composed"]) == {
+        "wire", "storage", "gray", "kill", "router"}
+    assert "token_exact" in camp["invariants_checked"]
+    assert "zero_recompiles" in camp["invariants_checked"]
+    assert "recover_idempotent" in camp["invariants_checked"]
+    assert camp["kills_fired_total"] > 0
+    assert camp["router_crashes_total"] == 3
+    assert camp["wire_faults_injected_total"] > 0
+    assert camp["storage_faults_injected_total"] > 0
+    assert camp["recovery_s"] > 0                  # measured, recorded
+    for key in ("non_durable_availability_x", "rearm_latency_s",
+                "recovery_s", "tokens_per_s_storm"):
+        assert metric_direction(key) != 0, key
+    # A hypothetical r22 record at the SAME config whose storage-chaos
+    # headlines regressed must fail the series gate loudly.
+    worse = copy.deepcopy(records[0])
+    w = worse["results"]
+    w["storm"]["non_durable_availability_x"] *= 0.8
+    w["storm"]["rearm_latency_s"] *= 10.0
+    w["campaign"]["recovery_s"] *= 2.0
+    import json as _json
+    import tempfile
+    with tempfile.TemporaryDirectory() as d_:
+        old_p = os.path.join(d_, "r21_s.json")
+        new_p = os.path.join(d_, "r22_s.json")
+        with open(old_p, "w") as f:
+            _json.dump(records[0], f)
+        with open(new_p, "w") as f:
+            _json.dump(worse, f)
+        pairs, failures = check_series([old_p, new_p])
+        assert pairs == 1 and len(failures) == 1
+        paths = {r["path"] for r in failures[0]["regressions"]}
+        assert "results.storm.non_durable_availability_x" in paths
+        assert "results.storm.rearm_latency_s" in paths
+        assert "results.campaign.recovery_s" in paths
+
+
 def test_compare_flags_directional_regressions_only():
     old = _record(tokens_per_s=1000.0, ttft_p99_s=0.10, spread_pct=2.0,
                   prefix_hit_rate=0.97)

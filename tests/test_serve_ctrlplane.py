@@ -589,13 +589,16 @@ def _make_gray(fleet, plans, victim_id, *, latency_s):
     latency injection, which the router's per-step wall sampling
     sees."""
     det = fleet.gray
-    need = det.window + det.baseline
+    # The median-of-``smooth`` prefilter (ISSUE 18 de-flake) consumes
+    # ``smooth`` raw samples per window entry — scale the drive counts
+    # so the baseline actually fills.
+    need = (det.window + det.baseline) * det.smooth
     for _ in range(need + 2):
         fleet.step()
     plans[victim_id]._rates = (0.0, 0.0, 1.0)  # latency on every call
     plans[victim_id].latency_s = latency_s
     plans[victim_id]._sleep = time.sleep
-    for _ in range(200):
+    for _ in range(200 * det.smooth):
         fleet.step()
         # A gray_drain fleet acts on the suspicion INSIDE the same
         # step (and forgets the retired replica) — the executed drain
@@ -612,15 +615,18 @@ def test_gray_hedge_first_result_wins_token_exact(gpt_setup, tmp_path):
     fleet, plans = _local_fleet(
         model, variables, 2, with_plans=True, tracer=tracer,
         journal=RouterJournal(str(tmp_path / "wal")),
-        gray=GrayDetector(window=4, baseline=12, z_threshold=4.0,
-                          min_excess_s=0.002, consecutive=2),
+        # smooth=3 (ISSUE 18 de-flake): median-of-3 prefilter kills
+        # single-sample wall outliers; baseline=4 medians keeps the
+        # same 12 RAW samples of baseline coverage as before.
+        gray=GrayDetector(window=4, baseline=4, z_threshold=4.0,
+                          min_excess_s=0.002, consecutive=2, smooth=3),
         gray_hedge=True, gray_drain=False)
     # Pin a session to replica 0, and keep BOTH of its engine slots
     # busy so a later hedged request must queue there — which is what
     # lets the healthy sibling win by rounds, deterministically.
-    pin = fleet.submit(list(range(1, 9)), 40, session="s0")
+    pin = fleet.submit(list(range(1, 9)), 56, session="s0")
     victim_id = pin.replica_id
-    busy = fleet.submit(list(range(2, 10)), 40, session="s0")
+    busy = fleet.submit(list(range(2, 10)), 56, session="s0")
     assert busy.replica_id == victim_id
     _make_gray(fleet, plans, victim_id, latency_s=0.002)
     assert fleet.gray.suspected == {victim_id}
@@ -694,12 +700,15 @@ def test_hedge_copy_failure_does_not_kill_the_stream(gpt_setup):
     fleet = FleetRouter(
         [FailsWhenArmed(i, factory(plans[i])) for i in range(2)],
         affinity_block_size=8, affinity_blocks=1, respawn=False,
-        gray=GrayDetector(window=4, baseline=12, z_threshold=4.0,
-                          min_excess_s=0.002, consecutive=2),
+        # smooth=3 (ISSUE 18 de-flake): median-of-3 prefilter kills
+        # single-sample wall outliers; baseline=4 medians keeps the
+        # same 12 RAW samples of baseline coverage as before.
+        gray=GrayDetector(window=4, baseline=4, z_threshold=4.0,
+                          min_excess_s=0.002, consecutive=2, smooth=3),
         gray_hedge=True, gray_drain=False)
-    pin = fleet.submit(list(range(1, 9)), 40, session="s0")
+    pin = fleet.submit(list(range(1, 9)), 56, session="s0")
     victim_id = pin.replica_id
-    fleet.submit(list(range(2, 10)), 40, session="s0")
+    fleet.submit(list(range(2, 10)), 56, session="s0")
     _make_gray(fleet, plans, victim_id, latency_s=0.002)
     sibling = next(s for s in fleet.replicas
                    if s.replica_id != victim_id)
@@ -723,17 +732,20 @@ def test_gray_drain_retires_suspect_via_live_migration(gpt_setup):
     tracer = RequestTracer()
     fleet, plans = _local_fleet(
         model, variables, 2, with_plans=True, tracer=tracer,
-        gray=GrayDetector(window=4, baseline=12, z_threshold=4.0,
-                          min_excess_s=0.002, consecutive=2),
+        # smooth=3 (ISSUE 18 de-flake): median-of-3 prefilter kills
+        # single-sample wall outliers; baseline=4 medians keeps the
+        # same 12 RAW samples of baseline coverage as before.
+        gray=GrayDetector(window=4, baseline=4, z_threshold=4.0,
+                          min_excess_s=0.002, consecutive=2, smooth=3),
         gray_hedge=False, gray_drain=True)
-    pin = fleet.submit(list(range(1, 9)), 40, session="s0")
+    pin = fleet.submit(list(range(1, 9)), 56, session="s0")
     victim_id = pin.replica_id
-    busy = fleet.submit(list(range(2, 10)), 40, session="s0")
+    busy = fleet.submit(list(range(2, 10)), 56, session="s0")
     assert busy.replica_id == victim_id
     refs = {tuple(range(1, 9)): _ref_greedy(model, variables,
-                                            list(range(1, 9)), 40),
+                                            list(range(1, 9)), 56),
             tuple(range(2, 10)): _ref_greedy(model, variables,
-                                             list(range(2, 10)), 40)}
+                                             list(range(2, 10)), 56)}
     _make_gray(fleet, plans, victim_id, latency_s=0.002)
     # The suspect was retired through scale_down (live migration): its
     # in-flight streams moved and still finish token-exact.
@@ -973,11 +985,11 @@ def test_exposition_ctrlplane_series_both_directions(gpt_setup,
     fleet, plans = _local_fleet(
         model, variables, 2, with_plans=True,
         journal=RouterJournal(str(tmp_path / "wal")),
-        gray=GrayDetector(window=4, baseline=12, min_excess_s=0.002,
-                          consecutive=2))
+        gray=GrayDetector(window=4, baseline=4, min_excess_s=0.002,
+                          consecutive=2, smooth=3))
     h = fleet.submit(list(range(1, 9)), 4, session="s0")
     victim_id = h.replica_id
-    fleet.submit(list(range(2, 10)), 30, session="s0")
+    fleet.submit(list(range(2, 10)), 56, session="s0")
     _make_gray(fleet, plans, victim_id, latency_s=0.002)
     fleet.submit(list(range(3, 9)), 3, session="s0")  # hedges
     fleet.run(max_steps=2000)
