@@ -17,6 +17,7 @@ from pddl_tpu.analysis.checkers.recompile import RecompileHazardRule
 from pddl_tpu.analysis.checkers.role_vocab import RoleVocabRule
 from pddl_tpu.analysis.checkers.site_vocab import SiteVocabRule
 from pddl_tpu.analysis.checkers.snapshot_vocab import SnapshotHygieneRule
+from pddl_tpu.analysis.checkers.trace_vocab import TraceVocabRule
 
 RULES = (
     PinReleaseRule,
@@ -26,6 +27,7 @@ RULES = (
     ExpositionParityRule,
     SnapshotHygieneRule,
     RoleVocabRule,
+    TraceVocabRule,
 )
 
 __all__ = ["RULES"] + [cls.__name__ for cls in RULES]

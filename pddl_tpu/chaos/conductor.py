@@ -28,7 +28,11 @@ the workload and runs the INVARIANT REFEREE:
 - **recovery_bounded** — the router crash+recover cycle, when the
   campaign includes one, completed within ``recovery_bound_s``;
 - **exposition_round_trip** — the surviving fleet's Prometheus
-  exposition still parses under the strict referee.
+  exposition still parses under the strict referee;
+- **trace_complete** — with distributed tracing armed
+  (``router_kw=dict(dtrace=True)``), every acked stream's stitched
+  fleet trace is gap-free across kills, migrations, and hand-offs
+  (`pddl_tpu.obs.assemble`); auto-skipped when tracing is off.
 
 The conductor is deliberately duck-typed over fleets: the caller
 supplies replica factories, per-replica :class:`ReplicaChaos` handles
@@ -472,6 +476,25 @@ class ChaosConductor:
         except Exception as e:  # noqa: BLE001 - the referee reports
             invariants["exposition_round_trip"] = False
             violations.append(f"exposition: {e}")
+        collector = getattr(fleet, "dtrace", None)
+        if collector is None:
+            invariants["trace_complete"] = True
+            skipped.append("trace_complete (tracing not armed)")
+        else:
+            # A few extra pump rounds first: span batches for the very
+            # last finishes may still sit in worker pipes.
+            for _ in range(3):
+                try:
+                    fleet.step()
+                except Exception:  # noqa: BLE001 - settled fleet only
+                    break
+            from pddl_tpu.obs.assemble import stitch
+            gappy: List[str] = []
+            for tid, trace in stitch(collector.records()).items():
+                for gap in trace.gaps():
+                    gappy.append(f"trace {tid}: {gap}")
+            invariants["trace_complete"] = not gappy
+            violations.extend(gappy[:5])
         return CampaignReport(
             seed=self.seed, planes=tuple(planes), actions=[], steps=0,
             wall_s=0.0, recovery_s=recovery_s, injected={},

@@ -33,7 +33,7 @@ import math
 import os
 import re
 import threading
-from typing import Callable, Dict, Mapping, Optional, Tuple
+from typing import Callable, Dict, Mapping, Optional, Sequence, Tuple
 
 SCHEMA_VERSION = 1
 
@@ -148,10 +148,44 @@ def _escape_label(v: str) -> str:
     return v.replace("\\", r"\\").replace('"', r'\"').replace("\n", r"\n")
 
 
+# Latency histogram bucket edges (seconds): the conventional
+# Prometheus latency ladder, clipped to the ranges the serving SLOs
+# actually alarm on. TTFT spans queue wait + prefill (up to seconds
+# under load); per-token decode latency is an order of magnitude
+# tighter.
+TTFT_BUCKETS_S = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+                  1.0, 2.5, 5.0, 10.0)
+TOKEN_LATENCY_BUCKETS_S = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+                           0.1, 0.25, 1.0)
+
+
+def reservoir_histogram(reservoir,
+                        buckets: Sequence[float]) -> Dict[str, object]:
+    """A :class:`~pddl_tpu.serve.metrics.Reservoir` (or any iterable
+    of floats) folded into the renderer's histogram spec: CUMULATIVE
+    per-``le`` counts in ascending edge order plus the implicit
+    ``+Inf`` bucket, with ``sum``/``count`` over the same samples —
+    so ``le="+Inf"`` always equals ``count``, the consistency the
+    round-trip test pins."""
+    edges = sorted(float(b) for b in buckets)
+    samples = sorted(float(v) for v in reservoir)
+    cum: Dict[str, int] = {}
+    i = 0
+    for edge in edges:
+        while i < len(samples) and samples[i] <= edge:
+            i += 1
+        cum[format(edge, "g")] = i
+    cum["+Inf"] = len(samples)
+    return {"buckets": cum, "sum": float(sum(samples)),
+            "count": len(samples)}
+
+
 def render_prometheus(snapshot: Mapping[str, object], *,
                       prefix: str = "pddl",
                       counters: frozenset = frozenset(),
-                      help_text: Optional[Mapping[str, str]] = None) -> str:
+                      help_text: Optional[Mapping[str, str]] = None,
+                      histograms: Optional[Mapping[str, Mapping]] = None,
+                      ) -> str:
     """Render a flat snapshot dict as Prometheus text exposition.
 
     EVERY key renders: scalars become ``{prefix}_{key}`` (counters per
@@ -162,6 +196,12 @@ def render_prometheus(snapshot: Mapping[str, object], *,
     ``{prefix}_{key}{{key="..."}}`` per entry (``compile_counts``,
     per-device memory). Keys must already be exposition-legal
     (``[a-zA-Z0-9_]``) — snapshots in this repo are.
+
+    ``histograms`` maps extra metric names to
+    :func:`reservoir_histogram` specs, rendered as conventional
+    cumulative histograms (``{name}_bucket{{le="..."}}`` ascending,
+    ``le="+Inf"`` == ``{name}_count``, plus ``_sum``/``_count``) —
+    the shape every Prometheus quantile/burn-rate recipe expects.
     """
     lines = []
     for key in snapshot:
@@ -194,6 +234,19 @@ def render_prometheus(snapshot: Mapping[str, object], *,
             lines.append(f"# TYPE {name} "
                          f"{'counter' if is_counter else 'gauge'}")
             lines.append(f"{name} {_fmt_value(value)}")
+    for key in (histograms or {}):
+        spec = histograms[key]
+        name = f"{prefix}_{key}"
+        if not _NAME_RE.match(name):
+            raise ValueError(f"metric name {name!r} is not "
+                             "exposition-legal")
+        lines.append(f"# TYPE {name} histogram")
+        buckets = spec["buckets"]
+        for le in buckets:
+            lines.append(f'{name}_bucket{{le="{le}"}} '
+                         f"{int(buckets[le])}")
+        lines.append(f"{name}_sum {_fmt_value(float(spec['sum']))}")
+        lines.append(f"{name}_count {int(spec['count'])}")
     return "\n".join(lines) + "\n"
 
 
@@ -502,8 +555,18 @@ def serve_exposition(metrics, engine=None, *,
     summary when an engine is given), optionally the training
     `StepTimer` snapshot and per-device memory — training and serving
     through a single export path."""
-    parts = [render_prometheus(metrics.snapshot(), prefix="pddl_serve",
-                               counters=SERVE_COUNTER_KEYS)]
+    parts = [render_prometheus(
+        metrics.snapshot(), prefix="pddl_serve",
+        counters=SERVE_COUNTER_KEYS,
+        # Cumulative latency histograms over the same reservoirs the
+        # p50/p99 gauges estimate from — the dashboard's
+        # histogram_quantile() and SLO burn-rate source.
+        histograms={
+            "ttft_seconds": reservoir_histogram(
+                metrics.ttft_s, TTFT_BUCKETS_S),
+            "token_latency_seconds": reservoir_histogram(
+                metrics.token_latency_s, TOKEN_LATENCY_BUCKETS_S),
+        })]
     if engine is not None:
         parts.append(render_prometheus(engine_gauges(engine),
                                        prefix="pddl_serve_engine"))

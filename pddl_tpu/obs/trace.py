@@ -187,6 +187,35 @@ class NullTracer:
         the event vocabulary belongs to the router, the transport (and
         the no-op discipline) to the tracer."""
 
+    # --------------------------------------- distributed-tracing hooks
+    # The fleet propagation layer (`obs/propagate.py`) and the flight
+    # recorder (`obs/flightrec.py`) report through the same surface —
+    # all no-ops here, so tracing-off stays exactly free (the
+    # tracemalloc pin covers these too).
+
+    def on_trace_context(self, request_id: int, trace_id: str,
+                         parent_span_id: Optional[str]) -> None:
+        """The router's wire context arrived for an in-flight request:
+        restamp its span into the fleet trace."""
+
+    def on_restored(self, handle, n_tokens: int) -> None:
+        """A drained/migrated/hand-off stream resumed in THIS engine
+        with ``n_tokens`` already emitted elsewhere."""
+
+    def on_chain_export(self, n_blocks: int, wall_s: float) -> None:
+        """A prefix chain left this engine over the chain wire."""
+
+    def on_chain_import(self, n_blocks: int, wall_s: float) -> None:
+        """A prefix chain landed in this engine's host tier."""
+
+    def on_span_shipped(self, n: int, dropped: int) -> None:
+        """A span batch left the worker for the router (``dropped`` is
+        the shipper's cumulative overflow counter)."""
+
+    def on_flight_rotate(self, segments: int,
+                         bytes_written: int) -> None:
+        """The flight recorder sealed a segment."""
+
     # ------------------------------------------------- training hooks
     # The Trainer's guarded boundary (`train/loop.py`) emits through
     # the SAME tracer surface the serving engine uses — `on_retry` and
@@ -254,6 +283,8 @@ class RequestTracer(NullTracer):
         self.spans_started = 0
         self.spans_finished = 0
         self.sink_errors = 0
+        self.spans_shipped = 0
+        self.span_ship_drops = 0
 
     # --------------------------------------------------------- plumbing
     def _span(self, handle) -> Optional[Span]:
@@ -401,6 +432,51 @@ class RequestTracer(NullTracer):
         # with kind="fleet_event", so events_named() and the JSONL log
         # cover the fleet without a second pipeline.
         self._engine_event(name, kind="fleet_event", **attrs)
+
+    # --------------------------------------- distributed-tracing hooks
+    def on_trace_context(self, request_id: int, trace_id: str,
+                         parent_span_id: Optional[str]) -> None:
+        span = self.active.get(request_id)
+        if span is None:
+            return
+        if trace_id:
+            span.trace_id = trace_id
+        if parent_span_id is not None:
+            span.attrs["parent_span_id"] = parent_span_id
+
+    def on_restored(self, handle, n_tokens: int) -> None:
+        # A restored stream gets a fresh span (the original lives in
+        # the source engine's record stream); the router's trace
+        # context arrives right after and restamps the trace id.
+        rid = handle.request.request_id
+        now = self._clock()
+        span = Span(trace_id=f"{rid:016x}",
+                    span_id="0000000000000001",
+                    name="request", request_id=rid, start_s=now,
+                    max_events=self._max_events)
+        span.attrs["prompt_len"] = len(handle.request.prompt)
+        span.attrs["max_new_tokens"] = handle.request.max_new_tokens
+        span.attrs["restored"] = True
+        span.event(now, "restored", n_tokens=int(n_tokens))
+        self.active[rid] = span
+        self.spans_started += 1
+
+    def on_chain_export(self, n_blocks: int, wall_s: float) -> None:
+        self._engine_event("chain_export", n_blocks=n_blocks,
+                           wall_s=wall_s)
+
+    def on_chain_import(self, n_blocks: int, wall_s: float) -> None:
+        self._engine_event("chain_import", n_blocks=n_blocks,
+                           wall_s=wall_s)
+
+    def on_span_shipped(self, n: int, dropped: int) -> None:
+        self.spans_shipped += int(n)
+        self.span_ship_drops = max(self.span_ship_drops, int(dropped))
+
+    def on_flight_rotate(self, segments: int,
+                         bytes_written: int) -> None:
+        self._engine_event("flight_rotate", segments=segments,
+                           bytes_written=bytes_written)
 
     # ------------------------------------------------- training hooks
     def on_checkpoint_saved(self, step: int, wall_s: float) -> None:

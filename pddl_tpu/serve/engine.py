@@ -3710,6 +3710,10 @@ class ServeEngine:
                     "(adapter/constraint) but this engine has no "
                     "tenant=TenantConfig(...)")
         self.scheduler.restore(handles)
+        for h in handles:
+            # Open a span for each resumed stream — without this, a
+            # migrated/hand-off stream's decode side traces nothing.
+            self._tracer.on_restored(h, len(h.tokens))
         return handles
 
     # ------------------------------------------- cross-replica transfer

@@ -181,6 +181,14 @@ class HandoffManager:
         dst = min(targets, key=lambda s: s.load)
         prompt = list(fh.request.prompt)
         t0 = r._gray_timer()
+        # The stream's trace follows it across the rebind below: both
+        # chain-wire legs carry the ORIGINAL trace context, and the
+        # fresh rid aliases back (`TraceCollector.rebind`) so one
+        # stitched trace spans prefill replica -> wire -> decode.
+        collector = r._dtrace
+        ctx = (collector.context_for(rid)
+               if collector is not None else None)
+        export_s = import_s = 0.0
         # 1. Ship the finished prefill KV: source exports the chain
         # (drain wire format), target lands it in its HOST tier. The
         # engine's export pins the chain for exactly the copy and
@@ -193,7 +201,10 @@ class HandoffManager:
         import_fn = getattr(dst.driver, "import_chain", None)
         try:
             if export is not None:
-                chain = export(prompt, None)
+                t_leg = r._gray_timer()
+                chain = (export(prompt, None, trace=ctx)
+                         if ctx is not None else export(prompt, None))
+                export_s = r._gray_timer() - t_leg
         except (KillPoint, ReplicaDied) as e:
             r.metrics.handoffs_failed += 1
             r._on_death(src, e)
@@ -202,7 +213,10 @@ class HandoffManager:
             chain = None
         if chain and import_fn is not None:
             try:
-                n_blocks = import_fn(chain)
+                t_leg = r._gray_timer()
+                n_blocks = (import_fn(chain, trace=ctx)
+                            if ctx is not None else import_fn(chain))
+                import_s = r._gray_timer() - t_leg
             except (KillPoint, ReplicaDied) as e:
                 r.metrics.handoffs_failed += 1
                 r._on_death(dst, e)
@@ -239,8 +253,15 @@ class HandoffManager:
             src.driver.cancel(rid)
         except Exception:  # noqa: BLE001 - a dying source settles later
             pass
+        if collector is not None:
+            collector.rebind(rid, new_rid)
         try:
-            dst.driver.restore([(new_rid, entry)])
+            if collector is not None:
+                dst.driver.restore(
+                    [(new_rid, entry)],
+                    traces={new_rid: collector.context_for(new_rid)})
+            else:
+                dst.driver.restore([(new_rid, entry)])
         except (KillPoint, ReplicaDied) as e:
             r.metrics.handoffs_failed += 1
             r._on_death(dst, e)
@@ -280,6 +301,10 @@ class HandoffManager:
             from_replica=src.replica_id, to_replica=dst.replica_id,
             blocks=n_blocks, bytes=moved_bytes,
             ms=round((r._gray_timer() - t0) * 1e3, 3))
+        if collector is not None:
+            collector.on_handoff(new_rid, src.replica_id,
+                                 dst.replica_id, export_s, import_s,
+                                 n_blocks)
         return 1
 
 

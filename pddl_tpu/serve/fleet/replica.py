@@ -43,6 +43,7 @@ import sys
 import time
 from typing import Dict, List, Optional, Tuple
 
+from pddl_tpu.obs.propagate import ClockAligner, SpanShipper
 from pddl_tpu.serve import drain as drain_io
 from pddl_tpu.serve.fleet.disagg import validate_role
 from pddl_tpu.serve.fleet.transport import (
@@ -182,17 +183,33 @@ class LocalReplica:
         self._factory = engine_factory
         self.engine = engine_factory()
         self._ledger = HandleLedger()
+        # Distributed tracing (ISSUE 19): finished engine spans are
+        # pumped into this buffer (rid-tagged) for the router's
+        # collector; inert unless the engine has an enabled tracer.
+        self._span_buf = SpanShipper()
+        self._trace_rids: Dict[int, int] = {}
+        self._dtrace_armed = False
 
     # ------------------------------------------------------------- intake
     def submit(self, rid: int, prompt, max_new_tokens: int,
                sampling: SamplingParams, deadline_s,
                priority: Priority = Priority.INTERACTIVE,
-               adapter=None, constraint=None) -> None:
+               adapter=None, constraint=None, trace=None) -> None:
         handle = self.engine.submit(prompt, max_new_tokens,
                                     sampling=sampling, deadline_s=deadline_s,
                                     priority=priority, adapter=adapter,
                                     constraint=constraint)
         self._ledger.add(rid, handle)
+        self._apply_trace(rid, handle, trace)
+
+    def _apply_trace(self, rid: int, handle, trace) -> None:
+        tracer = self.engine.tracer
+        if not tracer.enabled:
+            return
+        eng_rid = handle.request.request_id
+        self._trace_rids[eng_rid] = int(rid)
+        if trace is not None:
+            tracer.on_trace_context(eng_rid, str(trace[0]), trace[1])
 
     def cancel(self, rid: int) -> None:
         h = self._ledger.get(rid)
@@ -205,7 +222,51 @@ class LocalReplica:
 
     def step(self) -> List[Dict[str, object]]:
         self.engine.step()
+        self._pump_spans()
         return self._ledger.harvest()
+
+    def _pump_spans(self) -> None:
+        """Move finished engine spans (rid-tagged, replica-tagged) into
+        the span buffer — destructive on the tracer's deque, so each
+        record ships exactly once."""
+        tracer = self.engine.tracer
+        if not tracer.enabled:
+            return
+        finished = getattr(tracer, "finished", None)
+        if not finished:
+            return
+        while True:
+            try:
+                rec = finished.popleft()
+            except IndexError:
+                break
+            rec = dict(rec)
+            rec["rid"] = self._trace_rids.pop(rec.get("request_id"), None)
+            rec["replica"] = self.replica_id
+            rec["role"] = self.role
+            self._span_buf.add(rec)
+
+    def take_span_records(self) -> List[Dict[str, object]]:
+        """Span records since the last call (the router's collector
+        drains this each step)."""
+        self._pump_spans()
+        return self._span_buf.drain(None)
+
+    def flush_spans(self) -> None:
+        """Death-path flush: cut every in-flight span short (the same
+        ``drained`` discipline the engine's own drain applies) so the
+        postmortem trace covers streams that never finished."""
+        tracer = self.engine.tracer
+        try:
+            if tracer.enabled and tracer.active:
+                tracer.on_drain(0, len(tracer.active))
+        except Exception:  # noqa: BLE001 - the engine may be wedged
+            pass
+        self._pump_spans()
+
+    def clock_offset(self) -> Optional[float]:
+        """In-process replicas share the router's clock."""
+        return 0.0
 
     @property
     def queue_depth(self) -> int:
@@ -245,13 +306,18 @@ class LocalReplica:
             pass           # wedged post-kill; the entries above suffice
         return entries
 
-    def restore(self, pairs: List[Tuple[int, Dict]]) -> None:
+    def restore(self, pairs: List[Tuple[int, Dict]],
+                traces=None) -> None:
         """Migration in: wire entries join this engine's queue through
         the standard restore path (depth limits bypassed — every one of
-        these was admitted by the fleet already)."""
+        these was admitted by the fleet already). ``traces`` optionally
+        maps rid -> wire trace context so the resumed streams' spans
+        stay in their original fleet traces."""
         handles = self.engine.restore(snapshot_from_pairs(pairs))
         for (rid, _), handle in zip(pairs, handles):
             self._ledger.add(rid, handle)
+            self._apply_trace(rid, handle,
+                              None if traces is None else traces.get(rid))
 
     def take_pending(self) -> List[Dict[str, object]]:
         """Unharvested ledger events — a request can finish inside the
@@ -260,21 +326,59 @@ class LocalReplica:
         return self._ledger.harvest()
 
     def export_chain(self, prompt: List[int],
-                     max_blocks: Optional[int] = None):
+                     max_blocks: Optional[int] = None, trace=None):
         """Replica-to-replica prefix transfer OUT (ISSUE 13): the
         engine's longest cached chain for ``prompt`` as a drain-module
-        chain wire entry, or None."""
-        return self.engine.export_prefix_chain(prompt,
-                                               max_blocks=max_blocks)
+        chain wire entry, or None. A ``trace`` context makes the
+        transfer a span in the stream's fleet trace (ISSUE 19)."""
+        t0 = time.monotonic()
+        entry = self.engine.export_prefix_chain(prompt,
+                                                max_blocks=max_blocks)
+        if entry is not None and trace is not None \
+                and self.engine.tracer.enabled:
+            from pddl_tpu.obs.propagate import chain_export_span
 
-    def import_chain(self, entry) -> int:
+            n_blocks = len(entry.get("blocks") or ())
+            t1 = time.monotonic()
+            self.engine.tracer.on_chain_export(n_blocks, t1 - t0)
+            self._span_buf.add(chain_export_span(
+                trace, t0, t1, n_blocks, replica=self.replica_id,
+                role=self.role))
+        return entry
+
+    def import_chain(self, entry, trace=None) -> int:
         """Transfer IN: the chain lands in the engine's HOST tier;
         returns blocks stored (0 = tier off / refused)."""
-        return self.engine.import_prefix_chain(entry)
+        t0 = time.monotonic()
+        n = self.engine.import_prefix_chain(entry)
+        if n and trace is not None and self.engine.tracer.enabled:
+            from pddl_tpu.obs.propagate import chain_import_span
+
+            t1 = time.monotonic()
+            self.engine.tracer.on_chain_import(n, t1 - t0)
+            self._span_buf.add(chain_import_span(
+                trace, t0, t1, n, replica=self.replica_id,
+                role=self.role))
+        return n
+
+    def arm_tracing(self) -> None:
+        """Arm a per-request tracer on the engine (idempotent): the
+        router calls this when its dtrace collector is armed, so a
+        LocalReplica fleet traces without per-test engine plumbing. A
+        user-installed tracer is respected (never replaced)."""
+        if not self.engine.tracer.enabled:
+            from pddl_tpu.obs.trace import RequestTracer
+
+            self.engine.set_tracer(RequestTracer())
+        self._dtrace_armed = True
 
     def respawn(self) -> None:
         self.engine = self._factory()
         self._ledger = HandleLedger()
+        self._trace_rids = {}
+        if self._dtrace_armed:
+            self._dtrace_armed = False
+            self.arm_tracing()
 
     def close(self) -> None:
         pass
@@ -391,6 +495,12 @@ class ProcessReplica:
         self._next_resend_at = 0.0
         self._wire_retries = 0
         self._tick_walls: List[float] = []
+        # Distributed tracing (ISSUE 19), fresh per process: span
+        # records shipped back over the pipe, and the min-RTT clock
+        # aligner fed by ping-echo timestamps on pongs.
+        self._span_records: List[Dict[str, object]] = []
+        self._spans_dropped = 0
+        self._aligner = ClockAligner()
         self.ready_compile_counts: Optional[Dict[str, int]] = None
         if wait_ready:
             self.wait_ready()
@@ -511,7 +621,7 @@ class ProcessReplica:
                 # read fix): drop the line, count it, never crash.
                 self._receiver.stats["too_large"] += 1
                 return
-            out.append(json.loads(line))
+            self._absorb(json.loads(line), out)
             return
         ctl = decode_control(line)
         if ctl is not None:
@@ -529,7 +639,21 @@ class ProcessReplica:
                                     line + b"\n"))
         for raw in mangled:
             for payload in self._receiver.feed(raw.rstrip(b"\n")):
-                out.append(json.loads(payload))
+                self._absorb(json.loads(payload), out)
+
+    def _absorb(self, ev: Dict[str, object],
+                out: List[Dict[str, object]]) -> None:
+        """Span batches are transport-level (ISSUE 19): fold them into
+        the span buffer at the single ingestion point — whatever wait
+        loop happened to read them — instead of surfacing an event the
+        router's apply path would have to know to ignore."""
+        if ev.get("ev") == "spans":
+            self._span_records.extend(ev.get("spans") or [])
+            if ev.get("dropped") is not None:
+                self._spans_dropped = max(self._spans_dropped,
+                                          int(ev["dropped"]))
+            return
+        out.append(ev)
 
     def _nudge(self) -> None:
         """Traffic generator for framed wait loops: a ping at the
@@ -542,7 +666,9 @@ class ProcessReplica:
         now = self._clock()
         if now - self._last_ping_s >= self._ping_interval_s:
             self._last_ping_s = now
-            self._send({"cmd": "ping"})
+            # t_s echoes back on the pong with the worker's own
+            # monotonic read: one clock-offset sample per heartbeat.
+            self._send({"cmd": "ping", "t_s": now})
             if self._unanswered_ping_s is None:
                 self._unanswered_ping_s = now
 
@@ -639,19 +765,24 @@ class ProcessReplica:
     def submit(self, rid: int, prompt, max_new_tokens: int,
                sampling: SamplingParams, deadline_s,
                priority: Priority = Priority.INTERACTIVE,
-               adapter=None, constraint=None) -> None:
+               adapter=None, constraint=None, trace=None) -> None:
         """Synchronous across the pipe: the worker acks admission or
         reports its typed QueueFull (depth + retry_after hint), which
         re-raises here so the router's shed logic is driver-agnostic.
         ``adapter``/``constraint`` (the tenant fields) are already
-        plain wire values — a name string and a spec dict."""
-        self._send({"cmd": "submit", "rid": int(rid),
-                    "prompt": [int(t) for t in prompt],
-                    "max_new_tokens": int(max_new_tokens),
-                    "sampling": sampling_to_wire(sampling),
-                    "deadline_s": deadline_s,
-                    "priority": Priority(priority).value,
-                    "adapter": adapter, "constraint": constraint})
+        plain wire values — a name string and a spec dict; ``trace``
+        is the router's ``(trace_id, parent_span_id)`` wire context
+        (ISSUE 19), stamped only when fleet tracing is armed."""
+        cmd = {"cmd": "submit", "rid": int(rid),
+               "prompt": [int(t) for t in prompt],
+               "max_new_tokens": int(max_new_tokens),
+               "sampling": sampling_to_wire(sampling),
+               "deadline_s": deadline_s,
+               "priority": Priority(priority).value,
+               "adapter": adapter, "constraint": constraint}
+        if trace is not None:
+            cmd["trace"] = [str(trace[0]), trace[1]]
+        self._send(cmd)
         deadline = self._clock() + self._call_timeout_s
         while True:
             # Consume the WHOLE batch before acting on the ack: token
@@ -694,7 +825,7 @@ class ProcessReplica:
         now = self._clock()
         if now - self._last_ping_s >= self._ping_interval_s:
             self._last_ping_s = now
-            self._send({"cmd": "ping"})
+            self._send({"cmd": "ping", "t_s": now})
             if self._unanswered_ping_s is None:
                 self._unanswered_ping_s = now
         events, self._pending = self._pending, []
@@ -710,6 +841,16 @@ class ProcessReplica:
                 # wall cannot see a slow self-driving worker).
                 if ev.get("tick_wall_s") is not None:
                     self._tick_walls.append(float(ev["tick_wall_s"]))
+                # ...and as the clock aligner's: the echoed ping send
+                # time plus the worker's monotonic read is one NTP
+                # sample. A pong that sat buffered through a blocked
+                # call reads as a huge RTT, which the min-RTT filter
+                # discards on its own.
+                if (ev.get("echo_t_s") is not None
+                        and ev.get("mono_s") is not None):
+                    self._aligner.observe(float(ev["echo_t_s"]),
+                                          self._clock(),
+                                          float(ev["mono_s"]))
             else:
                 out.append(ev)
         return out
@@ -720,6 +861,31 @@ class ProcessReplica:
         detector's input for process replicas."""
         out, self._tick_walls = self._tick_walls, []
         return out
+
+    def take_span_records(self) -> List[Dict[str, object]]:
+        """Worker span records absorbed from the pipe since the last
+        call (the router's collector drains this each step)."""
+        out, self._span_records = self._span_records, []
+        return out
+
+    @property
+    def spans_dropped(self) -> int:
+        """The worker shipper's cumulative overflow counter, as last
+        reported."""
+        return self._spans_dropped
+
+    def clock_offset(self) -> Optional[float]:
+        """Best current estimate of (worker monotonic - router
+        monotonic), from the minimal-RTT ping/pong sample; None until
+        the first heartbeat answers."""
+        return self._aligner.offset_s
+
+    @property
+    def flightrec_dir(self) -> Optional[str]:
+        """Where this worker's flight recorder writes (config-armed);
+        the router harvests it on death."""
+        val = self._config.get("flightrec_dir")
+        return None if val is None else str(val)
 
     def set_tick_delay(self, delay_s: float) -> None:
         """Chaos knob: make THIS worker gray — every engine step gains
@@ -768,15 +934,18 @@ class ProcessReplica:
         raise ReplicaDied(self.replica_id, "counts request timed out")
 
     def export_chain(self, prompt: List[int],
-                     max_blocks: Optional[int] = None):
+                     max_blocks: Optional[int] = None, trace=None):
         """Replica-to-replica prefix transfer OUT, over the pipe:
         synchronous like :meth:`compile_counts` (the router is about to
         route based on the answer), bounded by ``call_timeout_s``.
         Returns the chain wire entry or None."""
-        self._send({"cmd": "export_chain",
-                    "prompt": [int(t) for t in prompt],
-                    "max_blocks": (int(max_blocks)
-                                   if max_blocks is not None else None)})
+        cmd = {"cmd": "export_chain",
+               "prompt": [int(t) for t in prompt],
+               "max_blocks": (int(max_blocks)
+                              if max_blocks is not None else None)}
+        if trace is not None:
+            cmd["trace"] = [str(trace[0]), trace[1]]
+        self._send(cmd)
         deadline = self._clock() + self._call_timeout_s
         while self._clock() < deadline:
             self._nudge()
@@ -790,10 +959,13 @@ class ProcessReplica:
                 return entry
         raise ReplicaDied(self.replica_id, "export_chain timed out")
 
-    def import_chain(self, entry) -> int:
+    def import_chain(self, entry, trace=None) -> int:
         """Transfer IN, over the pipe: the worker stores the chain in
         its engine's host tier and acks with the stored-block count."""
-        self._send({"cmd": "import_chain", "entry": entry})
+        cmd = {"cmd": "import_chain", "entry": entry}
+        if trace is not None:
+            cmd["trace"] = [str(trace[0]), trace[1]]
+        self._send(cmd)
         deadline = self._clock() + self._call_timeout_s
         while self._clock() < deadline:
             self._nudge()
@@ -872,18 +1044,27 @@ class ProcessReplica:
 
     _RESTORE_CHUNK = 8  # entries per restore command
 
-    def restore(self, pairs: List[Tuple[int, Dict]]) -> None:
+    def restore(self, pairs: List[Tuple[int, Dict]],
+                traces=None) -> None:
         """Migration in, chunked: one huge restore line can exceed the
         stdin pipe capacity while the worker is itself blocked writing
         token events nobody is reading — a mutual stall. Small commands
         with a non-blocking stdout drain between them keep both pipe
         directions moving; the worker treats each chunk as an
-        independent restore."""
+        independent restore. ``traces`` optionally maps rid -> wire
+        trace context (ISSUE 19)."""
         for i in range(0, len(pairs), self._RESTORE_CHUNK):
             chunk = pairs[i:i + self._RESTORE_CHUNK]
-            self._send({"cmd": "restore",
-                        "requests": [[int(rid), entry]
-                                     for rid, entry in chunk]})
+            cmd = {"cmd": "restore",
+                   "requests": [[int(rid), entry]
+                                for rid, entry in chunk]}
+            if traces:
+                stamped = [[int(rid), [str(traces[rid][0]),
+                                       traces[rid][1]]]
+                           for rid, _ in chunk if rid in traces]
+                if stamped:
+                    cmd["traces"] = stamped
+            self._send(cmd)
             self._pending.extend(self._read_events())
 
     def respawn(self) -> None:

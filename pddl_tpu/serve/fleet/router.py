@@ -85,6 +85,8 @@ import hashlib
 import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
+from pddl_tpu.obs import flightrec as flightrec_io
+from pddl_tpu.obs.propagate import TraceCollector
 from pddl_tpu.obs.trace import NULL_TRACER
 from pddl_tpu.serve import drain as drain_io
 from pddl_tpu.serve.fleet import journal as journal_io
@@ -503,7 +505,7 @@ class FleetRouter:
                  chain_pull_blocks: Optional[int] = None,
                  journal=None, gray=None, gray_hedge: bool = True,
                  gray_drain: bool = False, gray_timer=time.perf_counter,
-                 clock=time.monotonic):
+                 dtrace=None, clock=time.monotonic):
         if not replicas:
             raise ValueError("a fleet needs at least one replica")
         ids = [r.replica_id for r in replicas]
@@ -554,6 +556,18 @@ class FleetRouter:
         self._gray_hedge = bool(gray_hedge)
         self._gray_drain = bool(gray_drain)
         self._gray_timer = gray_timer
+        # Fleet-wide distributed tracing (ISSUE 19): `dtrace=True`
+        # builds the router-side TraceCollector; pass a constructed
+        # collector to share/inspect it. When armed, every submit/
+        # restore/chain command is stamped with a wire trace context
+        # and replica span records are drained into the collector each
+        # step. None/False keeps every hot path byte-identical.
+        if dtrace is None or dtrace is False:
+            self._dtrace = None
+        elif dtrace is True:
+            self._dtrace = TraceCollector(clock=clock)
+        else:
+            self._dtrace = dtrace
         # Hedge bookkeeping: rid <-> rid cross-links for live pairs,
         # and the subset of rids that are the HEDGE copy (so a win by
         # the hedge — not by the suspected primary — is countable).
@@ -624,6 +638,13 @@ class FleetRouter:
     def gray(self) -> Optional[GrayDetector]:
         """The gray-failure detector (None when not armed)."""
         return self._gray
+
+    @property
+    def dtrace(self):
+        """The distributed-trace collector (None when not armed) —
+        `obs/assemble.py` stitches its ``records()``; the chaos
+        conductor's ``trace_complete`` invariant keys off it."""
+        return self._dtrace
 
     def _new_rid(self) -> int:
         rid = self._rid_counter
@@ -1002,9 +1023,15 @@ class FleetRouter:
         for slot in order:
             rid = self._new_rid()
             try:
-                slot.driver.submit(rid, prompt, max_new_tokens,
-                                   sampling, deadline_s, priority,
-                                   adapter, constraint)
+                if self._dtrace is not None:
+                    slot.driver.submit(rid, prompt, max_new_tokens,
+                                       sampling, deadline_s, priority,
+                                       adapter, constraint,
+                                       trace=self._dtrace.context_for(rid))
+                else:
+                    slot.driver.submit(rid, prompt, max_new_tokens,
+                                       sampling, deadline_s, priority,
+                                       adapter, constraint)
             except QueueFull as e:
                 sheds_seen += 1
                 if e.retry_after_s is not None:
@@ -1063,6 +1090,13 @@ class FleetRouter:
                 # Engine-side signal: a reroute forced by QueueFull is
                 # pressure even though the request landed.
                 self._admission.observe(now, rejected=sheds_seen > 0)
+            if self._dtrace is not None:
+                # After the shed relabel, so the trace's route label
+                # matches the journal's.
+                self._dtrace.on_submit(rid, prompt_len=len(prompt),
+                                       priority=priority.value,
+                                       session=session)
+                self._dtrace.on_route(rid, slot.replica_id, how)
             if hedge_to is not None and slot is not hedge_to:
                 self._launch_hedge(fh, rid, slot, hedge_to,
                                    max_new_tokens)
@@ -1101,11 +1135,24 @@ class FleetRouter:
         one admission into a failure it would not otherwise have."""
         req = fh.request
         hrid = self._new_rid()
+        trace = None
+        if self._dtrace is not None:
+            # Alias FIRST so the hedge copy's wire context carries the
+            # primary's trace id (one trace, two replicas racing).
+            self._dtrace.alias(hrid, primary_rid)
+            trace = self._dtrace.context_for(hrid)
         try:
-            hedge_to.driver.submit(hrid, list(req.prompt),
-                                   int(max_new_tokens), req.sampling,
-                                   req.deadline_s, req.priority,
-                                   req.adapter, req.constraint)
+            if trace is not None:
+                hedge_to.driver.submit(hrid, list(req.prompt),
+                                       int(max_new_tokens), req.sampling,
+                                       req.deadline_s, req.priority,
+                                       req.adapter, req.constraint,
+                                       trace=trace)
+            else:
+                hedge_to.driver.submit(hrid, list(req.prompt),
+                                       int(max_new_tokens), req.sampling,
+                                       req.deadline_s, req.priority,
+                                       req.adapter, req.constraint)
         except Exception:  # noqa: BLE001 - QueueFull / ReplicaDied /
             return         # anything: the single copy stands alone
         self._by_rid[hrid] = fh
@@ -1115,6 +1162,9 @@ class FleetRouter:
         self._hedge_rids.add(hrid)
         self._hedge_alias[hrid] = primary_rid
         self.metrics.hedges_launched += 1
+        if self._dtrace is not None:
+            self._dtrace.on_hedge(hrid, primary_rid,
+                                  hedge_to.replica_id)
         if self._journal is not None:
             self._journal.append(journal_io.encode_route(
                 hrid, hedge_to.replica_id, "hedge"))
@@ -1198,6 +1248,10 @@ class FleetRouter:
                     fh.finish_reason = FinishReason.CANCELLED
                     fh.finish_s = now
                     self._by_rid.pop(rid, None)
+                    if self._dtrace is not None:
+                        self._dtrace.on_finish(
+                            rid, fh.state.value, fh.finish_reason.value,
+                            len(fh.tokens))
                 elif not fh.done:
                     kept.append((rid, fh))
             self._orphans = kept
@@ -1251,6 +1305,8 @@ class FleetRouter:
                     self._gray.observe(slot.replica_id,
                                        self._gray_timer() - step_t0)
             self._fold_wire_stats(slot)
+            if self._dtrace is not None:
+                self._collect_spans(slot)
             # A successful pump only counts as breaker success when the
             # heartbeat (if the driver has one) is actually fresh — a
             # hung-but-alive worker keeps accepting pings into its pipe
@@ -1282,6 +1338,30 @@ class FleetRouter:
                 self._journal_checkpoint()
             self._journal.tick()
         return tokens
+
+    def _collect_spans(self, slot: _ReplicaSlot) -> None:
+        """Drain a driver's shipped span records into the collector
+        and refresh the replica's clock-offset estimate (ISSUE 19).
+        Driver-agnostic via getattr — a test double without the trace
+        surface simply contributes nothing."""
+        take = getattr(slot.driver, "take_span_records", None)
+        if take is not None:
+            try:
+                records = take()
+            except Exception:  # noqa: BLE001 - a dying pipe settles later
+                records = []
+            if records:
+                self._dtrace.add_replica_records(slot.replica_id,
+                                                 records)
+        off = getattr(slot.driver, "clock_offset", None)
+        if off is not None:
+            try:
+                self._dtrace.set_offset(slot.replica_id, off())
+            except Exception:  # noqa: BLE001 - same
+                pass
+        dropped = getattr(slot.driver, "spans_dropped", None)
+        if dropped:
+            self._dtrace.note_remote_drops(int(dropped))
 
     def _fold_wire_stats(self, slot: _ReplicaSlot) -> None:
         """Aggregate a framed driver's transport counters into
@@ -1409,6 +1489,8 @@ class FleetRouter:
                         continue
                     if fh.ttft_s is None and toks:
                         fh.ttft_s = now - fh.arrival_s
+                        if self._dtrace is not None:
+                            self._dtrace.on_first_token(rid, fh.ttft_s)
                     if fh.state is RequestState.QUEUED:
                         fh.state = RequestState.RUNNING
                     fh.tokens.extend(int(t) for t in toks)
@@ -1467,6 +1549,13 @@ class FleetRouter:
                     self.metrics.requests_finished += 1
                 elif fh.state is RequestState.FAILED:
                     self.metrics.requests_failed += 1
+                if self._dtrace is not None:
+                    self._dtrace.on_finish(
+                        rid, fh.state.value,
+                        fh.finish_reason.value
+                        if fh.finish_reason is not None else None,
+                        len(fh.tokens),
+                        ttft_s=ev.get("ttft_s"))
                 if self._journal is not None:
                     self._journal.append(journal_io.encode_finish(
                         self._hedge_alias.pop(rid, rid),
@@ -1501,14 +1590,51 @@ class FleetRouter:
         self._tracer.on_fleet_event(
             "replica_down", replica=slot.replica_id,
             cause=type(cause).__name__, in_flight=len(slot.assigned))
+        # Mirror summary for the postmortem bundle BEFORE _evacuate
+        # clears the assignment map.
+        mirrors = ([[rid, len(fh.tokens)]
+                    for rid, fh in slot.assigned.items()]
+                   if self._dtrace is not None else None)
         # Live migration: the replica's own drain snapshot when it can
         # still produce one (`serve/drain.py` wire format, rid-tagged);
         # otherwise rebuild from the router mirrors — same format, the
         # prompt+emitted-token replay r08 pinned in-engine.
         migrate, leftovers, via = self._evacuate(slot, now)
+        if self._dtrace is not None:
+            self._harvest_flight(slot, mirrors)
         self._distribute(migrate, via)
         if leftovers:
             self._distribute(leftovers, "replay")
+
+    def _harvest_flight(self, slot: _ReplicaSlot,
+                        mirrors: Optional[List[List[int]]]) -> None:
+        """Post-mortem span recovery for a dead replica: flush whatever
+        the driver can still surface in-process, then read the crash-
+        durable flight-recorder segments off disk (`obs/flightrec.py`)
+        — the SIGKILL path, where the worker never shipped its final
+        batches — and leave a postmortem bundle beside the WAL and
+        drain mirrors for the operator runbook (docs/OPERATIONS.md)."""
+        flush = getattr(slot.driver, "flush_spans", None)
+        if flush is not None:
+            try:
+                flush()
+            except Exception:  # noqa: BLE001 - dead replica, best effort
+                pass
+        self._collect_spans(slot)
+        frdir = getattr(slot.driver, "flightrec_dir", None)
+        if frdir is None:
+            return
+        records = flightrec_io.harvest(str(frdir))
+        spans = [r for r in records if r.get("kind") == "span"]
+        if spans:
+            self._dtrace.add_replica_records(slot.replica_id, spans,
+                                             source="flightrec")
+        flightrec_io.write_postmortem(str(frdir), {
+            "replica": slot.replica_id,
+            "harvested_records": len(records),
+            "harvested_spans": len(spans),
+            "mirrors": mirrors or [],
+        })
 
     def _evacuate(self, slot: _ReplicaSlot, now: float) -> Tuple[
             List[Tuple[int, Dict, FleetHandle]],
@@ -1620,8 +1746,16 @@ class FleetRouter:
         for tid, items in per_target.items():
             target = by_id[tid]
             try:
-                target.driver.restore([(rid, entry)
-                                       for rid, entry, _ in items])
+                pairs = [(rid, entry) for rid, entry, _ in items]
+                if self._dtrace is not None:
+                    traces = {}
+                    for rid, _entry, _fh in items:
+                        self._dtrace.on_restore(rid, target.replica_id,
+                                                via)
+                        traces[rid] = self._dtrace.context_for(rid)
+                    target.driver.restore(pairs, traces=traces)
+                else:
+                    target.driver.restore(pairs)
             except (ReplicaDied, KillPoint) as e:
                 self._on_death(target, e)
                 # Re-distribute this shard over whoever remains — from
@@ -1665,6 +1799,9 @@ class FleetRouter:
         fh.finish_reason = FinishReason.ERROR
         fh.finish_s = self._clock()
         self.metrics.requests_failed += 1
+        if self._dtrace is not None and rid is not None:
+            self._dtrace.on_finish(rid, fh.state.value,
+                                   fh.finish_reason.value, len(fh.tokens))
         # Drop the routing entry too: a terminally-failed handle left in
         # `_by_rid` is scanned by every subsequent `has_work` forever —
         # a slow leak across total-outage windows on a long-lived router.
@@ -1791,6 +1928,13 @@ class FleetRouter:
                             self._block_size, self._shadow_capacity,
                             self._shadow_host_capacity)
         slot.breaker.on_transition = self._circuit_observer(slot)
+        if self._dtrace is not None:
+            # In-process replicas arm their engine tracer here (worker
+            # processes arm from their spawn config instead) — covers
+            # both the initial fleet and elastic scale-up.
+            arm = getattr(driver, "arm_tracing", None)
+            if arm is not None:
+                arm()
         self._slots.append(slot)
         return slot
 

@@ -198,6 +198,41 @@ def main(argv=None) -> int:
     engine.warmup()
     ledger = HandleLedger()
 
+    # Distributed tracing (ISSUE 19): `dtrace` arms a RequestTracer on
+    # the engine plus the bounded span shipper (records ride back on
+    # the pipe); `flightrec_dir` arms the crash-durable flight
+    # recorder (spans + per-tick records survive SIGKILL for the
+    # router's postmortem harvest). Both default off — the tracing-off
+    # worker is byte-identical to the pre-ISSUE-19 one.
+    tracer = None
+    shipper = None
+    recorder = None
+    trace_rids: Dict[int, int] = {}  # engine request_id -> router rid
+    if config.get("dtrace"):
+        from pddl_tpu.obs.propagate import SpanShipper
+        from pddl_tpu.obs.trace import RequestTracer
+
+        # Small decode-event budget: per-token events are cadence
+        # detail the TTFT critical path never reads (it keys off
+        # prefill/first_token events and the tokens_emitted field),
+        # but they dominate shipped-span JSON volume — and on a
+        # shared-core host, serialize/parse time is decode time.
+        tracer = RequestTracer(
+            max_decode_events_per_span=int(
+                config.get("dtrace_decode_events", 8)))
+        engine.set_tracer(tracer)
+        shipper = SpanShipper(capacity=int(
+            config.get("dtrace_buffer", 512)))
+    if config.get("flightrec_dir"):
+        from pddl_tpu.obs.flightrec import FlightRecorder
+
+        recorder = FlightRecorder(
+            str(config["flightrec_dir"]),
+            max_segment_bytes=int(
+                config.get("flightrec_segment_bytes", 262144)),
+            max_segments=int(config.get("flightrec_segments", 4)),
+            tracer=tracer)
+
     flags = {"drain": False, "shutdown": False}
 
     def _on_sigterm(signum, frame):  # flag only: async-signal-safe
@@ -208,6 +243,43 @@ def main(argv=None) -> int:
           "role": role, "compile_counts": engine.compile_counts()})
 
     import time
+
+    def note_trace(rid: int, handle, ctx) -> None:
+        """Stamp the router's wire trace context onto a fresh span and
+        remember the engine-id -> rid mapping for shipping."""
+        if tracer is None:
+            return
+        eng_rid = handle.request.request_id
+        trace_rids[eng_rid] = rid
+        if ctx:
+            tracer.on_trace_context(eng_rid, str(ctx[0]), ctx[1])
+
+    def pump_spans() -> None:
+        """Finished engine spans -> flight recorder + shipper, then one
+        ``spans`` event per batch so records reach the router in the
+        same pipe write as the finishes they describe (no heartbeat
+        lag for a test or a postmortem to wait out)."""
+        if tracer is None:
+            return
+        moved = 0
+        while True:
+            try:
+                rec = tracer.finished.popleft()
+            except IndexError:
+                break
+            rec = dict(rec)
+            rec["rid"] = trace_rids.pop(rec.get("request_id"), None)
+            rec["replica"] = config.get("replica_id")
+            rec["role"] = role
+            if recorder is not None:
+                recorder.append(rec)
+            shipper.add(rec)
+            moved += 1
+        if moved:
+            tracer.on_span_shipped(moved, shipper.dropped)
+        while len(shipper):
+            emit({"ev": "spans", "spans": shipper.drain(16),
+                  "dropped": shipper.dropped})
 
     def handle_cmd(cmd: Dict[str, object]) -> None:
         kind = cmd.get("cmd")
@@ -233,6 +305,7 @@ def main(argv=None) -> int:
                        "message": str(e)})         # whole worker
                 return
             ledger.add(rid, handle)
+            note_trace(rid, handle, cmd.get("trace"))
             emit({"ev": "submit_ok", "rid": rid})
         elif kind == "cancel":
             h = ledger.get(int(cmd["rid"]))
@@ -246,10 +319,17 @@ def main(argv=None) -> int:
             # self-reports — gray failure is degradation, not
             # byzantine lying, and the number is measured where the
             # time is actually spent.
+            # `echo_t_s`/`mono_s`: the parent's ping send time echoed
+            # back with this process's own monotonic read — one clock-
+            # offset sample per heartbeat (ISSUE 19 trace stitching).
             emit({"ev": "pong", "queue_depth": engine.scheduler.depth,
                   "live_slots": engine.live_slots,
                   "degraded": engine.degraded,
-                  "tick_wall_s": wire["tick_wall_s"]})
+                  "tick_wall_s": wire["tick_wall_s"],
+                  "echo_t_s": cmd.get("t_s"),
+                  "mono_s": time.monotonic()})
+            pump_spans()  # idle-path shipping: heartbeats flush spans
+                          # even when no engine step is harvesting
         elif kind == "set_tick_delay":
             # Chaos knob (the gray-failure injector): every subsequent
             # engine step gains this much wall time — the process-
@@ -266,6 +346,8 @@ def main(argv=None) -> int:
             # corrupted mirror, a prompt beyond THIS replica's max_len —
             # must fail that request terminally, not crash a healthy
             # survivor mid-failover and cascade the outage.
+            tmap = {int(p[0]): p[1]
+                    for p in (cmd.get("traces") or [])}
             for rid, entry in cmd["requests"]:
                 rid = int(rid)
                 try:
@@ -282,6 +364,7 @@ def main(argv=None) -> int:
                            "n_tokens": 0})
                     continue
                 ledger.add(rid, h)
+                note_trace(rid, h, tmap.get(rid))
         elif kind == "export_chain":
             # Replica-to-replica prefix transfer OUT (ISSUE 13): the
             # chain wire entry (or null) as a synchronous ack, like
@@ -290,22 +373,41 @@ def main(argv=None) -> int:
             # best-effort END TO END, so a failed export — tier off on
             # this engine, a device fault mid-read — answers null, it
             # never crashes a healthy replica serving live streams.
+            t0 = time.monotonic()
             try:
                 entry = engine.export_prefix_chain(
                     cmd["prompt"], max_blocks=cmd.get("max_blocks"))
             except Exception as e:  # noqa: BLE001 - reject the pull
                 print(f"export_chain rejected: {e}", file=sys.stderr)
                 entry = None
+            t1 = time.monotonic()
+            if entry is not None and tracer is not None:
+                from pddl_tpu.obs.propagate import chain_export_span
+
+                n_blocks = len(entry.get("blocks") or ())
+                tracer.on_chain_export(n_blocks, t1 - t0)
+                shipper.add(chain_export_span(
+                    cmd.get("trace"), t0, t1, n_blocks,
+                    replica=config.get("replica_id"), role=role))
             emit({"ev": "chain", "entry": entry})
         elif kind == "import_chain":
             # Same isolation inbound: a malformed wire entry (bad
             # base64, an invalid dtype string from a foreign build)
             # refuses the chain, not the worker.
+            t0 = time.monotonic()
             try:
                 n = engine.import_prefix_chain(cmd["entry"])
             except Exception as e:  # noqa: BLE001 - reject the entry
                 print(f"import_chain rejected: {e}", file=sys.stderr)
                 n = 0
+            t1 = time.monotonic()
+            if n and tracer is not None:
+                from pddl_tpu.obs.propagate import chain_import_span
+
+                tracer.on_chain_import(n, t1 - t0)
+                shipper.add(chain_import_span(
+                    cmd.get("trace"), t0, t1, n,
+                    replica=config.get("replica_id"), role=role))
             emit({"ev": "chain_imported", "n": n})
         elif kind == "drain":
             flags["drain"] = True
@@ -405,9 +507,15 @@ def main(argv=None) -> int:
                 engine.drain()
             except Exception:  # noqa: BLE001 - snapshot already captured
                 pass
+            # engine.drain() flushed every in-flight span; ship them
+            # BEFORE the snapshot so the migration's trace has no hole
+            # where the source replica's records should be.
+            pump_spans()
             emit({"ev": "snapshot",
                    "requests": [[rid, entry] for rid, entry in entries],
                    "compile_counts": engine.compile_counts()})
+            if recorder is not None:
+                recorder.close()
             return 0
         if engine.has_work:
             t0 = time.monotonic()
@@ -415,8 +523,24 @@ def main(argv=None) -> int:
             if wire["tick_delay_s"] > 0.0:
                 time.sleep(wire["tick_delay_s"])
             wire["tick_wall_s"] = time.monotonic() - t0
-            for ev in ledger.harvest():
+            events = ledger.harvest()
+            for ev in events:
                 emit(ev)
+            if recorder is not None:
+                # The flight record of THIS tick: enough to reassemble
+                # the worker's final moments after a SIGKILL (tokens
+                # streamed per rid, wall, load) from the file alone.
+                t_now = time.monotonic()
+                recorder.append({"kind": "flight_tick", "t_s": t_now,
+                                 "wall_s": wire["tick_wall_s"],
+                                 "queue_depth": engine.scheduler.depth,
+                                 "live_slots": engine.live_slots})
+                for ev in events:
+                    if ev.get("ev") == "tokens":
+                        recorder.append({"kind": "flight_tokens",
+                                         "t_s": t_now,
+                                         "toks": ev["toks"]})
+            pump_spans()
     return 0
 
 

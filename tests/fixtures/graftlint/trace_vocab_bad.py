@@ -1,0 +1,60 @@
+"""Seeded-bad fixture for the ``trace-vocab`` rule (ISSUE 19): the
+tracer and the assembler's event vocabulary drift in both directions
+the rule covers. Self-paired — TRACE_EVENTS and the emitting call
+sites live here, the fixture analogue of assemble.py + trace.py +
+propagate.py in one module.
+
+Seeded findings (3):
+- a ``span.event`` call site mints ``"first_tok"`` (a typo of
+  ``first_token``), which TRACE_EVENTS never declared — the
+  assembler's TTFT attribution would silently never anchor;
+- a ``self._event`` call site mints ``"rerouted"``, also undeclared
+  — invisible to the gap checker;
+- TRACE_EVENTS lists ``"thaw"``, which no call site emits — a stale
+  entry promising coverage no emitter mints.
+"""
+
+TRACE_EVENTS = ("queued", "first_token", "preempted", "finish", "thaw")
+
+
+def _named(events, name):
+    return [e for e in events if e.get("name") == name]
+
+
+def anchor(events):
+    # The assembler-side consumer: keeps ``first_token`` non-stale so
+    # the typo'd EMITTER below is the finding, not the declaration.
+    return _named(events, "first_token")
+
+
+class _Span:
+    def __init__(self):
+        self.events = []
+
+    def event(self, t_s, name, **attrs):
+        self.events.append({"t_s": t_s, "name": name, **attrs})
+
+
+class _Tracer:
+    def __init__(self):
+        self.span = _Span()
+
+    def _event(self, rid, name, **attrs):
+        return {"rid": rid, "name": name, **attrs}
+
+    def on_queue(self, now):
+        self.span.event(now, "queued", depth=0)
+
+    def on_first_token(self, now):
+        # BUG: a typo'd event name the assembler will never anchor on.
+        self.span.event(now, "first_tok", ttft_s=0.0)
+
+    def on_preempt(self, now):
+        self.span.event(now, "preempted")
+
+    def on_reroute(self, rid):
+        # BUG: an event name minted here only — undeclared.
+        self._event(rid, "rerouted", replica=1)
+
+    def on_finish(self, rid):
+        self._event(rid, "finish", state="finished")

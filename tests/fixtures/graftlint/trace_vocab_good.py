@@ -1,0 +1,43 @@
+"""Good twin of ``trace_vocab_bad.py``: the same self-paired shape
+with the vocabulary and every call site in agreement — every emitted
+event name is declared, every declared name is emitted (or consumed
+at a ``_named`` site). Zero findings expected.
+"""
+
+TRACE_EVENTS = ("queued", "first_token", "preempted", "finish")
+
+
+def _named(events, name):
+    return [e for e in events if e.get("name") == name]
+
+
+def anchor(events):
+    return _named(events, "first_token")
+
+
+class _Span:
+    def __init__(self):
+        self.events = []
+
+    def event(self, t_s, name, **attrs):
+        self.events.append({"t_s": t_s, "name": name, **attrs})
+
+
+class _Tracer:
+    def __init__(self):
+        self.span = _Span()
+
+    def _event(self, rid, name, **attrs):
+        return {"rid": rid, "name": name, **attrs}
+
+    def on_queue(self, now):
+        self.span.event(now, "queued", depth=0)
+
+    def on_first_token(self, now):
+        self.span.event(now, "first_token", ttft_s=0.0)
+
+    def on_preempt(self, now):
+        self.span.event(now, "preempted")
+
+    def on_finish(self, rid):
+        self._event(rid, "finish", state="finished")

@@ -73,6 +73,10 @@ BAD_FIXTURES = [
     # kind + two stale kinds + an unclassified route label + an
     # unclassified literal via at an encode_route call site.
     ("role-vocab", "role_vocab_bad.py", 3),
+    # The tracer/assembler event vocabulary (ISSUE 19): a typo'd
+    # span.event name + an undeclared _event name + a stale
+    # TRACE_EVENTS entry no emitter mints.
+    ("trace-vocab", "trace_vocab_bad.py", 3),
 ]
 
 GOOD_FIXTURES = [
@@ -82,6 +86,7 @@ GOOD_FIXTURES = [
     "site_vocab_storage_good.py",
     "exposition_good.py", "snapshot_good.py", "journal_good.py",
     "role_vocab_good.py",
+    "trace_vocab_good.py",
 ]
 
 

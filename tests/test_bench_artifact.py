@@ -522,6 +522,61 @@ def test_r21_chaosd_artifact_is_gated():
         assert "results.campaign.recovery_s" in paths
 
 
+def test_r22_dtrace_artifact_is_gated():
+    """The distributed-tracing artifact participates in the series: it
+    loads, keys into a (metric, config) group, its committed headlines
+    clear the ISSUE 19 bounds (tracing-on retains >= 0.95x tracing-off
+    throughput with EVERY pair above the floor; every stitched trace
+    gap-free; zero remote span drops; streams token-exact), they are
+    DIRECTIONAL — and a same-config r-record that regresses them fails
+    `check_series` LOUDLY."""
+    path = os.path.join(_BENCH_DIR, "r22_serve_dtrace.json")
+    records = [r for r in load_artifact(path)
+               if artifact_key(r) is not None]
+    assert records, "r22_serve_dtrace.json has no keyed record"
+    dt = records[0]["results"]["dtrace"]
+    # ISSUE 19 acceptance bounds on the committed medians.
+    floor = dt["tracing_retained_floor"]
+    assert floor == 0.95
+    assert dt["tracing_on_over_off_x"] >= floor
+    assert dt["all_pairs_above_floor"] is True
+    pairs = dt["tracing_on_over_off_per_pair"]
+    assert len(pairs) == 5                      # the 5 paired runs
+    assert all(r >= floor for r in pairs)       # every pair directional
+    assert dt["traces_stitched_total"] > 0
+    assert dt["traces_gap_free_total"] == dt["traces_stitched_total"]
+    assert dt["traces_all_gap_free"] is True
+    assert dt["replica_spans_collected_total"] > 0  # spans crossed the
+    assert dt["spans_dropped_remote_total"] == 0    # pipe, none lost
+    assert dt["streams_token_exact"] is True
+    for key in ("tracing_on_over_off_x", "tokens_per_s_tracing_on",
+                "tokens_per_s_tracing_off"):
+        assert metric_direction(key) != 0, key
+    # Per-pair lists and spreads are telemetry, never gated.
+    assert metric_direction("tracing_on_over_off_per_pair") == 0
+    assert metric_direction("tracing_on_over_off_spread_pct") == 0
+    # A hypothetical r23 record at the SAME config whose tracing
+    # overhead regressed must fail the series gate loudly.
+    worse = copy.deepcopy(records[0])
+    w = worse["results"]["dtrace"]
+    w["tracing_on_over_off_x"] *= 0.8
+    w["tokens_per_s_tracing_on"] *= 0.5
+    import json as _json
+    import tempfile
+    with tempfile.TemporaryDirectory() as d_:
+        old_p = os.path.join(d_, "r22_t.json")
+        new_p = os.path.join(d_, "r23_t.json")
+        with open(old_p, "w") as f:
+            _json.dump(records[0], f)
+        with open(new_p, "w") as f:
+            _json.dump(worse, f)
+        pairs_checked, failures = check_series([old_p, new_p])
+        assert pairs_checked == 1 and len(failures) == 1
+        paths = {r["path"] for r in failures[0]["regressions"]}
+        assert "results.dtrace.tracing_on_over_off_x" in paths
+        assert "results.dtrace.tokens_per_s_tracing_on" in paths
+
+
 def test_compare_flags_directional_regressions_only():
     old = _record(tokens_per_s=1000.0, ttft_p99_s=0.10, spread_pct=2.0,
                   prefix_hit_rate=0.97)

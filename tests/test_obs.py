@@ -92,6 +92,42 @@ def test_disabled_tracer_allocates_nothing(gpt_setup):
     assert not grew, f"disabled tracer allocated: {grew}"
 
 
+def test_disabled_tracer_dtrace_hooks_allocate_nothing():
+    """The ISSUE 19 extension of the zero-cost pin: the distributed-
+    tracing hook surface (trace context stamping, restore, chain
+    transfer, span shipping, flight-recorder rotation) must be no-op
+    AND allocation-free on the NullTracer — these hooks sit on the
+    fleet hot paths of every UNtraced fleet too."""
+    from pddl_tpu.obs import trace as trace_mod
+
+    tracer = trace_mod.NULL_TRACER
+
+    def drive():
+        for i in range(200):
+            tracer.on_trace_context(i, "0" * 16, "router")
+            tracer.on_restored(None, i)
+            tracer.on_chain_export(3, 0.001)
+            tracer.on_chain_import(3, 0.001)
+            tracer.on_span_shipped(4, 0)
+            tracer.on_flight_rotate(2, 4096)
+
+    drive()  # warm the code paths before measuring
+    tracemalloc.start()
+    try:
+        snap_before = tracemalloc.take_snapshot()
+        drive()
+        snap_after = tracemalloc.take_snapshot()
+    finally:
+        tracemalloc.stop()
+    trace_file = trace_mod.__file__
+    diff = snap_after.filter_traces(
+        [tracemalloc.Filter(True, trace_file)]).compare_to(
+        snap_before.filter_traces(
+            [tracemalloc.Filter(True, trace_file)]), "lineno")
+    grew = [d for d in diff if d.size_diff > 0]
+    assert not grew, f"disabled dtrace hooks allocated: {grew}"
+
+
 def test_span_timeline_reconstructs_request(gpt_setup, tmp_path,
                                             pin_zero_recompiles):
     """One traced request: the span carries the full queue → admission
@@ -338,6 +374,61 @@ def test_snapshot_drift_guard_every_metric_exported(gpt_setup):
         assert any(name == gauge for name, _ in samples), gauge
     for key in engine_gauges(eng):
         assert f"pddl_serve_engine_{key}" in {n for n, _ in samples}
+
+
+def test_latency_histograms_round_trip_strict():
+    """The ISSUE 19 exposition satellite: TTFT and token-latency
+    render as conventional CUMULATIVE ``_bucket`` histograms —
+    ascending ``le``, ``le="+Inf"`` equal to ``_count``, ``_sum``
+    over the same samples — and the whole body round-trips through
+    the strict parser in both directions (each histogram verified
+    sample-exact from the parsed side)."""
+    from pddl_tpu.obs import (TOKEN_LATENCY_BUCKETS_S, TTFT_BUCKETS_S,
+                              reservoir_histogram)
+
+    metrics = ServeMetrics()
+    ttfts = [0.004, 0.03, 0.03, 0.2, 3.0, 30.0]  # incl. one > max edge
+    toklats = [0.0005, 0.002, 0.02, 0.02, 0.3]
+    for v in ttfts:
+        metrics.ttft_s.append(v)
+    metrics.token_latency_s.extend(toklats)
+    text = serve_exposition(metrics)
+    samples, types = parse_prometheus_text(text)
+    for name, buckets, values in (
+            ("pddl_serve_ttft_seconds", TTFT_BUCKETS_S, ttfts),
+            ("pddl_serve_token_latency_seconds",
+             TOKEN_LATENCY_BUCKETS_S, toklats)):
+        assert types[name] == "histogram"
+        # Cumulative and ascending, each bucket counting v <= le.
+        prev = 0
+        for edge in sorted(buckets):
+            got = samples[(f"{name}_bucket",
+                           (("le", format(edge, "g")),))]
+            assert got == sum(1 for v in values if v <= edge)
+            assert got >= prev
+            prev = got
+        inf = samples[(f"{name}_bucket", (("le", "+Inf"),))]
+        assert inf == len(values) == samples[(f"{name}_count", ())]
+        assert samples[(f"{name}_sum", ())] == pytest.approx(
+            sum(values))
+    # The other direction: a hand-built spec renders, parses, and
+    # reproduces itself bucket-for-bucket.
+    spec = reservoir_histogram([0.01, 0.5], (0.1, 1.0))
+    assert spec["buckets"] == {"0.1": 1, "1": 2, "+Inf": 2}
+    body = render_prometheus({}, prefix="pddl_x",
+                             histograms={"lat_seconds": spec})
+    parsed, ptypes = parse_prometheus_text(body)
+    assert ptypes["pddl_x_lat_seconds"] == "histogram"
+    assert {le: parsed[("pddl_x_lat_seconds_bucket", (("le", le),))]
+            for le in spec["buckets"]} == {
+                le: float(c) for le, c in spec["buckets"].items()}
+    assert parsed[("pddl_x_lat_seconds_count", ())] == 2.0
+    # An empty reservoir still exports the full (all-zero) ladder.
+    empty = reservoir_histogram(Reservoir(4), TTFT_BUCKETS_S)
+    assert empty["count"] == 0 and empty["sum"] == 0.0
+    assert set(empty["buckets"].values()) == {0}
+    parse_prometheus_text(render_prometheus(
+        {}, prefix="pddl_y", histograms={"e_seconds": empty}))
 
 
 def test_metrics_http_endpoint_scrapes(gpt_setup):

@@ -856,11 +856,14 @@ def test_worker_self_reports_tick_walls_and_delay_knob():
             if len(slow) >= 3:
                 break
         assert len(slow) >= 3, "delay knob never surfaced in samples"
-        # Typical-vs-typical, not min-vs-max: a single scheduler
-        # hiccup in the clean phase can push one clean tick past the
-        # 50ms knob on a loaded host, and that outlier says nothing
-        # about the knob. The knob must shift the TYPICAL tick.
-        assert float(np.median(slow)) > float(np.median(clean))
+        # The clean stream finishes in ~100ms, after which every pong
+        # RE-REPORTS the final tick's wall — so one noise-inflated
+        # last tick can dominate the clean median on a loaded host
+        # (duplicated samples are not independent evidence). The
+        # fastest clean tick is the only sample the duplication
+        # artifact cannot poison: the knob's typical tick must clear
+        # it by most of the injected 50ms.
+        assert float(np.median(slow)) > float(min(clean)) + 0.04
     finally:
         rep.close()
 
