@@ -2903,6 +2903,183 @@ def _ctrlplane_leg(args) -> dict:
     return {"wire": wire, "recovery": recovery, "hedge": hedge}
 
 
+def _ha_failover_leg(model, variables, args, repeats: int) -> dict:
+    """Hot-standby failover vs the r19 cold recover path, PAIRED per
+    repeat on the same workload (ISSUE 20).
+
+    Hot: the standby tails the primary's WAL live over the shipper,
+    the lease lapses when the primary goes silent, and promotion
+    replays onto the SAME still-warm engines. Cold: the r19 path —
+    ``FleetRouter.recover`` onto FRESH replicas, which pays the spawn
+    + prefill/decode compile inside the outage window. Both clocks
+    start at the moment of primary silence and stop when every revived
+    stream has produced a token PAST its mirrored length (serving
+    again, not merely rebuilt), so the pair isolates exactly what the
+    standby buys. The deposed primary keeps commanding after each hot
+    takeover; its refusal count is the split-brain headline."""
+    from pddl_tpu.serve.fleet import (
+        EpochFenced,
+        FleetRouter,
+        HotStandby,
+        Lease,
+        LeaseKeeper,
+        LocalReplica,
+        RouterJournal,
+        WalShipper,
+    )
+
+    def factory():
+        return ServeEngine(model, variables, max_slots=4,
+                           prefill_len=32, max_queue_depth=96,
+                           prefix_cache_blocks=0)
+
+    def replicas():
+        return [LocalReplica(i, factory) for i in range(2)]
+
+    router_kw = dict(affinity_block_size=8, affinity_blocks=1,
+                     respawn=False)
+    new_tokens = 32
+    lease_ttl_s = 0.25
+    hot_all, cold_all, ratios, revived_all = [], [], [], []
+    exact = True
+    recompile_free = True
+    acked_lost = 0
+    probes_attempted = 0
+    probes_refused = 0
+
+    for rep in range(repeats):
+        rng = np.random.default_rng(900 + rep)
+        prompts = [rng.integers(0, 64, size=12).tolist()
+                   for _ in range(12)]
+        refs = {tuple(p): _make_ref(model, variables, p, new_tokens)
+                for p in prompts}
+
+        # ---- hot: WAL-shipped standby, lease-lapse promotion --------
+        d = tempfile.mkdtemp(prefix="pddl-ha-hot-")
+        try:
+            journal = RouterJournal(d, fsync_batch_records=16)
+            fleet = FleetRouter(replicas(), journal=journal,
+                                **router_kw)
+            lease = Lease(os.path.join(d, "ha_lease.json"),
+                          ttl_s=lease_ttl_s)
+            keeper = LeaseKeeper(lease, "primary", seed=rep)
+            fleet.set_epoch(keeper.acquire())
+            fleet.ha = keeper
+            standby = HotStandby(
+                d, [s.driver for s in fleet.replicas], lease=lease,
+                holder="standby", router_kw=router_kw, seed=rep + 1)
+            shipper = WalShipper(journal, standby.feed)
+            standby.attach(shipper)
+            handles = [fleet.submit(list(p), new_tokens)
+                       for p in prompts]
+            for _ in range(10):           # mid-stream, mirrors partial
+                fleet.step()
+                keeper.step()
+            acked = {tuple(int(t) for t in h.request.prompt):
+                     list(h.tokens) for h in handles}
+            # Primary goes silent: no more steps, no more renewals.
+            t0 = time.perf_counter()
+            out = None
+            while out is None and time.perf_counter() < t0 + 60.0:
+                out = standby.step()
+                time.sleep(0.002)
+            assert out is not None, "standby never promoted"
+            promoted, revived = out
+            at_promo = {rid: len(fh.tokens)
+                        for rid, fh in revived.items()}
+            for _ in range(100000):
+                if not any(len(fh.tokens) <= at_promo[rid]
+                           and not fh.done
+                           for rid, fh in revived.items()):
+                    break
+                promoted.step()
+            failover_s = time.perf_counter() - t0
+            # The deposed primary keeps commanding: every worker must
+            # refuse it on the fencing epoch, not on trust.
+            probes_attempted += 1
+            try:
+                fleet.submit([1, 2, 3], 4)
+            except EpochFenced:
+                probes_refused += 1
+            promoted.run(max_steps=100000)
+            revived_keys = set()
+            for fh in revived.values():
+                key = tuple(int(t) for t in fh.request.prompt)
+                revived_keys.add(key)
+                if fh.state.value != "finished" \
+                        or fh.tokens != refs[key]:
+                    exact = False
+            open_keys = {k for k, t in acked.items()
+                         if len(t) < len(refs[k])}
+            acked_lost += len(open_keys - revived_keys)
+            counts = promoted.compile_counts()
+            if not counts or any(v != 1 for v in counts.values()):
+                recompile_free = False
+            promoted.close()
+            revived_all.append(len(revived))
+            hot_all.append(failover_s)
+        finally:
+            shutil.rmtree(d, ignore_errors=True)
+
+        # ---- cold: the r19 recover path, same workload --------------
+        d2 = tempfile.mkdtemp(prefix="pddl-ha-cold-")
+        try:
+            fleet = FleetRouter(replicas(),
+                                journal=RouterJournal(
+                                    d2, fsync_batch_records=16),
+                                **router_kw)
+            for p in prompts:
+                fleet.submit(list(p), new_tokens)
+            for _ in range(10):
+                fleet.step()
+            t0 = time.perf_counter()
+            recovered, revived = FleetRouter.recover(
+                d2, replicas(), **router_kw)
+            at_rec = {rid: len(fh.tokens)
+                      for rid, fh in revived.items()}
+            for _ in range(100000):
+                if not any(len(fh.tokens) <= at_rec[rid]
+                           and not fh.done
+                           for rid, fh in revived.items()):
+                    break
+                recovered.step()
+            cold_s = time.perf_counter() - t0
+            recovered.run(max_steps=100000)
+            recovered.close()
+            cold_all.append(cold_s)
+        finally:
+            shutil.rmtree(d2, ignore_errors=True)
+
+        ratios.append(cold_all[-1] / hot_all[-1])
+        _log(f"ha pair {rep}: failover {hot_all[-1]:.3f}s vs cold "
+             f"recover {cold_all[-1]:.3f}s ({ratios[-1]:.1f}x)")
+
+    med, spread = median_spread(hot_all)
+    cold_med, _ = median_spread(cold_all)
+    ratio_med, ratio_spread = median_spread(ratios)
+    return {
+        "outage": "primary partitioned mid-stream (stops stepping and "
+                  "renewing; OBJECT stays alive and keeps commanding), "
+                  "standby promotes on lease lapse over the same live "
+                  "replicas",
+        "detection_lease_ttl_s": lease_ttl_s,
+        "failover_s": round(med, 4),
+        "failover_s_spread_pct": round(spread, 2),
+        "failover_s_per_repeat": [round(s, 4) for s in hot_all],
+        "cold_recover_s": round(cold_med, 4),
+        "cold_recover_s_per_repeat": [round(s, 4) for s in cold_all],
+        "failover_speedup_vs_cold_x": round(ratio_med, 2),
+        "failover_speedup_spread_pct": round(ratio_spread, 2),
+        "all_pairs_directional": all(r > 1.0 for r in ratios),
+        "streams_revived_per_repeat": revived_all,
+        "acked_streams_lost_total": acked_lost,
+        "streams_token_exact": exact,
+        "zero_recompiles_promoted": recompile_free,
+        "deposed_probes_attempted": probes_attempted,
+        "deposed_probes_refused": probes_refused,
+    }
+
+
 def _chaosd_availability_leg(model, variables, args,
                              repeats: int) -> dict:
     """Paired clean vs persistent-EIO-storm waves over a WAL-armed
@@ -3325,6 +3502,12 @@ def main() -> None:
                         "crash recovery, gray-replica hedging; "
                         "ISSUE 14) and write a standalone artifact "
                         "(r19_serve_ctrlplane.json)")
+    p.add_argument("--ha-only", action="store_true",
+                   help="run ONLY the router high-availability leg "
+                        "(hot-standby WAL tail + lease-lapse fenced "
+                        "promotion vs the cold recover path, paired "
+                        "per repeat; ISSUE 20) and write a standalone "
+                        "artifact (r23_serve_ha.json)")
     p.add_argument("--chaosd-only", action="store_true",
                    help="run ONLY the storage-chaos leg (paired "
                         "clean vs persistent-EIO-storm NON_DURABLE "
@@ -3362,6 +3545,63 @@ def main() -> None:
                         "unified capacity")
     p.add_argument("--out", default="")
     args = p.parse_args()
+
+    if args.ha_only:
+        repeats = max(args.repeats, 5)
+        _log(f"ha leg only: hot-standby failover vs cold recover, "
+             f"{repeats} paired runs, gpt 2x64")
+        cfg = _ctrlplane_cfg()
+        model = GPT(vocab_size=cfg["vocab"], max_len=cfg["max_len"],
+                    embed_dim=cfg["embed_dim"], depth=cfg["depth"],
+                    num_heads=cfg["heads"], attention="reference")
+        dummy = jnp.ones((1, 16), jnp.int32)
+        params = model.init(jax.random.key(0), dummy,
+                            train=False)["params"]
+        variables = {"params": params}
+        ha = _ha_failover_leg(model, variables, args, repeats)
+        record = {
+            "metric": "fleet_serving_router_ha",
+            "unit": "seconds (primary silence -> every revived stream "
+                    "serving again); ratio (cold recover / hot "
+                    "failover wall)",
+            "config": {
+                "model": "gpt 2x64 (vocab 64, max_len 128)",
+                "replicas": 2,
+                "standby": "WAL-shipped hot standby: live record "
+                           "stream over the framed transport, disk "
+                           "catch-up on join and wire gaps "
+                           "(serve/fleet/standby.py)",
+                "lease": f"file-backed, ttl {ha['detection_lease_ttl_s']}s, "
+                         "seeded subtractive renewal jitter; holder "
+                         "change bumps the fencing epoch",
+                "fencing": "every worker-bound command carries the "
+                           "issuing router's epoch; workers persist "
+                           "the highest seen and refuse lower with a "
+                           "typed reject (EpochFenced)",
+                "promotion": "lease-lapse takeover replays the WAL "
+                             "suffix onto the SAME live engines "
+                             "(mirror-replay contract: token-exact, "
+                             "zero recompiles)",
+                "cold_baseline": "r19 FleetRouter.recover onto fresh "
+                                 "replicas (spawn + compile inside "
+                                 "the outage window), same workload",
+            },
+            "provenance": provenance(repeats),
+            "results": {"ha": ha},
+            "device": jax.devices()[0].device_kind,
+        }
+        _log(f"ha: failover {ha['failover_s']}s median vs cold "
+             f"{ha['cold_recover_s']}s "
+             f"({ha['failover_speedup_vs_cold_x']}x, all pairs "
+             f"directional {ha['all_pairs_directional']}); acked "
+             f"streams lost {ha['acked_streams_lost_total']}, "
+             f"token-exact {ha['streams_token_exact']}, zero "
+             f"recompiles {ha['zero_recompiles_promoted']}; deposed "
+             f"primary refused "
+             f"{ha['deposed_probes_refused']}/"
+             f"{ha['deposed_probes_attempted']}")
+        _write_record(record, args.out)
+        return
 
     if args.chaosd_only:
         repeats = max(args.repeats, 5)

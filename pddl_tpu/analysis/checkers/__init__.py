@@ -11,6 +11,7 @@ fixture + good twin under ``tests/fixtures/graftlint/``.
 from __future__ import annotations
 
 from pddl_tpu.analysis.checkers.donation import DonationRule
+from pddl_tpu.analysis.checkers.epoch_vocab import EpochVocabRule
 from pddl_tpu.analysis.checkers.exposition import ExpositionParityRule
 from pddl_tpu.analysis.checkers.pin_release import PinReleaseRule
 from pddl_tpu.analysis.checkers.recompile import RecompileHazardRule
@@ -28,6 +29,7 @@ RULES = (
     SnapshotHygieneRule,
     RoleVocabRule,
     TraceVocabRule,
+    EpochVocabRule,
 )
 
 __all__ = ["RULES"] + [cls.__name__ for cls in RULES]

@@ -10,10 +10,13 @@ double-free, or silently lose a stream when two planes overlap.
 
 :class:`ChaosConductor` owns that composition. From one seed it draws
 a randomized schedule of :class:`ChaosAction` coordinates — hard
-kills, gray slow-wall spans, storage-fault storms, a router crash —
-fires them against a live fleet while the passive planes (device
-fault-plan rates, wire fault-plan rates) run underneath, then settles
-the workload and runs the INVARIANT REFEREE:
+kills, gray slow-wall spans, storage-fault storms, a router crash, a
+primary/standby PARTITION (ISSUE 20: the primary goes silent but stays
+alive and keeps trying to command after the standby promotes — the
+split-brain mode, distinct from kill) — fires them against a live
+fleet while the passive planes (device fault-plan rates, wire
+fault-plan rates) run underneath, then settles the workload and runs
+the INVARIANT REFEREE:
 
 - **acked_terminal** — every acked stream reached a terminal state;
 - **token_exact** — every finished stream matches the greedy oracle
@@ -32,7 +35,12 @@ the workload and runs the INVARIANT REFEREE:
 - **trace_complete** — with distributed tracing armed
   (``router_kw=dict(dtrace=True)``), every acked stream's stitched
   fleet trace is gap-free across kills, migrations, and hand-offs
-  (`pddl_tpu.obs.assemble`); auto-skipped when tracing is off.
+  (`pddl_tpu.obs.assemble`); auto-skipped when tracing is off;
+- **single_writer** — with the ``partition`` plane armed, no two
+  routers' commands are accepted in the same epoch interval: every
+  command the deposed primary attempted after the standby promoted
+  was refused by epoch fencing (typed reject, counted); auto-skipped
+  when the plane did not fire.
 
 The conductor is deliberately duck-typed over fleets: the caller
 supplies replica factories, per-replica :class:`ReplicaChaos` handles
@@ -47,6 +55,7 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import os
 import time
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
@@ -54,7 +63,10 @@ import numpy as np
 
 from pddl_tpu.serve.fleet import journal as journal_io
 from pddl_tpu.serve.fleet.journal import RouterJournal
+from pddl_tpu.serve.fleet.replica import EpochFenced
 from pddl_tpu.serve.fleet.router import FleetRouter
+from pddl_tpu.serve.fleet.standby import (HotStandby, Lease, LeaseKeeper,
+                                          WalShipper)
 from pddl_tpu.utils.faults import FaultKind
 
 
@@ -86,8 +98,8 @@ class ReplicaChaos:
 class ChaosAction:
     """One scheduled campaign event: at drive-loop step ``step``, do
     ``kind`` (``kill`` / ``slow_on`` / ``slow_off`` / ``storm_on`` /
-    ``storm_off`` / ``router_crash``) to ``replica_id`` (fleet-wide
-    actions carry None)."""
+    ``storm_off`` / ``router_crash`` / ``partition``) to
+    ``replica_id`` (fleet-wide actions carry None)."""
 
     step: int
     kind: str
@@ -109,6 +121,8 @@ class CampaignReport:
     invariants: Dict[str, bool]
     violations: List[str]
     skipped: List[str]
+    failover_s: Optional[float] = None  # partition plane: silence ->
+    #                                     promoted standby serving
 
     @property
     def ok(self) -> bool:
@@ -238,6 +252,13 @@ class ChaosConductor:
             # fleet already carrying composed damage.
             actions.append(ChaosAction(int(rng.integers(hi, horizon)),
                                        "router_crash"))
+        if "partition" in planes and self.journal_dir is not None:
+            # Early-mid window, strictly BEFORE the router-crash
+            # window: the partition's promoted standby is the router
+            # the crash plane then gets to SIGKILL — the planes
+            # compose instead of fighting over one takeover.
+            actions.append(ChaosAction(int(rng.integers(lo, hi)),
+                                       "partition"))
         actions.sort(key=lambda a: (a.step, a.kind))
         return actions
 
@@ -249,6 +270,27 @@ class ChaosConductor:
                              storage_plan=self.storage_plan,
                              **self._journal_kw)
 
+    def _arm_ha(self, fleet, lease_ttl_s: float) -> Dict[str, object]:
+        """The partition plane's precondition: a lease-armed primary
+        (epoch stamped on every worker-bound command) with a hot
+        standby tailing its WAL over the framed transport."""
+        lease = Lease(os.path.join(self.journal_dir, "ha_lease.json"),
+                      ttl_s=lease_ttl_s)
+        keeper = LeaseKeeper(lease, "primary", seed=self.seed)
+        fleet.set_epoch(keeper.acquire())
+        standby = HotStandby(
+            self.journal_dir, [s.driver for s in fleet.replicas],
+            lease=lease, holder="standby", seed=self.seed + 1,
+            router_kw=self._router_kw,
+            journal_kw={"storage_plan": self.storage_plan,
+                        **self._journal_kw})
+        shipper = WalShipper(fleet._journal, standby.feed)
+        standby.attach(shipper)
+        return {"lease": lease, "keeper": keeper, "standby": standby,
+                "shipper": shipper, "partitioned": False,
+                "promoted": False, "probes_attempted": 0,
+                "probes_refused": 0, "counted": 0}
+
     # ----------------------------------------------------------------- run
     def run(self, workload: Sequence[Tuple[Sequence[int], int]], *,
             planes: Sequence[str] = ("device", "wire", "storage",
@@ -256,7 +298,8 @@ class ChaosConductor:
             horizon: int = 40, kills: int = 1,
             slow_delay_s: float = 0.01, storm_rate: float = 1.0,
             max_wall_s: float = 120.0,
-            pace_s: float = 0.0) -> CampaignReport:
+            pace_s: float = 0.0, lease_ttl_s: float = 0.25,
+            partition_probes: int = 3) -> CampaignReport:
         """One campaign: build fleet, submit workload, fire the drawn
         schedule while stepping, settle, referee. Prompts must be
         unique per campaign (they key the token-exact check across a
@@ -276,6 +319,10 @@ class ChaosConductor:
                             **self._router_kw)
         chaos = self._make_chaos(fleet)
         by_id = {c.replica_id: c for c in chaos}
+        ha = (self._arm_ha(fleet, lease_ttl_s)
+              if "partition" in planes and self.journal_dir is not None
+              else None)
+        failover_s: Optional[float] = None
         schedule = self._draw_schedule(planes, horizon, chaos,
                                        kills=kills,
                                        slow_delay_s=slow_delay_s,
@@ -333,6 +380,61 @@ class ChaosConductor:
                         continue
                     storm_baseline = None
                     self.storage_plan._rates = (0.0, 0.0, 0.0, 0.0)
+                elif action.kind == "partition":
+                    if ha is None:
+                        continue
+                    # Full bidirectional silence: the primary stops
+                    # being stepped and stops renewing — but the
+                    # OBJECT stays alive, and after the standby
+                    # promotes it keeps trying to command (the mode
+                    # kill can never produce).
+                    ha["partitioned"] = True
+                    if self.storage_plan is not None:
+                        # Promotion arms a FRESH journal against the
+                        # disk exactly like cold recovery does — a
+                        # still-raging storm would fail that open, so
+                        # the partition ends the storm (same call the
+                        # router_crash plane makes below).
+                        self.storage_plan._rates = (0.0, 0.0, 0.0, 0.0)
+                        storm_baseline = None
+                    t_part = time.monotonic()
+                    promoted = None
+                    while time.monotonic() < deadline:
+                        out = ha["standby"].step()
+                        if out is not None:
+                            promoted = out
+                            break
+                        time.sleep(0.005)
+                    if promoted is None:
+                        violations.append(
+                            "standby never promoted during partition")
+                        continue
+                    new_fleet, reborn = promoted
+                    failover_s = time.monotonic() - t_part
+                    ha["promoted"] = True
+                    # The deposed-but-alive primary issues commands:
+                    # every one must come back a TYPED EpochFenced
+                    # reject — and the refusal must be counted.
+                    for k in range(int(partition_probes)):
+                        probe = [1 + (k % 30)] * (6 + k)
+                        ha["probes_attempted"] += 1
+                        try:
+                            fleet.submit(probe, 4)
+                        except EpochFenced:
+                            ha["probes_refused"] += 1
+                        except Exception:  # noqa: BLE001 - any other
+                            pass   # outcome is NOT a fencing refusal
+                    ha["counted"] = int(
+                        fleet.metrics.fenced_commands_refused)
+                    # The workload rides over: reborn handles replace
+                    # the deposed router's, matched by unique prompt
+                    # (finished streams keep their settled handles).
+                    reborn_by_prompt = {
+                        tuple(int(t) for t in fh.request.prompt): fh
+                        for fh in reborn.values()}
+                    handles = [(ptup, n, reborn_by_prompt.get(ptup, fh))
+                               for ptup, n, fh in handles]
+                    fleet = new_fleet
                 elif action.kind == "router_crash":
                     crashed = True
                     if self.storage_plan is not None:
@@ -351,6 +453,11 @@ class ChaosConductor:
                             finished_pre_crash.append(
                                 (ptup, list(fh.tokens)))
             fleet.step()
+            if ha is not None:
+                if not ha["partitioned"]:
+                    ha["keeper"].step()   # primary keeps its lease
+                elif ha["promoted"]:
+                    ha["standby"].step()  # promoted standby renews
             step_idx += 1
             live = (revived_handles.values() if crashed
                     else [fh for _, _, fh in handles])
@@ -361,10 +468,12 @@ class ChaosConductor:
         wall_s = time.monotonic() - t0
         report = self._referee(fleet, handles, expect, crashed,
                                finished_pre_crash, revived_handles,
-                               recovery_s, violations, skipped, planes)
+                               recovery_s, violations, skipped, planes,
+                               ha)
         report.actions = schedule
         report.steps = step_idx
         report.wall_s = wall_s
+        report.failover_s = failover_s
         _fold_injected(chaos, injected_acc)
         if self.storage_plan is not None:
             injected_acc["storage"] = int(
@@ -410,7 +519,8 @@ class ChaosConductor:
     # -------------------------------------------------------------- referee
     def _referee(self, fleet, handles, expect, crashed,
                  finished_pre_crash, revived_handles, recovery_s,
-                 violations, skipped, planes) -> CampaignReport:
+                 violations, skipped, planes,
+                 ha=None) -> CampaignReport:
         invariants: Dict[str, bool] = {}
         live = (list(revived_handles.values()) if crashed
                 else [fh for _, _, fh in handles])
@@ -476,6 +586,20 @@ class ChaosConductor:
         except Exception as e:  # noqa: BLE001 - the referee reports
             invariants["exposition_round_trip"] = False
             violations.append(f"exposition: {e}")
+        if ha is not None and ha.get("promoted"):
+            attempted = int(ha["probes_attempted"])
+            refused = int(ha["probes_refused"])
+            counted = int(ha["counted"])
+            invariants["single_writer"] = (
+                attempted > 0 and refused == attempted
+                and counted >= attempted)
+            if not invariants["single_writer"]:
+                violations.append(
+                    f"single_writer: {refused}/{attempted} deposed "
+                    f"commands refused ({counted} counted)")
+        else:
+            invariants["single_writer"] = True
+            skipped.append("single_writer (partition plane not fired)")
         collector = getattr(fleet, "dtrace", None)
         if collector is None:
             invariants["trace_complete"] = True

@@ -455,6 +455,15 @@ FLEET_COUNTER_KEYS = frozenset({
     # `journal_non_durable` gauge below.
     "journal_storage_errors", "journal_degraded_events",
     "journal_rearms",
+    # Router high availability (ISSUE 20, `serve/fleet/standby.py`):
+    # standby promotions to primary, worker-side epoch refusals of a
+    # deposed router's commands (each one is a split-brain write that
+    # did NOT happen — any nonzero value during steady state is a
+    # page), and WAL-tail catch-up resyncs (checkpoint+segment reads
+    # covering stream gaps or NON_DURABLE backlogs). The live
+    # `router_epoch` / `lease_age_s` / `standby_lag_records` gauges
+    # ride below.
+    "takeovers", "fenced_commands_refused", "standby_catchups",
 })
 
 
@@ -521,6 +530,19 @@ def fleet_exposition(router, autoscaler=None) -> str:
     gray = getattr(router, "gray", None)
     snap["replicas_suspected_gray"] = (len(gray.suspected)
                                        if gray is not None else None)
+    # Router HA gauges (ISSUE 20): the armed fencing epoch (NaN on an
+    # epoch-free router — the pre-HA deployment shape), the lease's
+    # age since last renewal (read against its TTL: age approaching
+    # TTL means the holder's renewal loop is wedged), and the hot
+    # standby's replication lag in WAL records (0 = promotable with an
+    # empty loss window). `router.ha` duck-types either side of the
+    # pair: a primary's LeaseKeeper or a promoted HotStandby.
+    snap["router_epoch"] = getattr(router, "epoch", None)
+    ha = getattr(router, "ha", None)
+    lease_age = getattr(ha, "lease_age_s", None)
+    snap["lease_age_s"] = lease_age() if callable(lease_age) else None
+    lag = getattr(ha, "lag_records", None)
+    snap["standby_lag_records"] = lag() if callable(lag) else None
     if router.admission is not None:
         # The ladder rung as a gauge: 0 NORMAL … 3 REJECT_COLD. The
         # runbook's first stop during an overload page.

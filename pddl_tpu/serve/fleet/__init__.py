@@ -55,6 +55,7 @@ from pddl_tpu.serve.fleet.health import (
 from pddl_tpu.serve.fleet.journal import RouterJournal
 from pddl_tpu.serve.fleet.replay import ReplayReport, replay_trace
 from pddl_tpu.serve.fleet.replica import (
+    EpochFenced,
     LocalReplica,
     ProcessReplica,
     ReplicaDied,
@@ -66,6 +67,14 @@ from pddl_tpu.serve.fleet.router import (
     FleetRouter,
     NoHealthyReplica,
     ReplicaLifecycle,
+)
+from pddl_tpu.serve.fleet.standby import (
+    HotStandby,
+    Lease,
+    LeaseHeld,
+    LeaseKeeper,
+    WalShipper,
+    WalTail,
 )
 from pddl_tpu.serve.fleet.tracegen import diurnal_trace
 from pddl_tpu.serve.fleet.transport import (
@@ -83,6 +92,7 @@ __all__ = [
     "BrownoutController",
     "BrownoutRung",
     "CircuitBreaker",
+    "EpochFenced",
     "FleetAutoscaler",
     "FleetHandle",
     "FleetMetrics",
@@ -91,6 +101,10 @@ __all__ = [
     "FrameSender",
     "GrayDetector",
     "HandoffManager",
+    "HotStandby",
+    "Lease",
+    "LeaseHeld",
+    "LeaseKeeper",
     "LocalReplica",
     "NoHealthyReplica",
     "OverloadDetector",
@@ -104,6 +118,8 @@ __all__ = [
     "RouterJournal",
     "ScaleDecision",
     "TokenBucket",
+    "WalShipper",
+    "WalTail",
     "WireFaultKind",
     "WireFaultPlan",
     "WireFaultSpec",

@@ -100,32 +100,35 @@ from typing import Dict, List, Optional, Tuple
 from pddl_tpu.serve import drain as drain_io
 from pddl_tpu.utils.faults import StorageFaultKind
 
-# Version 2: version 1 (the initial ISSUE 14 control-plane WAL) plus
-# the ``handoff`` record — the prefill->decode KV rebinding the
-# disaggregated fleet stamps, which carries ``from_replica``. Bumping
-# the record shape requires bumping this AND renaming RECORD_KEYS_V2 —
-# graftlint's snapshot-hygiene rule machine-checks the pairing, the
-# same discipline `serve/drain.py` carries for its snapshot entries.
-# V1 logs stay readable: the new record kind is additive and recovery
-# ignores it like ``route``.
-JOURNAL_VERSION = 2
-_READABLE_JOURNAL_VERSIONS = frozenset({1, 2})
+# Version 3: version 2 (the disaggregation-era WAL) plus the
+# ``epoch`` record — the router-HA single-writer token (ISSUE 20). A
+# promoted router stamps its fencing epoch into the log so a forensic
+# read shows exactly which writer issued every suffix, and so a
+# standby tailing the stream learns leadership changes in-band.
+# Bumping the record shape requires bumping this AND renaming
+# RECORD_KEYS_V3 — graftlint's snapshot-hygiene rule machine-checks
+# the pairing, the same discipline `serve/drain.py` carries for its
+# snapshot entries. V1/V2 logs stay readable: the new record kind is
+# additive and recovery ignores it like ``route``.
+JOURNAL_VERSION = 3
+_READABLE_JOURNAL_VERSIONS = frozenset({1, 2, 3})
 
 # Machine-checked wire manifest (graftlint `snapshot-hygiene`): the
 # exact record keys the encode_* functions below emit at the CURRENT
 # journal version. Changing a record shape requires bumping
 # JOURNAL_VERSION and renaming this tuple to RECORD_KEYS_V<new> in the
 # same commit — the static checker fails the tree otherwise.
-RECORD_KEYS_V2 = ("rec", "rid", "prompt", "max_new_tokens", "sampling",
+RECORD_KEYS_V3 = ("rec", "rid", "prompt", "max_new_tokens", "sampling",
                   "deadline_s", "priority", "adapter", "constraint",
                   "session", "replica", "via", "toks", "state", "reason",
-                  "from_replica")
+                  "from_replica", "epoch")
 
 # Machine-checked record-kind vocabulary (graftlint `role-vocab`):
 # every ``"rec"`` literal an encoder below emits, exactly. Recovery's
 # fold dispatches on these; adding a kind here without a reader-side
 # decision (rebuild vs audit-only) is what the rule exists to catch.
-RECORD_KINDS = ("admit", "route", "tokens", "finish", "handoff")
+RECORD_KINDS = ("admit", "route", "tokens", "finish", "handoff",
+                "epoch")
 
 # Machine-checked ``via`` vocabulary (graftlint `role-vocab`): every
 # label a ``route`` record may carry — the router's routing labels
@@ -243,6 +246,21 @@ def encode_handoff(rid: int, from_replica: int, to_replica: int) -> Dict:
             "from_replica": int(from_replica)}
 
 
+def encode_fence_epoch(epoch: int) -> Dict:
+    """Encoder for the ``"epoch"`` record (NOT ``encode_epoch``: a
+    helper named ``encode_<declared wire key>`` reads as a nested
+    sub-encoder to graftlint's snapshot-hygiene manifest check, and
+    ``epoch`` is both the record kind and its field).
+
+    The single-writer token (router HA, ISSUE 20): the issuing
+    router's fencing epoch, stamped at arm/takeover and re-stamped
+    after every checkpoint so the live WAL tail always carries the
+    current writer's identity. Audit-only on recovery — leadership is
+    re-acquired through the lease, never replayed — but it is what a
+    split-brain forensic reads."""
+    return {"rec": "epoch", "epoch": int(epoch)}
+
+
 class RouterJournal:
     """Append-only, CRC-framed, fsync-batched control-plane WAL with an
     atomic checkpoint+truncate cycle.
@@ -308,6 +326,12 @@ class RouterJournal:
         # Observer ``fn(event, detail_dict)`` — the router wires it to
         # its tracer + FleetMetrics so degradation is alarmable.
         self.on_storage_event = None
+        # Observer ``fn(seq, record)`` — fired on EVERY append, before
+        # any disk I/O, so a hot standby's WAL shipper sees the record
+        # stream even while the journal is degraded NON_DURABLE (when
+        # the disk shows nothing, the wire is the only replica of the
+        # backlog). Must not raise; must not touch the journal.
+        self.on_record = None
         # Continue the seq line past whatever is already durable — and
         # TRUNCATE the torn tail first: appending after unreadable
         # bytes would put every later record (fsynced admits included)
@@ -347,6 +371,8 @@ class RouterJournal:
         self._pending += 1
         self.records_appended += 1
         self.records_since_checkpoint += 1
+        if self.on_record is not None:
+            self.on_record(seq, record)
         if durable or self._pending >= self._fsync_batch:
             if self.non_durable:
                 # Writes pause while degraded: the batch threshold must
@@ -699,6 +725,44 @@ def iter_wal_records(wal_path: str):
         off = end
 
 
+def tail_wal_records(wal_path: str,
+                     offset: int = 0) -> Tuple[List[Tuple[int, Dict]],
+                                               int]:
+    """Incremental WAL read for a standby's catch-up loop:
+    ``(records, new_offset)`` over the readable frames starting at byte
+    ``offset``. Pass the returned offset back on the next poll to read
+    only what the primary appended since — the file is never re-parsed
+    from the top. A torn/corrupt frame ends the read at its start (the
+    offset does NOT advance past it), so a half-flushed tail is re-read
+    whole once the primary completes it."""
+    try:
+        with open(wal_path, "rb") as f:
+            f.seek(offset)
+            data = f.read()
+    except OSError:
+        return [], offset
+    out: List[Tuple[int, Dict]] = []
+    off = 0
+    while off + _HEADER.size <= len(data):
+        magic, seq, length, crc = _HEADER.unpack_from(data, off)
+        if magic != _MAGIC:
+            break
+        start = off + _HEADER.size
+        end = start + length
+        if end > len(data):
+            break
+        payload = data[start:end]
+        if (zlib.crc32(payload) & 0xFFFFFFFF) != crc:
+            break
+        try:
+            record = json.loads(payload)
+        except ValueError:
+            break
+        out.append((int(seq), record))
+        off = end
+    return out, offset + off
+
+
 def load_checkpoint(journal_dir: str) -> Optional[Dict]:
     """The newest VERIFIED checkpoint body (r10 discipline): the
     current file if its embedded CRC verifies, else the previous one,
@@ -775,10 +839,12 @@ def read_state(journal_dir: str) -> Tuple[Dict[int, Dict], int]:
         elif kind == "finish":
             finished.add(rid)
             entries.pop(rid, None)
-        # "route" and "handoff" records rebuild nothing here: recovery
-        # re-routes on the fresh fleet (the old bindings name dead
-        # processes), but they make the decision history auditable and
-        # are what a partial-failover or hand-off forensic reads.
+        # "route", "handoff", and "epoch" records rebuild nothing
+        # here: recovery re-routes on the fresh fleet (the old bindings
+        # name dead processes) and re-acquires leadership through the
+        # lease, but they make the decision history auditable and are
+        # what a partial-failover, hand-off, or split-brain forensic
+        # reads.
     for rid in finished:
         entries.pop(rid, None)
     return entries, max_rid + 1

@@ -87,6 +87,33 @@ class ReplicaSpawnTimeout(ReplicaDied):
                          "(worker never became ready)")
 
 
+class EpochFenced(RuntimeError):
+    """The worker refused a command stamped with a STALE fencing epoch
+    (router HA, ISSUE 20): a newer router has taken over and this
+    driver's caller is the deposed primary. Deliberately NOT a
+    :class:`ReplicaDied` — the replica is healthy and serving the new
+    epoch's commands; the correct reaction is to stop commanding, not
+    to migrate the replica's work."""
+
+    def __init__(self, replica_id: int, epoch: int, highest: int):
+        self.replica_id = int(replica_id)
+        self.epoch = int(epoch)
+        self.highest = int(highest)
+        super().__init__(
+            f"replica {replica_id} fenced epoch {epoch} command "
+            f"(highest seen: {highest})")
+
+
+# Machine-checked fencing manifest (graftlint `epoch-vocab`): the
+# exact worker-bound command kinds the drivers below stamp with the
+# issuing router's epoch — the fleet-state mutators plus the ``fence``
+# probe itself. The worker's FENCED_CMDS dispatch table must equal
+# this tuple, both directions: a command stamped here but unchecked
+# there is a hole a deposed primary drives through; one checked there
+# but never stamped here would fence every legacy (epoch-free) caller.
+EPOCH_CMDS = ("submit", "cancel", "restore", "fence")
+
+
 # The submit protocol's sampling wire shape IS the drain snapshot's —
 # one encode/decode pair (`serve/drain.py`) for both.
 sampling_to_wire = drain_io.encode_sampling
@@ -189,12 +216,38 @@ class LocalReplica:
         self._span_buf = SpanShipper()
         self._trace_rids: Dict[int, int] = {}
         self._dtrace_armed = False
+        # Highest fencing epoch seen (router HA, ISSUE 20). -1 =
+        # never fenced; survives respawn() — the engine dies, the
+        # single-writer promise does not.
+        self.fence_epoch = -1
+
+    # ------------------------------------------------------------ fencing
+    def _check_epoch(self, epoch) -> None:
+        """The worker-side fencing decision, in-object: a command
+        carrying an epoch below the highest seen is refused with the
+        typed reject; an equal-or-higher epoch is adopted. ``None``
+        (an epoch-free caller, every pre-HA fleet) always passes."""
+        if epoch is None:
+            return
+        if int(epoch) < self.fence_epoch:
+            raise EpochFenced(self.replica_id, int(epoch),
+                              self.fence_epoch)
+        self.fence_epoch = int(epoch)
+
+    def fence(self, epoch: int) -> int:
+        """Adopt ``epoch`` as the floor for future commands (the
+        promotion probe): returns the highest epoch now held. Raises
+        :class:`EpochFenced` when the CALLER is the stale one."""
+        self._check_epoch(int(epoch))
+        return self.fence_epoch
 
     # ------------------------------------------------------------- intake
     def submit(self, rid: int, prompt, max_new_tokens: int,
                sampling: SamplingParams, deadline_s,
                priority: Priority = Priority.INTERACTIVE,
-               adapter=None, constraint=None, trace=None) -> None:
+               adapter=None, constraint=None, trace=None,
+               epoch=None) -> None:
+        self._check_epoch(epoch)
         handle = self.engine.submit(prompt, max_new_tokens,
                                     sampling=sampling, deadline_s=deadline_s,
                                     priority=priority, adapter=adapter,
@@ -211,7 +264,8 @@ class LocalReplica:
         if trace is not None:
             tracer.on_trace_context(eng_rid, str(trace[0]), trace[1])
 
-    def cancel(self, rid: int) -> None:
+    def cancel(self, rid: int, epoch=None) -> None:
+        self._check_epoch(epoch)
         h = self._ledger.get(rid)
         if h is not None:
             h.cancel()
@@ -307,12 +361,13 @@ class LocalReplica:
         return entries
 
     def restore(self, pairs: List[Tuple[int, Dict]],
-                traces=None) -> None:
+                traces=None, epoch=None) -> None:
         """Migration in: wire entries join this engine's queue through
         the standard restore path (depth limits bypassed — every one of
         these was admitted by the fleet already). ``traces`` optionally
         maps rid -> wire trace context so the resumed streams' spans
         stay in their original fleet traces."""
+        self._check_epoch(epoch)
         handles = self.engine.restore(snapshot_from_pairs(pairs))
         for (rid, _), handle in zip(pairs, handles):
             self._ledger.add(rid, handle)
@@ -765,14 +820,18 @@ class ProcessReplica:
     def submit(self, rid: int, prompt, max_new_tokens: int,
                sampling: SamplingParams, deadline_s,
                priority: Priority = Priority.INTERACTIVE,
-               adapter=None, constraint=None, trace=None) -> None:
+               adapter=None, constraint=None, trace=None,
+               epoch=None) -> None:
         """Synchronous across the pipe: the worker acks admission or
         reports its typed QueueFull (depth + retry_after hint), which
         re-raises here so the router's shed logic is driver-agnostic.
         ``adapter``/``constraint`` (the tenant fields) are already
         plain wire values — a name string and a spec dict; ``trace``
         is the router's ``(trace_id, parent_span_id)`` wire context
-        (ISSUE 19), stamped only when fleet tracing is armed."""
+        (ISSUE 19), stamped only when fleet tracing is armed;
+        ``epoch`` is the issuing router's fencing epoch (ISSUE 20) —
+        a stale one re-raises the worker's typed reject as
+        :class:`EpochFenced`."""
         cmd = {"cmd": "submit", "rid": int(rid),
                "prompt": [int(t) for t in prompt],
                "max_new_tokens": int(max_new_tokens),
@@ -782,6 +841,8 @@ class ProcessReplica:
                "adapter": adapter, "constraint": constraint}
         if trace is not None:
             cmd["trace"] = [str(trace[0]), trace[1]]
+        if epoch is not None:
+            cmd["epoch"] = int(epoch)
         self._send(cmd)
         deadline = self._clock() + self._call_timeout_s
         while True:
@@ -802,6 +863,10 @@ class ProcessReplica:
                                         priority=Priority(priority))
                 elif kind == "error" and ev.get("rid") == rid:
                     verdict = ValueError(str(ev.get("message")))
+                elif kind == "fenced" and ev.get("rid") == rid:
+                    verdict = EpochFenced(self.replica_id,
+                                          int(ev.get("epoch", -1)),
+                                          int(ev.get("highest", -1)))
                 else:
                     self._pending.append(ev)
             if verdict == "ok":
@@ -811,8 +876,40 @@ class ProcessReplica:
             if self._clock() > deadline:
                 raise ReplicaDied(self.replica_id, "submit ack timed out")
 
-    def cancel(self, rid: int) -> None:
-        self._send({"cmd": "cancel", "rid": int(rid)})
+    def cancel(self, rid: int, epoch=None) -> None:
+        cmd = {"cmd": "cancel", "rid": int(rid)}
+        if epoch is not None:
+            cmd["epoch"] = int(epoch)
+        self._send(cmd)
+
+    def fence(self, epoch: int) -> int:
+        """Adopt ``epoch`` on the worker (the promotion probe):
+        synchronous like :meth:`compile_counts` — the promoting router
+        must KNOW every worker holds the new epoch before the deposed
+        primary's next command can race it. Returns the worker's
+        highest epoch; raises :class:`EpochFenced` when the caller's
+        epoch is the stale one."""
+        self._send({"cmd": "fence", "epoch": int(epoch)})
+        deadline = self._clock() + self._call_timeout_s
+        while self._clock() < deadline:
+            self._nudge()
+            verdict = None  # consume the whole batch (see submit())
+            for ev in self._read_events(block_s=0.05):
+                kind = ev.get("ev")
+                if kind == "fence_ok" and verdict is None:
+                    verdict = int(ev.get("highest", epoch))
+                elif kind == "fenced" and ev.get("rid") is None \
+                        and verdict is None:
+                    verdict = EpochFenced(self.replica_id,
+                                          int(ev.get("epoch", -1)),
+                                          int(ev.get("highest", -1)))
+                else:
+                    self._pending.append(ev)
+            if isinstance(verdict, EpochFenced):
+                raise verdict
+            if verdict is not None:
+                return verdict
+        raise ReplicaDied(self.replica_id, "fence ack timed out")
 
     # ------------------------------------------------------------ serving
     def warmup(self) -> None:
@@ -1045,14 +1142,16 @@ class ProcessReplica:
     _RESTORE_CHUNK = 8  # entries per restore command
 
     def restore(self, pairs: List[Tuple[int, Dict]],
-                traces=None) -> None:
+                traces=None, epoch=None) -> None:
         """Migration in, chunked: one huge restore line can exceed the
         stdin pipe capacity while the worker is itself blocked writing
         token events nobody is reading — a mutual stall. Small commands
         with a non-blocking stdout drain between them keep both pipe
         directions moving; the worker treats each chunk as an
         independent restore. ``traces`` optionally maps rid -> wire
-        trace context (ISSUE 19)."""
+        trace context (ISSUE 19); ``epoch`` is the issuing router's
+        fencing epoch (ISSUE 20) — a stale restore is refused whole
+        (the typed reject surfaces through the event stream)."""
         for i in range(0, len(pairs), self._RESTORE_CHUNK):
             chunk = pairs[i:i + self._RESTORE_CHUNK]
             cmd = {"cmd": "restore",
@@ -1064,6 +1163,8 @@ class ProcessReplica:
                            for rid, _ in chunk if rid in traces]
                 if stamped:
                     cmd["traces"] = stamped
+            if epoch is not None:
+                cmd["epoch"] = int(epoch)
             self._send(cmd)
             self._pending.extend(self._read_events())
 

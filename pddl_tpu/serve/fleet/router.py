@@ -97,7 +97,7 @@ from pddl_tpu.serve.fleet.health import (
     CircuitBreaker,
     GrayDetector,
 )
-from pddl_tpu.serve.fleet.replica import ReplicaDied
+from pddl_tpu.serve.fleet.replica import EpochFenced, ReplicaDied
 from pddl_tpu.serve.kvcache import RadixPrefixCache
 from pddl_tpu.serve.request import (
     AdmissionRejected,
@@ -281,6 +281,15 @@ class FleetMetrics:
         self.journal_storage_errors = 0
         self.journal_degraded_events = 0
         self.journal_rearms = 0
+        # Router HA (ISSUE 20): standby promotions executed by this
+        # process, worker-bound commands a replica REFUSED because they
+        # carried a stale fencing epoch (a nonzero count is the split-
+        # brain defence firing, not a fleet fault), and catch-up folds a
+        # standby ran from checkpoint+segment because the live stream
+        # had a gap (join, or a NON_DURABLE backlog on the primary).
+        self.takeovers = 0
+        self.fenced_commands_refused = 0
+        self.standby_catchups = 0
         self.requests_finished = 0
         self.requests_failed = 0
         self.requests_orphaned = 0
@@ -607,10 +616,43 @@ class FleetRouter:
         # for a probe to bring one back.
         self._orphans: List[Tuple[int, FleetHandle]] = []
         self._closed = False
+        # Fencing epoch (ISSUE 20): None = unarmed, every driver call
+        # goes out epoch-free and pre-HA fleets are byte-identical.
+        # Armed (via set_epoch, normally by HotStandby.promote), every
+        # worker-bound mutator carries it and a deposed router's
+        # commands come back as typed EpochFenced rejects.
+        self._epoch: Optional[int] = None
         self._admission = admission
         if admission is not None:
             admission.brownout.on_transition = self._brownout_observer(
                 admission.brownout.on_transition)
+
+    @property
+    def epoch(self) -> Optional[int]:
+        """The fencing epoch this router stamps on worker-bound
+        commands; None while HA is unarmed (single-router fleets)."""
+        return self._epoch
+
+    def set_epoch(self, epoch: int) -> None:
+        """Arm (or raise) the fencing epoch. Journals an ``epoch``
+        record so the WAL tail always names the current writer — a
+        standby tailing this journal learns the leadership change from
+        the same stream it replicates."""
+        epoch = int(epoch)
+        if self._epoch is not None and epoch < self._epoch:
+            raise ValueError(
+                f"epoch may only move forward ({self._epoch} -> {epoch})")
+        self._epoch = epoch
+        if self._journal is not None:
+            self._journal.append(journal_io.encode_fence_epoch(epoch),
+                                 durable=True)
+        self._tracer.on_fleet_event("epoch_armed", epoch=epoch)
+
+    def _count_fenced(self, exc: EpochFenced) -> None:
+        self.metrics.fenced_commands_refused += 1
+        self._tracer.on_fleet_event(
+            "command_fenced", replica_id=exc.replica_id,
+            epoch=exc.epoch, highest=exc.highest)
 
     def _brownout_observer(self, chained):
         def observe(old, new) -> None:
@@ -1022,16 +1064,23 @@ class FleetRouter:
         depth_sum = cap_sum = sheds_seen = 0
         for slot in order:
             rid = self._new_rid()
+            # Stamp-only-when-armed: an unarmed router (epoch None)
+            # emits the exact pre-HA call shape, so drivers and test
+            # doubles that predate fencing keep working untouched.
+            extra: Dict[str, object] = {}
+            if self._dtrace is not None:
+                extra["trace"] = self._dtrace.context_for(rid)
+            if self._epoch is not None:
+                extra["epoch"] = self._epoch
             try:
-                if self._dtrace is not None:
-                    slot.driver.submit(rid, prompt, max_new_tokens,
-                                       sampling, deadline_s, priority,
-                                       adapter, constraint,
-                                       trace=self._dtrace.context_for(rid))
-                else:
-                    slot.driver.submit(rid, prompt, max_new_tokens,
-                                       sampling, deadline_s, priority,
-                                       adapter, constraint)
+                slot.driver.submit(rid, prompt, max_new_tokens,
+                                   sampling, deadline_s, priority,
+                                   adapter, constraint, **extra)
+            except EpochFenced as e:
+                # Deposed router: the fleet refused us by design. No
+                # point trying siblings — they share the fence floor.
+                self._count_fenced(e)
+                raise
             except QueueFull as e:
                 sheds_seen += 1
                 if e.retry_after_s is not None:
@@ -1141,18 +1190,19 @@ class FleetRouter:
             # primary's trace id (one trace, two replicas racing).
             self._dtrace.alias(hrid, primary_rid)
             trace = self._dtrace.context_for(hrid)
+        extra: Dict[str, object] = {}
+        if trace is not None:
+            extra["trace"] = trace
+        if self._epoch is not None:
+            extra["epoch"] = self._epoch
         try:
-            if trace is not None:
-                hedge_to.driver.submit(hrid, list(req.prompt),
-                                       int(max_new_tokens), req.sampling,
-                                       req.deadline_s, req.priority,
-                                       req.adapter, req.constraint,
-                                       trace=trace)
-            else:
-                hedge_to.driver.submit(hrid, list(req.prompt),
-                                       int(max_new_tokens), req.sampling,
-                                       req.deadline_s, req.priority,
-                                       req.adapter, req.constraint)
+            hedge_to.driver.submit(hrid, list(req.prompt),
+                                   int(max_new_tokens), req.sampling,
+                                   req.deadline_s, req.priority,
+                                   req.adapter, req.constraint, **extra)
+        except EpochFenced as e:
+            self._count_fenced(e)
+            return
         except Exception:  # noqa: BLE001 - QueueFull / ReplicaDied /
             return         # anything: the single copy stands alone
         self._by_rid[hrid] = fh
@@ -1189,7 +1239,12 @@ class FleetRouter:
             if loser_rid in slot.assigned:
                 slot.assigned.pop(loser_rid, None)
                 try:
-                    slot.driver.cancel(loser_rid)
+                    if self._epoch is not None:
+                        slot.driver.cancel(loser_rid, epoch=self._epoch)
+                    else:
+                        slot.driver.cancel(loser_rid)
+                except EpochFenced as e:
+                    self._count_fenced(e)
                 except Exception:  # noqa: BLE001 - loser may be dying;
                     pass           # either way its events are unbound
         winner_hedge = winner_rid in self._hedge_rids
@@ -1425,6 +1480,11 @@ class FleetRouter:
     def _journal_checkpoint(self) -> None:
         self._journal.checkpoint(self._journal_entries(),
                                  next_rid=self._rid_counter)
+        if self._epoch is not None:
+            # The checkpoint truncated the WAL: re-assert the writer's
+            # epoch so the fresh segment — the suffix a standby tails —
+            # always opens by naming who is allowed to write it.
+            self._journal.append(journal_io.encode_fence_epoch(self._epoch))
 
     def _on_journal_storage_event(self, event: str, detail: Dict) -> None:
         """The WAL's degradation observer: mirror storage health into
@@ -1467,7 +1527,12 @@ class FleetRouter:
         for rid, fh in list(slot.assigned.items()):
             if fh.cancelled and not fh.done:
                 try:
-                    slot.driver.cancel(rid)
+                    if self._epoch is not None:
+                        slot.driver.cancel(rid, epoch=self._epoch)
+                    else:
+                        slot.driver.cancel(rid)
+                except EpochFenced as e:
+                    self._count_fenced(e)
                 except (ReplicaDied, OSError):
                     pass  # death handling will settle it
 
@@ -1564,6 +1629,15 @@ class FleetRouter:
                         if fh.finish_reason is not None else None))
                 else:
                     self._hedge_alias.pop(rid, None)
+            elif kind == "fenced":
+                # A fire-and-forget command (cancel, a restore chunk)
+                # bounced off the worker's fence floor asynchronously.
+                # The replica is healthy — this router is just not the
+                # writer any more; count it, the chaos referee reads
+                # the counter as the split-brain discriminant.
+                self._count_fenced(EpochFenced(
+                    slot.replica_id, int(ev.get("epoch", -1)),
+                    int(ev.get("highest", -1))))
         self.metrics.tokens_streamed += tokens
         return tokens
 
@@ -1747,15 +1821,24 @@ class FleetRouter:
             target = by_id[tid]
             try:
                 pairs = [(rid, entry) for rid, entry, _ in items]
+                extra: Dict[str, object] = {}
+                if self._epoch is not None:
+                    extra["epoch"] = self._epoch
                 if self._dtrace is not None:
                     traces = {}
                     for rid, _entry, _fh in items:
                         self._dtrace.on_restore(rid, target.replica_id,
                                                 via)
                         traces[rid] = self._dtrace.context_for(rid)
-                    target.driver.restore(pairs, traces=traces)
+                    target.driver.restore(pairs, traces=traces, **extra)
                 else:
-                    target.driver.restore(pairs)
+                    target.driver.restore(pairs, **extra)
+            except EpochFenced as e:
+                # A fenced restore means WE are the deposed router —
+                # the new primary owns these streams now. Do not park
+                # them as orphans (that would double-drive on revive).
+                self._count_fenced(e)
+                raise
             except (ReplicaDied, KillPoint) as e:
                 self._on_death(target, e)
                 # Re-distribute this shard over whoever remains — from

@@ -183,8 +183,13 @@ class FrameReceiver:
     """
 
     def __init__(self, *, max_frame_bytes: int = MAX_FRAME_BYTES,
-                 reorder_buffer: int = 256):
-        self._expected = 1
+                 reorder_buffer: int = 256, first_seq: int = 1):
+        # ``first_seq``: where the sequence space begins for THIS
+        # receiver. A standby that joins an already-running WAL stream
+        # (router HA, ISSUE 20) resumes from the shipper's next frame
+        # after a disk catch-up — demanding history the sender's replay
+        # buffer no longer holds would wedge the gap logic forever.
+        self._expected = int(first_seq)
         self._pending: Dict[int, bytes] = {}
         self._max_frame = int(max_frame_bytes)
         self._reorder_buffer = int(reorder_buffer)
@@ -244,6 +249,22 @@ class FrameReceiver:
             out.append(self._pending.pop(self._expected))
             self._expected += 1
         self.stats["frames_ok"] += len(out)
+        return out
+
+    def drain_pending(self) -> List[Tuple[int, bytes]]:
+        """Abandon in-order delivery: every buffered out-of-order
+        frame, ``(seq, payload)`` sorted by seq, and the expectation
+        jumps past them. The standby's catch-up path (router HA,
+        ISSUE 20) calls this after refolding from disk — a gap on a
+        one-way replication stream will never heal from the wire (the
+        primary may be dead), and the disk fold already covers the
+        missing range's durable prefix; whatever was buffered beyond
+        it is the NON_DURABLE backlog, deduplicated downstream by the
+        journal's own record sequence."""
+        out = sorted(self._pending.items())
+        self._pending.clear()
+        if out:
+            self._expected = max(self._expected, out[-1][0] + 1)
         return out
 
 

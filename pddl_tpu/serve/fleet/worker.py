@@ -4,9 +4,9 @@
 a GPT + :class:`~pddl_tpu.serve.ServeEngine` from the config, warms it,
 and then speaks the JSON-line protocol of
 :class:`~pddl_tpu.serve.fleet.replica.ProcessReplica` over stdio:
-commands (submit/cancel/ping/counts/restore/shutdown) arrive on stdin,
-events (ready/submit_ok/queue_full/tokens/finish/pong/counts/snapshot)
-leave on stdout. stdout is PROTOCOL-ONLY — anything chatty (jax logs)
+commands (submit/cancel/ping/counts/restore/fence/shutdown) arrive on
+stdin, events (ready/submit_ok/queue_full/tokens/finish/pong/counts/
+snapshot/fenced/fence_ok) leave on stdout. stdout is PROTOCOL-ONLY — anything chatty (jax logs)
 must go to stderr, which the parent leaves attached to its own.
 
 Determinism contract: every worker of a fleet (and the oracle engine a
@@ -51,6 +51,14 @@ from pddl_tpu.serve.request import Priority, QueueFull
 # refuse a role this build has never heard of even when spawned by a
 # newer (or older) parent.
 ROLES = ("prefill", "decode", "unified")
+
+# Machine-checked fencing dispatch table (graftlint `epoch-vocab`):
+# the command kinds whose ``epoch`` stamp this worker checks before
+# dispatch — must stay tuple-equal to `fleet/replica.py`'s EPOCH_CMDS
+# (the driver-side stamping manifest), both directions. Declared as a
+# literal on BOTH sides of the process boundary on purpose, like
+# ROLES: fencing is only as strong as the stalest binary's table.
+FENCED_CMDS = ("submit", "cancel", "restore", "fence")
 
 
 def build_engine(config: Dict[str, object]):
@@ -235,6 +243,29 @@ def main(argv=None) -> int:
 
     flags = {"drain": False, "shutdown": False}
 
+    # Fencing epoch (router HA, ISSUE 20): the highest epoch any
+    # command has carried. -1 = never fenced, so epoch-free callers
+    # (every pre-HA fleet) are never refused. ``fence_path`` persists
+    # the floor across a worker respawn — a deposed primary must not
+    # regain the fleet by bouncing its workers.
+    fence = {"epoch": -1}
+    fence_path = config.get("fence_path")
+    if fence_path:
+        try:
+            with open(str(fence_path)) as f:
+                fence["epoch"] = max(fence["epoch"], int(f.read()))
+        except (OSError, ValueError):
+            pass  # no file yet / unreadable: the in-memory floor rules
+
+    def raise_fence(epoch: int) -> None:
+        fence["epoch"] = epoch
+        if fence_path:
+            try:
+                with open(str(fence_path), "w") as f:
+                    f.write(str(epoch))
+            except OSError as e:  # keep serving: the in-memory floor
+                print(f"fence persist failed: {e}", file=sys.stderr)
+
     def _on_sigterm(signum, frame):  # flag only: async-signal-safe
         flags["drain"] = True
 
@@ -283,7 +314,26 @@ def main(argv=None) -> int:
 
     def handle_cmd(cmd: Dict[str, object]) -> None:
         kind = cmd.get("cmd")
-        if kind == "submit":
+        # Fencing gate, BEFORE dispatch (ISSUE 20): a command in the
+        # FENCED_CMDS table carrying a STALE epoch is refused whole
+        # with the typed reject — the deposed-but-alive primary
+        # physically cannot drive this worker. Equal-or-higher epochs
+        # are adopted (and persisted) first, so the promotion probe
+        # and the new primary's first command both raise the floor.
+        if kind in FENCED_CMDS and cmd.get("epoch") is not None:
+            epoch = int(cmd["epoch"])
+            if epoch < fence["epoch"]:
+                emit({"ev": "fenced", "cmd": kind,
+                      "rid": cmd.get("rid"), "epoch": epoch,
+                      "highest": fence["epoch"]})
+                return
+            if epoch > fence["epoch"]:
+                raise_fence(epoch)
+        if kind == "fence":
+            # The promotion probe: the gate above already adopted the
+            # epoch (or refused the probe); ack with the floor held.
+            emit({"ev": "fence_ok", "highest": fence["epoch"]})
+        elif kind == "submit":
             rid = int(cmd["rid"])
             try:
                 handle = engine.submit(

@@ -177,7 +177,12 @@ _LOWER_BETTER = ("ttft", "latency", "_ms", "_wall_s", "overhead",
                  # bursts (r20): the interference disaggregation
                  # exists to remove — lower means prefill stopped
                  # stealing decode ticks.
-                 "interference")
+                 "interference",
+                 # Hot-standby detection+promotion wall time (r23):
+                 # the HA headline riding next to recovery_s — a
+                 # same-config record whose failover got slower
+                 # regressed the whole point of keeping a standby.
+                 "failover_s")
 _NEVER = ("spread", "samples", "per_pair", "per_repeat", "n_requests",
           "count", "injected", "provenance", "seed", "offered",
           # The r18 tier curve's sweep axis (working_set_x is a

@@ -1,0 +1,61 @@
+"""Seeded-bad fixture for the ``epoch-vocab`` rule (ISSUE 20): the
+fencing-epoch manifest drifts in every direction the rule covers.
+Self-paired — EPOCH_CMDS (driver manifest) and FENCED_CMDS (worker
+fence-gate mirror) both live here, the fixture analogue of
+replica.py + worker.py in one module.
+
+Seeded findings (4):
+- ``drain_replica`` emits ``{"cmd": "drain"}`` with an inline epoch
+  stamp, but EPOCH_CMDS never declared it — the fence gate will not
+  intercept it, so a deposed primary can still drain the fleet;
+- EPOCH_CMDS lists ``"retire"``, which no function epoch-stamps — a
+  stale manifest entry claiming a fence the driver never arms;
+- FENCED_CMDS disagrees with EPOCH_CMDS: it gates ``"pause"`` (never
+  stamped) and is missing ``"restore"`` and ``"retire"``;
+- FENCED_CMDS entry ``"pause"`` has no ``== "pause"`` dispatch branch
+  in the handler — the gate guards a command no branch serves.
+"""
+
+EPOCH_CMDS = ("submit", "cancel", "restore", "fence", "retire")
+
+FENCED_CMDS = ("submit", "cancel", "fence", "pause")
+
+
+def submit(rid, prompt, epoch=None):
+    cmd = {"cmd": "submit", "rid": int(rid), "prompt": list(prompt)}
+    if epoch is not None:
+        cmd["epoch"] = int(epoch)
+    return cmd
+
+
+def cancel(rid, epoch=None):
+    return {"cmd": "cancel", "rid": int(rid), "epoch": epoch}
+
+
+def restore(rid, tokens, epoch=None):
+    cmd = {"cmd": "restore", "rid": int(rid), "tokens": list(tokens)}
+    if epoch is not None:
+        cmd["epoch"] = int(epoch)
+    return cmd
+
+
+def fence(epoch):
+    return {"cmd": "fence", "epoch": int(epoch)}
+
+
+def drain_replica(epoch):
+    # BUG: epoch-stamped mutator that never entered EPOCH_CMDS.
+    return {"cmd": "drain", "epoch": int(epoch)}
+
+
+def handle(cmd):
+    kind = cmd.get("cmd")
+    if kind == "fence":
+        return {"ev": "fence_ok"}
+    if kind == "submit":
+        return {"ev": "admitted", "rid": cmd["rid"]}
+    if kind == "cancel":
+        return {"ev": "cancelled", "rid": cmd["rid"]}
+    if kind == "restore":
+        return {"ev": "restored", "rid": cmd["rid"]}
+    return {"ev": "unknown"}

@@ -1,0 +1,55 @@
+"""Good twin for the epoch-vocab fixture: the fence-gate mirror
+equals the driver manifest, every manifested command has an
+epoch-stamped emit site, every gated command has a dispatch branch,
+and the epoch-free read path (``"ping"``) is legitimately outside
+the manifest. Must lint clean."""
+
+EPOCH_CMDS = ("submit", "cancel", "restore", "fence")
+
+FENCED_CMDS = ("submit", "cancel", "restore", "fence")
+
+
+def submit(rid, prompt, epoch=None):
+    cmd = {"cmd": "submit", "rid": int(rid), "prompt": list(prompt)}
+    if epoch is not None:
+        cmd["epoch"] = int(epoch)
+    return cmd
+
+
+def cancel(rid, epoch=None):
+    cmd = {"cmd": "cancel", "rid": int(rid)}
+    if epoch is not None:
+        cmd["epoch"] = int(epoch)
+    return cmd
+
+
+def restore(rid, tokens, epoch=None):
+    cmd = {"cmd": "restore", "rid": int(rid), "tokens": list(tokens)}
+    if epoch is not None:
+        cmd["epoch"] = int(epoch)
+    return cmd
+
+
+def fence(epoch):
+    return {"cmd": "fence", "epoch": int(epoch)}
+
+
+def ping():
+    # Read-only probe: carries no epoch and is not a fleet mutator,
+    # so it stays out of the manifest by design.
+    return {"cmd": "ping"}
+
+
+def handle(cmd):
+    kind = cmd.get("cmd")
+    if kind == "fence":
+        return {"ev": "fence_ok"}
+    if kind == "submit":
+        return {"ev": "admitted", "rid": cmd["rid"]}
+    if kind == "cancel":
+        return {"ev": "cancelled", "rid": cmd["rid"]}
+    if kind == "restore":
+        return {"ev": "restored", "rid": cmd["rid"]}
+    if kind == "ping":
+        return {"ev": "pong"}
+    return {"ev": "unknown"}

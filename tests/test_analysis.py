@@ -77,6 +77,11 @@ BAD_FIXTURES = [
     # span.event name + an undeclared _event name + a stale
     # TRACE_EVENTS entry no emitter mints.
     ("trace-vocab", "trace_vocab_bad.py", 3),
+    # The fencing-epoch manifest (ISSUE 20): an epoch-stamped command
+    # outside EPOCH_CMDS + a stale manifest entry + a FENCED_CMDS
+    # mirror drift (extra "pause", missing "restore"/"retire") + a
+    # gated command with no dispatch branch.
+    ("epoch-vocab", "epoch_vocab_bad.py", 4),
 ]
 
 GOOD_FIXTURES = [
@@ -87,6 +92,7 @@ GOOD_FIXTURES = [
     "exposition_good.py", "snapshot_good.py", "journal_good.py",
     "role_vocab_good.py",
     "trace_vocab_good.py",
+    "epoch_vocab_good.py",
 ]
 
 

@@ -577,6 +577,67 @@ def test_r22_dtrace_artifact_is_gated():
         assert "results.dtrace.tokens_per_s_tracing_on" in paths
 
 
+def test_r23_ha_artifact_is_gated():
+    """The router-HA artifact participates in the series: it loads,
+    keys into a (metric, config) group, its committed headlines clear
+    the ISSUE 20 bounds (automatic lease-lapse failover under 2 s
+    median vs the multi-second cold recover path, every pair
+    directional; zero acked-stream loss; token-exact vs the unkilled
+    oracle; zero recompiles on the promoted router; the deposed
+    primary refused by fencing on 100% of its probes), they are
+    DIRECTIONAL — and a same-config r-record that regresses them fails
+    `check_series` LOUDLY."""
+    path = os.path.join(_BENCH_DIR, "r23_serve_ha.json")
+    records = [r for r in load_artifact(path)
+               if artifact_key(r) is not None]
+    assert records, "r23_serve_ha.json has no keyed record"
+    ha = records[0]["results"]["ha"]
+    # ISSUE 20 acceptance bounds on the committed medians.
+    assert ha["failover_s"] <= 2.0            # sub-2s detect+promote
+    assert ha["failover_s"] > 0               # measured, recorded
+    assert ha["cold_recover_s"] > ha["failover_s"]
+    assert ha["failover_speedup_vs_cold_x"] > 1.0
+    assert ha["all_pairs_directional"] is True
+    pairs = list(zip(ha["failover_s_per_repeat"],
+                     ha["cold_recover_s_per_repeat"]))
+    assert len(pairs) == 5                    # the 5 paired runs
+    assert all(hot < cold for hot, cold in pairs)
+    assert ha["acked_streams_lost_total"] == 0
+    assert ha["streams_token_exact"] is True
+    assert ha["zero_recompiles_promoted"] is True
+    assert ha["deposed_probes_attempted"] > 0
+    assert ha["deposed_probes_refused"] == \
+        ha["deposed_probes_attempted"]        # fencing: 100% refusal
+    assert ha["detection_lease_ttl_s"] > 0    # detection is in the clock
+    for key in ("failover_s", "failover_speedup_vs_cold_x"):
+        assert metric_direction(key) != 0, key
+    # Per-pair lists, spreads, and the baseline's own wall are
+    # telemetry, never gated (the cold path is r19's series to watch).
+    assert metric_direction("failover_s_per_repeat") == 0
+    assert metric_direction("failover_s_spread_pct") == 0
+    assert metric_direction("detection_lease_ttl_s") == 0
+    # A hypothetical r24 record at the SAME config whose failover
+    # headlines regressed must fail the series gate loudly.
+    worse = copy.deepcopy(records[0])
+    w = worse["results"]["ha"]
+    w["failover_s"] *= 10.0
+    w["failover_speedup_vs_cold_x"] *= 0.1
+    import json as _json
+    import tempfile
+    with tempfile.TemporaryDirectory() as d_:
+        old_p = os.path.join(d_, "r23_h.json")
+        new_p = os.path.join(d_, "r24_h.json")
+        with open(old_p, "w") as f:
+            _json.dump(records[0], f)
+        with open(new_p, "w") as f:
+            _json.dump(worse, f)
+        pairs_checked, failures = check_series([old_p, new_p])
+        assert pairs_checked == 1 and len(failures) == 1
+        paths = {r["path"] for r in failures[0]["regressions"]}
+        assert "results.ha.failover_s" in paths
+        assert "results.ha.failover_speedup_vs_cold_x" in paths
+
+
 def test_compare_flags_directional_regressions_only():
     old = _record(tokens_per_s=1000.0, ttft_p99_s=0.10, spread_pct=2.0,
                   prefix_hit_rate=0.97)

@@ -615,3 +615,87 @@ def test_conductor_campaign_disagg_tier_fleet(gpt_setup, tmp_path):
     assert report.invariants["pins_balanced"]
     assert "router_crash" in [a.kind for a in report.actions]
     assert report.recovery_s is not None
+
+
+# The worker-subprocess model config (mirrors the ctrlplane process
+# fleet): the oracle is the worker's OWN engine built from the same
+# cfg, so parent and child provably share params.
+_WORKER_CFG = dict(vocab=32, max_len=64, embed_dim=32, depth=1, heads=2,
+                   slots=4, prefill_len=16, max_queue_depth=64,
+                   param_seed=0, prefix_cache_blocks=0)
+
+
+@pytest.mark.chaosd
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_conductor_campaign_seven_planes_process_fleet(tmp_path, seed):
+    """ISSUE 20 acceptance: 3-seed campaigns drawing all SEVEN planes
+    — device, wire, storage, gray, kill, router, partition — over a
+    fleet of REAL worker processes, every referee invariant green
+    including ``single_writer`` (no two routers' commands accepted in
+    the same epoch interval: 100% of the deposed primary's
+    post-partition probes come back typed EpochFenced rejects).
+
+    The partition plane fires strictly before the router-crash window,
+    so the promoted standby is the router the crash plane then
+    SIGKILLs — hot failover and cold recovery compose in one campaign.
+    The device plane rides along declared-but-inert: its injection
+    surface is an in-process engine FaultPlan, which does not exist
+    behind the worker pipe (the unified local-fleet campaign above
+    owns that coverage)."""
+    import subprocess
+    import sys
+
+    from pddl_tpu.serve.fleet import ProcessReplica, WireFaultPlan
+    from pddl_tpu.serve.fleet.worker import build_engine
+
+    wire_plans = {}
+    state = {"base": 0}
+
+    def make_replicas():
+        base, state["base"] = state["base"], state["base"] + 10
+        reps = []
+        for k in range(2):
+            rid = base + k
+            wp = WireFaultPlan(1000 * seed + rid, corrupt_rate=0.01,
+                               duplicate_rate=0.01, drop_rate=0.005)
+            wire_plans[rid] = wp
+            reps.append(ProcessReplica(
+                rid, {**_WORKER_CFG, "replica_id": rid},
+                python=sys.executable, stderr=subprocess.DEVNULL,
+                wire_fault_plan=wp))
+        return reps
+
+    def make_chaos(fleet):
+        return [ReplicaChaos(replica_id=int(s.replica_id),
+                             wire_plan=wire_plans.get(int(s.replica_id)),
+                             slow_fn=s.driver.set_tick_delay,
+                             kill_fn=s.driver.kill)
+                for s in fleet.replicas]
+
+    eng = build_engine(_WORKER_CFG)
+    sp = StorageFaultPlan(seed=seed)
+    cond = ChaosConductor(
+        make_replicas, make_chaos,
+        lambda p, n: _ref_greedy(eng.model, {"params": eng._params},
+                                 p, n),
+        journal_dir=str(tmp_path / "wal"), storage_plan=sp,
+        router_kw=dict(affinity_block_size=BS, affinity_blocks=1,
+                       respawn=False),
+        journal_kw=dict(fsync_batch_records=2, retry_limit=1,
+                        retry_backoff_s=0.0, rearm_interval_s=0.0,
+                        sleep_fn=_no_sleep),
+        recovery_bound_s=60.0, seed=seed)
+    report = cond.run(
+        _workload(300 + seed, n_requests=4),
+        planes=("device", "wire", "storage", "gray", "kill", "router",
+                "partition"),
+        horizon=30, kills=1, pace_s=0.01, max_wall_s=240.0)
+    assert report.ok, report.violations
+    assert report.invariants["single_writer"]
+    assert "single_writer" not in " ".join(report.skipped)
+    kinds = [a.kind for a in report.actions]
+    assert {"partition", "router_crash", "kill", "storm_on",
+            "slow_on"} <= set(kinds)
+    assert report.failover_s is not None and report.failover_s < 10.0
+    assert report.recovery_s is not None
+    assert report.injected.get("wire", 0) >= 1    # the storm was real

@@ -123,7 +123,7 @@ def test_role_vocabulary_parity():
     assert worker_mod.ROLES == disagg_mod.ROLES
     assert set(router_mod.ROUTE_LABELS) <= set(journal_io.VIA_LABELS)
     assert "handoff" in journal_io.RECORD_KINDS
-    assert "from_replica" in journal_io.RECORD_KEYS_V2
+    assert "from_replica" in journal_io.RECORD_KEYS_V3
 
 
 def test_validate_role():
